@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Headline benchmark: dense GEMM TFLOPS/chip at 32k x 32k.
+
+BASELINE.md metric: "dense GEMM TFLOPS/chip (32k x 32k); multiply() wall-clock
+vs Spark+OpenBLAS", north star >= 50% of peak on v5e with the MatrixMultiply
+call-site shape preserved (random A x random B through the auto-dispatch
+``multiply()``, examples/MatrixMultiply.scala:46). The reference publishes no
+numbers (BASELINE.json "published": {}), so ``vs_baseline`` reports the ratio
+against the north-star target: 50% of per-chip bf16 peak (v5e: 197 TFLOPS
+-> target 98.5).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.utils import random as mrand
+
+# TPU-fast mode: bf16 operands (f32 accumulation on the MXU); float64 stays the
+# correctness reference in the tests.
+N = 32768
+DTYPE = jnp.bfloat16
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,  # bf16 peak per v5e chip
+    "TPU v5e": 197.0,
+    "TPU v4": 275.0,
+    "TPU v6 lite": 918.0,
+    "cpu": 1.0,
+}
+
+
+def guess_peak() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_TFLOPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 197.0
+
+
+def main():
+    mt.set_config(default_dtype=DTYPE, matmul_precision="default")
+    n_dev = len(jax.devices())
+    a = mrand.random_den_vec_matrix(N, N, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(N, N, seed=2, dtype=DTYPE)
+
+    # Sync via a scalar fetch: on the remote-tunnel (axon) platform,
+    # block_until_ready can return before execution finishes, so the timing
+    # fence is a device_get of a reduction over the result.
+    fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+    # Warmup (compile) through the MatrixMultiply call-site shape.
+    float(fence(a.multiply(b).data))
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        float(fence(a.multiply(b).data))
+    dt = (time.perf_counter() - t0) / iters
+
+    flops = 2.0 * N * N * N
+    tflops_per_chip = flops / dt / 1e12 / n_dev
+    target = 0.5 * guess_peak()
+    print(
+        json.dumps(
+            {
+                "metric": "dense_gemm_tflops_per_chip_32k",
+                "value": round(tflops_per_chip, 2),
+                "unit": "TFLOPS/chip",
+                "vs_baseline": round(tflops_per_chip / target, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

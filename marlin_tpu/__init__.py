@@ -1,0 +1,40 @@
+"""marlin_tpu — a TPU-native distributed dense + sparse matrix framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Marlin
+(KharbandaArush/marlin, a Spark/Scala distributed matrix library): distributed
+row-/block-partitioned matrix and vector types, auto-strategy GEMM (broadcast vs
+SUMMA/CARMA split), blocked LU / Cholesky / inverse, Gramian SVD with a Lanczos
+eigensolver, sparse multiply, matrix transformations, text I/O, and the
+reference's algorithm workloads (ALS, logistic regression, PageRank, mini-batch
+neural network) — all on sharded ``jax.Array``s over a named device mesh with
+ICI collectives instead of RDDs and shuffles.
+"""
+
+from .config import MarlinConfig, config_override, enable_x64, get_config, set_config
+from .mesh import create_mesh, default_mesh, set_default_mesh
+from .matrix.base import DistributedMatrix
+from .matrix.block import BlockMatrix
+from .matrix.dense import DenseVecMatrix
+from .matrix.sparse import CoordinateMatrix, MatrixEntry, SparseVecMatrix
+from .matrix.vector import DistributedIntVector, DistributedVector
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MarlinConfig",
+    "config_override",
+    "enable_x64",
+    "get_config",
+    "set_config",
+    "create_mesh",
+    "default_mesh",
+    "set_default_mesh",
+    "DistributedMatrix",
+    "BlockMatrix",
+    "DenseVecMatrix",
+    "SparseVecMatrix",
+    "CoordinateMatrix",
+    "MatrixEntry",
+    "DistributedVector",
+    "DistributedIntVector",
+]

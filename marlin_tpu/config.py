@@ -1,0 +1,101 @@
+"""Typed configuration for marlin_tpu.
+
+The reference (Marlin) scatters configuration across three ad-hoc layers: SparkConf
+keys read at use sites (``marlin.lu.basesize`` DenseVecMatrix.scala:313,
+``marlin.cholesky.basesize`` :499, ``marlin.inverse.basesize`` :591), method
+parameters (``cores``, ``broadcastThreshold`` default 300 MB DenseVecMatrix.scala:196,
+mode strings), and CLI positional args. Here all of it lives in one typed config
+object, overridable globally or per call.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class MarlinConfig:
+    """Global knobs for marlin_tpu.
+
+    Attributes mirror the reference's configuration surface (SparkConf keys +
+    method defaults) plus TPU-specific additions (mesh axis names, matmul
+    precision, summa mode).
+    """
+
+    # Broadcast-vs-split GEMM threshold, in megabytes of the smaller operand.
+    # Reference default: 300 MB (DenseVecMatrix.scala:196-198). On TPU the real
+    # constraint is HBM residency of a replicated operand, but the knob is kept.
+    broadcast_threshold_mb: float = 300.0
+
+    # Panel ("base") block sizes for the blocked decompositions; reference reads
+    # these from SparkConf with default 1000 (DenseVecMatrix.scala:313, :499, :591).
+    lu_base_size: int = 1000
+    cholesky_base_size: int = 1000
+    inverse_base_size: int = 1000
+
+    # Default element dtype. The reference is Double end-to-end; float64 stays the
+    # correctness reference (enable x64), while float32/bfloat16 are the TPU-fast
+    # modes used by benchmarks.
+    default_dtype: jnp.dtype = jnp.float32
+
+    # Precision passed to jnp matmuls ("default" | "high" | "highest").
+    matmul_precision: str = "highest"
+
+    # GEMM engine for the split path: "gspmd" lets XLA's SPMD partitioner insert
+    # collectives from sharding constraints; "summa" uses the explicit shard_map
+    # SUMMA loop in marlin_tpu.parallel.summa.
+    gemm_engine: str = "summa"
+
+    # Mesh axis names (rows, cols) used throughout.
+    mesh_axis_rows: str = "mr"
+    mesh_axis_cols: str = "mc"
+
+    # Analogue of spark.default.parallelism (MTUtils.scala:498-501): preferred
+    # number of shards when a caller gives no hint. None => device count.
+    default_parallelism: Optional[int] = None
+
+    # Structured op-timing subsystem switch (see utils/timing.py).
+    enable_timing: bool = False
+
+
+_config = MarlinConfig()
+
+
+def get_config() -> MarlinConfig:
+    return _config
+
+
+def set_config(**kwargs) -> MarlinConfig:
+    """Update global config fields in place; returns the config."""
+    for k, v in kwargs.items():
+        if not hasattr(_config, k):
+            raise ValueError(f"unknown config field: {k!r}")
+        setattr(_config, k, v)
+    return _config
+
+
+@contextlib.contextmanager
+def config_override(**kwargs):
+    """Temporarily override config fields."""
+    old = {k: getattr(_config, k) for k in kwargs}
+    try:
+        set_config(**kwargs)
+        yield _config
+    finally:
+        set_config(**old)
+
+
+def enable_x64() -> None:
+    """Make float64 the default dtype (the reference's element type).
+
+    TPUs emulate f64; use for correctness testing, not for benchmarks.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _config.default_dtype = jnp.float64

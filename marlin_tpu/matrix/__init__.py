@@ -1,0 +1,5 @@
+from .base import DistributedMatrix
+from .block import BlockMatrix
+from .dense import DenseVecMatrix
+from .sparse import CoordinateMatrix, MatrixEntry, SparseVecMatrix
+from .vector import DistributedIntVector, DistributedVector

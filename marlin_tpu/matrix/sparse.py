@@ -1,0 +1,253 @@
+"""Sparse distributed matrix types.
+
+Counterparts of ``SparseVecMatrix`` (SparseVecMatrix.scala:12-70, row-distributed
+`RDD[(Long, BSV[Double])]`) and ``CoordinateMatrix`` (CoordinateMatrix.scala:28-99,
+COO `RDD[((Long,Long), Float)]` with a ``MatrixEntry`` view).
+
+TPU-native design: TPUs have no CSC gather kernels, so sparsity is carried as
+**BCOO** (``jax.experimental.sparse``) for storage/conversion plus index/value
+triples for COO. Sparse x sparse multiply follows the reference's outer-product
+formulation (``multiplySparse``, SparseVecMatrix.scala:22-50) but is computed as
+``bcoo_dot_general`` — XLA lowers it to gather/scatter on TPU — with a
+densify-per-block fallback that matches the reference's sparse->dense modes
+(SparseMultiply.scala:31-82). The result comes back as a CoordinateMatrix, as in
+the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..config import get_config
+from ..mesh import default_mesh, row_sharding
+
+
+class MatrixEntry:
+    """(i, j, value) view of one COO entry (CoordinateMatrix.scala:16)."""
+
+    __slots__ = ("i", "j", "value")
+
+    def __init__(self, i: int, j: int, value: float):
+        self.i, self.j, self.value = int(i), int(j), float(value)
+
+    def __iter__(self):
+        return iter((self.i, self.j, self.value))
+
+    def __repr__(self):
+        return f"MatrixEntry({self.i}, {self.j}, {self.value})"
+
+
+class CoordinateMatrix:
+    """COO-format distributed matrix."""
+
+    def __init__(self, rows, cols, values, shape: Optional[Tuple[int, int]] = None, mesh=None):
+        self.mesh = mesh or default_mesh()
+        self.row_idx = jnp.asarray(rows, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+        self.col_idx = jnp.asarray(cols, self.row_idx.dtype)
+        self.values = jnp.asarray(values)
+        if self.row_idx.shape != self.col_idx.shape or self.row_idx.shape != self.values.shape:
+            raise ValueError("rows/cols/values must have equal lengths")
+        self._shape = shape
+
+    # -- metadata -----------------------------------------------------------
+    def _compute_size(self) -> Tuple[int, int]:
+        """Size by max-index reduce (``computeSize``, CoordinateMatrix.scala:67)."""
+        return (
+            int(jnp.max(self.row_idx)) + 1,
+            int(jnp.max(self.col_idx)) + 1,
+        )
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        if self._shape is None:
+            self._shape = self._compute_size()
+        return self._shape
+
+    @property
+    def num_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def num_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    def entries(self):
+        r = np.asarray(self.row_idx)
+        c = np.asarray(self.col_idx)
+        v = np.asarray(self.values)
+        return [MatrixEntry(*t) for t in zip(r, c, v)]
+
+    # -- conversions --------------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Densified host value (``toBreeze``, CoordinateMatrix.scala:78)."""
+        arr = np.zeros(self.shape, dtype=self.values.dtype)
+        np.add.at(
+            arr,
+            (np.asarray(self.row_idx), np.asarray(self.col_idx)),
+            np.asarray(self.values),
+        )
+        return arr
+
+    to_breeze = to_numpy
+
+    def to_dense_vec_matrix(self, mesh=None):
+        """Densify to the row-distributed type (``toDenseVecMatrix``,
+        CoordinateMatrix.scala:51). Scatter runs on device so the dense result
+        is born sharded."""
+        from .dense import DenseVecMatrix
+
+        mesh = mesh or self.mesh
+        cfg = get_config()
+        shape = self.shape  # concretize before tracing
+
+        def scatter(r, c, v):
+            z = jnp.zeros(shape, dtype=cfg.default_dtype)
+            return z.at[r, c].add(v.astype(cfg.default_dtype))
+
+        out = jax.jit(scatter)(self.row_idx, self.col_idx, self.values)
+        return DenseVecMatrix(out, mesh=mesh)
+
+    def to_bcoo(self) -> jsparse.BCOO:
+        idx = jnp.stack([self.row_idx, self.col_idx], axis=1)
+        return jsparse.BCOO((self.values, idx), shape=self.shape)
+
+    def to_sparse_vec_matrix(self, mesh=None):
+        return SparseVecMatrix(self.to_bcoo(), mesh=mesh or self.mesh)
+
+    # -- ML entry point (CoordinateMatrix.scala:89-98) ----------------------
+    def als(
+        self,
+        rank: int,
+        iterations: int = 10,
+        lambda_: float = 0.01,
+        implicit_prefs: bool = False,
+        alpha: float = 1.0,
+        seed=None,
+    ):
+        """Alternating least squares on this ratings matrix — see ml.als.
+        (The reference's product-index copy bug, ALSHelp.scala:37, is fixed:
+        entries are (user, product, rating) faithfully.)"""
+        from ..ml.als import als_run
+
+        return als_run(
+            self,
+            rank=rank,
+            iterations=iterations,
+            lambda_=lambda_,
+            implicit_prefs=implicit_prefs,
+            alpha=alpha,
+            seed=seed,
+        )
+
+    def __repr__(self):
+        return f"CoordinateMatrix(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseVecMatrix:
+    """Row-distributed sparse matrix backed by BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO, mesh=None):
+        self.mesh = mesh or default_mesh()
+        if bcoo.ndim != 2:
+            raise ValueError("expected a 2-D sparse matrix")
+        self._bcoo = bcoo
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def shape(self):
+        return self._bcoo.shape
+
+    @property
+    def num_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def num_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def bcoo(self) -> jsparse.BCOO:
+        return self._bcoo
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_dense(cls, mat, mesh=None):
+        return cls.from_dense_array(mat.logical, mesh=mesh or mat.mesh)
+
+    @classmethod
+    def from_dense_array(cls, arr, mesh=None):
+        return cls(jsparse.BCOO.fromdense(jnp.asarray(arr)), mesh=mesh)
+
+    @classmethod
+    def from_coo(cls, rows, cols, values, shape, mesh=None):
+        idx = jnp.stack(
+            [jnp.asarray(rows), jnp.asarray(cols)], axis=1
+        )
+        return cls(jsparse.BCOO((jnp.asarray(values), idx), shape=shape), mesh=mesh)
+
+    # -- ops ----------------------------------------------------------------
+    def multiply_sparse(self, other: "SparseVecMatrix") -> CoordinateMatrix:
+        """Sparse x sparse -> COO result (``multiplySparse``,
+        SparseVecMatrix.scala:22-50). The reference emits per-k outer products
+        and reduces by (i, j); here the contraction is one bcoo_dot_general and
+        the result is re-sparsified."""
+        if self.num_cols != other.num_rows:
+            raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
+        out_dense = jsparse.bcoo_dot_general(
+            self._bcoo,
+            other._bcoo,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+        )
+        if isinstance(out_dense, jsparse.BCOO):
+            out_dense = out_dense.todense()
+        r, c = jnp.nonzero(out_dense)
+        v = out_dense[r, c]
+        return CoordinateMatrix(r, c, v, shape=(self.num_rows, other.num_cols), mesh=self.mesh)
+
+    def multiply(self, other):
+        """Sparse x (sparse | dense): dense operand uses the densified row
+        path of the SparseMultiply modes (SparseMultiply.scala:31-82)."""
+        from .dense import DenseVecMatrix
+
+        if isinstance(other, SparseVecMatrix):
+            return self.multiply_sparse(other)
+        if isinstance(other, DenseVecMatrix):
+            cfg = get_config()
+            out = jsparse.bcoo_dot_general(
+                self._bcoo,
+                other.logical,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+            )
+            return DenseVecMatrix(out, mesh=self.mesh)
+        raise TypeError(f"cannot multiply SparseVecMatrix by {type(other).__name__}")
+
+    def to_dense_vec_matrix(self):
+        """Densify (``toDenseVecMatrix``, SparseVecMatrix.scala:56)."""
+        from .dense import DenseVecMatrix
+
+        return DenseVecMatrix(self._bcoo.todense(), mesh=self.mesh)
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self._bcoo.todense())
+
+    to_breeze = to_numpy
+
+    def __repr__(self):
+        return f"SparseVecMatrix(shape={self.shape}, nnz={self.nnz})"

@@ -1,0 +1,189 @@
+"""Distributed vectors.
+
+Counterparts of ``DistributedVector`` (DistributedVector.scala:17-192) and its
+int-element clone ``DistributedIntVector`` (DistributedIntVector.scala:17-190):
+a chunked `RDD[(Int chunkId, DenseVector)]` with a ``columnMajor`` orientation
+flag becomes one 1-D ``jax.Array`` sharded over all mesh devices plus the same
+orientation flag. ``transpose`` stays an orientation flip; ``multiply`` picks
+outer (-> BlockMatrix) or inner (-> scalar) product by orientation; the
+``toDisVector`` re-chunking plan becomes a resharding (the chunk plan itself
+lives in utils.split.reblock_plan for parity). Like the matrix types, the
+physical array is zero-padded to a device-count multiple; the logical length is
+kept alongside.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import get_config
+from ..mesh import default_mesh, vector_sharding
+
+
+class DistributedVector:
+    """Chunk-distributed vector with row/column orientation."""
+
+    def __init__(
+        self,
+        data,
+        mesh=None,
+        column_major: bool = True,
+        dtype=None,
+        _logical_len: Optional[int] = None,
+    ):
+        self.mesh = mesh or default_mesh()
+        dtype = dtype or (
+            data.dtype if hasattr(data, "dtype") else get_config().default_dtype
+        )
+        arr = jnp.asarray(data, dtype=dtype)
+        if arr.ndim != 1:
+            raise ValueError(f"expected a 1-D vector, got shape {arr.shape}")
+        # Column-major == column vector (the reference's default orientation,
+        # DistributedVector.scala:24-29).
+        self.column_major = column_major
+        if _logical_len is not None:
+            self._len = int(_logical_len)
+            self._data = arr
+        else:
+            if arr.size == 0:
+                raise ValueError("cannot construct a distributed vector from empty data")
+            self._len = int(arr.shape[0])
+            n_dev = len(self.mesh.devices.flat)
+            pad = (-arr.shape[0]) % n_dev
+            if pad:
+                arr = jnp.pad(arr, (0, pad))
+            self._data = jax.device_put(arr, vector_sharding(self.mesh))
+
+    # -- metadata (DistributedVector.scala:31-43) ---------------------------
+    @property
+    def length(self) -> int:
+        return self._len
+
+    @property
+    def split_num(self) -> int:
+        """Number of physical chunks — one per device here."""
+        return len(self.mesh.devices.flat)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def data(self) -> jax.Array:
+        """Physical (padded, sharded) array."""
+        return self._data
+
+    def to_jax(self) -> jax.Array:
+        """Logical-length view."""
+        if self._data.shape[0] == self._len:
+            return self._data
+        return self._data[: self._len]
+
+    def to_numpy(self) -> np.ndarray:
+        """``toBreeze`` (DistributedVector.scala:65)."""
+        return np.asarray(jax.device_get(self.to_jax()))
+
+    to_breeze = to_numpy
+
+    def _like(self, physical: jax.Array, column_major=None) -> "DistributedVector":
+        return DistributedVector(
+            physical,
+            mesh=self.mesh,
+            column_major=self.column_major if column_major is None else column_major,
+            _logical_len=self._len,
+        )
+
+    # -- ops ----------------------------------------------------------------
+    def substract(self, other: "DistributedVector") -> "DistributedVector":
+        """Elementwise difference — reference name kept, typo and all
+        (``substract``, DistributedVector.scala:45)."""
+        return self.subtract(other)
+
+    def subtract(self, other: "DistributedVector") -> "DistributedVector":
+        self._check_len(other)
+        return self._like(self._data - other._data.astype(self.dtype))
+
+    def add(self, other: "DistributedVector") -> "DistributedVector":
+        self._check_len(other)
+        return self._like(self._data + other._data.astype(self.dtype))
+
+    def multiply(self, scalar: Union[int, float]) -> "DistributedVector":
+        return self._like(self._data * scalar)
+
+    def transpose(self) -> "DistributedVector":
+        """Orientation flip (DistributedVector.scala:56) — no data movement."""
+        return self._like(self._data, column_major=not self.column_major)
+
+    def to_dis_vector(self, new_chunk: int) -> "DistributedVector":
+        """Re-chunk (``toDisVector``, DistributedVector.scala:83). Chunking is
+        physicalized by the mesh here, so the value is unchanged; the chunk
+        plan computation is exposed via utils.split.reblock_plan."""
+        return self._like(self._data)
+
+    def multiply_vector(self, other: "DistributedVector", mode: str = "dist"):
+        """Orientation-dispatched product (``multiply(other, mode)``,
+        DistributedVector.scala:147-181):
+
+        * column x row -> outer product, a BlockMatrix (``mode`` "dist") or a
+          local ndarray (``mode`` "local");
+        * row x column -> inner product scalar.
+        """
+        cfg = get_config()
+        if self.column_major and not other.column_major:
+            outer = jnp.outer(self.to_jax(), other.to_jax().astype(self.dtype))
+            if mode == "local":
+                return np.asarray(jax.device_get(outer))
+            from .block import BlockMatrix
+
+            return BlockMatrix(outer, mesh=self.mesh)
+        if not self.column_major and other.column_major:
+            return self.dot(other)
+        raise ValueError(
+            "vector multiply needs opposite orientations "
+            f"(self.column_major={self.column_major}, other={other.column_major})"
+        )
+
+    def dot(self, other: "DistributedVector") -> float:
+        self._check_len(other)
+        cfg = get_config()
+        # Physical dot is safe: pad regions are zero on both sides.
+        return float(
+            jnp.dot(
+                self._data,
+                other._data.astype(self.dtype),
+                precision=cfg.matmul_precision,
+            )
+        )
+
+    def _check_len(self, other: "DistributedVector") -> None:
+        if self.length != other.length:
+            raise ValueError(f"length mismatch: {self.length} vs {other.length}")
+
+    @classmethod
+    def from_vector(cls, vec, num_splits: Optional[int] = None, mesh=None):
+        """``fromVector`` (DistributedVector.scala:186): distribute a local
+        vector. ``num_splits`` is accepted for API parity; physical chunking
+        follows the mesh."""
+        return cls(np.asarray(vec), mesh=mesh)
+
+    def __repr__(self) -> str:
+        orient = "col" if self.column_major else "row"
+        return f"DistributedVector(length={self.length}, {orient}, dtype={self.dtype})"
+
+
+class DistributedIntVector(DistributedVector):
+    """Integer-element distributed vector (DistributedIntVector.scala:17) —
+    used for labels in the NN example."""
+
+    def __init__(self, data, mesh=None, column_major: bool = True, dtype=None, _logical_len=None):
+        super().__init__(
+            data,
+            mesh=mesh,
+            column_major=column_major,
+            dtype=dtype or jnp.int32,
+            _logical_len=_logical_len,
+        )

@@ -1,0 +1,120 @@
+"""Device-mesh runtime.
+
+The reference's "runtime" is Spark's driver/executor model: data lives in RDD
+partitions, communication is shuffle/broadcast (SURVEY.md L2). The TPU-native
+replacement is a named `jax.sharding.Mesh`: distributed matrices are single
+logical `jax.Array`s sharded over mesh axes, and communication is XLA collectives
+over ICI (``all_gather``/``psum``/``psum_scatter``/``ppermute``) inserted either
+by GSPMD from sharding constraints or explicitly under ``shard_map``.
+
+A single global default mesh with axes ``('mr', 'mc')`` (matrix-rows,
+matrix-cols) plays the role of the SparkContext: created once from all visible
+devices, as square as possible, and used by every distributed type unless a
+caller passes its own mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import get_config
+
+_default_mesh: Optional[Mesh] = None
+
+
+def squarest_grid(n: int) -> Tuple[int, int]:
+    """Factor ``n`` into the most-square (rows, cols) grid, rows >= cols."""
+    best = (n, 1)
+    for c in range(1, int(math.isqrt(n)) + 1):
+        if n % c == 0:
+            best = (n // c, c)
+    return best
+
+
+def create_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a mesh over ``devices`` (default: all) with the given grid shape.
+
+    With no ``shape``, uses the squarest 2-D factorization of the device count —
+    the mesh-level analogue of Marlin's near-square split heuristic
+    (DenseVecMatrix.scala:208-213).
+    """
+    cfg = get_config()
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_names is None:
+        axis_names = (cfg.mesh_axis_rows, cfg.mesh_axis_cols)
+    if shape is None:
+        shape = squarest_grid(len(devices))
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} does not cover {len(devices)} devices"
+        )
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def default_mesh() -> Mesh:
+    """The process-wide default mesh, created lazily from all devices."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = create_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def axis_sizes(mesh: Mesh) -> Tuple[int, int]:
+    """(rows-axis size, cols-axis size) of a 2-D marlin mesh."""
+    cfg = get_config()
+    return (
+        mesh.shape[cfg.mesh_axis_rows],
+        mesh.shape[cfg.mesh_axis_cols],
+    )
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-distributed layout: rows sharded over *all* devices, cols replicated.
+
+    The counterpart of ``DenseVecMatrix``'s `RDD[(Long, BDV)]` row distribution
+    (DenseVecMatrix.scala:41-44): every device owns a horizontal stripe.
+    """
+    cfg = get_config()
+    return NamedSharding(mesh, P((cfg.mesh_axis_rows, cfg.mesh_axis_cols), None))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """2-D block layout: the counterpart of ``BlockMatrix``'s `RDD[(BlockID,
+    SubMatrix)]` grid distribution (BlockMatrix.scala:28)."""
+    cfg = get_config()
+    return NamedSharding(mesh, P(cfg.mesh_axis_rows, cfg.mesh_axis_cols))
+
+
+def col_sharding(mesh: Mesh) -> NamedSharding:
+    """Column-distributed layout (used for transposed row matrices)."""
+    cfg = get_config()
+    return NamedSharding(mesh, P(None, (cfg.mesh_axis_rows, cfg.mesh_axis_cols)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — the analogue of a Spark torrent broadcast
+    (DenseVecMatrix.scala:172)."""
+    return NamedSharding(mesh, P())
+
+
+def vector_sharding(mesh: Mesh) -> NamedSharding:
+    """1-D chunked layout over all devices: the counterpart of
+    ``DistributedVector``'s `RDD[(Int, DenseVector)]` chunks
+    (DistributedVector.scala:17-29)."""
+    cfg = get_config()
+    return NamedSharding(mesh, P((cfg.mesh_axis_rows, cfg.mesh_axis_cols)))

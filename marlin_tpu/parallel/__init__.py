@@ -1,0 +1,2 @@
+from . import summa
+from .summa import matmul, matmul_3d

@@ -1,0 +1,263 @@
+"""Distributed GEMM engines over the device mesh.
+
+The reference's core GEMM is replicate-join-reduce over Spark shuffles: blocks
+are replicated with a target-partition tag (``BlockID.seq``,
+BlockMatrix.scala:161-171), routed by ``MatrixMultPartitioner``
+(MatrixMultPartitioner.scala:13-20), joined, multiplied per block, and reduced
+over the k-grid with ``reduceByKey`` (BlockMatrix.scala:132,:186).
+
+TPU-native mapping (SURVEY.md §2.8): replication -> ``all_gather`` over an ICI
+mesh axis; the k-way ``reduceByKey`` -> ``psum``/``psum_scatter``; the join is
+free (shards are already co-located by the mesh layout). Three engines:
+
+* ``gspmd``     — ``jnp.dot`` under jit with sharding constraints; XLA's SPMD
+                  partitioner chooses and inserts the collectives.
+* ``summa``     — explicit all-gather SUMMA under ``shard_map``: gather the A
+                  row-panel along the col axis, the B col-panel along the row
+                  axis, one local MXU matmul. The direct analogue of the
+                  reference's replicated block GEMM.
+* ``cannon``    — memory-lean streaming variant for square meshes: skewed
+                  ``ppermute`` ring, one k-step resident at a time. This is the
+                  "keep the k-loop streaming" design for operands whose gathered
+                  panels would not fit HBM.
+
+A separate 3-D engine (:func:`matmul_3d`) reshapes the devices into a
+(pm, pk, pn) grid chosen by the CARMA-style policy and contracts the k axis
+with ``psum_scatter`` — the counterpart of Marlin's (m,k,n)-grid RMM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import get_config
+from ..mesh import axis_sizes, block_sharding, default_mesh
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _pad_to(x: jax.Array, mults: Sequence[int]) -> jax.Array:
+    """Zero-pad each dim of ``x`` up to a multiple of ``mults``.
+
+    Uneven shards don't exist under shard_map (SURVEY.md §7 hard parts);
+    zero-padding is GEMM-neutral, and callers slice the logical shape back out.
+    """
+    pads = []
+    needs = False
+    for dim, m in zip(x.shape, mults):
+        extra = (-dim) % m
+        pads.append((0, extra))
+        needs = needs or extra > 0
+    return jnp.pad(x, pads) if needs else x
+
+
+# ---------------------------------------------------------------------------
+# Engine: GSPMD
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _gspmd_fn(mesh: Mesh, precision: str):
+    cfg = get_config()
+    out = NamedSharding(mesh, P(cfg.mesh_axis_rows, cfg.mesh_axis_cols))
+
+    @functools.partial(jax.jit, out_shardings=out)
+    def f(a, b):
+        return jnp.dot(a, b, precision=precision)
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Engine: all-gather SUMMA under shard_map
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _summa_fn(mesh: Mesh, precision: str):
+    cfg = get_config()
+    ar, ac = cfg.mesh_axis_rows, cfg.mesh_axis_cols
+
+    def kernel(a_blk, b_blk):
+        # a_blk: (m/P, k/Q); gather the full row panel of A along the col axis.
+        a_panel = jax.lax.all_gather(a_blk, ac, axis=1, tiled=True)  # (m/P, k)
+        # b_blk: (k/P, n/Q); gather the full col panel of B along the row axis.
+        b_panel = jax.lax.all_gather(b_blk, ar, axis=0, tiled=True)  # (k, n/Q)
+        return jnp.dot(a_panel, b_panel, precision=precision)  # (m/P, n/Q)
+
+    spec = P(ar, ac)
+    f = _shard_map(kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+    return jax.jit(f)
+
+
+# ---------------------------------------------------------------------------
+# Engine: Cannon streaming ring (square meshes)
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _cannon_fn(mesh: Mesh, precision: str):
+    cfg = get_config()
+    ar, ac = cfg.mesh_axis_rows, cfg.mesh_axis_cols
+    p = mesh.shape[ar]
+    assert p == mesh.shape[ac], "cannon engine requires a square mesh"
+
+    def kernel(a_blk, b_blk):
+        i = jax.lax.axis_index(ar)
+        j = jax.lax.axis_index(ac)
+
+        def shift(x, axis_name, steps):
+            # Rotate shards ``steps`` positions left along ``axis_name``.
+            perm = [(s, (s - steps) % p) for s in range(p)]
+            return jax.lax.ppermute(x, axis_name, perm)
+
+        # Initial skew: row i of A shifts left by i; col j of B shifts up by j.
+        # ppermute shift amounts must be static, so skew via p-1 masked
+        # single-step rotations; the mask is uniform along the rotated axis
+        # (it depends only on the orthogonal mesh coordinate), so each
+        # row/column consistently rotates or holds.
+        def skew(x, axis_name, amount):
+            def body(s, x):
+                do = s < amount
+                shifted = shift(x, axis_name, 1)
+                return jnp.where(do, shifted, x)
+
+            return jax.lax.fori_loop(0, p - 1, body, x)
+
+        a = skew(a_blk, ac, i)
+        b = skew(b_blk, ar, j)
+        acc = jnp.dot(a, b, precision=precision)
+
+        def step(_, carry):
+            a, b, acc = carry
+            a = shift(a, ac, 1)
+            b = shift(b, ar, 1)
+            acc = acc + jnp.dot(a, b, precision=precision)
+            return a, b, acc
+
+        _, _, acc = jax.lax.fori_loop(0, p - 1, step, (a, b, acc))
+        return acc
+
+    spec = P(ar, ac)
+    f = _shard_map(kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+    return jax.jit(f)
+
+
+# ---------------------------------------------------------------------------
+# 3-D (m, k, n)-grid engine with psum_scatter over k
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _mesh3d(devices: Tuple, grid: Tuple[int, int, int]) -> Mesh:
+    devs = np.array(devices[: int(np.prod(grid))]).reshape(grid)
+    return Mesh(devs, ("gm", "gk", "gn"))
+
+
+@functools.cache
+def _gemm3d_fn(mesh3: Mesh, precision: str):
+    def kernel(a_blk, b_blk):
+        # a_blk: (m/pm, k/pk) replicated over gn; b_blk: (k/pk, n/pn)
+        # replicated over gm. Local MXU matmul then contract the k grid axis —
+        # the reduceByKey of BlockMatrix.scala:132 as an ICI psum.
+        part = jnp.dot(a_blk, b_blk, precision=precision)
+        return jax.lax.psum(part, "gk")
+
+    f = _shard_map(
+        kernel,
+        mesh=mesh3,
+        in_specs=(P("gm", "gk"), P("gk", "gn")),
+        out_specs=P("gm", "gn"),
+    )
+    return jax.jit(f)
+
+
+def matmul_3d(
+    a: jax.Array,
+    b: jax.Array,
+    grid: Tuple[int, int, int],
+    precision: Optional[str] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> jax.Array:
+    """C = A @ B over an explicit (pm, pk, pn) device grid.
+
+    The counterpart of ``multiply(that, (m, k, n))`` (DenseVecMatrix.scala:109);
+    the k axis of the grid is contracted with ``psum``.
+    """
+    cfg = get_config()
+    precision = precision or cfg.matmul_precision
+    pm, pk, pn = grid
+    devices = tuple(devices) if devices is not None else tuple(jax.devices())
+    if pm * pk * pn > len(devices):
+        raise ValueError(
+            f"grid {grid} needs {pm * pk * pn} devices, have {len(devices)}"
+        )
+    mesh3 = _mesh3d(devices, (pm, pk, pn))
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, f"inner dims mismatch: {a.shape} x {b.shape}"
+    ap = _pad_to(a, (pm, pk))
+    bp = _pad_to(b, (pk, pn))
+    ap = jax.device_put(ap, NamedSharding(mesh3, P("gm", "gk")))
+    bp = jax.device_put(bp, NamedSharding(mesh3, P("gk", "gn")))
+    cp = _gemm3d_fn(mesh3, precision)(ap, bp)
+    return cp[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+
+def matmul(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Optional[Mesh] = None,
+    engine: Optional[str] = None,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """Distributed C = A @ B on the 2-D mesh; result block-sharded.
+
+    Pads to shard-divisible shapes, runs the selected engine, slices the
+    logical shape back out.
+    """
+    cfg = get_config()
+    mesh = mesh or default_mesh()
+    engine = engine or cfg.gemm_engine
+    precision = precision or cfg.matmul_precision
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"inner dimensions mismatch: {a.shape} x {b.shape}")
+    pr, pc = axis_sizes(mesh)
+    if engine == "cannon" and pr != pc:
+        engine = "summa"
+
+    # Pad k to a common multiple so A's col-shards and B's row-shards agree.
+    lcm = int(np.lcm(pr, pc))
+    ap = _pad_to(a, (pr, lcm))
+    bp = _pad_to(b, (lcm, pc))
+    sh = block_sharding(mesh)
+    ap = jax.device_put(ap, sh)
+    bp = jax.device_put(bp, sh)
+    if engine == "gspmd":
+        fn = _gspmd_fn(mesh, precision)
+    elif engine == "summa":
+        fn = _summa_fn(mesh, precision)
+    elif engine == "cannon":
+        fn = _cannon_fn(mesh, precision)
+    else:
+        raise ValueError(f"unknown gemm engine: {engine!r}")
+    cp = fn(ap, bp)
+    if cp.shape != (m, n):
+        cp = cp[:m, :n]
+    return cp

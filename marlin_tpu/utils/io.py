@@ -1,0 +1,268 @@
+"""Text-file matrix I/O in the reference's exact formats.
+
+Formats (studied from MTUtils.scala:228-399 and the save methods):
+
+* dense rows  — one line per row, ``rowIndex:v,v,...`` (loadMatrixFile,
+  MTUtils.scala:286; saveToFileSystem, DenseVecMatrix.scala:1042). Value
+  separators on load may be commas or whitespace.
+* block       — one line per block, ``r-c-rows-cols:data`` with data
+  **column-major** (Breeze ``BDM.create``; loadBlockMatrixFile,
+  MTUtils.scala:324).
+* coordinate  — ``row,col,value`` or ``row col value`` with an optional
+  trailing timestamp ignored (MovieLens-tolerant; loadCoordinateMatrix,
+  MTUtils.scala:228).
+* svm-like    — ``rowIndex i:v i:v ...`` with 1-based column indices
+  (loadSVMDenVecMatrix, MTUtils.scala:253).
+* description — a ``_description`` file ``MatrixName\\tname\\nMatrixSize\\trows
+  cols`` (saveWithDescription, DenseVecMatrix.scala:1055-1064).
+
+The reference writes one part-file per RDD partition into a directory; we keep
+the directory layout (``part-00000`` ...) so files interoperate, and also accept
+single plain files on load. "Directory of files" loaders (loadMatrixFiles,
+MTUtils.scala:350) are the same code path here.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_SEP = re.compile(r",\s?|\s+")
+
+
+def _data_lines(path: str) -> List[str]:
+    """All non-empty lines of a file, or of every non-hidden file in a dir."""
+    paths = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.startswith("_") or name.startswith("."):
+                continue
+            full = os.path.join(path, name)
+            if os.path.isfile(full):
+                paths.append(full)
+    else:
+        paths.append(path)
+    lines: List[str] = []
+    for p in paths:
+        with open(p) as f:
+            lines.extend(l for l in (ln.strip() for ln in f) if l)
+    return lines
+
+
+def _fmt(v: float) -> str:
+    """Format one value the way the reference data files carry them."""
+    return repr(float(v))
+
+
+# ---------------------------------------------------------------------------
+# Dense row format
+# ---------------------------------------------------------------------------
+
+
+def load_dense_matrix(path: str, mesh=None, dtype=None):
+    """``row:csv`` text -> DenseVecMatrix (loadMatrixFile, MTUtils.scala:286)."""
+    from ..config import get_config
+    from ..matrix.dense import DenseVecMatrix
+
+    rows = []
+    width = 0
+    for line in _data_lines(path):
+        idx_s, vals_s = line.split(":", 1)
+        vals = [float(x) for x in _SEP.split(vals_s.strip()) if x]
+        rows.append((int(idx_s), vals))
+        width = max(width, len(vals))
+    if not rows:
+        raise ValueError(f"no matrix rows found in {path}")
+    n_rows = max(i for i, _ in rows) + 1
+    arr = np.zeros((n_rows, width), dtype=np.dtype(dtype or get_config().default_dtype))
+    for i, vals in rows:
+        arr[i, : len(vals)] = vals
+    return DenseVecMatrix(arr, mesh=mesh, dtype=arr.dtype)
+
+
+def save_dense_matrix(mat, path: str, parts: Optional[int] = None) -> None:
+    """DenseVecMatrix -> ``row:csv`` part-files in a directory."""
+    arr = mat.to_numpy()
+    _write_parts(
+        path,
+        [f"{i}:{','.join(_fmt(v) for v in arr[i])}" for i in range(arr.shape[0])],
+        parts,
+    )
+
+
+def save_dense_matrix_with_description(mat, path: str, name: str = "N/A") -> None:
+    save_dense_matrix(mat, path)
+    with open(os.path.join(path, "_description"), "w") as f:
+        f.write(f"MatrixName\t{name}\nMatrixSize\t{mat.num_rows} {mat.num_cols}")
+
+
+def load_description(path: str) -> Tuple[str, int, int]:
+    """Read a ``_description`` file -> (name, rows, cols)."""
+    with open(os.path.join(path, "_description")) as f:
+        text = f.read()
+    name = "N/A"
+    rows = cols = 0
+    for line in text.splitlines():
+        k, _, v = line.partition("\t")
+        if k == "MatrixName":
+            name = v
+        elif k == "MatrixSize":
+            rows, cols = (int(x) for x in v.split())
+    return name, rows, cols
+
+
+# ---------------------------------------------------------------------------
+# Block format
+# ---------------------------------------------------------------------------
+
+
+def load_block_matrix(path: str, mesh=None, dtype=None):
+    """``r-c-rows-cols:colmajor`` text -> BlockMatrix (loadBlockMatrixFile,
+    MTUtils.scala:324)."""
+    from ..config import get_config
+    from ..matrix.block import BlockMatrix
+
+    blocks = {}
+    for line in _data_lines(path):
+        head, vals_s = line.split(":", 1)
+        info = head.split("-")
+        bi, bj, r, c = (int(x) for x in info[:4])
+        vals = np.array([float(x) for x in _SEP.split(vals_s.strip()) if x])
+        blocks[(bi, bj)] = vals.reshape((r, c), order="F")  # column-major
+    if not blocks:
+        raise ValueError(f"no matrix blocks found in {path}")
+    nbr = max(bi for bi, _ in blocks) + 1
+    nbc = max(bj for _, bj in blocks) + 1
+    row_heights = [blocks[(bi, 0)].shape[0] for bi in range(nbr)]
+    col_widths = [blocks[(0, bj)].shape[1] for bj in range(nbc)]
+    arr = np.zeros(
+        (sum(row_heights), sum(col_widths)),
+        dtype=np.dtype(dtype or get_config().default_dtype),
+    )
+    r0 = 0
+    for bi in range(nbr):
+        c0 = 0
+        for bj in range(nbc):
+            blk = blocks[(bi, bj)]
+            arr[r0 : r0 + blk.shape[0], c0 : c0 + blk.shape[1]] = blk
+            c0 += col_widths[bj]
+        r0 += row_heights[bi]
+    return BlockMatrix(
+        arr, mesh=mesh, dtype=arr.dtype, blks_by_row=nbr, blks_by_col=nbc
+    )
+
+
+def save_block_matrix(mat, path: str, parts: Optional[int] = None) -> None:
+    """BlockMatrix -> block-format part-files using the logical grid."""
+    lines = []
+    for bi in range(mat.blks_by_row):
+        for bj in range(mat.blks_by_col):
+            blk = np.asarray(mat.get_block(bi, bj))
+            data = ",".join(_fmt(v) for v in blk.flatten(order="F"))
+            lines.append(f"{bi}-{bj}-{blk.shape[0]}-{blk.shape[1]}:{data}")
+    _write_parts(path, lines, parts)
+
+
+# ---------------------------------------------------------------------------
+# Coordinate / SVM formats
+# ---------------------------------------------------------------------------
+
+
+def load_coordinate_matrix(path: str, mesh=None, dtype=np.float32):
+    """``row,col,value[,timestamp]`` -> CoordinateMatrix (loadCoordinateMatrix,
+    MTUtils.scala:228). Values parse as float32 like the reference's Float."""
+    from ..matrix.sparse import CoordinateMatrix
+
+    rows, cols, vals = [], [], []
+    for line in _data_lines(path):
+        parts = [x for x in _SEP.split(line) if x]
+        if len(parts) not in (3, 4):
+            raise ValueError(f"bad coordinate line: {line!r}")
+        rows.append(int(parts[0]))
+        cols.append(int(parts[1]))
+        vals.append(float(parts[2]))  # 4th field (timestamp) ignored
+    if not rows:
+        raise ValueError(f"no entries found in {path}")
+    return CoordinateMatrix(
+        np.asarray(rows, np.int64),
+        np.asarray(cols, np.int64),
+        np.asarray(vals, dtype),
+        mesh=mesh,
+    )
+
+
+def load_svm_den_vec_matrix(path: str, vector_len: int, mesh=None, dtype=None):
+    """SVM-like rows ``idx i:v i:v ...`` with 1-based i
+    (loadSVMDenVecMatrix, MTUtils.scala:253)."""
+    from ..config import get_config
+    from ..matrix.dense import DenseVecMatrix
+
+    entries = []
+    for line in _data_lines(path):
+        items = line.split(" ")
+        idx = int(items[0])
+        pairs = []
+        for item in items[1:]:
+            if not item:
+                continue
+            i_s, v_s = item.split(":")
+            pairs.append((int(i_s) - 1, float(v_s)))
+        entries.append((idx, pairs))
+    if not entries:
+        raise ValueError(f"no rows found in {path}")
+    n_rows = max(i for i, _ in entries) + 1
+    arr = np.zeros((n_rows, vector_len), dtype=np.dtype(dtype or get_config().default_dtype))
+    for idx, pairs in entries:
+        for i, v in pairs:
+            arr[idx, i] = v
+    return DenseVecMatrix(arr, mesh=mesh, dtype=arr.dtype)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _write_parts(path: str, lines: List[str], parts: Optional[int] = None) -> None:
+    """Write lines into Hadoop-style part-files + _SUCCESS marker."""
+    os.makedirs(path, exist_ok=True)
+    parts = max(1, parts or 1)
+    per = -(-len(lines) // parts)
+    for p in range(parts):
+        chunk = lines[p * per : (p + 1) * per]
+        with open(os.path.join(path, f"part-{p:05d}"), "w") as f:
+            f.write("\n".join(chunk))
+            if chunk:
+                f.write("\n")
+    open(os.path.join(path, "_SUCCESS"), "w").close()
+
+
+def array_to_matrix(arr, mesh=None):
+    """2-D host array -> DenseVecMatrix (``MTUtils.arrayToMatrix``,
+    MTUtils.scala:402)."""
+    from ..matrix.dense import DenseVecMatrix
+
+    return DenseVecMatrix(np.asarray(arr), mesh=mesh)
+
+
+def matrix_to_array(mat) -> np.ndarray:
+    """DenseVecMatrix -> 2-D host array (``MTUtils.matrixToArray``,
+    MTUtils.scala:416)."""
+    return mat.to_numpy()
+
+
+def repeat_by_row(mat, times: int):
+    """R-style ``rep`` along rows (``MTUtils.repeatByRow``, MTUtils.scala:446)."""
+    import jax.numpy as jnp
+
+    return mat._from_logical(jnp.tile(mat.logical, (times, 1)))
+
+
+def repeat_by_column(mat, times: int):
+    """(``MTUtils.repeatByColumn``, MTUtils.scala:471)."""
+    import jax.numpy as jnp
+
+    return mat._from_logical(jnp.tile(mat.logical, (1, times)))

@@ -1,0 +1,212 @@
+"""Seeded distributed matrix/vector generation.
+
+Counterpart of the RandomRDD stack (rdd/RandomRDD.scala:15-223,
+rdd/RandomRDDs.scala, utils/RandomDataGenerator.scala): the reference generates
+data *in place on executors* with a per-partition deterministic re-seed so
+recomputation is reproducible (RandomRDD.scala:69-70). The TPU-native analogue:
+``jax.random`` with the partitionable threefry PRNG, generated under jit with an
+output sharding — each device materializes only its own shard, and the result
+is bit-identical for a given seed regardless of device count (the same
+reproducibility contract, enforced globally instead of per-partition).
+
+Generator inventory mirrors RandomDataGenerator.scala: zeros (:29), ones (:41),
+uniform (:53), standard normal (:70), Poisson (:89).
+"""
+
+from __future__ import annotations
+
+import functools
+import zlib
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import get_config
+from ..mesh import block_sharding, default_mesh, row_sharding, vector_sharding
+
+DISTRIBUTIONS = ("uniform", "normal", "zeros", "ones", "poisson")
+
+
+def hash_seed(seed: Union[int, str, None]) -> int:
+    """Stable seed hashing (``MTUtils.hashSeed`` Murmur3, MTUtils.scala:18).
+    Accepts ints, strings, or None (fresh nondeterministic seed)."""
+    if seed is None:
+        seed = np.random.SeedSequence().entropy
+    if isinstance(seed, str):
+        return zlib.crc32(seed.encode()) & 0x7FFFFFFF
+    return int(seed) & 0x7FFFFFFFFFFFFFFF
+
+
+def _sample(key, shape, distribution: str, dtype, **params):
+    if distribution == "uniform":
+        lo = params.get("low", 0.0)
+        hi = params.get("high", 1.0)
+        return jax.random.uniform(key, shape, dtype=dtype, minval=lo, maxval=hi)
+    if distribution == "normal":
+        mean = params.get("mean", 0.0)
+        std = params.get("std", 1.0)
+        return mean + std * jax.random.normal(key, shape, dtype=dtype)
+    if distribution == "zeros":
+        return jnp.zeros(shape, dtype=dtype)
+    if distribution == "ones":
+        return jnp.ones(shape, dtype=dtype)
+    if distribution == "poisson":
+        lam = params.get("mean", 1.0)
+        return jax.random.poisson(key, lam, shape).astype(dtype)
+    raise ValueError(f"unknown distribution {distribution!r}; use one of {DISTRIBUTIONS}")
+
+
+@functools.cache
+def _gen_fn(sharding, phys_shape, logical_shape, distribution, dtype, params_key):
+    params = dict(params_key)
+
+    @functools.partial(jax.jit, out_shardings=sharding)
+    def f(seed):
+        key = jax.random.PRNGKey(seed)
+        out = _sample(key, phys_shape, distribution, dtype, **params)
+        if phys_shape != logical_shape:
+            # Zero the pad region so the padded-physical invariant holds.
+            masks = [
+                jnp.arange(p) < l for p, l in zip(phys_shape, logical_shape)
+            ]
+            mask = masks[0]
+            if len(masks) == 2:
+                mask = masks[0][:, None] & masks[1][None, :]
+            out = jnp.where(mask, out, jnp.zeros((), dtype=dtype))
+        return out
+
+    return f
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _generate(logical_shape, pad_multiples, sharding, distribution, seed, dtype, **params):
+    """Generate a zero-pad-masked physical array, each device materializing its
+    own shard (the per-partition in-place generation of RandomRDD.scala:116-223)."""
+    dtype = dtype or get_config().default_dtype
+    phys = tuple(_round_up(s, m) for s, m in zip(logical_shape, pad_multiples))
+    f = _gen_fn(
+        sharding,
+        phys,
+        tuple(logical_shape),
+        distribution,
+        jnp.dtype(dtype),
+        tuple(sorted(params.items())),
+    )
+    return f(hash_seed(seed))
+
+
+# ---------------------------------------------------------------------------
+# Public factories (MTUtils.scala:34-147, RandomRDDs.scala)
+# ---------------------------------------------------------------------------
+
+
+def random_den_vec_matrix(
+    rows: int,
+    cols: int,
+    distribution: str = "uniform",
+    seed=None,
+    mesh=None,
+    dtype=None,
+    **params,
+):
+    """Row-distributed random matrix (``MTUtils.randomDenVecMatrix``,
+    MTUtils.scala:63)."""
+    from ..matrix.dense import DenseVecMatrix
+
+    mesh = mesh or default_mesh()
+    n_dev = len(mesh.devices.flat)
+    data = _generate(
+        (rows, cols), (n_dev, 1), row_sharding(mesh), distribution, seed, dtype, **params
+    )
+    return DenseVecMatrix(data, mesh=mesh, _logical_shape=(rows, cols))
+
+
+def random_block_matrix(
+    rows: int,
+    cols: int,
+    blks_by_row: Optional[int] = None,
+    blks_by_col: Optional[int] = None,
+    distribution: str = "uniform",
+    seed=None,
+    mesh=None,
+    dtype=None,
+    **params,
+):
+    """Block-distributed random matrix (``MTUtils.randomBlockMatrix``,
+    MTUtils.scala:34)."""
+    from ..matrix.block import BlockMatrix
+    from ..mesh import axis_sizes
+
+    mesh = mesh or default_mesh()
+    data = _generate(
+        (rows, cols), axis_sizes(mesh), block_sharding(mesh), distribution, seed, dtype, **params
+    )
+    return BlockMatrix(
+        data,
+        mesh=mesh,
+        blks_by_row=blks_by_row,
+        blks_by_col=blks_by_col,
+        _logical_shape=(rows, cols),
+    )
+
+
+def random_dist_vector(
+    length: int, distribution: str = "uniform", seed=None, mesh=None, dtype=None, **params
+):
+    """Random distributed vector (``MTUtils.randomDistVector``, MTUtils.scala:87)."""
+    from ..matrix.vector import DistributedVector
+
+    mesh = mesh or default_mesh()
+    n_dev = len(mesh.devices.flat)
+    data = _generate(
+        (length,), (n_dev,), vector_sharding(mesh), distribution, seed, dtype, **params
+    )
+    return DistributedVector(data, mesh=mesh, _logical_len=length)
+
+
+def zeros_den_vec_matrix(rows: int, cols: int, mesh=None, dtype=None):
+    """(MTUtils.scala:103)."""
+    return random_den_vec_matrix(rows, cols, distribution="zeros", seed=0, mesh=mesh, dtype=dtype)
+
+
+def ones_den_vec_matrix(rows: int, cols: int, mesh=None, dtype=None):
+    """(MTUtils.scala:119)."""
+    return random_den_vec_matrix(rows, cols, distribution="ones", seed=0, mesh=mesh, dtype=dtype)
+
+
+def ones_dist_vector(length: int, mesh=None, dtype=None):
+    """(MTUtils.scala:128)."""
+    return random_dist_vector(length, distribution="ones", seed=0, mesh=mesh, dtype=dtype)
+
+
+def random_spa_vec_matrix(
+    rows: int,
+    cols: int,
+    sparsity: float = 0.1,
+    distribution: str = "uniform",
+    seed=None,
+    mesh=None,
+    dtype=None,
+    **params,
+):
+    """Row-distributed random sparse matrix (``MTUtils.randomSpaVecMatrix``,
+    MTUtils.scala:75; per-row Bernoulli mask like RandomRDD.getSparseVecIterator,
+    RandomRDD.scala:47)."""
+    from ..matrix.sparse import SparseVecMatrix
+
+    base = hash_seed(seed)
+    vals = random_den_vec_matrix(
+        rows, cols, distribution=distribution, seed=base, mesh=mesh, dtype=dtype, **params
+    )
+    gate = random_den_vec_matrix(
+        rows, cols, distribution="uniform", seed=base + 1, mesh=mesh, dtype=dtype
+    )
+    dense = jnp.where(
+        gate.logical < sparsity, vals.logical, jnp.zeros((), dtype=vals.dtype)
+    )
+    return SparseVecMatrix.from_dense_array(dense, mesh=vals.mesh)

@@ -1,0 +1,134 @@
+"""GEMM split policy — the CARMA-style (m, k, n) grid chooser.
+
+The reference picks its block-replication grid by repeatedly halving the largest
+of the three GEMM dimensions while parallelism budget remains
+(``MTUtils.splitMethod(m,k,n,cores)``, MTUtils.scala:150-175, after the CARMA
+paper), plus a near-square special case ``split = floor((3*cores)^(1/3))``
+(DenseVecMatrix.scala:208-213). On TPU the same policy chooses how the *device
+mesh* is factored over (m, k, n): splitting m/n maps to sharding the output
+rows/cols, splitting k maps to a ``psum``/``psum_scatter`` contraction over a
+k-mesh-axis. The policy is re-derived for communication volume over ICI, but
+keeps the reference's API shape and its recursive-halving structure.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+
+def split_method(m: int, k: int, n: int, parallelism: int) -> Tuple[int, int, int]:
+    """Choose an (m_split, k_split, n_split) grid with product <= parallelism.
+
+    Repeatedly halve the currently-largest dimension (ties: m, then n, then k)
+    while budget remains — the CARMA recursive-split heuristic
+    (MTUtils.scala:150-175). Splits never exceed the dimension itself.
+    """
+    if parallelism < 1:
+        raise ValueError("parallelism must be >= 1")
+    ms, ks, ns = 1, 1, 1
+    budget = parallelism
+    # Remaining per-split extents.
+    dm, dk, dn = m, k, n
+    while budget >= 2:
+        # Pick the largest remaining extent that can still be split.
+        candidates = [(dm, "m"), (dn, "n"), (dk, "k")]
+        candidates.sort(key=lambda t: -t[0])
+        ext, which = candidates[0]
+        if ext < 2:
+            break
+        if which == "m":
+            ms *= 2
+            dm = max(1, dm // 2)
+        elif which == "n":
+            ns *= 2
+            dn = max(1, dn // 2)
+        else:
+            ks *= 2
+            dk = max(1, dk // 2)
+        budget //= 2
+    return ms, ks, ns
+
+
+def near_square_split(parallelism: int) -> int:
+    """Marlin's near-square heuristic: split every dimension by
+    ``floor((3*parallelism)^(1/3))`` (DenseVecMatrix.scala:208-213)."""
+    return max(1, int(round((3.0 * parallelism) ** (1.0 / 3.0) - 1e-9)))
+
+
+def is_near_square(m: int, k: int, n: int, tol: float = 4.0) -> bool:
+    """True when the three dimensions are within ``tol``x of each other."""
+    lo, hi = min(m, k, n), max(m, k, n)
+    return hi <= tol * lo
+
+
+def grid_for_devices(
+    m: int, k: int, n: int, n_devices: int
+) -> Tuple[int, int, int]:
+    """Factor ``n_devices`` into an (pm, pk, pn) mesh grid for C[m,n] = A[m,k] B[k,n].
+
+    Unlike :func:`split_method` (which may use fewer than ``parallelism`` cells),
+    the product must equal ``n_devices`` exactly so every device belongs to the
+    mesh. Greedy: give each factor-of-2 (and residual factors) to the dimension
+    with the largest per-shard extent, preferring m/n over k (k-splits cost a
+    reduction collective).
+    """
+    pm, pk, pn = 1, 1, 1
+    factors = _prime_factors(n_devices)
+    for f in sorted(factors, reverse=True):
+        # Per-shard extents if we applied f to each axis; k discounted to
+        # reflect the extra psum_scatter traffic a k-split incurs.
+        em, ek, en = m / pm, (k / pk) * 0.5, n / pn
+        best = max(em, ek, en)
+        if best == em:
+            pm *= f
+        elif best == en:
+            pn *= f
+        else:
+            pk *= f
+    return pm, pk, pn
+
+
+def _prime_factors(x: int):
+    out = []
+    d = 2
+    while d * d <= x:
+        while x % d == 0:
+            out.append(d)
+            x //= d
+        d += 1
+    if x > 1:
+        out.append(x)
+    return out
+
+
+def dim_to_split(row_ratio: float, col_ratio: float) -> str:
+    """Which dimension a re-blocking should split first (MTUtils.scala:204)."""
+    return "row" if row_ratio >= col_ratio else "column"
+
+
+def reblock_plan(old_starts, new_block: int):
+    """Plan a re-chunking of a 1-D extent from old chunk boundaries to uniform
+    ``new_block`` chunks — the split-status planner behind
+    ``DistributedVector.toDisVector`` and ``toBlockMatrix`` re-gridding
+    (MTUtils.scala:182-202).
+
+    Returns a list of (old_chunk_idx, old_offset, new_chunk_idx, new_offset,
+    length) copy descriptors. With single logical jax.Arrays re-blocking is just
+    resharding, so this planner exists for the re-chunk *metadata* API parity and
+    for the C++ host-side IO path.
+    """
+    plan = []
+    total = old_starts[-1]
+    starts = list(old_starts[:-1])
+    for oi, ostart in enumerate(starts):
+        oend = old_starts[oi + 1]
+        pos = ostart
+        while pos < oend:
+            ni = pos // new_block
+            nstart = ni * new_block
+            nend = min(nstart + new_block, total)
+            length = min(oend, nend) - pos
+            plan.append((oi, pos - ostart, ni, pos - nstart, length))
+            pos += length
+    return plan

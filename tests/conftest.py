@@ -1,0 +1,49 @@
+"""Test fixtures: a virtual 8-device CPU mesh.
+
+The reference tests run Spark with ``local[2]`` — 2 executor threads in one JVM
+— so multi-node code paths (shuffles, partitioners) execute for real without a
+cluster (LocalSparkContext.scala:7-22). The analogue here:
+``--xla_force_host_platform_device_count=8`` gives 8 CPU devices, so every
+mesh/collective path (shard_map SUMMA, psum grids, reshardings) runs for real
+without a TPU pod. Golden tests compare against NumPy in float64 (the
+reference's element type), so x64 is enabled.
+
+Note: this image's sitecustomize force-registers the 'axon' TPU platform and
+sets ``jax_platforms`` via jax.config (overriding the env var), so the CPU
+override must also go through jax.config, after import, before first backend
+use.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _setup():
+    assert len(jax.devices()) == 8, "tests need the 8-device virtual CPU mesh"
+    mt.set_config(default_dtype=np.float64)
+    yield
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    return mt.default_mesh()
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,96 @@
+"""Text I/O tests — the reference's exact formats, plus roundtrips and the
+shipped sample-data files."""
+
+import os
+
+import numpy as np
+import pytest
+
+from marlin_tpu.matrix.block import BlockMatrix
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.utils import io as mio
+
+
+class TestDenseFormat:
+    def test_roundtrip(self, tmp_path, rng):
+        a = rng.standard_normal((9, 5))
+        path = str(tmp_path / "m")
+        DenseVecMatrix(a).save_to_file_system(path)
+        assert os.path.exists(os.path.join(path, "_SUCCESS"))
+        back = mio.load_dense_matrix(path)
+        np.testing.assert_allclose(back.to_numpy(), a)
+
+    def test_description(self, tmp_path, rng):
+        a = rng.standard_normal((4, 6))
+        path = str(tmp_path / "m")
+        DenseVecMatrix(a).save_with_description(path, name="testmat")
+        name, rows, cols = mio.load_description(path)
+        assert (name, rows, cols) == ("testmat", 4, 6)
+
+    def test_parse_variants(self, tmp_path):
+        # Loader accepts comma or whitespace separators (MTUtils.scala regex).
+        p = tmp_path / "f.txt"
+        p.write_text("0:1.0,2.0,3.0\n2:7.0 8.0 9.0\n1:4.0, 5.0, 6.0\n")
+        m = mio.load_dense_matrix(str(p))
+        np.testing.assert_allclose(
+            m.to_numpy(), [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+        )
+
+    def test_shipped_sample_data_format(self, tmp_path):
+        # The reference ships data/a.100.100 in this format; emulate a slice.
+        p = tmp_path / "a.3.3"
+        p.write_text("0:1,0,2\n1:0,1,0\n2:2,0,1\n")
+        m = mio.load_dense_matrix(str(p))
+        assert m.shape == (3, 3)
+
+
+class TestBlockFormat:
+    def test_roundtrip_uneven_grid(self, tmp_path, rng):
+        a = rng.standard_normal((5, 7))
+        path = str(tmp_path / "b")
+        BlockMatrix(a, blks_by_row=2, blks_by_col=3).save_to_file_system(path)
+        back = mio.load_block_matrix(path)
+        np.testing.assert_allclose(back.to_numpy(), a)
+        assert (back.blks_by_row, back.blks_by_col) == (2, 3)
+
+    def test_column_major_data(self, tmp_path):
+        # `r-c-rows-cols:data` carries column-major data (Breeze BDM.create).
+        p = tmp_path / "blk.txt"
+        p.write_text("0-0-2-2:1.0,3.0,2.0,4.0\n")
+        m = mio.load_block_matrix(str(p))
+        np.testing.assert_allclose(m.to_numpy(), [[1.0, 2.0], [3.0, 4.0]])
+
+
+class TestCoordinateFormat:
+    def test_load_with_timestamp(self, tmp_path):
+        # MovieLens-tolerant: 4th field ignored (MTUtils.scala:239-241).
+        p = tmp_path / "r.txt"
+        p.write_text("0,0,5.0,838985046\n1,2,3.0\n2 1 4.0\n")
+        cm = mio.load_coordinate_matrix(str(p))
+        assert cm.shape == (3, 3) and cm.nnz == 3
+        dense = cm.to_numpy()
+        assert dense[0, 0] == 5.0 and dense[1, 2] == 3.0 and dense[2, 1] == 4.0
+
+    def test_to_dense_vec_matrix(self, tmp_path):
+        p = tmp_path / "r.txt"
+        p.write_text("0,1,2.0\n1,0,3.0\n")
+        dvm = mio.load_coordinate_matrix(str(p)).to_dense_vec_matrix()
+        np.testing.assert_allclose(dvm.to_numpy(), [[0, 2], [3, 0]])
+
+
+class TestSVMFormat:
+    def test_one_based_indices(self, tmp_path):
+        p = tmp_path / "svm.txt"
+        p.write_text("0 1:1.5 3:2.5\n1 2:4.0\n")
+        m = mio.load_svm_den_vec_matrix(str(p), vector_len=4)
+        np.testing.assert_allclose(
+            m.to_numpy(), [[1.5, 0, 2.5, 0], [0, 4.0, 0, 0]]
+        )
+
+
+class TestArrayHelpers:
+    def test_array_matrix_roundtrip(self, rng):
+        a = rng.standard_normal((6, 4))
+        m = mio.array_to_matrix(a)
+        assert isinstance(m, DenseVecMatrix)
+        np.testing.assert_allclose(mio.matrix_to_array(m), a)

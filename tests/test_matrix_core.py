@@ -1,0 +1,217 @@
+"""Core distributed-type tests.
+
+Mirrors the reference's DistributedMatrixSuite pattern
+(src/test/scala/.../DistributedMatrixSuite.scala): tiny fixtures, run the
+distributed op, ``to_numpy()``, compare to a hand-computed local oracle.
+"""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.matrix.block import BlockMatrix
+from marlin_tpu.matrix.vector import DistributedVector
+
+# The reference's 4x4 fixture rows (DistributedMatrixSuite.scala:15-32 style).
+A4 = np.array(
+    [
+        [1.0, 2.0, 3.0, 4.0],
+        [2.0, 3.0, 4.0, 5.0],
+        [3.0, 4.0, 5.0, 6.0],
+        [4.0, 5.0, 6.0, 7.0],
+    ]
+)
+B4 = np.array(
+    [
+        [1.0, 0.0, 2.0, 0.0],
+        [0.0, 1.0, 0.0, 2.0],
+        [2.0, 0.0, 1.0, 0.0],
+        [0.0, 2.0, 0.0, 1.0],
+    ]
+)
+
+
+def dvm(arr):
+    return DenseVecMatrix(arr)
+
+
+def blk(arr, r=2, c=2):
+    return BlockMatrix(arr, blks_by_row=r, blks_by_col=c)
+
+
+class TestMetadata:
+    def test_size_inference(self):
+        m = dvm(A4)
+        assert m.num_rows == 4 and m.num_cols == 4
+        assert m.elements_count() == 16
+
+    def test_empty_error_contract(self):
+        # Reference: sys.error on an empty RDD (suite :53).
+        with pytest.raises(ValueError):
+            DenseVecMatrix(np.zeros((0, 3)))
+        with pytest.raises(ValueError):
+            DistributedVector(np.zeros((0,)))
+
+    def test_from_rows(self):
+        m = DenseVecMatrix.from_rows([(0, A4[0]), (2, A4[2]), (1, A4[1]), (3, A4[3])])
+        np.testing.assert_allclose(m.to_numpy(), A4)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("make", [dvm, blk])
+    def test_add_subtract(self, make):
+        m = make(A4)
+        np.testing.assert_allclose(m.add(make(B4)).to_numpy(), A4 + B4)
+        np.testing.assert_allclose(m.subtract(make(B4)).to_numpy(), A4 - B4)
+        np.testing.assert_allclose(m.add(2.5).to_numpy(), A4 + 2.5)
+        np.testing.assert_allclose(m.subtract(1.5).to_numpy(), A4 - 1.5)
+
+    @pytest.mark.parametrize("make", [dvm, blk])
+    def test_scalar_ops(self, make):
+        m = make(A4)
+        np.testing.assert_allclose(m.multiply(3.0).to_numpy(), A4 * 3)
+        np.testing.assert_allclose(m.divide(2.0).to_numpy(), A4 / 2)
+        np.testing.assert_allclose(m.divide_by(2.0).to_numpy(), 2 / A4)
+        np.testing.assert_allclose(m.subtract_by(10.0).to_numpy(), 10 - A4)
+
+    def test_element_multiply(self):
+        np.testing.assert_allclose(
+            blk(A4).element_multiply(blk(B4)).to_numpy(), A4 * B4
+        )
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            dvm(A4).add(dvm(A4[:3]))
+
+
+class TestReductions:
+    def test_sum(self):
+        assert dvm(A4).sum() == pytest.approx(A4.sum())
+        assert blk(A4).sum() == pytest.approx(A4.sum())
+
+    def test_dot_product_all_pairings(self):
+        # All 4 type pairings (suite :326).
+        expected = (A4 * B4).sum()
+        for left in (dvm, blk):
+            for right in (dvm, blk):
+                assert left(A4).dot_product(right(B4)) == pytest.approx(expected)
+
+    def test_norms(self):
+        m = dvm(A4)
+        assert m.norm("1") == pytest.approx(np.abs(A4).sum(axis=0).max())
+        assert m.norm("inf") == pytest.approx(np.abs(A4).sum(axis=1).max())
+        with pytest.raises(ValueError):
+            m.norm("fro")
+
+
+class TestStructure:
+    @pytest.mark.parametrize("make", [dvm, blk])
+    def test_transpose(self, make):
+        np.testing.assert_allclose(make(A4).transpose().to_numpy(), A4.T)
+
+    def test_c_bind(self):
+        np.testing.assert_allclose(
+            dvm(A4).c_bind(dvm(B4)).to_numpy(), np.hstack([A4, B4])
+        )
+        with pytest.raises(ValueError):
+            dvm(A4).c_bind(dvm(B4[:2]))
+
+    def test_slicing_inclusive(self):
+        # Reference slicing is inclusive on both ends (DenseVecMatrix.scala:928).
+        m = dvm(A4)
+        np.testing.assert_allclose(m.slice_by_row(1, 2).to_numpy(), A4[1:3])
+        np.testing.assert_allclose(m.slice_by_column(0, 1).to_numpy(), A4[:, 0:2])
+        np.testing.assert_allclose(
+            m.get_sub_matrix(1, 3, 2, 3).to_numpy(), A4[1:4, 2:4]
+        )
+        with pytest.raises(ValueError):
+            m.slice_by_row(2, 4)
+
+    def test_row_exchange(self):
+        m = dvm(A4).row_exchange(0, 3)
+        expected = A4.copy()
+        expected[[0, 3]] = expected[[3, 0]]
+        np.testing.assert_allclose(m.to_numpy(), expected)
+        # Indices into the pad region must be rejected, not silently corrupt.
+        with pytest.raises(ValueError):
+            dvm(A4).row_exchange(1, 5)
+
+    def test_block_transpose_swaps_grid(self):
+        m = BlockMatrix(np.arange(35.0).reshape(5, 7), blks_by_row=2, blks_by_col=3)
+        t = m.transpose()
+        assert (t.blks_by_row, t.blks_by_col) == (3, 2)
+        np.testing.assert_allclose(t.to_numpy(), np.arange(35.0).reshape(5, 7).T)
+
+    def test_repeat(self):
+        from marlin_tpu.utils.io import repeat_by_column, repeat_by_row
+
+        np.testing.assert_allclose(
+            repeat_by_row(dvm(A4), 2).to_numpy(), np.tile(A4, (2, 1))
+        )
+        np.testing.assert_allclose(
+            repeat_by_column(dvm(A4), 3).to_numpy(), np.tile(A4, (1, 3))
+        )
+
+
+class TestConversions:
+    def test_dense_block_roundtrip(self):
+        m = dvm(A4).to_block_matrix(2, 2)
+        assert isinstance(m, BlockMatrix)
+        assert (m.blks_by_row, m.blks_by_col) == (2, 2)
+        back = m.to_dense_vec_matrix()
+        np.testing.assert_allclose(back.to_numpy(), A4)
+
+    def test_block_regrid(self):
+        m = blk(A4, 2, 2).to_block_matrix(4, 1)
+        assert (m.blks_by_row, m.blks_by_col) == (4, 1)
+        np.testing.assert_allclose(m.to_numpy(), A4)
+
+    def test_block_extents_uneven(self):
+        m = BlockMatrix(np.arange(35.0).reshape(5, 7), blks_by_row=2, blks_by_col=3)
+        # Edge blocks are smaller (RandomRDD.scala:196-218 edge-dim logic).
+        assert m.block_extent(1, 2) == (3, 5, 6, 7)
+        np.testing.assert_allclose(
+            np.asarray(m.get_block(1, 2)),
+            np.arange(35.0).reshape(5, 7)[3:5, 6:7],
+        )
+
+
+class TestVector:
+    def test_metadata_and_to_numpy(self):
+        v = DistributedVector(np.arange(10.0))
+        assert v.length == 10
+        np.testing.assert_allclose(v.to_numpy(), np.arange(10.0))
+
+    def test_subtract_and_transpose(self):
+        a = DistributedVector(np.arange(6.0))
+        b = DistributedVector(np.ones(6))
+        np.testing.assert_allclose(a.substract(b).to_numpy(), np.arange(6.0) - 1)
+        assert a.column_major and not a.transpose().column_major
+
+    def test_inner_outer_product(self):
+        # BLAS1 inner/outer products (suite :390).
+        x = np.arange(1.0, 5.0)
+        y = np.arange(2.0, 6.0)
+        col = DistributedVector(x, column_major=True)
+        row = DistributedVector(y, column_major=False)
+        outer = col.multiply_vector(row, mode="dist")
+        assert isinstance(outer, BlockMatrix)
+        np.testing.assert_allclose(outer.to_numpy(), np.outer(x, y))
+        np.testing.assert_allclose(col.multiply_vector(row, mode="local"), np.outer(x, y))
+        inner = row.multiply_vector(col)
+        assert inner == pytest.approx(x @ y)
+        with pytest.raises(ValueError):
+            col.multiply_vector(col)
+
+    def test_rechunk_plan(self):
+        from marlin_tpu.utils.split import reblock_plan
+
+        plan = reblock_plan([0, 3, 7, 10], 4)
+        # Copies must tile the whole extent exactly once.
+        covered = sorted(
+            (d[2] * 4 + d[3], d[2] * 4 + d[3] + d[4]) for d in plan
+        )
+        assert covered[0][0] == 0 and covered[-1][1] == 10
+        total = sum(d[4] for d in plan)
+        assert total == 10

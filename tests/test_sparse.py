@@ -1,0 +1,85 @@
+"""Sparse type tests — golden-value multiplies like LocalMatrixSuite
+(src/test/scala/.../LocalMatrixSuite.scala:8-72) plus the SparseMultiply mode
+matrix (SparseMultiply.scala:31-82 exercises 6 sparsity regimes)."""
+
+import numpy as np
+import pytest
+
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.matrix.sparse import CoordinateMatrix, MatrixEntry, SparseVecMatrix
+from marlin_tpu.utils import random as mrand
+
+# Golden 4x4 sparse fixture (LocalMatrixSuite style: hand-checked values).
+S1 = np.array(
+    [
+        [1.0, 0.0, 0.0, 2.0],
+        [0.0, 3.0, 0.0, 0.0],
+        [0.0, 0.0, 0.0, 0.0],
+        [4.0, 0.0, 5.0, 0.0],
+    ]
+)
+S2 = np.array(
+    [
+        [0.0, 1.0, 0.0, 0.0],
+        [2.0, 0.0, 0.0, 3.0],
+        [0.0, 0.0, 4.0, 0.0],
+        [5.0, 0.0, 0.0, 6.0],
+    ]
+)
+
+
+class TestCoordinateMatrix:
+    def test_compute_size_by_max_index(self):
+        cm = CoordinateMatrix([0, 3, 1], [2, 0, 5], [1.0, 2.0, 3.0])
+        assert cm.shape == (4, 6)  # computeSize: max index + 1
+
+    def test_entries_and_dense(self):
+        cm = CoordinateMatrix([0, 1], [1, 0], [2.5, 3.5])
+        es = cm.entries()
+        assert isinstance(es[0], MatrixEntry)
+        assert (es[0].i, es[0].j, es[0].value) == (0, 1, 2.5)
+        np.testing.assert_allclose(cm.to_numpy(), [[0, 2.5], [3.5, 0]])
+
+    def test_conversion_chain(self):
+        cm = CoordinateMatrix([0, 1, 1], [0, 0, 1], [1.0, 2.0, 3.0])
+        sp = cm.to_sparse_vec_matrix()
+        assert isinstance(sp, SparseVecMatrix)
+        np.testing.assert_allclose(sp.to_numpy(), cm.to_numpy())
+
+
+class TestSparseVecMatrix:
+    def test_sparse_x_sparse_golden(self):
+        a = SparseVecMatrix.from_dense_array(S1)
+        b = SparseVecMatrix.from_dense_array(S2)
+        out = a.multiply_sparse(b)
+        assert isinstance(out, CoordinateMatrix)
+        np.testing.assert_allclose(out.to_numpy(), S1 @ S2)
+
+    def test_sparse_x_dense(self, rng):
+        a = SparseVecMatrix.from_dense_array(S1)
+        d = rng.standard_normal((4, 3))
+        out = a.multiply(DenseVecMatrix(d))
+        assert isinstance(out, DenseVecMatrix)
+        np.testing.assert_allclose(out.to_numpy(), S1 @ d, rtol=1e-12)
+
+    def test_dense_sparse_roundtrip(self):
+        dm = DenseVecMatrix(S1)
+        sp = dm.to_sparse_vec_matrix()
+        assert sp.nnz == 5
+        back = sp.to_dense_vec_matrix()
+        np.testing.assert_allclose(back.to_numpy(), S1)
+
+    def test_dimension_mismatch(self):
+        a = SparseVecMatrix.from_dense_array(S1)
+        b = SparseVecMatrix.from_dense_array(S2[:3])
+        with pytest.raises(ValueError):
+            a.multiply_sparse(b)
+
+    def test_random_sparse_multiply(self):
+        # The sparse-COO CRM regime of SparseMultiply with random operands.
+        a = mrand.random_spa_vec_matrix(30, 20, sparsity=0.15, seed=11)
+        b = mrand.random_spa_vec_matrix(20, 25, sparsity=0.15, seed=12)
+        out = a.multiply_sparse(b)
+        np.testing.assert_allclose(
+            out.to_numpy(), a.to_numpy() @ b.to_numpy(), rtol=1e-10
+        )
