@@ -1,0 +1,111 @@
+"""Blocked LU decomposition with partial pivoting.
+
+Counterpart of ``DenseVecMatrix.luDecompose`` (DenseVecMatrix.scala:283-461):
+returns (BlockMatrix with L and U packed in one matrix, pivot array). The
+reference's driver loop collects the diagonal block to the driver, runs LAPACK
+``dgetrf`` locally, broadcasts (L, U, perm), runs distributed triangular solves
+and a shuffle-based Schur update per panel (call stack SURVEY.md §3.2).
+
+TPU-native restatement: a host-Python loop over logical panels of ONE sharded
+array. Per panel: XLA's ``lax.linalg.lu`` factors the *tall pivot panel*
+in place (rows j.. x panel cols — this also does the reference's
+``rowExchange`` pivot search across all blocks below the diagonal), the row
+permutation is applied to the trailing columns as a gather (XLA lowers it to
+ICI ppermute of stripes), the U row-block comes from a unit-lower triangular
+solve, and the Schur complement is one sharded GEMM. "Collect diag block to
+driver + broadcast" disappears: blocks never leave HBM.
+
+Permutation convention: returns ``perm`` with ``A[perm] = L @ U`` (row ``i`` of
+the factorization came from original row ``perm[i]``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import get_config
+
+
+def _resolve_mode(mode: str, n: int, dist_threshold: int = 6000) -> str:
+    """"auto" -> dist for >6000 rows, else local (DenseVecMatrix.scala:289-298).
+    "breeze" is accepted as an alias of "local" for reference-API parity."""
+    if mode == "auto":
+        return "dist" if n > dist_threshold else "local"
+    if mode in ("breeze", "local"):
+        return "local"
+    if mode == "dist":
+        return "dist"
+    raise ValueError(f"Do not support mode {mode}.")
+
+
+def lu_factor_array(a: jax.Array, mode: str = "auto", base_size: int = None):
+    """LU-factor a square array. Returns (packed LU, perm) with A[perm] = L U."""
+    cfg = get_config()
+    n = a.shape[0]
+    if a.shape[0] != a.shape[1]:
+        raise ValueError(
+            f"LU decompose only support square matrix: {a.shape[0]} v.s {a.shape[1]}"
+        )
+    base = base_size or cfg.lu_base_size
+    if _resolve_mode(mode, n) == "local" or base >= n:
+        packed, _, perm = jax.lax.linalg.lu(a)
+        return packed, np.asarray(jax.device_get(perm))
+    return _lu_blocked(a, base)
+
+
+def _lu_blocked(a: jax.Array, base: int) -> Tuple[jax.Array, np.ndarray]:
+    """Right-looking blocked LU over logical panels of the sharded array."""
+    n = a.shape[0]
+    perm = jnp.arange(n)
+    for j0 in range(0, n, base):
+        b = min(base, n - j0)
+        # Factor the tall pivot panel (rows j0.., panel columns).
+        panel = a[j0:, j0 : j0 + b]
+        plu, _, pperm = jax.lax.linalg.lu(panel)
+        # Apply the panel's row permutation to ALL columns of rows j0.. —
+        # the reference's rowExchange bookkeeping (DenseVecMatrix.scala:438-460)
+        # as one gather.
+        a = a.at[j0:, :].set(a[j0:, :][pperm, :])
+        perm = perm.at[j0:].set(perm[j0:][pperm])
+        # Write the packed panel (L21 below, L11\U11 on the diagonal block).
+        a = a.at[j0:, j0 : j0 + b].set(plu)
+        if j0 + b < n:
+            # U12 = unit_lower(L11)^-1 A12 — the distributed triangular solve
+            # (A2 <- L \ A2, DenseVecMatrix.scala:370-387).
+            l11 = plu[:b, :b]
+            u12 = jax.lax.linalg.triangular_solve(
+                l11,
+                a[j0 : j0 + b, j0 + b :],
+                left_side=True,
+                lower=True,
+                unit_diagonal=True,
+            )
+            a = a.at[j0 : j0 + b, j0 + b :].set(u12)
+            # Schur complement: A22 -= L21 @ U12 — the reference's
+            # emit-join-outer-product shuffle (:392-428) as one sharded GEMM.
+            l21 = plu[b:, :b]
+            a = a.at[j0 + b :, j0 + b :].add(
+                -jnp.dot(l21, u12, precision=get_config().matmul_precision)
+            )
+    return a, np.asarray(jax.device_get(perm))
+
+
+def lu_decompose(mat, mode: str = "auto"):
+    """(BlockMatrix with L and U packed, pivot array) — the reference's return
+    shape (DenseVecMatrix.scala:283)."""
+    from ..matrix.block import BlockMatrix
+
+    packed, perm = lu_factor_array(mat.logical, mode=mode)
+    return BlockMatrix(packed, mesh=mat.mesh), perm
+
+
+def unpack_lu(packed: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Split a packed LU into (unit-lower L, upper U) — convenience for
+    verification and solves."""
+    l = np.tril(packed, -1) + np.eye(packed.shape[0], dtype=packed.dtype)
+    u = np.triu(packed)
+    return l, u

@@ -243,11 +243,15 @@ class DistributedMatrix:
             jnp.concatenate([self.logical, other.logical.astype(self.dtype)], axis=1)
         )
 
-    def inverse(self):
-        """Blocked inverse (DenseVecMatrix.scala:568; BlockMatrix.scala:529)."""
+    def inverse(self, mode: str = "auto"):
+        """Blocked inverse -> BlockMatrix (DenseVecMatrix.scala:568;
+        BlockMatrix.scala:529)."""
         from ..linalg.inverse import inverse as _inv
+        from .block import BlockMatrix
 
-        return self._from_logical(_inv(self.logical, mesh=self.mesh))
+        return BlockMatrix(
+            _inv(self.logical, mesh=self.mesh, mode=mode), mesh=self.mesh
+        )
 
     # -- GEMM (subclasses wire the dispatch) --------------------------------
     def multiply(self, other, *args, **kwargs):
