@@ -1,0 +1,6 @@
+"""Example CLIs mirroring the reference's spark-submit examples (SURVEY.md §2.6).
+
+Run as: python -m marlin_tpu.examples.<name> --help
+Modules: matrix_multiply, blas1, blas3, rmm_compare, sparse_multiply,
+matrix_lu_decompose, als, logistic_regression, page_rank, neural_network.
+"""

@@ -1,0 +1,64 @@
+"""ALS — collaborative filtering on a ratings file.
+
+Counterpart of ``examples/ALS.scala``: load COO ratings (MovieLens-tolerant
+format), run ``CoordinateMatrix.ALS`` (:23-26), save user/product factors.
+
+Usage: python -m marlin_tpu.examples.als ratings.txt out_dir \
+         [--rank 10] [--iterations 10] [--lambda 0.01] [--implicit --alpha 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from ..utils.io import load_coordinate_matrix
+from ..utils.timing import fence
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("ratings")
+    p.add_argument("output")
+    p.add_argument("--rank", type=int, default=10)
+    p.add_argument("--iterations", type=int, default=10)
+    p.add_argument("--lambda", dest="lambda_", type=float, default=0.01)
+    p.add_argument("--implicit", action="store_true")
+    p.add_argument("--alpha", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=None)
+    args = p.parse_args(argv)
+
+    ratings = load_coordinate_matrix(args.ratings)
+    t0 = time.perf_counter()
+    users, products = ratings.als(
+        rank=args.rank,
+        iterations=args.iterations,
+        lambda_=args.lambda_,
+        implicit_prefs=args.implicit,
+        alpha=args.alpha,
+        seed=args.seed,
+    )
+    fence(users, products)
+    dt = time.perf_counter() - t0
+
+    users.save_to_file_system(os.path.join(args.output, "userFeatures"))
+    products.save_to_file_system(os.path.join(args.output, "productFeatures"))
+    print(
+        json.dumps(
+            {
+                "example": "ALS",
+                "ratings_shape": list(ratings.shape),
+                "nnz": ratings.nnz,
+                "rank": args.rank,
+                "iterations": args.iterations,
+                "seconds": round(dt, 6),
+                "output": args.output,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""BLAS1 — distributed dot product.
+
+Counterpart of ``examples/BLAS1.scala``: two random distributed vectors,
+inner product in "dist" vs "local" mode (BLAS1.scala:33).
+
+Usage: python -m marlin_tpu.examples.blas1 1000000 [--mode dist|local]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from ..utils import random as mrand
+from ..utils.timing import fence
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("length", type=int)
+    p.add_argument("--mode", default="dist", choices=["dist", "local"])
+    args = p.parse_args(argv)
+
+    x = mrand.random_dist_vector(args.length, seed=1)
+    y = mrand.random_dist_vector(args.length, seed=2)
+    fence(x.data, y.data)
+
+    t0 = time.perf_counter()
+    if args.mode == "dist":
+        # Row-vector x column-vector -> on-device inner product.
+        value = x.transpose().multiply_vector(y)
+    else:
+        value = float(np.dot(x.to_numpy(), y.to_numpy()))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {"example": "BLAS1", "mode": args.mode, "dot": value, "seconds": round(dt, 6)}
+        )
+    )
+    return value
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""NeuralNetwork — mini-batch SGD for a 1-hidden-layer sigmoid MLP.
+
+Counterpart of ``examples/NeuralNetwork.scala`` (:33-290): MNIST images loaded
+into partition-aligned blocks co-located with label chunks
+(``NeuralNetworkPartitioner``, :267-290), per-iteration random block sampling
+(:94), forward = per-block ``block * weight`` with driver-held weights
+(:223-232), hand-written backprop (:120-163), ``treeReduce`` gradient
+aggregation (:172-184), driver weight update (:245-249), CSV weight export
+(:260-261).
+
+TPU-native restatement: the dataset is ONE sharded array (data-parallel over
+mesh rows — the co-partitioning is the sharding); weights live replicated on
+device instead of on a driver; a training step is one jitted program whose
+gradient (via ``jax.grad``, matching the reference's manual
+sigmoid-MSE backprop math) is reduced by XLA's psum instead of treeReduce;
+mini-batches are gathered by on-device random index sampling (the random
+block-id sampling analogue). This module also provides the flagship
+``forward`` used by ``__graft_entry__``.
+
+Usage:
+  python -m marlin_tpu.examples.neural_network --synthetic 4096 \
+      [--batch-size 512] [--iterations 50] [--hidden 256] [--output w_dir]
+  python -m marlin_tpu.examples.neural_network --images mnist.csv ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import get_config
+from ..mesh import default_mesh, replicated_sharding, row_sharding
+from ..utils.random import hash_seed
+
+
+def forward(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    """block @ hiddenWeight -> sigmoid -> @ outputWeight -> sigmoid
+    (NeuralNetwork.scala:223-232)."""
+    h = jax.nn.sigmoid(x @ params["hidden"])
+    return jax.nn.sigmoid(h @ params["output"])
+
+
+def loss_fn(params, x, y):
+    """Squared error, as in computeOutputError (NeuralNetwork.scala:120-134)."""
+    pred = forward(params, x)
+    return 0.5 * jnp.mean(jnp.sum((pred - y) ** 2, axis=1))
+
+
+def init_params(d_in: int, d_hidden: int, d_out: int, seed=0, dtype=jnp.float32):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(hash_seed(seed)))
+    scale_h = 1.0 / np.sqrt(d_in)
+    scale_o = 1.0 / np.sqrt(d_hidden)
+    return {
+        "hidden": scale_h * jax.random.normal(k1, (d_in, d_hidden), dtype),
+        "output": scale_o * jax.random.normal(k2, (d_hidden, d_out), dtype),
+    }
+
+
+def train(
+    images: np.ndarray,
+    labels: np.ndarray,
+    hidden: int = 256,
+    batch_size: int = 512,
+    iterations: int = 50,
+    learning_rate: float = 0.5,
+    seed: int = 0,
+    mesh=None,
+) -> Tuple[Dict[str, jax.Array], float]:
+    """Mini-batch SGD; returns (params, final mini-batch loss)."""
+    mesh = mesh or default_mesh()
+    n, d_in = images.shape
+    d_out = labels.shape[1]
+    # Data lives sharded over all devices (the partition-aligned load);
+    # weights are replicated (the "driver-held, implicitly re-broadcast"
+    # weights, without the re-broadcast cost).
+    x_all = jax.device_put(jnp.asarray(images, jnp.float32), row_sharding(mesh))
+    y_all = jax.device_put(jnp.asarray(labels, jnp.float32), row_sharding(mesh))
+    params = jax.device_put(
+        init_params(d_in, hidden, d_out, seed=seed), replicated_sharding(mesh)
+    )
+
+    @jax.jit
+    def step(params, key):
+        # Random mini-batch gather — the genRandomBlocks sampling (:94).
+        idx = jax.random.randint(key, (batch_size,), 0, n)
+        x, y = x_all[idx], y_all[idx]
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new_params = jax.tree.map(lambda p, g: p - learning_rate * g, params, grads)
+        return new_params, loss
+
+    key = jax.random.PRNGKey(hash_seed(seed) + 1)
+    loss = None
+    for i in range(iterations):
+        key, sub = jax.random.split(key)
+        params, loss = step(params, sub)
+    return params, float(loss)
+
+
+def save_weights_csv(params, out_dir: str) -> None:
+    """CSV export like the reference's csvwrite (NeuralNetwork.scala:260-261)."""
+    os.makedirs(out_dir, exist_ok=True)
+    for name, w in params.items():
+        np.savetxt(os.path.join(out_dir, f"{name}.csv"), np.asarray(w), delimiter=",")
+
+
+def load_mnist_csv(path: str, d_in: int = 784, d_out: int = 10):
+    """Rows: label,pix,pix,... (the loadMNISTImages analogue, :33-85)."""
+    raw = np.loadtxt(path, delimiter=",")
+    labels = np.eye(d_out)[raw[:, 0].astype(int)]
+    images = raw[:, 1:] / 255.0
+    return images, labels
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--images", help="MNIST csv: label,pix,...")
+    p.add_argument("--synthetic", type=int, metavar="N", help="N synthetic samples")
+    p.add_argument("--d-in", type=int, default=784)
+    p.add_argument("--d-out", type=int, default=10)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--iterations", type=int, default=50)
+    p.add_argument("--learning-rate", type=float, default=0.5)
+    p.add_argument("--output", help="directory for weight CSVs")
+    args = p.parse_args(argv)
+
+    if args.images:
+        images, labels = load_mnist_csv(args.images, args.d_in, args.d_out)
+    elif args.synthetic:
+        rng = np.random.default_rng(0)
+        images = rng.random((args.synthetic, args.d_in))
+        classes = rng.integers(0, args.d_out, args.synthetic)
+        labels = np.eye(args.d_out)[classes]
+    else:
+        p.error("give --images or --synthetic N")
+
+    t0 = time.perf_counter()
+    params, loss = train(
+        images,
+        labels,
+        hidden=args.hidden,
+        batch_size=args.batch_size,
+        iterations=args.iterations,
+        learning_rate=args.learning_rate,
+    )
+    dt = time.perf_counter() - t0
+    if args.output:
+        save_weights_csv(params, args.output)
+    print(
+        json.dumps(
+            {
+                "example": "NeuralNetwork",
+                "samples": int(images.shape[0]),
+                "hidden": args.hidden,
+                "iterations": args.iterations,
+                "final_loss": round(loss, 6),
+                "seconds": round(dt, 6),
+                **({"output": args.output} if args.output else {}),
+            }
+        )
+    )
+    return params
+
+
+if __name__ == "__main__":
+    main()

