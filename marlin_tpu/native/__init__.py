@@ -1,0 +1,133 @@
+"""Native (C++) host-side components.
+
+The reference's native layer is the netlib/OpenBLAS JNI kernels plus a C++
+matrix-file generator (SURVEY.md §2.7). Here the per-device kernels are XLA's
+job; the native layer is the host-side data path: a C++ text codec for the
+dense ``row:v,v,...`` format (textio.cpp), bound via ctypes (the image has no
+pybind11). The library is compiled on first use with g++ into
+``_build/libmarlin_textio.so``; every consumer falls back to the pure-Python
+parser when the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libmarlin_textio.so")
+_SRC = os.path.join(_HERE, "textio.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.marlin_textio_probe.restype = ctypes.c_int
+        lib.marlin_textio_probe.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.marlin_textio_parse.restype = ctypes.c_int
+        lib.marlin_textio_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        lib.marlin_textio_format.restype = ctypes.c_int
+        lib.marlin_textio_format.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.marlin_textio_free.restype = None
+        lib.marlin_textio_free.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_dense_text(data: bytes) -> Optional[np.ndarray]:
+    """Parse ``row:v,v,...`` text into a float64 array, or None if the native
+    codec is unavailable. Raises ValueError on malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_lines = ctypes.c_int64()
+    max_index = ctypes.c_int64()
+    width = ctypes.c_int64()
+    rc = lib.marlin_textio_probe(
+        data, len(data), ctypes.byref(n_lines), ctypes.byref(max_index), ctypes.byref(width)
+    )
+    if rc != 0:
+        raise ValueError(f"malformed matrix text at line {n_lines.value}")
+    if max_index.value < 0:
+        raise ValueError("no matrix rows found")
+    out = np.zeros((max_index.value + 1, width.value), dtype=np.float64)
+    rc = lib.marlin_textio_parse(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), width.value
+    )
+    if rc != 0:
+        raise ValueError("malformed matrix text")
+    return out
+
+
+def format_dense_text(arr: np.ndarray) -> Optional[bytes]:
+    """Format a 2-D array as ``row:v,v,...`` text, or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(arr, dtype=np.float64)
+    buf = ctypes.c_char_p()
+    out_len = ctypes.c_int64()
+    rc = lib.marlin_textio_format(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        arr.shape[0],
+        arr.shape[1],
+        ctypes.byref(buf),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None
+    try:
+        return ctypes.string_at(buf, out_len.value)
+    finally:
+        lib.marlin_textio_free(buf)

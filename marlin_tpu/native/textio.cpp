@@ -1,0 +1,146 @@
+// textio — fast C++ codec for the dense `row:v,v,...` text matrix format.
+//
+// The reference's data path runs through JVM/Hadoop text I/O with native
+// (netlib) kernels underneath; here the compute path is XLA and the host-side
+// data loader is this C++ codec (SURVEY.md §2.7: the native layer obligation).
+// Exposed via a C ABI consumed with ctypes (no pybind11 in the image).
+//
+// Format per line:  <rowIndex>:<v>(,<v>)*   — separators may also be spaces.
+//
+// Two-phase protocol:
+//   marlin_textio_probe(buf, len, &n_lines, &max_index, &width)
+//   marlin_textio_parse(buf, len, out /* (max_index+1) x width, zeroed by
+//                       caller */, width)
+// and the writer:
+//   marlin_textio_format(values, rows, cols, &out_buf, &out_len) +
+//   marlin_textio_free(out_buf)
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan the buffer: count data lines, the maximum row index, and the widest
+// row. Returns 0 on success, -1 on a malformed line (its 1-based line number
+// is stored in *n_lines for diagnostics).
+int marlin_textio_probe(const char* buf, int64_t len, int64_t* n_lines,
+                        int64_t* max_index, int64_t* width) {
+  *n_lines = 0;
+  *max_index = -1;
+  *width = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t lineno = 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* eol = nl ? nl : end;
+    ++lineno;
+    p = skip_ws(p, eol);
+    if (p < eol) {  // non-empty line
+      char* after = nullptr;
+      const long long idx = strtoll(p, &after, 10);
+      if (after == p || after >= eol || *after != ':' || idx < 0) {
+        *n_lines = lineno;
+        return -1;
+      }
+      int64_t w = 0;
+      const char* q = after + 1;
+      while (q < eol) {
+        q = skip_ws(q, eol);
+        if (q >= eol) break;
+        char* vend = nullptr;
+        strtod(q, &vend);
+        if (vend == q) {
+          *n_lines = lineno;
+          return -1;
+        }
+        ++w;
+        q = vend;
+        q = skip_ws(q, eol);
+        if (q < eol && *q == ',') ++q;
+      }
+      if (w == 0) {
+        *n_lines = lineno;
+        return -1;
+      }
+      if (idx > *max_index) *max_index = idx;
+      if (w > *width) *width = w;
+      ++*n_lines;
+    }
+    p = eol + 1;
+  }
+  return 0;
+}
+
+// Parse into a row-major (max_index+1) x width array the caller allocated and
+// zeroed. Rows may appear in any order; missing rows stay zero. Returns 0 on
+// success.
+int marlin_textio_parse(const char* buf, int64_t len, double* out,
+                        int64_t width) {
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* eol = nl ? nl : end;
+    p = skip_ws(p, eol);
+    if (p < eol) {
+      char* after = nullptr;
+      const long long idx = strtoll(p, &after, 10);
+      if (after == p || *after != ':') return -1;
+      double* row = out + idx * width;
+      int64_t c = 0;
+      const char* q = after + 1;
+      while (q < eol && c < width) {
+        q = skip_ws(q, eol);
+        if (q >= eol) break;
+        char* vend = nullptr;
+        const double v = strtod(q, &vend);
+        if (vend == q) return -1;
+        row[c++] = v;
+        q = skip_ws(vend, eol);
+        if (q < eol && *q == ',') ++q;
+      }
+    }
+    p = eol + 1;
+  }
+  return 0;
+}
+
+// Format a row-major rows x cols array into `row:v,v,...` lines. Allocates
+// *out_buf (caller frees with marlin_textio_free); stores the byte length in
+// *out_len. Returns 0 on success.
+int marlin_textio_format(const double* values, int64_t rows, int64_t cols,
+                         char** out_buf, int64_t* out_len) {
+  // %.17g worst case ~24 chars + separator; row prefix ~22.
+  const size_t cap =
+      static_cast<size_t>(rows) * (static_cast<size_t>(cols) * 26 + 24) + 1;
+  char* buf = static_cast<char*>(malloc(cap));
+  if (!buf) return -1;
+  char* w = buf;
+  for (int64_t r = 0; r < rows; ++r) {
+    w += sprintf(w, "%" PRId64 ":", r);
+    for (int64_t c = 0; c < cols; ++c) {
+      w += sprintf(w, c + 1 == cols ? "%.17g" : "%.17g,", values[r * cols + c]);
+    }
+    *w++ = '\n';
+  }
+  *out_buf = buf;
+  *out_len = w - buf;
+  return 0;
+}
+
+void marlin_textio_free(char* buf) { free(buf); }
+
+}  // extern "C"

@@ -62,10 +62,23 @@ def _fmt(v: float) -> str:
 # ---------------------------------------------------------------------------
 
 
-def load_dense_matrix(path: str, mesh=None, dtype=None):
-    """``row:csv`` text -> DenseVecMatrix (loadMatrixFile, MTUtils.scala:286)."""
+def load_dense_matrix(path: str, mesh=None, dtype=None, use_native: bool = True):
+    """``row:csv`` text -> DenseVecMatrix (loadMatrixFile, MTUtils.scala:286).
+
+    Uses the C++ textio codec (marlin_tpu.native) when available — the
+    host-side native data loader — with a pure-Python fallback."""
     from ..config import get_config
     from ..matrix.dense import DenseVecMatrix
+
+    if use_native:
+        from .. import native
+
+        if native.available():
+            data = b"\n".join(l.encode() for l in _data_lines(path))
+            arr = native.parse_dense_text(data)
+            if arr is not None:
+                arr = arr.astype(np.dtype(dtype or get_config().default_dtype), copy=False)
+                return DenseVecMatrix(arr, mesh=mesh, dtype=arr.dtype)
 
     rows = []
     width = 0
@@ -83,9 +96,22 @@ def load_dense_matrix(path: str, mesh=None, dtype=None):
     return DenseVecMatrix(arr, mesh=mesh, dtype=arr.dtype)
 
 
-def save_dense_matrix(mat, path: str, parts: Optional[int] = None) -> None:
+def save_dense_matrix(
+    mat, path: str, parts: Optional[int] = None, use_native: bool = True
+) -> None:
     """DenseVecMatrix -> ``row:csv`` part-files in a directory."""
     arr = mat.to_numpy()
+    if use_native and parts in (None, 1):
+        from .. import native
+
+        if native.available():
+            text = native.format_dense_text(arr)
+            if text is not None:
+                os.makedirs(path, exist_ok=True)
+                with open(os.path.join(path, "part-00000"), "wb") as f:
+                    f.write(text)
+                open(os.path.join(path, "_SUCCESS"), "w").close()
+                return
     _write_parts(
         path,
         [f"{i}:{','.join(_fmt(v) for v in arr[i])}" for i in range(arr.shape[0])],

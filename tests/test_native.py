@@ -1,0 +1,87 @@
+"""Native C++ layer tests: textio codec (ctypes) and the generate_matrix tool."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from marlin_tpu import native
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.utils.io import load_dense_matrix
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+@pytest.fixture(scope="module")
+def native_ok():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    return True
+
+
+class TestTextIOCodec:
+    def test_roundtrip(self, native_ok, rng):
+        arr = rng.standard_normal((17, 9))
+        text = native.format_dense_text(arr)
+        back = native.parse_dense_text(text)
+        np.testing.assert_allclose(back, arr)  # %.17g is exact for float64
+
+    def test_parse_variants(self, native_ok):
+        back = native.parse_dense_text(b"0:1.0,2.0\n2:5.0 6.0\n1:3.0, 4.0\n")
+        np.testing.assert_allclose(back, [[1, 2], [3, 4], [5, 6]])
+
+    def test_malformed_raises(self, native_ok):
+        with pytest.raises(ValueError, match="line 2"):
+            native.parse_dense_text(b"0:1.0,2.0\nnot-a-row\n")
+
+    def test_matches_python_path(self, native_ok, rng, tmp_path):
+        arr = rng.standard_normal((11, 6))
+        p_native = str(tmp_path / "n")
+        p_python = str(tmp_path / "p")
+        m = DenseVecMatrix(arr)
+        m.save_to_file_system(p_native)
+        from marlin_tpu.utils.io import save_dense_matrix
+
+        save_dense_matrix(m, p_python, use_native=False)
+        a = load_dense_matrix(p_native, use_native=True).to_numpy()
+        b = load_dense_matrix(p_python, use_native=False).to_numpy()
+        np.testing.assert_allclose(a, arr)
+        np.testing.assert_allclose(b, arr)
+
+
+class TestGenerateMatrixTool:
+    @pytest.fixture(scope="class")
+    def binary(self, tmp_path_factory):
+        build = tmp_path_factory.mktemp("tools")
+        out = str(build / "generate_matrix")
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-o", out,
+                 os.path.join(TOOLS, "generate_matrix.cpp")],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            pytest.skip("g++ unavailable")
+        return out
+
+    def test_output_loads_as_matrix(self, binary, tmp_path):
+        out = subprocess.run(
+            [binary, "8", "5", "7"], check=True, capture_output=True, timeout=60
+        ).stdout
+        f = tmp_path / "gen.txt"
+        f.write_bytes(out)
+        m = load_dense_matrix(str(f))
+        assert m.shape == (8, 5)
+        vals = m.to_numpy()
+        assert (-1 <= vals).all() and (vals < 1).all()
+
+    def test_deterministic_by_seed(self, binary):
+        a = subprocess.run([binary, "4", "4", "9"], capture_output=True, timeout=60).stdout
+        b = subprocess.run([binary, "4", "4", "9"], capture_output=True, timeout=60).stdout
+        c = subprocess.run([binary, "4", "4", "10"], capture_output=True, timeout=60).stdout
+        assert a == b and a != c
+
+    def test_usage_error(self, binary):
+        r = subprocess.run([binary], capture_output=True, timeout=60)
+        assert r.returncode == 1 and b"usage" in r.stderr

@@ -1,0 +1,67 @@
+// generate_matrix — standalone generator for the dense text matrix format.
+//
+// Counterpart of the reference's tools/generateMatrix.cpp (26-line C++ tool,
+// tools/README.md:2): writes `row:v,v,...` lines to stdout so generated files
+// interoperate with both frameworks' loaders (MTUtils.loadMatrixFile format).
+//
+// Usage: ./generate_matrix <rows> <cols> [seed] [lo] [hi] > matrix.txt
+//
+// Values are uniform in [lo, hi) (default [-1, 1)), from a seeded xorshift64*
+// generator so output is reproducible.
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct XorShift64Star {
+  uint64_t state;
+  explicit XorShift64Star(uint64_t seed) : state(seed ? seed : 0x9E3779B97F4A7C15ULL) {}
+  uint64_t next() {
+    state ^= state >> 12;
+    state ^= state << 25;
+    state ^= state >> 27;
+    return state * 0x2545F4914F6CDD1DULL;
+  }
+  double uniform() {  // [0, 1)
+    return (next() >> 11) * (1.0 / 9007199254740992.0);
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <rows> <cols> [seed] [lo] [hi]\n", argv[0]);
+    return 1;
+  }
+  const long rows = std::strtol(argv[1], nullptr, 10);
+  const long cols = std::strtol(argv[2], nullptr, 10);
+  const uint64_t seed = argc > 3 ? std::strtoull(argv[3], nullptr, 10) : 42;
+  const double lo = argc > 4 ? std::strtod(argv[4], nullptr) : -1.0;
+  const double hi = argc > 5 ? std::strtod(argv[5], nullptr) : 1.0;
+  if (rows <= 0 || cols <= 0 || hi <= lo) {
+    std::fprintf(stderr, "invalid arguments\n");
+    return 1;
+  }
+
+  XorShift64Star rng(seed);
+  // One output buffer per row keeps this I/O-bound path in large writes.
+  const size_t cap = 32 * static_cast<size_t>(cols) + 32;
+  char* buf = static_cast<char*>(std::malloc(cap));
+  if (!buf) return 1;
+  for (long r = 0; r < rows; ++r) {
+    char* p = buf;
+    p += std::sprintf(p, "%ld:", r);
+    for (long c = 0; c < cols; ++c) {
+      const double v = lo + (hi - lo) * rng.uniform();
+      p += std::sprintf(p, c + 1 == cols ? "%.6f" : "%.6f,", v);
+    }
+    *p++ = '\n';
+    std::fwrite(buf, 1, static_cast<size_t>(p - buf), stdout);
+  }
+  std::free(buf);
+  return 0;
+}
