@@ -3,3 +3,11 @@ from .block import BlockMatrix
 from .dense import DenseVecMatrix
 from .sparse import CoordinateMatrix, MatrixEntry, SparseVecMatrix
 from .vector import DistributedIntVector, DistributedVector
+from .local import (
+    DenseMatrix,
+    DenseVector,
+    Matrices,
+    SparseMatrix,
+    SparseVector,
+    Vectors,
+)

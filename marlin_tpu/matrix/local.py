@@ -1,0 +1,338 @@
+"""Local (single-host) matrix & vector types and kernels — the L0 layer.
+
+Counterparts of ``Matrices.scala`` (local ``DenseMatrix`` column-major,
+``SparseMatrix`` as compressed sparse columns with a hand-written
+column-compressed multiply, Matrices.scala:48-173), ``Vectors.scala`` (local
+dense/sparse vectors with Writable binary serialization, Vectors.scala:61-278),
+``LibMatrixMult`` (mixed-sparsity GEMM kernels, LibMatrixMult.scala:15-77) and
+the ``DenseVecMatrix`` companion kernels ``dspr``/``triuToFull``
+(DenseVecMatrix.scala:1691-1722).
+
+Role in the TPU build: the *device* kernels are XLA's (jnp.dot on the MXU) —
+these local types exist for (a) API/test parity with the reference's L0 suite
+(LocalMatrixSuite golden tests), (b) host-side staging of sparse data in CSC
+before densify-to-device, and (c) the binary serialization format the
+reference carried via Hadoop ``Writable``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Local vectors (Vectors.scala)
+# ---------------------------------------------------------------------------
+
+
+class DenseVector:
+    """Local dense vector (Vectors.scala DenseVector)."""
+
+    def __init__(self, values):
+        self.values = np.asarray(values, dtype=np.float64)
+
+    @property
+    def size(self) -> int:
+        return int(self.values.shape[0])
+
+    def add(self, other: "DenseVector") -> "DenseVector":
+        return DenseVector(self.values + other.values)
+
+    def subtract(self, other: "DenseVector") -> "DenseVector":
+        return DenseVector(self.values - other.values)
+
+    def dot(self, other: "DenseVector") -> float:
+        return float(self.values @ other.values)
+
+    def to_numpy(self) -> np.ndarray:
+        return self.values
+
+    # Binary serialization — the Writable write/readFields analogue
+    # (Vectors.scala:174-187): tag byte, length, payload.
+    def to_bytes(self) -> bytes:
+        return struct.pack("<bq", 0, self.size) + self.values.tobytes()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "DenseVector":
+        tag, n = struct.unpack_from("<bq", data)
+        if tag != 0:
+            raise ValueError("not a DenseVector payload")
+        off = struct.calcsize("<bq")
+        return DenseVector(np.frombuffer(data, np.float64, count=n, offset=off).copy())
+
+    def __eq__(self, other):
+        return isinstance(other, DenseVector) and np.array_equal(self.values, other.values)
+
+    def __repr__(self):
+        return f"DenseVector({self.values.tolist()})"
+
+
+class SparseVector:
+    """Local sparse vector (Vectors.scala SparseVector): size + parallel
+    index/value arrays."""
+
+    def __init__(self, size: int, indices, values):
+        self.size = int(size)
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.values = np.asarray(values, dtype=np.float64)
+        if self.indices.shape != self.values.shape:
+            raise ValueError("indices and values must have equal lengths")
+        if self.indices.size and (
+            self.indices.min() < 0 or self.indices.max() >= self.size
+        ):
+            raise ValueError("index out of range")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    def to_dense(self) -> DenseVector:
+        out = np.zeros(self.size)
+        out[self.indices] = self.values
+        return DenseVector(out)
+
+    def to_numpy(self) -> np.ndarray:
+        return self.to_dense().values
+
+    # Writable analogue (Vectors.scala:252-278).
+    def to_bytes(self) -> bytes:
+        head = struct.pack("<bqq", 1, self.size, self.nnz)
+        return head + self.indices.tobytes() + self.values.tobytes()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "SparseVector":
+        tag, size, nnz = struct.unpack_from("<bqq", data)
+        if tag != 1:
+            raise ValueError("not a SparseVector payload")
+        off = struct.calcsize("<bqq")
+        idx = np.frombuffer(data, np.int64, count=nnz, offset=off).copy()
+        off += 8 * nnz
+        vals = np.frombuffer(data, np.float64, count=nnz, offset=off).copy()
+        return SparseVector(size, idx, vals)
+
+    def __repr__(self):
+        return f"SparseVector({self.size}, {self.indices.tolist()}, {self.values.tolist()})"
+
+
+class Vectors:
+    """Factories (Vectors.scala:61-139)."""
+
+    @staticmethod
+    def dense(*values) -> DenseVector:
+        if len(values) == 1 and np.ndim(values[0]) == 1:
+            return DenseVector(values[0])
+        return DenseVector(values)
+
+    @staticmethod
+    def sparse(size: int, indices, values) -> SparseVector:
+        return SparseVector(size, indices, values)
+
+    @staticmethod
+    def from_numpy(arr) -> DenseVector:
+        return DenseVector(arr)
+
+    @staticmethod
+    def from_bytes(data: bytes):
+        return (
+            DenseVector.from_bytes(data)
+            if data[0] == 0
+            else SparseVector.from_bytes(data)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Local matrices (Matrices.scala)
+# ---------------------------------------------------------------------------
+
+
+class DenseMatrix:
+    """Column-major local dense matrix (Matrices.scala:48-55)."""
+
+    def __init__(self, num_rows: int, num_cols: int, values):
+        self.num_rows = int(num_rows)
+        self.num_cols = int(num_cols)
+        self.values = np.asarray(values, dtype=np.float64).reshape(-1)
+        if self.values.size != self.num_rows * self.num_cols:
+            raise ValueError(
+                f"values length {self.values.size} != {num_rows}x{num_cols}"
+            )
+
+    def to_numpy(self) -> np.ndarray:
+        return self.values.reshape((self.num_rows, self.num_cols), order="F")
+
+    def __call__(self, i: int, j: int) -> float:
+        return float(self.values[j * self.num_rows + i])
+
+    def __repr__(self):
+        return f"DenseMatrix({self.num_rows}x{self.num_cols})"
+
+
+class SparseMatrix:
+    """CSC local sparse matrix (Matrices.scala:57-153: per-column sparse
+    vectors; canonical CSC here)."""
+
+    def __init__(self, num_rows: int, num_cols: int, col_ptrs, row_indices, values):
+        self.num_rows = int(num_rows)
+        self.num_cols = int(num_cols)
+        self.col_ptrs = np.asarray(col_ptrs, dtype=np.int64)
+        self.row_indices = np.asarray(row_indices, dtype=np.int64)
+        self.values = np.asarray(values, dtype=np.float64)
+        if self.col_ptrs.shape[0] != self.num_cols + 1:
+            raise ValueError("col_ptrs must have num_cols + 1 entries")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    @staticmethod
+    def from_dense(arr) -> "SparseMatrix":
+        arr = np.asarray(arr, dtype=np.float64)
+        rows, cols = arr.shape
+        col_ptrs = [0]
+        ridx, vals = [], []
+        for j in range(cols):
+            nz = np.nonzero(arr[:, j])[0]
+            ridx.extend(nz.tolist())
+            vals.extend(arr[nz, j].tolist())
+            col_ptrs.append(len(ridx))
+        return SparseMatrix(rows, cols, col_ptrs, ridx, vals)
+
+    def to_dense(self) -> np.ndarray:
+        """(``toDense``, Matrices.scala:106)."""
+        out = np.zeros((self.num_rows, self.num_cols))
+        for j in range(self.num_cols):
+            lo, hi = self.col_ptrs[j], self.col_ptrs[j + 1]
+            out[self.row_indices[lo:hi], j] = self.values[lo:hi]
+        return out
+
+    to_numpy = to_dense
+
+    def multiply(self, other: "SparseMatrix") -> "SparseMatrix":
+        """Column-compressed sparse x sparse (the ``multiply`` +
+        ``vectMultiplyAdd`` kernel, Matrices.scala:122-152): for each output
+        column, axpy the left columns selected by the right column's entries
+        into a dense accumulator, then compress."""
+        if self.num_cols != other.num_rows:
+            raise ValueError(
+                f"dimension mismatch: {self.num_rows}x{self.num_cols} x "
+                f"{other.num_rows}x{other.num_cols}"
+            )
+        col_ptrs = [0]
+        ridx, vals = [], []
+        acc = np.zeros(self.num_rows)
+        for j in range(other.num_cols):
+            acc[:] = 0.0
+            lo, hi = other.col_ptrs[j], other.col_ptrs[j + 1]
+            for t in range(lo, hi):
+                k = other.row_indices[t]
+                b_kj = other.values[t]
+                llo, lhi = self.col_ptrs[k], self.col_ptrs[k + 1]
+                # vectMultiplyAdd: acc[rows(k)] += b_kj * vals(k)
+                acc[self.row_indices[llo:lhi]] += b_kj * self.values[llo:lhi]
+            nz = np.nonzero(acc)[0]
+            ridx.extend(nz.tolist())
+            vals.extend(acc[nz].tolist())
+            col_ptrs.append(len(ridx))
+        return SparseMatrix(self.num_rows, other.num_cols, col_ptrs, ridx, vals)
+
+    @staticmethod
+    def rand(num_rows: int, num_cols: int, sparsity: float, seed=0) -> "SparseMatrix":
+        """(``SparseMatrix.rand``, Matrices.scala:157-173)."""
+        rng = np.random.default_rng(seed)
+        dense = rng.random((num_rows, num_cols))
+        dense[rng.random((num_rows, num_cols)) >= sparsity] = 0.0
+        return SparseMatrix.from_dense(dense)
+
+    def __repr__(self):
+        return f"SparseMatrix({self.num_rows}x{self.num_cols}, nnz={self.nnz})"
+
+
+class Matrices:
+    """Factories (Matrices.scala:179-208)."""
+
+    @staticmethod
+    def dense(num_rows: int, num_cols: int, values) -> DenseMatrix:
+        return DenseMatrix(num_rows, num_cols, values)
+
+    @staticmethod
+    def from_numpy(arr) -> DenseMatrix:
+        arr = np.asarray(arr, dtype=np.float64)
+        return DenseMatrix(arr.shape[0], arr.shape[1], arr.flatten(order="F"))
+
+    @staticmethod
+    def sparse_from_numpy(arr) -> SparseMatrix:
+        return SparseMatrix.from_dense(arr)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-sparsity GEMM kernels (LibMatrixMult.scala)
+# ---------------------------------------------------------------------------
+
+
+def mult_dense_sparse(dense: np.ndarray, sparse: SparseMatrix) -> np.ndarray:
+    """Dense x CSC (``multDenseSparse``, LibMatrixMult.scala:15-41, including
+    its copy shortcut for singleton 1.0-valued columns)."""
+    dense = np.asarray(dense, dtype=np.float64)
+    if dense.shape[1] != sparse.num_rows:
+        raise ValueError("dimension mismatch")
+    out = np.zeros((dense.shape[0], sparse.num_cols))
+    for j in range(sparse.num_cols):
+        lo, hi = sparse.col_ptrs[j], sparse.col_ptrs[j + 1]
+        if hi - lo == 1 and sparse.values[lo] == 1.0:
+            # Copy shortcut: column j of the product is a column of `dense`.
+            out[:, j] = dense[:, sparse.row_indices[lo]]
+        elif hi > lo:
+            out[:, j] = dense[:, sparse.row_indices[lo:hi]] @ sparse.values[lo:hi]
+    return out
+
+
+def mult_sparse_dense(sparse: SparseMatrix, dense: np.ndarray) -> np.ndarray:
+    """CSC x dense (``multSparseDense``, LibMatrixMult.scala:43-77; the 32x32
+    cache blocking there is moot for a vectorized scatter-axpy)."""
+    dense = np.asarray(dense, dtype=np.float64)
+    if sparse.num_cols != dense.shape[0]:
+        raise ValueError("dimension mismatch")
+    out = np.zeros((sparse.num_rows, dense.shape[1]))
+    for k in range(sparse.num_cols):
+        lo, hi = sparse.col_ptrs[k], sparse.col_ptrs[k + 1]
+        if hi > lo:
+            np.add.at(
+                out,
+                sparse.row_indices[lo:hi],
+                sparse.values[lo:hi, None] * dense[k][None, :],
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Packed symmetric kernels (DenseVecMatrix companion, :1691-1722)
+# ---------------------------------------------------------------------------
+
+
+def dspr(alpha: float, x: np.ndarray, packed: np.ndarray) -> np.ndarray:
+    """Packed upper-triangular rank-1 update U += alpha * x x^T (``dspr``,
+    DenseVecMatrix.scala:1691; column-major packed upper layout, in place)."""
+    x = np.asarray(x, dtype=np.float64)
+    n = x.shape[0]
+    if packed.shape[0] != n * (n + 1) // 2:
+        raise ValueError("packed buffer has wrong length")
+    pos = 0
+    for j in range(n):
+        packed[pos : pos + j + 1] += alpha * x[j] * x[: j + 1]
+        pos += j + 1
+    return packed
+
+
+def triu_to_full(n: int, packed: np.ndarray) -> np.ndarray:
+    """Expand a packed upper triangle to a full symmetric matrix
+    (``triuToFull``, DenseVecMatrix.scala:1702)."""
+    out = np.zeros((n, n))
+    pos = 0
+    for j in range(n):
+        out[: j + 1, j] = packed[pos : pos + j + 1]
+        out[j, : j + 1] = packed[pos : pos + j + 1]
+        pos += j + 1
+    return out

@@ -1,2 +1,3 @@
-from . import summa
+from . import ring, summa
+from .ring import ring_matmul, ring_self_attention
 from .summa import matmul, matmul_3d

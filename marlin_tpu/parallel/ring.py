@@ -1,0 +1,201 @@
+"""Ring / streaming dimension parallelism — the long-context engine.
+
+The reference scales one logical dimension past single-node memory by
+row-chunking and arbitrary re-blocking (SURVEY.md §5 long-context:
+``DenseVecMatrix`` rows, ``toBlockMatrix`` re-gridding). The TPU-native
+first-class version: keep the giant dimension sharded over the mesh ring and
+STREAM the other operand with ``ppermute`` so no device ever materializes a
+full panel — the ring-attention communication pattern applied to this
+library's workloads.
+
+* :func:`ring_matmul` — C = A @ B with the contraction dimension k sharded:
+  each device holds its row stripe of A and ONE k-chunk of B at a time; B
+  chunks rotate around the ICI ring, overlapping compute with the permute.
+  Peak memory per device: m/P x k (A stripe) + k/P x n (one B chunk), vs the
+  all-gather SUMMA's k x n/P panel.
+
+* :func:`ring_self_attention` — blockwise-softmax ring attention over a
+  sequence dimension sharded on the ring: Q stays local, K/V blocks rotate,
+  the softmax is accumulated online (running max + denominator), so sequences
+  scale with the number of devices. Beyond the reference's capability set, but
+  the canonical long-context primitive this framework is expected to carry.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import get_config
+from ..mesh import default_mesh
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """All mesh axes flattened into one logical ring."""
+    return tuple(mesh.axis_names)
+
+
+def _pad_dim(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    extra = (-x.shape[axis]) % mult
+    if not extra:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, extra)
+    return jnp.pad(x, pads)
+
+
+# ---------------------------------------------------------------------------
+# Ring GEMM
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _ring_matmul_fn(mesh: Mesh, n_dev: int, precision: str):
+    axes = _ring_axes(mesh)
+
+    def kernel(a_blk, b_blk):
+        # a_blk: (m/P, k) — full contraction stripe of A rows.
+        # b_blk: (k/P, n) — ONE k-chunk of B; rotates around the ring.
+        i = jax.lax.axis_index(axes)
+        chunk = b_blk.shape[0]
+        perm = [(s, (s - 1) % n_dev) for s in range(n_dev)]
+
+        def step(t, carry):
+            b_cur, acc = carry
+            src = (i + t) % n_dev  # which k-chunk we hold at step t
+            a_chunk = jax.lax.dynamic_slice_in_dim(a_blk, src * chunk, chunk, axis=1)
+            acc = acc + jnp.dot(a_chunk, b_cur, precision=precision)
+            b_next = jax.lax.ppermute(b_cur, axes, perm)
+            return b_next, acc
+
+        acc0 = jax.lax.pvary(
+            jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=a_blk.dtype), axes
+        )
+        _, acc = jax.lax.fori_loop(0, n_dev, step, (b_blk, acc0))
+        return acc
+
+    f = _shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None)),
+        out_specs=P(axes, None),
+    )
+    return jax.jit(f)
+
+
+def ring_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Optional[Mesh] = None,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """C = A @ B streaming B's k-chunks around the ring."""
+    cfg = get_config()
+    mesh = mesh or default_mesh()
+    precision = precision or cfg.matmul_precision
+    n_dev = len(mesh.devices.flat)
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"inner dimensions mismatch: {a.shape} x {b.shape}")
+    ap = _pad_dim(_pad_dim(a, 0, n_dev), 1, n_dev)
+    bp = _pad_dim(b, 0, n_dev)
+    axes = _ring_axes(mesh)
+    ap = jax.device_put(ap, NamedSharding(mesh, P(axes, None)))
+    bp = jax.device_put(bp, NamedSharding(mesh, P(axes, None)))
+    out = _ring_matmul_fn(mesh, n_dev, precision)(ap, bp)
+    return out[:m, :n] if out.shape != (m, n) else out
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism)
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _ring_attention_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
+    axes = _ring_axes(mesh)
+
+    def kernel(q_blk, k_blk, v_blk):
+        # q_blk: (sq/P, d); k_blk, v_blk: (skv/P, d) — K/V rotate.
+        i = jax.lax.axis_index(axes)
+        perm = [(s, (s - 1) % n_dev) for s in range(n_dev)]
+        sq = q_blk.shape[0]
+        skv = k_blk.shape[0]
+        neg = jnp.asarray(-1e30, q_blk.dtype)
+
+        def step(t, carry):
+            k_cur, v_cur, m_run, l_run, o_run = carry
+            src = (i + t) % n_dev  # which kv block we currently hold
+            logits = scale * jnp.dot(q_blk, k_cur.T)  # (sq/P, skv/P)
+            if causal:
+                q_pos = i * sq + jnp.arange(sq)[:, None]
+                k_pos = src * skv + jnp.arange(skv)[None, :]
+                logits = jnp.where(k_pos <= q_pos, logits, neg)
+            # Online softmax merge (running max + denominator).
+            m_new = jnp.maximum(m_run, jnp.max(logits, axis=1))
+            corr = jnp.exp(m_run - m_new)
+            p = jnp.exp(logits - m_new[:, None])
+            l_new = l_run * corr + jnp.sum(p, axis=1)
+            o_new = o_run * corr[:, None] + jnp.dot(p, v_cur)
+            k_next = jax.lax.ppermute(k_cur, axes, perm)
+            v_next = jax.lax.ppermute(v_cur, axes, perm)
+            return k_next, v_next, m_new, l_new, o_new
+
+        m0 = jax.lax.pvary(jnp.full((sq,), neg, q_blk.dtype), axes)
+        l0 = jax.lax.pvary(jnp.zeros((sq,), q_blk.dtype), axes)
+        o0 = jax.lax.pvary(jnp.zeros((sq, v_blk.shape[1]), q_blk.dtype), axes)
+        _, _, _, l_fin, o_fin = jax.lax.fori_loop(
+            0, n_dev, step, (k_blk, v_blk, m0, l0, o0)
+        )
+        return o_fin / jnp.maximum(l_fin, 1e-30)[:, None]
+
+    f = _shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes, None)),
+        out_specs=P(axes, None),
+    )
+    return jax.jit(f)
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """softmax(Q K^T * scale) V with the sequence dimension sharded on the
+    ring; K/V blocks stream. Shapes: q (sq, d), k (skv, d), v (skv, dv);
+    sq and skv must each be divisible-padded to the device count (zero-pad
+    keys get masked out by the softmax max-shift only if padded — callers
+    should pass divisible lengths; this wrapper pads q only)."""
+    mesh = mesh or default_mesh()
+    n_dev = len(mesh.devices.flat)
+    if k.shape[0] % n_dev != 0:
+        raise ValueError(
+            f"key/value length {k.shape[0]} must divide by {n_dev} devices"
+        )
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[1])
+    sq = q.shape[0]
+    qp = _pad_dim(q, 0, n_dev)
+    axes = _ring_axes(mesh)
+    sh = NamedSharding(mesh, P(axes, None))
+    qp = jax.device_put(qp, sh)
+    kp = jax.device_put(k, sh)
+    vp = jax.device_put(v, sh)
+    out = _ring_attention_fn(mesh, n_dev, causal, float(scale))(qp, kp, vp)
+    return out[:sq] if out.shape[0] != sq else out

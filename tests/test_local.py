@@ -1,0 +1,128 @@
+"""L0 local-kernel tests — mirrors LocalMatrixSuite's golden 4x4 pattern
+(src/test/scala/.../LocalMatrixSuite.scala:8-72): CSC conversion and the three
+multiply kernels against hand-written expected matrices."""
+
+import numpy as np
+import pytest
+
+from marlin_tpu.matrix.local import (
+    DenseMatrix,
+    DenseVector,
+    Matrices,
+    SparseMatrix,
+    SparseVector,
+    Vectors,
+    dspr,
+    mult_dense_sparse,
+    mult_sparse_dense,
+    triu_to_full,
+)
+
+# Golden 4x4 fixtures, hand-checked.
+S = np.array(
+    [
+        [1.0, 0.0, 0.0, 2.0],
+        [0.0, 0.0, 3.0, 0.0],
+        [0.0, 4.0, 0.0, 0.0],
+        [5.0, 0.0, 0.0, 6.0],
+    ]
+)
+D = np.array(
+    [
+        [1.0, 2.0, 3.0, 4.0],
+        [4.0, 3.0, 2.0, 1.0],
+        [1.0, 1.0, 1.0, 1.0],
+        [2.0, 0.0, 2.0, 0.0],
+    ]
+)
+
+
+class TestCSCConversion:
+    def test_from_to_dense(self):
+        sm = SparseMatrix.from_dense(S)
+        assert sm.nnz == 6
+        np.testing.assert_allclose(sm.to_dense(), S)
+        # CSC layout golden check: column pointers count 2,1,1,2 nnz per col.
+        np.testing.assert_array_equal(sm.col_ptrs, [0, 2, 3, 4, 6])
+        np.testing.assert_array_equal(sm.row_indices, [0, 3, 2, 1, 0, 3])
+
+    def test_rand_sparsity(self):
+        sm = SparseMatrix.rand(50, 50, 0.1, seed=1)
+        assert 0.04 < sm.nnz / 2500 < 0.16
+
+
+class TestMultiplyKernels:
+    def test_sparse_x_sparse_golden(self):
+        a = SparseMatrix.from_dense(S)
+        b = SparseMatrix.from_dense(S.T)
+        out = a.multiply(b)
+        np.testing.assert_allclose(out.to_dense(), S @ S.T)
+
+    def test_dense_x_sparse_golden(self):
+        np.testing.assert_allclose(
+            mult_dense_sparse(D, SparseMatrix.from_dense(S)), D @ S
+        )
+
+    def test_dense_x_sparse_copy_shortcut(self):
+        # A singleton 1.0 column triggers the copy shortcut
+        # (LibMatrixMult.scala:15-41).
+        s = np.zeros((4, 3))
+        s[2, 1] = 1.0
+        s[0, 0] = 2.0
+        np.testing.assert_allclose(
+            mult_dense_sparse(D, SparseMatrix.from_dense(s)), D @ s
+        )
+
+    def test_sparse_x_dense_golden(self):
+        np.testing.assert_allclose(
+            mult_sparse_dense(SparseMatrix.from_dense(S), D), S @ D
+        )
+
+    def test_dimension_mismatch(self):
+        with pytest.raises(ValueError):
+            SparseMatrix.from_dense(S).multiply(SparseMatrix.from_dense(S[:3]))
+
+
+class TestLocalDense:
+    def test_column_major(self):
+        m = Matrices.dense(2, 3, [1, 2, 3, 4, 5, 6])
+        np.testing.assert_allclose(m.to_numpy(), [[1, 3, 5], [2, 4, 6]])
+        assert m(1, 2) == 6
+        back = Matrices.from_numpy(m.to_numpy())
+        np.testing.assert_allclose(back.values, m.values)
+
+
+class TestVectors:
+    def test_dense_ops(self):
+        a = Vectors.dense(1.0, 2.0, 3.0)
+        b = Vectors.dense([4.0, 5.0, 6.0])
+        np.testing.assert_allclose(a.add(b).values, [5, 7, 9])
+        np.testing.assert_allclose(b.subtract(a).values, [3, 3, 3])
+        assert a.dot(b) == 32
+
+    def test_sparse_vector(self):
+        s = Vectors.sparse(5, [1, 3], [2.0, 4.0])
+        np.testing.assert_allclose(s.to_numpy(), [0, 2, 0, 4, 0])
+        with pytest.raises(ValueError):
+            Vectors.sparse(3, [5], [1.0])
+
+    def test_binary_serialization_roundtrip(self):
+        # The Writable write/readFields analogue (Vectors.scala:174-187).
+        d = Vectors.dense(1.5, -2.5)
+        assert Vectors.from_bytes(d.to_bytes()) == d
+        s = Vectors.sparse(10, [0, 9], [1.0, 2.0])
+        back = Vectors.from_bytes(s.to_bytes())
+        assert isinstance(back, SparseVector)
+        np.testing.assert_allclose(back.to_numpy(), s.to_numpy())
+
+
+class TestPackedKernels:
+    def test_dspr_and_triu_to_full(self):
+        n = 4
+        rng = np.random.default_rng(0)
+        packed = np.zeros(n * (n + 1) // 2)
+        x1, x2 = rng.standard_normal(n), rng.standard_normal(n)
+        dspr(1.0, x1, packed)
+        dspr(0.5, x2, packed)
+        expected = np.outer(x1, x1) + 0.5 * np.outer(x2, x2)
+        np.testing.assert_allclose(triu_to_full(n, packed), expected, rtol=1e-12)
