@@ -27,6 +27,33 @@ from .config import get_config
 _default_mesh: Optional[Mesh] = None
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-host runtime (the DCN story).
+
+    The reference's multi-node backend is Spark's driver/executor RPC + shuffle
+    service (SURVEY.md §2.8); ours is JAX's distributed runtime: call this once
+    per host before any mesh creation and ``jax.devices()`` becomes the GLOBAL
+    device list — meshes built from it span hosts, XLA routes intra-slice
+    collectives over ICI and cross-slice traffic over DCN. With no arguments,
+    cluster-environment auto-detection is used (TPU pods populate it from
+    metadata).
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
 def squarest_grid(n: int) -> Tuple[int, int]:
     """Factor ``n`` into the most-square (rows, cols) grid, rows >= cols."""
     best = (n, 1)

@@ -1,0 +1,1 @@
+from .block_sparse import BlockSparse, block_sparse_matmul

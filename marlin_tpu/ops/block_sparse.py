@@ -1,0 +1,140 @@
+"""Block-sparse GEMM — a Pallas TPU kernel for the sparse hot path.
+
+The reference's sparse multiply is CSC-kernel-per-block over the shuffle
+(SparseVecMatrix.multiplySparse, LibMatrixMult kernels). TPUs have no gather
+CSC unit — the TPU-shaped sparse format is DENSE BLOCKS with a block mask
+(zero blocks skipped), which keeps every surviving FLOP on the MXU
+(SURVEY.md §7: "blocked dense-within-sparse (Pallas)"). This module provides:
+
+* :class:`BlockSparse` — block-compressed container: (K/bs, N/bs) bool mask +
+  the dense backing array (only masked blocks meaningful).
+* :func:`block_sparse_matmul` — C = A @ B with B block-sparse, as a Pallas
+  kernel: 3-D grid over (M, N, K) tiles, the mask scalar-prefetched into SMEM,
+  and ``pl.when`` skipping the MXU work of empty blocks. (The next step —
+  remapping the grid via prefetched block indices so empty blocks also skip
+  their DMA — is noted at the kernel.)
+
+Falls back to interpreter mode off-TPU so the same code path is testable on
+the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..config import get_config
+
+
+class BlockSparse:
+    """Block-compressed matrix: dense backing + (rows/bs, cols/bs) block mask."""
+
+    def __init__(self, data: jax.Array, mask: jax.Array, block_size: int):
+        if data.shape[0] % block_size or data.shape[1] % block_size:
+            raise ValueError(
+                f"shape {data.shape} not divisible by block_size {block_size}"
+            )
+        expect = (data.shape[0] // block_size, data.shape[1] // block_size)
+        if tuple(mask.shape) != expect:
+            raise ValueError(f"mask shape {mask.shape} != block grid {expect}")
+        self.data = data
+        self.mask = mask.astype(jnp.int32)
+        self.block_size = block_size
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.data.shape
+
+    @property
+    def block_density(self) -> float:
+        return float(np.asarray(self.mask).mean())
+
+    @classmethod
+    def from_dense(cls, arr, block_size: int = 128) -> "BlockSparse":
+        arr = jnp.asarray(arr)
+        pad = [(-s) % block_size for s in arr.shape]
+        if any(pad):
+            arr = jnp.pad(arr, [(0, pad[0]), (0, pad[1])])
+        r, c = arr.shape
+        blocks = arr.reshape(
+            r // block_size, block_size, c // block_size, block_size
+        )
+        mask = jnp.any(blocks != 0, axis=(1, 3))
+        data = jnp.where(
+            jnp.repeat(
+                jnp.repeat(mask, block_size, axis=0), block_size, axis=1
+            ),
+            arr,
+            jnp.zeros((), arr.dtype),
+        )
+        return cls(data, mask, block_size)
+
+    def to_dense(self) -> jax.Array:
+        return self.data
+
+
+def _spmm_kernel(mask_ref, a_ref, b_ref, o_ref):
+    k = pl.program_id(2)
+    j = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    @pl.when(mask_ref[k, j] != 0)
+    def _accumulate():
+        o_ref[:] += jnp.dot(
+            a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+        ).astype(o_ref.dtype)
+
+
+@functools.cache
+def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret):
+    # TODO(perf): remap the grid through prefetched per-column block lists so
+    # empty blocks skip their DMA too, not just their MXU issue.
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(m // bm, n // bn, k // bs),
+            in_specs=[
+                pl.BlockSpec((bm, bs), lambda i, j, kk, mask: (i, kk)),
+                pl.BlockSpec((bs, bn), lambda i, j, kk, mask: (kk, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk, mask: (i, j)),
+        )
+    except (ImportError, AttributeError):  # pragma: no cover
+        grid_spec = None
+
+    f = pl.pallas_call(
+        _spmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), dtype),
+        interpret=interpret,
+    )
+    return jax.jit(f)
+
+
+def block_sparse_matmul(
+    a: jax.Array, b: BlockSparse, interpret: Optional[bool] = None
+) -> jax.Array:
+    """C = A @ B with B block-sparse; empty B blocks issue no MXU work."""
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"dimension mismatch: {a.shape} x {b.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    bs = b.block_size
+    m = a.shape[0]
+    pad_m = (-m) % bs
+    ap = jnp.pad(a, [(0, pad_m), (0, 0)]) if pad_m else a
+    ap = ap.astype(b.data.dtype)
+    out = _spmm_fn(
+        ap.shape[0], b.shape[0], b.shape[1], bs, bs, bs, b.data.dtype, interpret
+    )(b.mask, ap, b.data)
+    return out[:m] if pad_m else out

@@ -1,0 +1,80 @@
+"""Block/shard placement helpers — the partitioner layer's counterpart.
+
+The reference routes data to executors with custom Spark partitioners:
+``MatrixMultPartitioner`` sends a replicated ``BlockID`` to the shuffle
+partition pre-computed in its ``seq`` field (MatrixMultPartitioner.scala:13-20,
+BlockID seq encoding Block.scala:37-48), ``MatrixElemOpPartitioner`` uses the
+grid formula ``row * numBlksByCol + column`` (MatrixElemOpPartitioner.scala:
+13-19), and the NN example co-locates data blocks with label chunks
+(NeuralNetwork.scala:267-290).
+
+On a mesh, placement is DECLARED (NamedSharding) rather than routed, so these
+helpers answer the inverse questions the partitioners answered: which device
+owns a logical block / row / vector chunk, and which (m, k, n)-grid cell a
+replicated GEMM block lands on. They exist for parity, introspection, and for
+host-side loaders that want to feed each device only its own shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+from jax.sharding import Mesh
+
+from ..mesh import axis_sizes, default_mesh
+
+
+@dataclass(frozen=True)
+class BlockID:
+    """Logical block coordinate (Block.scala:37-48). ``seq`` tags replicated
+    copies in the GEMM grid — the reference's shuffle-destination encoding,
+    kept as the 3-D grid cell id here."""
+
+    row: int
+    column: int
+    seq: int = 0
+
+
+def grid_seq(block: BlockID, m_split: int, k_split: int, n_split: int, k: int) -> int:
+    """The destination cell of a replicated block in an (m, k, n) grid — the
+    ``seq`` the reference pre-computes before ``partitionBy``
+    (MatrixMultPartitioner numPartitions = m*k*n)."""
+    return block.row * k_split * n_split + k * n_split + block.column
+
+
+def elem_op_partition(block: BlockID, blks_by_col: int) -> int:
+    """``row * numBlksByCol + column`` (MatrixElemOpPartitioner.scala:13-19)."""
+    return block.row * blks_by_col + block.column
+
+
+def device_for_block(
+    bi: int, bj: int, blks_by_row: int, blks_by_col: int, mesh: Mesh = None
+) -> jax.Device:
+    """Owning device of logical block (bi, bj) under the 2-D block layout
+    (blocks map proportionally onto the mesh grid)."""
+    mesh = mesh or default_mesh()
+    pr, pc = axis_sizes(mesh)
+    di = min(bi * pr // max(blks_by_row, 1), pr - 1)
+    dj = min(bj * pc // max(blks_by_col, 1), pc - 1)
+    return mesh.devices[di][dj]
+
+
+def device_for_row(row: int, num_rows: int, mesh: Mesh = None) -> jax.Device:
+    """Owning device of a logical row under the row-striped layout."""
+    mesh = mesh or default_mesh()
+    devs = list(mesh.devices.flat)
+    stripe = -(-num_rows // len(devs))
+    return devs[min(row // stripe, len(devs) - 1)]
+
+
+def colocated(row: int, chunk: int, num_rows: int, num_chunks: int, mesh: Mesh = None) -> bool:
+    """Whether data row ``row`` and vector chunk ``chunk`` live on the same
+    device — the property NeuralNetworkPartitioner enforced by construction
+    (NeuralNetwork.scala:272-280); here it falls out of using one mesh for
+    both layouts."""
+    mesh = mesh or default_mesh()
+    devs = list(mesh.devices.flat)
+    chunk_dev = devs[min(chunk * len(devs) // max(num_chunks, 1), len(devs) - 1)]
+    return device_for_row(row, num_rows, mesh) == chunk_dev

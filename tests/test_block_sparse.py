@@ -1,0 +1,70 @@
+"""Pallas block-sparse GEMM tests (interpreter mode on the CPU mesh; the same
+kernel compiles for TPU)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from marlin_tpu.ops import BlockSparse, block_sparse_matmul
+
+BS = 8
+
+
+def _block_sparse_dense(rng, rows, cols, keep=0.4):
+    arr = rng.standard_normal((rows, cols)).astype(np.float32)
+    for bi in range(rows // BS):
+        for bj in range(cols // BS):
+            if rng.random() > keep:
+                arr[bi * BS : (bi + 1) * BS, bj * BS : (bj + 1) * BS] = 0
+    return arr
+
+
+class TestBlockSparse:
+    def test_from_dense_mask(self, rng):
+        arr = _block_sparse_dense(rng, 32, 24)
+        b = BlockSparse.from_dense(arr, block_size=BS)
+        assert b.mask.shape == (4, 3)
+        expected_mask = np.array(
+            [
+                [np.any(arr[i * BS : (i + 1) * BS, j * BS : (j + 1) * BS])
+                 for j in range(3)]
+                for i in range(4)
+            ]
+        )
+        np.testing.assert_array_equal(np.asarray(b.mask).astype(bool), expected_mask)
+
+    def test_from_dense_pads(self, rng):
+        arr = rng.standard_normal((10, 13)).astype(np.float32)
+        b = BlockSparse.from_dense(arr, block_size=BS)
+        assert b.shape == (16, 16)
+        np.testing.assert_allclose(np.asarray(b.to_dense())[:10, :13], arr)
+
+    def test_matmul_matches_dense(self, rng):
+        arr = _block_sparse_dense(rng, 40, 24)
+        b = BlockSparse.from_dense(arr, block_size=BS)
+        a = rng.standard_normal((16, 40)).astype(np.float32)
+        out = block_sparse_matmul(jnp.asarray(a), b)
+        np.testing.assert_allclose(np.asarray(out), a @ arr, rtol=1e-4, atol=1e-4)
+
+    def test_matmul_uneven_m_padded(self, rng):
+        arr = _block_sparse_dense(rng, 24, 16)
+        b = BlockSparse.from_dense(arr, block_size=BS)
+        a = rng.standard_normal((11, 24)).astype(np.float32)
+        out = block_sparse_matmul(jnp.asarray(a), b)
+        assert out.shape == (11, 16)
+        np.testing.assert_allclose(np.asarray(out), a @ arr, rtol=1e-4, atol=1e-4)
+
+    def test_all_zero_matrix(self, rng):
+        b = BlockSparse.from_dense(np.zeros((16, 16), np.float32), block_size=BS)
+        a = rng.standard_normal((8, 16)).astype(np.float32)
+        out = block_sparse_matmul(jnp.asarray(a), b)
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    def test_dimension_mismatch(self, rng):
+        b = BlockSparse.from_dense(np.ones((16, 16), np.float32), block_size=BS)
+        with pytest.raises(ValueError):
+            block_sparse_matmul(jnp.ones((4, 8), jnp.float32), b)
+
+    def test_mask_shape_contract(self):
+        with pytest.raises(ValueError):
+            BlockSparse(jnp.ones((16, 16)), jnp.ones((3, 2)), BS)
