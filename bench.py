@@ -42,39 +42,90 @@ def guess_peak() -> float:
     return 197.0
 
 
-def main():
-    mt.set_config(default_dtype=DTYPE, matmul_precision="default")
+# Sync via a scalar fetch: on the remote-tunnel (axon) platform,
+# block_until_ready can return before execution finishes, so the timing fence
+# is a device_get of a reduction over the result.
+_fence = None
+
+
+def fence(mat) -> float:
+    global _fence
+    if _fence is None:
+        _fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    return float(_fence(mat.data))
+
+
+def _timed(fn, iters=5):
+    fence(fn())  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fence(fn())
+    return (time.perf_counter() - t0) / iters
+
+
+def headline():
+    """Config: 32k x 32k auto-dispatch multiply (the MatrixMultiply shape)."""
     n_dev = len(jax.devices())
     a = mrand.random_den_vec_matrix(N, N, seed=1, dtype=DTYPE)
     b = mrand.random_den_vec_matrix(N, N, seed=2, dtype=DTYPE)
-
-    # Sync via a scalar fetch: on the remote-tunnel (axon) platform,
-    # block_until_ready can return before execution finishes, so the timing
-    # fence is a device_get of a reduction over the result.
-    fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
-
-    # Warmup (compile) through the MatrixMultiply call-site shape.
-    float(fence(a.multiply(b).data))
-
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        float(fence(a.multiply(b).data))
-    dt = (time.perf_counter() - t0) / iters
-
-    flops = 2.0 * N * N * N
-    tflops_per_chip = flops / dt / 1e12 / n_dev
+    dt = _timed(lambda: a.multiply(b))
+    tflops_per_chip = 2.0 * N * N * N / dt / 1e12 / n_dev
     target = 0.5 * guess_peak()
-    print(
-        json.dumps(
-            {
-                "metric": "dense_gemm_tflops_per_chip_32k",
-                "value": round(tflops_per_chip, 2),
-                "unit": "TFLOPS/chip",
-                "vs_baseline": round(tflops_per_chip / target, 3),
-            }
-        )
-    )
+    return {
+        "metric": "dense_gemm_tflops_per_chip_32k",
+        "value": round(tflops_per_chip, 2),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(tflops_per_chip / target, 3),
+    }
+
+
+def config_square_8k():
+    """BASELINE config #2: 8192^2 square GEMM."""
+    a = mrand.random_den_vec_matrix(8192, 8192, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(8192, 8192, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b))
+    return {"metric": "gemm_8k_seconds", "value": round(dt, 4), "unit": "s",
+            "vs_baseline": 0}
+
+
+def config_tall_skinny():
+    """BASELINE config #3: 1,000,000 x 512 times 512 x 512 (broadcast path)."""
+    a = mrand.random_den_vec_matrix(1_000_000, 512, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(512, 512, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b))
+    return {"metric": "tall_skinny_seconds", "value": round(dt, 4), "unit": "s",
+            "vs_baseline": 0}
+
+
+def config_chained():
+    """BASELINE config #4: chained A.B.C at 16384^3 (HBM residency stress)."""
+    n = 16384
+    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
+    c = mrand.random_den_vec_matrix(n, n, seed=3, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b).to_dense_vec_matrix().multiply(c), iters=3)
+    tflops = 2 * 2.0 * n**3 / dt / 1e12
+    return {"metric": "chained_abc_16k_tflops", "value": round(tflops, 2),
+            "unit": "TFLOPS", "vs_baseline": 0}
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="headline",
+                   choices=["headline", "square8k", "tallskinny", "chained", "all"])
+    args = p.parse_args()
+    mt.set_config(default_dtype=DTYPE, matmul_precision="default")
+    runs = {
+        "headline": [headline],
+        "square8k": [config_square_8k],
+        "tallskinny": [config_tall_skinny],
+        "chained": [config_chained],
+        "all": [headline, config_square_8k, config_tall_skinny, config_chained],
+    }[args.config]
+    for fn in runs:
+        print(json.dumps(fn()))
 
 
 if __name__ == "__main__":

@@ -244,6 +244,14 @@ class SparseVecMatrix:
 
         return DenseVecMatrix(self._bcoo.todense(), mesh=self.mesh)
 
+    def to_block_sparse(self, block_size: int = 128):
+        """Block-compressed form for the Pallas SpMM kernel
+        (ops.block_sparse) — the TPU-shaped sparse format: dense blocks +
+        block mask, zero blocks skipped on the MXU."""
+        from ..ops.block_sparse import BlockSparse
+
+        return BlockSparse.from_dense(self._bcoo.todense(), block_size=block_size)
+
     def to_numpy(self) -> np.ndarray:
         return np.asarray(self._bcoo.todense())
 

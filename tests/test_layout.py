@@ -1,0 +1,52 @@
+"""Placement-helper tests (partitioner-parity formulas)."""
+
+import jax
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.parallel.layout import (
+    BlockID,
+    colocated,
+    device_for_block,
+    device_for_row,
+    elem_op_partition,
+    grid_seq,
+)
+
+
+class TestPartitionFormulas:
+    def test_grid_seq_covers_all_cells(self):
+        m, k, n = 2, 3, 2
+        seqs = {
+            grid_seq(BlockID(i, j), m, k, n, kk)
+            for i in range(m)
+            for j in range(n)
+            for kk in range(k)
+        }
+        assert seqs == set(range(m * k * n))  # numPartitions = m*k*n
+
+    def test_elem_op_partition(self):
+        assert elem_op_partition(BlockID(2, 1), blks_by_col=4) == 9
+
+
+class TestDeviceOwnership:
+    def test_block_owner_in_mesh(self):
+        mesh = mt.default_mesh()
+        devs = set(mesh.devices.flat)
+        owners = {
+            device_for_block(bi, bj, 4, 4, mesh) for bi in range(4) for bj in range(4)
+        }
+        assert owners <= devs and len(owners) == 8  # 4x4 grid over 4x2 mesh
+
+    def test_row_striping(self):
+        mesh = mt.default_mesh()
+        devs = list(mesh.devices.flat)
+        assert device_for_row(0, 80, mesh) == devs[0]
+        assert device_for_row(79, 80, mesh) == devs[-1]
+
+    def test_colocation_matches_striping(self):
+        mesh = mt.default_mesh()
+        # Row stripe i and chunk i of an equally-chunked vector share a device.
+        assert colocated(0, 0, 64, 8, mesh)
+        assert colocated(63, 7, 64, 8, mesh)
+        assert not colocated(0, 7, 64, 8, mesh)
