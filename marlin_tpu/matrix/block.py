@@ -215,6 +215,12 @@ class BlockMatrix(DistributedMatrix):
 
         return DenseVecMatrix(self.logical, mesh=self.mesh)
 
+    def to_dense_blocks(self) -> "BlockMatrix":
+        """API parity with ``toDenseBlocks`` (BlockMatrix.scala:596), which
+        densifies sparse SubMatrix blocks. Blocks here are always dense XLA
+        shards, so this is the identity."""
+        return self
+
     def to_block_matrix(self, blks_by_row: int, blks_by_col: int) -> "BlockMatrix":
         """Re-grid (``toBlockMatrix``, BlockMatrix.scala:610): in the reference
         a full shuffle through ``MTUtils.splitMethod``'s split-status plan; here

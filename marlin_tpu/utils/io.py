@@ -82,10 +82,15 @@ def load_dense_matrix(path: str, mesh=None, dtype=None, use_native: bool = True)
 
     rows = []
     width = 0
-    for line in _data_lines(path):
-        idx_s, vals_s = line.split(":", 1)
-        vals = [float(x) for x in _SEP.split(vals_s.strip()) if x]
-        rows.append((int(idx_s), vals))
+    for lineno, line in enumerate(_data_lines(path), 1):
+        try:
+            idx_s, vals_s = line.split(":", 1)
+            vals = [float(x) for x in _SEP.split(vals_s.strip()) if x]
+            rows.append((int(idx_s), vals))
+        except ValueError as e:
+            raise ValueError(
+                f"{path}: malformed matrix line {lineno}: {line[:60]!r} ({e})"
+            ) from None
         width = max(width, len(vals))
     if not rows:
         raise ValueError(f"no matrix rows found in {path}")
