@@ -1,0 +1,63 @@
+"""Failure recovery: checkpointed iteration with resume.
+
+The reference's resilience story is inherited entirely from Spark RDD lineage
+recomputation plus explicit persist() of iteration state; driver-held state
+(weights, pivots, factors) is a single point of failure and there is no
+checkpoint/resume anywhere (SURVEY.md §5). JAX has no lineage, so recovery =
+periodic checkpoints + restart: this module wraps any host-driven iteration
+(ALS sweeps, LU panel loops, NN training) so a crashed run resumes from the
+last completed checkpoint instead of step 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional, Tuple
+
+from . import checkpoint as ckpt
+
+_META = "loop_state.json"
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Step index of the newest checkpoint under ``path``, or None."""
+    meta = os.path.join(path, _META)
+    if not os.path.exists(meta):
+        return None
+    with open(meta) as f:
+        return json.load(f)["step"]
+
+
+def run_with_checkpoints(
+    step_fn: Callable[[Any, int], Any],
+    init_state: Any,
+    num_steps: int,
+    path: str,
+    every: int = 10,
+    resume: bool = True,
+) -> Tuple[Any, int]:
+    """Run ``state = step_fn(state, i)`` for ``num_steps`` steps, persisting
+    every ``every`` steps. On restart with ``resume=True``, continues from the
+    last completed checkpoint. Returns (final_state, steps_actually_run)."""
+    os.makedirs(path, exist_ok=True)
+    state = init_state
+    start = 0
+    if resume:
+        done = latest_step(path)
+        if done is not None:
+            state = ckpt.load_pytree(os.path.join(path, "state"))
+            start = done
+    ran = 0
+    for i in range(start, num_steps):
+        state = step_fn(state, i)
+        ran += 1
+        if (i + 1) % every == 0 or (i + 1) == num_steps:
+            _save(state, path, i + 1)
+    return state, ran
+
+
+def _save(state: Any, path: str, step: int) -> None:
+    ckpt.save_pytree(state, os.path.join(path, "state"))
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump({"step": step}, f)

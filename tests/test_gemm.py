@@ -50,6 +50,25 @@ class TestDenseVecMultiply:
         assert isinstance(c, BlockMatrix)
         np.testing.assert_allclose(c.to_numpy(), a @ b, rtol=1e-12)
 
+    def test_carma_branch_d(self, rng):
+        # Non-near-square shapes with both operands over threshold -> Branch D
+        # (CARMA grid). m >> k, n: grid (8,1,1) -> k-degenerate 2-D engine.
+        a = rng.standard_normal((640, 8))
+        b = rng.standard_normal((8, 16))
+        c = DenseVecMatrix(a).multiply(DenseVecMatrix(b), broadcast_threshold_mb=1e-9)
+        np.testing.assert_allclose(c.to_numpy(), a @ b, rtol=1e-12, atol=1e-13)
+
+    def test_carma_branch_d_k_split(self, rng):
+        # k >> m, n: the CARMA grid splits k -> the 3-D psum engine.
+        from marlin_tpu.utils.split import grid_for_devices
+
+        a = rng.standard_normal((8, 640))
+        b = rng.standard_normal((640, 8))
+        grid = grid_for_devices(8, 640, 8, 8)
+        assert grid[1] > 1  # policy must give the k axis the budget
+        c = DenseVecMatrix(a).multiply(DenseVecMatrix(b), broadcast_threshold_mb=1e-9)
+        np.testing.assert_allclose(c.to_numpy(), a @ b, rtol=1e-11, atol=1e-12)
+
     def test_local_vector_operand(self, abn):
         a, _ = abn
         x = np.arange(17.0)

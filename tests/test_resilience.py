@@ -1,0 +1,55 @@
+"""Crash-resume tests for the checkpointed iteration wrapper."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marlin_tpu.utils.resilience import latest_step, run_with_checkpoints
+
+
+def _step(state, i):
+    return {"x": state["x"] + (i + 1)}
+
+
+class TestRunWithCheckpoints:
+    def test_uninterrupted(self, tmp_path):
+        state, ran = run_with_checkpoints(
+            _step, {"x": jnp.zeros(3)}, 10, str(tmp_path / "c"), every=4
+        )
+        assert ran == 10
+        np.testing.assert_allclose(np.asarray(state["x"]), 55.0)
+
+    def test_crash_and_resume_matches(self, tmp_path):
+        path = str(tmp_path / "c")
+
+        class Crash(Exception):
+            pass
+
+        def crashing(state, i):
+            if i == 6:
+                raise Crash()
+            return _step(state, i)
+
+        with pytest.raises(Crash):
+            run_with_checkpoints(crashing, {"x": jnp.zeros(3)}, 10, path, every=3)
+        assert latest_step(path) == 6  # checkpoints at 3 and 6 completed
+
+        # Resume runs only the remaining steps and reaches the same result.
+        state, ran = run_with_checkpoints(_step, {"x": jnp.zeros(3)}, 10, path, every=3)
+        assert ran == 4
+        np.testing.assert_allclose(np.asarray(state["x"]), 55.0)
+
+    def test_resume_disabled_restarts(self, tmp_path):
+        path = str(tmp_path / "c")
+        run_with_checkpoints(_step, {"x": jnp.zeros(1)}, 4, path, every=2)
+        _, ran = run_with_checkpoints(
+            _step, {"x": jnp.zeros(1)}, 4, path, every=2, resume=False
+        )
+        assert ran == 4
+
+    def test_completed_run_resumes_to_noop(self, tmp_path):
+        path = str(tmp_path / "c")
+        run_with_checkpoints(_step, {"x": jnp.zeros(1)}, 5, path, every=2)
+        state, ran = run_with_checkpoints(_step, {"x": jnp.zeros(1)}, 5, path, every=2)
+        assert ran == 0
+        np.testing.assert_allclose(np.asarray(state["x"]), 15.0)
