@@ -7,26 +7,70 @@ checkpoint/resume anywhere (SURVEY.md §5). JAX has no lineage, so recovery =
 periodic checkpoints + restart: this module wraps any host-driven iteration
 (ALS sweeps, LU panel loops, NN training) so a crashed run resumes from the
 last completed checkpoint instead of step 0.
+
+Crash-safety design: the step counter is stored INSIDE the checkpoint payload
+(one atomic unit with the state — a torn meta file can never disagree with the
+state), a new checkpoint is written to a side directory and swapped in with
+renames (the previous checkpoint survives until the new one is complete), and
+restore rebuilds each array with its original sharding (device-direct reads)
+derived from ``init_state``.
 """
 
 from __future__ import annotations
 
-import json
 import os
+import shutil
 from typing import Any, Callable, Optional, Tuple
+
+import jax
 
 from . import checkpoint as ckpt
 
-_META = "loop_state.json"
+_CKPT = "ckpt"
+_NEXT = "ckpt.next"
+_OLD = "ckpt.old"
 
 
-def latest_step(path: str) -> Optional[int]:
-    """Step index of the newest checkpoint under ``path``, or None."""
-    meta = os.path.join(path, _META)
-    if not os.path.exists(meta):
+def _ckpt_dir(path: str) -> Optional[str]:
+    """The newest complete checkpoint dir under ``path``, or None.
+
+    ``ckpt`` is preferred; ``ckpt.old`` covers a crash between the two swap
+    renames."""
+    for name in (_CKPT, _OLD):
+        d = os.path.join(path, name)
+        if os.path.isdir(d):
+            return d
+    return None
+
+
+def _abstract_like(state: Any) -> Any:
+    """ShapeDtypeStructs (with shardings) mirroring ``state``'s arrays, so
+    restore lands device-direct in the original sharding."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(leaf, state)
+
+
+def latest_step(path: str, like: Any = None) -> Optional[int]:
+    """Step index of the newest complete checkpoint under ``path``, or None."""
+    d = _ckpt_dir(path)
+    if d is None:
         return None
-    with open(meta) as f:
-        return json.load(f)["step"]
+    abstract = {"step": 0, "state": _abstract_like(like)} if like is not None else None
+    payload = ckpt.load_pytree(d, abstract)
+    return int(payload["step"])
+
+
+def clear(path: str) -> None:
+    """Remove any checkpoints under ``path``."""
+    for name in (_CKPT, _NEXT, _OLD):
+        d = os.path.join(path, name)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
 
 
 def run_with_checkpoints(
@@ -38,16 +82,22 @@ def run_with_checkpoints(
     resume: bool = True,
 ) -> Tuple[Any, int]:
     """Run ``state = step_fn(state, i)`` for ``num_steps`` steps, persisting
-    every ``every`` steps. On restart with ``resume=True``, continues from the
-    last completed checkpoint. Returns (final_state, steps_actually_run)."""
+    every ``every`` steps. With ``resume=True``, continues from the last
+    complete checkpoint; with ``resume=False``, existing checkpoints under
+    ``path`` are cleared first (a later resume can then never pick up a stale
+    run). Returns (final_state, steps_actually_run)."""
     os.makedirs(path, exist_ok=True)
     state = init_state
     start = 0
     if resume:
-        done = latest_step(path)
-        if done is not None:
-            state = ckpt.load_pytree(os.path.join(path, "state"))
-            start = done
+        d = _ckpt_dir(path)
+        if d is not None:
+            abstract = {"step": 0, "state": _abstract_like(init_state)}
+            payload = ckpt.load_pytree(d, abstract)
+            state = payload["state"]
+            start = int(payload["step"])
+    else:
+        clear(path)
     ran = 0
     for i in range(start, num_steps):
         state = step_fn(state, i)
@@ -58,6 +108,17 @@ def run_with_checkpoints(
 
 
 def _save(state: Any, path: str, step: int) -> None:
-    ckpt.save_pytree(state, os.path.join(path, "state"))
-    with open(os.path.join(path, _META), "w") as f:
-        json.dump({"step": step}, f)
+    """Write {step, state} atomically: side-dir write, then rename swap."""
+    nxt = os.path.join(path, _NEXT)
+    cur = os.path.join(path, _CKPT)
+    old = os.path.join(path, _OLD)
+    if os.path.isdir(nxt):
+        shutil.rmtree(nxt)  # orphan from an earlier crash mid-write
+    ckpt.save_pytree({"step": step, "state": state}, nxt)
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+    if os.path.isdir(cur):
+        os.rename(cur, old)
+    os.rename(nxt, cur)
+    if os.path.isdir(old):
+        shutil.rmtree(old)

@@ -1,10 +1,16 @@
-"""Crash-resume tests for the checkpointed iteration wrapper."""
+"""Crash-resume tests for the checkpointed iteration wrapper, including the
+crash-atomicity and stale-state contracts."""
+
+import os
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from marlin_tpu.utils.resilience import latest_step, run_with_checkpoints
+from marlin_tpu.utils import resilience
+from marlin_tpu.utils.resilience import clear, latest_step, run_with_checkpoints
+
+STATE0 = lambda: {"x": jnp.zeros(3)}
 
 
 def _step(state, i):
@@ -13,9 +19,7 @@ def _step(state, i):
 
 class TestRunWithCheckpoints:
     def test_uninterrupted(self, tmp_path):
-        state, ran = run_with_checkpoints(
-            _step, {"x": jnp.zeros(3)}, 10, str(tmp_path / "c"), every=4
-        )
+        state, ran = run_with_checkpoints(_step, STATE0(), 10, str(tmp_path / "c"), every=4)
         assert ran == 10
         np.testing.assert_allclose(np.asarray(state["x"]), 55.0)
 
@@ -31,25 +35,66 @@ class TestRunWithCheckpoints:
             return _step(state, i)
 
         with pytest.raises(Crash):
-            run_with_checkpoints(crashing, {"x": jnp.zeros(3)}, 10, path, every=3)
-        assert latest_step(path) == 6  # checkpoints at 3 and 6 completed
+            run_with_checkpoints(crashing, STATE0(), 10, path, every=3)
+        assert latest_step(path, like=STATE0()) == 6  # checkpoints 3, 6 completed
 
         # Resume runs only the remaining steps and reaches the same result.
-        state, ran = run_with_checkpoints(_step, {"x": jnp.zeros(3)}, 10, path, every=3)
+        state, ran = run_with_checkpoints(_step, STATE0(), 10, path, every=3)
         assert ran == 4
         np.testing.assert_allclose(np.asarray(state["x"]), 55.0)
 
-    def test_resume_disabled_restarts(self, tmp_path):
+    def test_resume_false_clears_stale_state(self, tmp_path):
         path = str(tmp_path / "c")
-        run_with_checkpoints(_step, {"x": jnp.zeros(1)}, 4, path, every=2)
-        _, ran = run_with_checkpoints(
-            _step, {"x": jnp.zeros(1)}, 4, path, every=2, resume=False
-        )
+        run_with_checkpoints(_step, STATE0(), 10, path, every=5)  # run A completes
+        # Fresh run crashes before its first checkpoint...
+        state, ran = run_with_checkpoints(_step, STATE0(), 0, path, every=5, resume=False)
+        assert ran == 0
+        # ...and a retry with resume=True must NOT pick up run A's state.
+        assert latest_step(path, like=STATE0()) is None
+        state, ran = run_with_checkpoints(_step, STATE0(), 4, path, every=2)
         assert ran == 4
+        np.testing.assert_allclose(np.asarray(state["x"]), 10.0)
 
     def test_completed_run_resumes_to_noop(self, tmp_path):
         path = str(tmp_path / "c")
-        run_with_checkpoints(_step, {"x": jnp.zeros(1)}, 5, path, every=2)
-        state, ran = run_with_checkpoints(_step, {"x": jnp.zeros(1)}, 5, path, every=2)
+        run_with_checkpoints(_step, STATE0(), 5, path, every=2)
+        state, ran = run_with_checkpoints(_step, STATE0(), 5, path, every=2)
         assert ran == 0
         np.testing.assert_allclose(np.asarray(state["x"]), 15.0)
+
+    def test_crash_mid_save_keeps_previous_checkpoint(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "c")
+        run_with_checkpoints(_step, STATE0(), 4, path, every=4)  # checkpoint @4
+
+        # Simulate a crash inside the NEXT save, after the side-dir write
+        # begins but before the swap: the step-4 checkpoint must survive.
+        real_save = resilience.ckpt.save_pytree
+
+        def dying_save(tree, p):
+            real_save(tree, p)
+            raise RuntimeError("power loss")
+
+        monkeypatch.setattr(resilience.ckpt, "save_pytree", dying_save)
+        with pytest.raises(RuntimeError):
+            run_with_checkpoints(_step, STATE0(), 8, path, every=4)
+        monkeypatch.setattr(resilience.ckpt, "save_pytree", real_save)
+
+        assert latest_step(path, like=STATE0()) == 4
+        state, ran = run_with_checkpoints(_step, STATE0(), 8, path, every=4)
+        assert ran == 4
+        np.testing.assert_allclose(np.asarray(state["x"]), 36.0)
+
+    def test_restore_preserves_sharding(self, tmp_path):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import marlin_tpu as mt
+
+        mesh = mt.default_mesh()
+        sh = NamedSharding(mesh, P(("mr", "mc")))
+        path = str(tmp_path / "c")
+        init = {"x": jax.device_put(jnp.zeros(16), sh)}
+        run_with_checkpoints(lambda s, i: {"x": s["x"] + 1}, init, 2, path, every=1)
+        state, ran = run_with_checkpoints(lambda s, i: {"x": s["x"] + 1}, init, 4, path, every=1)
+        assert ran == 2
+        assert state["x"].sharding == sh or len(state["x"].sharding.device_set) == 8
