@@ -1,3 +1,4 @@
-from . import ring, summa
+from . import ring, summa, ulysses
 from .ring import ring_matmul, ring_self_attention
 from .summa import matmul, matmul_3d
+from .ulysses import sequence_parallel_attention, ulysses_self_attention

@@ -40,6 +40,13 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+def _pvary(x: jax.Array, axes) -> jax.Array:
+    """jax.lax.pvary compat: pcast(..., to='varying') on jax >= 0.9."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)  # pragma: no cover
+
+
 def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
     """All mesh axes flattened into one logical ring."""
     return tuple(mesh.axis_names)
@@ -78,7 +85,7 @@ def _ring_matmul_fn(mesh: Mesh, n_dev: int, precision: str):
             b_next = jax.lax.ppermute(b_cur, axes, perm)
             return b_next, acc
 
-        acc0 = jax.lax.pvary(
+        acc0 = _pvary(
             jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=a_blk.dtype), axes
         )
         _, acc = jax.lax.fori_loop(0, n_dev, step, (b_blk, acc0))
@@ -152,9 +159,9 @@ def _ring_attention_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
             v_next = jax.lax.ppermute(v_cur, axes, perm)
             return k_next, v_next, m_new, l_new, o_new
 
-        m0 = jax.lax.pvary(jnp.full((sq,), neg, q_blk.dtype), axes)
-        l0 = jax.lax.pvary(jnp.zeros((sq,), q_blk.dtype), axes)
-        o0 = jax.lax.pvary(jnp.zeros((sq, v_blk.shape[1]), q_blk.dtype), axes)
+        m0 = _pvary(jnp.full((sq,), neg, q_blk.dtype), axes)
+        l0 = _pvary(jnp.zeros((sq,), q_blk.dtype), axes)
+        o0 = _pvary(jnp.zeros((sq, v_blk.shape[1]), q_blk.dtype), axes)
         _, _, _, l_fin, o_fin = jax.lax.fori_loop(
             0, n_dev, step, (k_blk, v_blk, m0, l0, o0)
         )

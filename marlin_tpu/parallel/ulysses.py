@@ -1,0 +1,174 @@
+"""All-to-all (Ulysses-style) sequence/context parallelism.
+
+The second first-class long-context engine next to :mod:`.ring` (SURVEY.md §5:
+the reference scales a giant dimension by row-chunking / re-blocking —
+DenseVecMatrix rows, BlockMatrix re-gridding; here the giant dimension is a
+sequence axis sharded over the mesh). Where ring attention streams K/V blocks
+around the ICI ring, the all-to-all scheme re-shards: QKV arrive sharded on
+the **sequence** axis, one ``all_to_all`` turns them head-sharded with the
+full sequence local, every device runs plain full-sequence attention for its
+own heads, and a second ``all_to_all`` restores sequence sharding.
+
+Communication: 2x all_to_all per tensor (O(S·H·D / P) bytes each, pairwise
+over ICI) vs ring's P-step ppermute pipeline. All-to-all wins when the head
+count divides the mesh and the per-device full-sequence score matrix
+(S x S/P) fits in HBM; ring wins when S is so large that no device may ever
+hold a full-sequence axis. Both are exported; :func:`sequence_parallel_attention`
+dispatches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mesh import default_mesh
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def _attend(q, k, v, scale, causal, q0=0, k0=0):
+    """Plain blockwise attention oracle: softmax(q k^T * scale) v.
+
+    q: (sq, d) starting at absolute position q0; k/v: (skv, d) at k0.
+    """
+    logits = scale * jnp.dot(q, k.T)
+    if causal:
+        q_pos = q0 + jnp.arange(q.shape[0])[:, None]
+        k_pos = k0 + jnp.arange(k.shape[0])[None, :]
+        logits = jnp.where(k_pos <= q_pos, logits, jnp.asarray(-1e30, q.dtype))
+    logits = logits - jnp.max(logits, axis=1, keepdims=True)
+    p = jnp.exp(logits)
+    return jnp.dot(p, v) / jnp.sum(p, axis=1, keepdims=True)
+
+
+@functools.cache
+def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
+    axes = _mesh_axes(mesh)
+
+    def kernel(q_blk, k_blk, v_blk):
+        # Arrive sequence-sharded: (S/P, H, D). One all_to_all swaps the
+        # sharded axis: split heads (axis 1), concat sequence (axis 0) ->
+        # (S, H/P, D) with the FULL sequence local to every device.
+        def seq_to_head(x):
+            return jax.lax.all_to_all(x, axes, split_axis=1, concat_axis=0, tiled=True)
+
+        def head_to_seq(x):
+            return jax.lax.all_to_all(x, axes, split_axis=0, concat_axis=1, tiled=True)
+
+        q_h = seq_to_head(q_blk)
+        k_h = seq_to_head(k_blk)
+        v_h = seq_to_head(v_blk)
+
+        # Full-sequence attention, vmapped over this device's heads.
+        out_h = jax.vmap(
+            lambda q, k, v: _attend(q, k, v, scale, causal),
+            in_axes=1,
+            out_axes=1,
+        )(q_h, k_h, v_h)
+        return head_to_seq(out_h)
+
+    f = _shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes, None, None),) * 3,
+        out_specs=P(axes, None, None),
+    )
+    return jax.jit(f)
+
+
+def ulysses_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Multi-head attention with sequence sharding via two all-to-alls.
+
+    Shapes: q/k/v are (seq, n_heads, head_dim); seq and n_heads must both be
+    divisible by the device count (all_to_all re-shards each of them once).
+    Returns (seq, n_heads, head_dim_v) with the same sequence sharding.
+    """
+    mesh = mesh or default_mesh()
+    n_dev = len(mesh.devices.flat)
+    s, h, d = q.shape
+    if s % n_dev != 0:
+        raise ValueError(f"sequence length {s} must divide by {n_dev} devices")
+    if h % n_dev != 0:
+        raise ValueError(f"head count {h} must divide by {n_dev} devices")
+    if k.shape[:2] != (s, h) or v.shape[:2] != (s, h):
+        raise ValueError(
+            f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape} "
+            "(all-to-all attention needs equal seq and head counts)"
+        )
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    axes = _mesh_axes(mesh)
+    sh = NamedSharding(mesh, P(axes, None, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return _ulysses_fn(mesh, n_dev, causal, float(scale))(q, k, v)
+
+
+def sequence_parallel_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    strategy: str = "auto",
+) -> jax.Array:
+    """Dispatch between the two sequence-parallel attention engines.
+
+    ``strategy``: ``"ring"`` | ``"all_to_all"`` | ``"auto"``. Auto picks
+    all-to-all when the head axis exists and divides the mesh (cheaper: two
+    re-shards instead of a P-step pipeline), ring otherwise — the same
+    auto-dispatch-by-operand-shape policy style as ``multiply(cores,
+    threshold)`` (DenseVecMatrix.scala:196-217).
+
+    Accepts (seq, dim) for ring-only use or (seq, heads, dim) for both; a
+    2-D input to all_to_all mode is treated as a single head and rejected
+    (one head cannot shard).
+    """
+    from .ring import ring_self_attention
+
+    mesh = mesh or default_mesh()
+    n_dev = len(mesh.devices.flat)
+    if strategy == "auto":
+        strategy = (
+            "all_to_all" if q.ndim == 3 and q.shape[1] % n_dev == 0 else "ring"
+        )
+    if strategy == "all_to_all":
+        if q.ndim != 3:
+            raise ValueError("all_to_all strategy needs (seq, heads, dim) inputs")
+        return ulysses_self_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
+    if strategy == "ring":
+        if q.ndim == 3:
+            # Per-head ring passes: seq stays sharded, heads run sequentially
+            # (each head is an independent ring pipeline).
+            return jnp.stack(
+                [
+                    ring_self_attention(
+                        q[:, h], k[:, h], v[:, h],
+                        mesh=mesh, causal=causal, scale=scale,
+                    )
+                    for h in range(q.shape[1])
+                ],
+                axis=1,
+            )
+        return ring_self_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
+    raise ValueError(f"unknown sequence-parallel strategy: {strategy!r}")

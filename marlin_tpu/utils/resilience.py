@@ -18,6 +18,7 @@ derived from ``init_state``.
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 from typing import Any, Callable, Optional, Tuple
@@ -29,6 +30,7 @@ from . import checkpoint as ckpt
 _CKPT = "ckpt"
 _NEXT = "ckpt.next"
 _OLD = "ckpt.old"
+_STEP_FILE = "step.json"
 
 
 def _ckpt_dir(path: str) -> Optional[str]:
@@ -56,10 +58,19 @@ def _abstract_like(state: Any) -> Any:
 
 
 def latest_step(path: str, like: Any = None) -> Optional[int]:
-    """Step index of the newest complete checkpoint under ``path``, or None."""
+    """Step index of the newest complete checkpoint under ``path``, or None.
+
+    Reads the few-byte ``step.json`` sidecar written inside the (atomically
+    swapped) checkpoint dir — no array restore. Falls back to restoring the
+    payload for checkpoints written before the sidecar existed; pass ``like``
+    (a pytree shaped like the state) to make that fallback device-direct."""
     d = _ckpt_dir(path)
     if d is None:
         return None
+    sidecar = os.path.join(d, _STEP_FILE)
+    if os.path.isfile(sidecar):
+        with open(sidecar) as f:
+            return int(json.load(f)["step"])
     abstract = {"step": 0, "state": _abstract_like(like)} if like is not None else None
     payload = ckpt.load_pytree(d, abstract)
     return int(payload["step"])
@@ -115,6 +126,8 @@ def _save(state: Any, path: str, step: int) -> None:
     if os.path.isdir(nxt):
         shutil.rmtree(nxt)  # orphan from an earlier crash mid-write
     ckpt.save_pytree({"step": step, "state": state}, nxt)
+    with open(os.path.join(nxt, _STEP_FILE), "w") as f:
+        json.dump({"step": step}, f)
     if os.path.isdir(old):
         shutil.rmtree(old)
     if os.path.isdir(cur):

@@ -97,4 +97,5 @@ class TestRunWithCheckpoints:
         run_with_checkpoints(lambda s, i: {"x": s["x"] + 1}, init, 2, path, every=1)
         state, ran = run_with_checkpoints(lambda s, i: {"x": s["x"] + 1}, init, 4, path, every=1)
         assert ran == 2
-        assert state["x"].sharding == sh or len(state["x"].sharding.device_set) == 8
+        assert state["x"].sharding.is_equivalent_to(sh, state["x"].ndim)
+        np.testing.assert_allclose(np.asarray(state["x"]), 4.0)
