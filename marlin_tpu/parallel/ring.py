@@ -130,7 +130,9 @@ def ring_matmul(
 
 
 @functools.cache
-def _ring_attention_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
+def _ring_attention_fn(
+    mesh: Mesh, n_dev: int, causal: bool, scale: float, multihead: bool = False
+):
     axes = _ring_axes(mesh)
 
     def kernel(q_blk, k_blk, v_blk):
@@ -167,12 +169,15 @@ def _ring_attention_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
         )
         return o_fin / jnp.maximum(l_fin, 1e-30)[:, None]
 
-    f = _shard_map(
-        kernel,
-        mesh=mesh,
-        in_specs=(P(axes, None), P(axes, None), P(axes, None)),
-        out_specs=P(axes, None),
-    )
+    if multihead:
+        # (S/P, H, D) blocks: one dispatch, head axis vmapped through the
+        # same streaming pipeline (K/V permutes batch over heads).
+        body = jax.vmap(kernel, in_axes=1, out_axes=1)
+        specs = P(axes, None, None)
+    else:
+        body = kernel
+        specs = P(axes, None)
+    f = _shard_map(body, mesh=mesh, in_specs=(specs,) * 3, out_specs=specs)
     return jax.jit(f)
 
 
@@ -185,10 +190,12 @@ def ring_self_attention(
     scale: Optional[float] = None,
 ) -> jax.Array:
     """softmax(Q K^T * scale) V with the sequence dimension sharded on the
-    ring; K/V blocks stream. Shapes: q (sq, d), k (skv, d), v (skv, dv);
-    sq and skv must each be divisible-padded to the device count (zero-pad
-    keys get masked out by the softmax max-shift only if padded — callers
-    should pass divisible lengths; this wrapper pads q only)."""
+    ring; K/V blocks stream. Shapes: q (sq, d) or (sq, h, d) multi-head (the
+    head axis is vmapped through one pipeline); k/v match q's rank with
+    lengths (skv, ...). sq and skv must each be divisible-padded to the
+    device count (zero-pad keys get masked out by the softmax max-shift only
+    if padded — callers should pass divisible lengths; this wrapper pads q
+    only)."""
     mesh = mesh or default_mesh()
     n_dev = len(mesh.devices.flat)
     if k.shape[0] % n_dev != 0:
@@ -196,13 +203,14 @@ def ring_self_attention(
             f"key/value length {k.shape[0]} must divide by {n_dev} devices"
         )
     if scale is None:
-        scale = 1.0 / np.sqrt(q.shape[1])
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    multihead = q.ndim == 3
     sq = q.shape[0]
     qp = _pad_dim(q, 0, n_dev)
     axes = _ring_axes(mesh)
-    sh = NamedSharding(mesh, P(axes, None))
+    sh = NamedSharding(mesh, P(axes, *([None] * (q.ndim - 1))))
     qp = jax.device_put(qp, sh)
     kp = jax.device_put(k, sh)
     vp = jax.device_put(v, sh)
-    out = _ring_attention_fn(mesh, n_dev, causal, float(scale))(qp, kp, vp)
+    out = _ring_attention_fn(mesh, n_dev, causal, float(scale), multihead)(qp, kp, vp)
     return out[:sq] if out.shape[0] != sq else out

@@ -11,9 +11,11 @@ own heads, and a second ``all_to_all`` restores sequence sharding.
 
 Communication: 2x all_to_all per tensor (O(S·H·D / P) bytes each, pairwise
 over ICI) vs ring's P-step ppermute pipeline. All-to-all wins when the head
-count divides the mesh and the per-device full-sequence score matrix
-(S x S/P) fits in HBM; ring wins when S is so large that no device may ever
-hold a full-sequence axis. Both are exported; :func:`sequence_parallel_attention`
+count divides the mesh and the per-device score memory — H/P full S x S
+logits matrices (every device holds the FULL sequence for its own heads; the
+score footprint does not shrink with P once H/P reaches 1) — fits in HBM;
+ring wins when S is so large that no device may ever hold a full-sequence
+axis. Both are exported; :func:`sequence_parallel_attention`
 dispatches.
 """
 
@@ -39,15 +41,12 @@ def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-def _attend(q, k, v, scale, causal, q0=0, k0=0):
-    """Plain blockwise attention oracle: softmax(q k^T * scale) v.
-
-    q: (sq, d) starting at absolute position q0; k/v: (skv, d) at k0.
-    """
+def _attend(q, k, v, scale, causal):
+    """Full-sequence attention: softmax(q k^T * scale) v. q: (sq, d); k/v: (skv, d)."""
     logits = scale * jnp.dot(q, k.T)
     if causal:
-        q_pos = q0 + jnp.arange(q.shape[0])[:, None]
-        k_pos = k0 + jnp.arange(k.shape[0])[None, :]
+        q_pos = jnp.arange(q.shape[0])[:, None]
+        k_pos = jnp.arange(k.shape[0])[None, :]
         logits = jnp.where(k_pos <= q_pos, logits, jnp.asarray(-1e30, q.dtype))
     logits = logits - jnp.max(logits, axis=1, keepdims=True)
     p = jnp.exp(logits)
@@ -110,10 +109,11 @@ def ulysses_self_attention(
         raise ValueError(f"sequence length {s} must divide by {n_dev} devices")
     if h % n_dev != 0:
         raise ValueError(f"head count {h} must divide by {n_dev} devices")
-    if k.shape[:2] != (s, h) or v.shape[:2] != (s, h):
+    if k.shape != (s, h, d) or v.shape[:2] != (s, h):
         raise ValueError(
             f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape} "
-            "(all-to-all attention needs equal seq and head counts)"
+            "(all-to-all attention needs equal seq/head counts and "
+            "matching q/k head_dim)"
         )
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -149,26 +149,26 @@ def sequence_parallel_attention(
     mesh = mesh or default_mesh()
     n_dev = len(mesh.devices.flat)
     if strategy == "auto":
+        # all_to_all needs what ulysses_self_attention enforces: (s, h, d)
+        # inputs with s and h divisible by the mesh and self-attention
+        # lengths (kv length == q length). Cross-attention or non-divisible
+        # shapes fall back to ring, which streams unequal K/V fine.
         strategy = (
-            "all_to_all" if q.ndim == 3 and q.shape[1] % n_dev == 0 else "ring"
+            "all_to_all"
+            if (
+                q.ndim == 3
+                and q.shape[1] % n_dev == 0
+                and q.shape[0] % n_dev == 0
+                and k.shape == q.shape
+                and v.shape[:2] == q.shape[:2]
+            )
+            else "ring"
         )
     if strategy == "all_to_all":
         if q.ndim != 3:
             raise ValueError("all_to_all strategy needs (seq, heads, dim) inputs")
         return ulysses_self_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
     if strategy == "ring":
-        if q.ndim == 3:
-            # Per-head ring passes: seq stays sharded, heads run sequentially
-            # (each head is an independent ring pipeline).
-            return jnp.stack(
-                [
-                    ring_self_attention(
-                        q[:, h], k[:, h], v[:, h],
-                        mesh=mesh, causal=causal, scale=scale,
-                    )
-                    for h in range(q.shape[1])
-                ],
-                axis=1,
-            )
+        # ring_self_attention vmaps a 3-D head axis through one pipeline.
         return ring_self_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
     raise ValueError(f"unknown sequence-parallel strategy: {strategy!r}")

@@ -69,8 +69,11 @@ def latest_step(path: str, like: Any = None) -> Optional[int]:
         return None
     sidecar = os.path.join(d, _STEP_FILE)
     if os.path.isfile(sidecar):
-        with open(sidecar) as f:
-            return int(json.load(f)["step"])
+        try:
+            with open(sidecar) as f:
+                return int(json.load(f)["step"])
+        except (ValueError, KeyError, TypeError, OSError):
+            pass  # torn/empty sidecar: fall through to the payload restore
     abstract = {"step": 0, "state": _abstract_like(like)} if like is not None else None
     payload = ckpt.load_pytree(d, abstract)
     return int(payload["step"])
@@ -128,6 +131,8 @@ def _save(state: Any, path: str, step: int) -> None:
     ckpt.save_pytree({"step": step, "state": state}, nxt)
     with open(os.path.join(nxt, _STEP_FILE), "w") as f:
         json.dump({"step": step}, f)
+        f.flush()
+        os.fsync(f.fileno())
     if os.path.isdir(old):
         shutil.rmtree(old)
     if os.path.isdir(cur):

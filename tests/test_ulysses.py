@@ -106,3 +106,32 @@ class TestSequenceParallelDispatch:
         q, k, v = rand_qkv(9, 32, 8, 8)
         with pytest.raises(ValueError, match="unknown"):
             sequence_parallel_attention(q, k, v, mesh=mesh, strategy="spiral")
+
+    def test_auto_cross_attention_falls_back_to_ring(self, mesh):
+        # kv length != q length: all_to_all can't express it, ring streams it.
+        q, _, _ = rand_qkv(10, 32, 8, 8)
+        _, k, v = rand_qkv(11, 64, 8, 8)
+        out = sequence_parallel_attention(q, k, v, mesh=mesh, strategy="auto")
+        scale = 1.0 / np.sqrt(8)
+        qn, kn, vn = (np.asarray(x, np.float64) for x in (q, k, v))
+        want = np.zeros((32, 8, 8))
+        for hh in range(8):
+            logits = scale * (qn[:, hh] @ kn[:, hh].T)
+            logits -= logits.max(axis=1, keepdims=True)
+            p = np.exp(logits)
+            want[:, hh] = (p / p.sum(axis=1, keepdims=True)) @ vn[:, hh]
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-9, atol=1e-9)
+
+    def test_multihead_ring_matches_per_head_2d(self, mesh):
+        # The vmapped multi-head ring path must agree with independent 2-D
+        # ring passes per head (the previous implementation's semantics).
+        q, k, v = rand_qkv(12, 32, 3, 8)
+        out = sequence_parallel_attention(q, k, v, mesh=mesh, strategy="ring")
+        per_head = np.stack(
+            [
+                np.asarray(ring_self_attention(q[:, h], k[:, h], v[:, h], mesh=mesh))
+                for h in range(3)
+            ],
+            axis=1,
+        )
+        np.testing.assert_allclose(np.asarray(out), per_head, rtol=1e-12, atol=1e-12)
