@@ -1,1 +1,1 @@
-from . import io, random, split
+from . import doctor, io, random, split

@@ -1,0 +1,213 @@
+"""Race / nondeterminism detection and numeric tripwires.
+
+The reference has NO race-detection subsystem (SURVEY.md §5): thread safety is
+delegated wholesale to Spark's task model, and the RNG explicitly renounces
+per-instance thread safety (RandomDataGenerator.scala:108-112). A TPU/JAX
+framework has no threads racing on shared mutable state, but it has analogous
+hazard classes, and this module makes each one checkable:
+
+* **Nondeterministic kernels** — scatter-add orderings, multi-pass reductions,
+  or collective reassociation can make two executions of the same jitted
+  function differ in low bits, silently breaking reproducibility (the property
+  the reference's per-partition re-seeding protects, RandomRDD.scala:69-70).
+  :func:`check_determinism` re-executes and compares bitwise.
+* **Unintended host<->device transfers** — the TPU analogue of an accidental
+  ``collect()`` to the driver: a silent ``device_get`` in a hot loop
+  serializes the pipeline. :func:`transfer_guard` turns them into errors.
+* **NaN/Inf escapes** — :func:`check_finite` walks a pytree and names the
+  offending leaves; :func:`debug_nans` scopes ``jax_debug_nans`` so the
+  faulting primitive is identified at its call site.
+* **Donated-buffer reuse** — re-reading an argument donated to a jitted call
+  is JAX's closest analogue to a use-after-free race;
+  :func:`check_donation_safe` verifies a function does not read its donated
+  inputs after dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _leaves_with_paths(tree: Any) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, (jax.Array, np.ndarray)) else x, tree
+    )
+
+
+@dataclass
+class DeterminismReport:
+    """Outcome of :func:`check_determinism`."""
+
+    deterministic: bool
+    runs: int
+    mismatches: List[str] = field(default_factory=list)  # leaf paths
+    max_abs_diff: float = 0.0
+
+    def __bool__(self) -> bool:
+        return self.deterministic
+
+
+def check_determinism(
+    fn: Callable[..., Any],
+    *args: Any,
+    runs: int = 3,
+    bitwise: bool = True,
+    atol: float = 0.0,
+    **kwargs: Any,
+) -> DeterminismReport:
+    """Execute ``fn(*args, **kwargs)`` ``runs`` times and compare the outputs.
+
+    ``bitwise=True`` (default) demands exact equality — the reproducibility
+    bar the reference sets by re-seeding each partition's RNG so recomputation
+    is identical (RandomRDD.scala:69-70). ``bitwise=False`` allows ``atol``
+    slack for intentionally reassociated reductions. Inputs are fetched to
+    host once so every run sees identical operands.
+    """
+    if runs < 2:
+        raise ValueError("runs must be >= 2 to compare executions")
+    # Host-fetch the operands once so every run sees identical inputs and a
+    # donate_argnums fn can't invalidate them between runs.
+    args = _to_host(args)
+    kwargs = _to_host(kwargs)
+    baseline = _to_host(fn(*args, **kwargs))
+    report = DeterminismReport(deterministic=True, runs=runs)
+    for _ in range(runs - 1):
+        again = _to_host(fn(*args, **kwargs))
+        for (path, a), (_, b) in zip(
+            _leaves_with_paths(baseline), _leaves_with_paths(again)
+        ):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape or a.dtype != b.dtype:
+                report.deterministic = False
+                report.mismatches.append(path)
+                continue
+            if np.issubdtype(a.dtype, np.floating) or np.issubdtype(
+                a.dtype, np.complexfloating
+            ):
+                same = (
+                    np.array_equal(a, b, equal_nan=True)
+                    if bitwise
+                    else np.allclose(a, b, rtol=0.0, atol=atol, equal_nan=True)
+                )
+                if not same:
+                    diff = float(
+                        np.nanmax(np.abs(a.astype(np.float64) - b.astype(np.float64)))
+                    )
+                    report.max_abs_diff = max(report.max_abs_diff, diff)
+                    report.deterministic = False
+                    if path not in report.mismatches:
+                        report.mismatches.append(path)
+            elif not np.array_equal(a, b):
+                report.deterministic = False
+                if path not in report.mismatches:
+                    report.mismatches.append(path)
+    return report
+
+
+@contextlib.contextmanager
+def transfer_guard(level: str = "disallow"):
+    """Error (or log) on implicit host<->device transfers inside the block.
+
+    Levels per ``jax.transfer_guard``: "allow", "log", "disallow",
+    "log_explicit", "disallow_explicit". The reference's analogous failure
+    mode is an accidental ``collect()``/``toBreeze`` inside an iteration
+    (SURVEY.md §3.5: driver-held weights re-broadcast every step)."""
+    with jax.transfer_guard(level):
+        yield
+
+
+class NonFiniteError(FloatingPointError):
+    """Raised by :func:`check_finite`; carries the offending leaf paths."""
+
+    def __init__(self, paths: List[str]):
+        self.paths = paths
+        super().__init__(f"non-finite values in leaves: {', '.join(paths)}")
+
+
+def check_finite(tree: Any, name: str = "value") -> Any:
+    """Assert every float leaf of ``tree`` is finite; returns ``tree``.
+
+    Raises :class:`NonFiniteError` naming each offending leaf path (a
+    structured replacement for the reference's bare println diagnostics,
+    DenseVecMatrix.scala:322-323)."""
+    bad = []
+    for path, leaf in _leaves_with_paths(tree):
+        if isinstance(leaf, (jax.Array, np.ndarray)) and np.issubdtype(
+            leaf.dtype, np.floating
+        ):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                bad.append(f"{name}{path}")
+    if bad:
+        raise NonFiniteError(bad)
+    return tree
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Scope ``jax_debug_nans`` so the faulting primitive is reported at its
+    call site (compile-time cost: jit re-traces with checks)."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def check_donation_safe(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> bool:
+    """True iff ``fn`` leaves its array arguments readable after the call.
+
+    A jitted function with ``donate_argnums`` invalidates donated operands —
+    reading one afterwards is the JAX analogue of a use-after-free race. Runs
+    ``fn`` then attempts to fetch each input array."""
+    fn(*args, **kwargs)
+    for _, leaf in _leaves_with_paths((args, kwargs)):
+        if isinstance(leaf, jax.Array):
+            try:
+                np.asarray(leaf)
+            except RuntimeError:  # deleted/donated buffer
+                return False
+    return True
+
+
+def audit(fn: Callable[..., Any], *args: Any, runs: int = 2, **kwargs: Any) -> dict:
+    """One-call health check: determinism + donation safety + finiteness.
+
+    Returns a dict report; raises nothing (findings are data, in the style of
+    a sanitizer summary)."""
+    # Host copies feed determinism/finiteness (immune to donation); the
+    # donation probe gets fresh device arrays so donate_argnums is observable.
+    args = _to_host(args)
+    kwargs = _to_host(kwargs)
+    det = check_determinism(fn, *args, runs=runs, **kwargs)
+    try:
+        check_finite(fn(*args, **kwargs), name="output")
+        finite = True
+        nonfinite_leaves: List[str] = []
+    except NonFiniteError as e:
+        finite = False
+        nonfinite_leaves = e.paths
+    dev_args, dev_kwargs = jax.tree.map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+        (args, kwargs),
+    )
+    donation_ok = check_donation_safe(fn, *dev_args, **dev_kwargs)
+    return {
+        "deterministic": det.deterministic,
+        "determinism_mismatches": det.mismatches,
+        "max_abs_diff": det.max_abs_diff,
+        "donation_safe": donation_ok,
+        "finite": finite,
+        "nonfinite_leaves": nonfinite_leaves,
+    }

@@ -1,0 +1,139 @@
+"""Race/nondeterminism detection subsystem (utils.doctor).
+
+The reference has no race detection (SURVEY.md §5); these tests pin down the
+TPU-native hazard classes the subsystem covers: kernel nondeterminism,
+implicit transfers, NaN escapes, donated-buffer reuse.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marlin_tpu.utils import doctor
+
+
+class TestDeterminism:
+    def test_deterministic_jit_passes(self):
+        f = jax.jit(lambda x: jnp.sin(x) @ jnp.cos(x.T))
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+        rep = doctor.check_determinism(f, x, runs=3)
+        assert rep.deterministic and not rep.mismatches
+
+    def test_summa_engine_is_deterministic(self, mesh):
+        from marlin_tpu.utils import random as mrand
+
+        a = mrand.random_den_vec_matrix(32, 24, seed=1)
+        b = mrand.random_den_vec_matrix(24, 16, seed=2)
+        rep = doctor.check_determinism(
+            lambda: a.multiply(b, mode="summa").to_numpy(), runs=3
+        )
+        assert rep.deterministic
+
+    def test_nondeterministic_fn_flagged(self):
+        state = {"n": 0}
+
+        def flaky(x):
+            state["n"] += 1
+            return x + state["n"]
+
+        rep = doctor.check_determinism(flaky, jnp.ones((4,)), runs=2)
+        assert not rep.deterministic
+        assert rep.max_abs_diff > 0
+
+    def test_pytree_mismatch_paths_named(self):
+        state = {"n": 0}
+
+        def flaky(x):
+            state["n"] += 1
+            return {"stable": x, "drifting": x * state["n"]}
+
+        rep = doctor.check_determinism(flaky, jnp.ones((4,)), runs=2)
+        assert any("drifting" in p for p in rep.mismatches)
+        assert not any("stable" in p for p in rep.mismatches)
+
+    def test_tolerance_mode(self):
+        state = {"n": 0}
+
+        def jitter(x):
+            state["n"] += 1
+            return x + 1e-9 * state["n"]
+
+        assert doctor.check_determinism(
+            jitter, jnp.ones((4,)), runs=2, bitwise=False, atol=1e-6
+        )
+        assert not doctor.check_determinism(jitter, jnp.ones((4,)), runs=2)
+
+    def test_runs_validation(self):
+        with pytest.raises(ValueError, match="runs"):
+            doctor.check_determinism(lambda: 0, runs=1)
+
+
+class TestTransferGuard:
+    def test_guard_level_scoped(self):
+        # CPU-backend transfers are zero-copy and never trip the guard, so
+        # assert the level is plumbed through jax's config for the scope.
+        before = jax.config.jax_transfer_guard
+        with doctor.transfer_guard("disallow"):
+            assert jax.config.jax_transfer_guard == "disallow"
+        assert jax.config.jax_transfer_guard == before
+
+    def test_blocks_implicit_host_transfer_on_accelerator(self):
+        if jax.devices()[0].platform == "cpu":
+            pytest.skip("host<->CPU-device copies are zero-copy exempt")
+        x = jnp.arange(8.0)
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            with doctor.transfer_guard("disallow"):
+                np.asarray(x) + 1  # implicit device->host
+
+    def test_allows_inside_allow_level(self):
+        x = jnp.arange(8.0)
+        with doctor.transfer_guard("allow"):
+            assert float(np.asarray(x).sum()) == 28.0
+
+
+class TestFinite:
+    def test_passes_finite_tree(self):
+        tree = {"a": jnp.ones((3,)), "b": np.zeros((2, 2))}
+        assert doctor.check_finite(tree) is tree
+
+    def test_names_bad_leaf(self):
+        tree = {"good": jnp.ones((2,)), "bad": jnp.array([1.0, np.inf])}
+        with pytest.raises(doctor.NonFiniteError) as e:
+            doctor.check_finite(tree, name="grads")
+        assert any("bad" in p for p in e.value.paths)
+        assert not any("good" in p for p in e.value.paths)
+
+    def test_int_leaves_ignored(self):
+        doctor.check_finite({"i": jnp.arange(4)})
+
+
+class TestDonation:
+    def test_safe_fn(self):
+        f = jax.jit(lambda x: x * 2)
+        assert doctor.check_donation_safe(f, jnp.ones((4,)))
+
+    def test_donated_buffer_flagged(self):
+        f = jax.jit(lambda x: x * 2, donate_argnums=0)
+        x = jnp.ones((256,))
+        assert not doctor.check_donation_safe(f, x)
+
+
+class TestAudit:
+    def test_clean_function(self):
+        f = jax.jit(lambda x: x @ x.T)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+        rep = doctor.audit(f, x)
+        assert rep["deterministic"] and rep["donation_safe"] and rep["finite"]
+
+    def test_nan_producer(self):
+        f = lambda x: jnp.log(x - 10.0)  # negative -> NaN
+        rep = doctor.audit(f, jnp.ones((4,)))
+        assert not rep["finite"] and rep["nonfinite_leaves"]
+
+    def test_audit_with_donated_inputs(self):
+        # check_determinism host-fetches operands, so a donate_argnums fn
+        # can't invalidate them between runs; audit still flags the donation.
+        f = jax.jit(lambda x: x * 2, donate_argnums=0)
+        rep = doctor.audit(f, jnp.ones((256,)))
+        assert rep["deterministic"] and not rep["donation_safe"]
