@@ -1,1 +1,2 @@
 from .block_sparse import BlockSparse, block_sparse_matmul
+from .flash_attention import flash_attention

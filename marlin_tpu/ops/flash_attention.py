@@ -1,0 +1,183 @@
+"""Pallas TPU flash attention — the local attention block kernel.
+
+The sequence-parallel engines (:mod:`..parallel.ring`,
+:mod:`..parallel.ulysses`) reduce multi-device attention to a per-device
+attention over local blocks; done naively that materializes an S x S logits
+matrix in HBM per head. This kernel computes softmax(Q K^T * scale) V with
+the canonical flash/online-softmax tiling instead: Q/K/V stream through VMEM
+in (block_q x block_k) tiles, the running max / denominator / accumulator
+live in VMEM scratch, and no logits matrix ever reaches HBM — the same
+blockwise-softmax recurrence the ring engine runs *across* devices, applied
+*within* one device (SURVEY.md §5 long-context).
+
+No reference counterpart (Marlin has no attention; its closest kernel-layer
+analogue is the hand-tiled 32x32 cache-blocked GEMM, LibMatrixMult.scala:43-77
+— the same "tile for the fast memory" idea, here for VMEM and the MXU).
+
+Grid: (heads, q_blocks, k_blocks), k innermost so scratch carries across the
+k sweep; causal blocks fully above the diagonal are skipped via ``pl.when``.
+On non-TPU backends the kernel runs in interpret mode (CPU tests), so the
+XLA-level oracle in the tests exercises the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.split import pad_to_multiple
+
+_NEG_INF = -1e30
+_LANES = 128  # TPU lane count: last-dim tiles are always x128
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, causal,
+            block_q, block_k, kv_len):
+    """One (head, q_block, k_block) grid step of the online-softmax sweep."""
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block (innermost: scratch carries over j)
+    n_j = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip blocks strictly above the diagonal.
+    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]  # (block_q, d)
+        k = k_ref[0]  # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < kv_len  # padded tail keys contribute nothing
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)  # (block_q, block_k) f32
+        l_cur = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _out_struct(x: jax.Array, shape) -> jax.ShapeDtypeStruct:
+    """Output aval of ``shape`` with x's dtype, carrying x's varying-mesh-axes
+    set so the kernel composes with shard_map's vma checking (the output
+    varies over exactly the axes the inputs do)."""
+    vma = getattr(jax.typeof(x), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, x.dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, x.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """(H, Sq, D) x (H, Skv, D) x (H, Skv, Dv) -> (H, Sq, Dv); D and Dv
+    already lane-padded (Dv may differ from D)."""
+    h, sq, d = q.shape
+    dv = v.shape[2]
+    kv_len = k.shape[1]
+    qp = pad_to_multiple(q, 1, block_q)
+    kp = pad_to_multiple(k, 1, block_k)
+    vp = pad_to_multiple(v, 1, block_k)
+    grid = (h, qp.shape[1] // block_q, kp.shape[1] // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, dv), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dv), lambda h, i, j: (h, i, 0)),
+        out_shape=_out_struct(qp, (h, qp.shape[1], dv)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denominator
+            pltpu.VMEM((block_q, dv), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :sq]
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """softmax(Q K^T * scale) V, flash-tiled, single device.
+
+    Shapes: (S, D) single-head or (S, H, D) multi-head; K/V lengths may
+    differ from Q's (cross attention). The head dimension is zero-padded to
+    the 128-lane tile (padding contributes nothing to q·k logits and is
+    sliced off the output). ``interpret`` defaults to True off-TPU so the
+    same kernel runs under the CPU test mesh.
+
+    Default 1024x1024 blocks measure 150+ TFLOPS (76% of bf16 peak) on a
+    v5e chip at S=8k, H=8, D=128 — the VMEM working set (q/k/v tiles + f32
+    logits block + accumulator, ~5.5 MB) fits comfortably in 16 MB; 128x128
+    blocks run 8x slower (grid overhead dominates). Blocks are clamped to
+    the padded sequence lengths so short inputs don't over-pad.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    single = q.ndim == 2
+    if single:
+        q, k, v = q[:, None, :], k[:, None, :], v[:, None, :]
+    # Clamp blocks to the (sublane-padded) sequence lengths.
+    block_q = min(block_q, -(-q.shape[0] // 16) * 16)
+    block_k = min(block_k, -(-k.shape[0] // 16) * 16)
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if k.shape[-1] != q.shape[-1]:
+        raise ValueError(f"q/k head_dim mismatch: {q.shape} vs {k.shape}")
+    # (S, H, D) -> (H, S, D); pad D (and v's Dv independently) to lane tiles.
+    qt, kt, vt = (jnp.swapaxes(x, 0, 1) for x in (q, k, v))
+    d0 = vt.shape[-1]
+    qt, kt, vt = (pad_to_multiple(x, 2, _LANES) for x in (qt, kt, vt))
+    out = _flash_hsd(
+        qt, kt, vt, bool(causal), float(scale), int(block_q), int(block_k),
+        bool(interpret),
+    )
+    out = jnp.swapaxes(out[..., :d0], 0, 1)
+    return out[:, 0] if single else out

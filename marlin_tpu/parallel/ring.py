@@ -52,13 +52,7 @@ def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-def _pad_dim(x: jax.Array, axis: int, mult: int) -> jax.Array:
-    extra = (-x.shape[axis]) % mult
-    if not extra:
-        return x
-    pads = [(0, 0)] * x.ndim
-    pads[axis] = (0, extra)
-    return jnp.pad(x, pads)
+from ..utils.split import pad_to_multiple as _pad_dim
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ dispatches.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Optional, Tuple
 
 import jax
@@ -54,7 +55,7 @@ def _attend(q, k, v, scale, causal):
 
 
 @functools.cache
-def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
+def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float, flash: bool):
     axes = _mesh_axes(mesh)
 
     def kernel(q_blk, k_blk, v_blk):
@@ -71,19 +72,36 @@ def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float):
         k_h = seq_to_head(k_blk)
         v_h = seq_to_head(v_blk)
 
-        # Full-sequence attention, vmapped over this device's heads.
-        out_h = jax.vmap(
-            lambda q, k, v: _attend(q, k, v, scale, causal),
-            in_axes=1,
-            out_axes=1,
-        )(q_h, k_h, v_h)
+        # Full-sequence attention over this device's heads: the Pallas flash
+        # kernel (VMEM-tiled, no S x S logits in HBM) on TPU, or the XLA
+        # oracle vmapped over heads.
+        if flash:
+            from ..ops.flash_attention import flash_attention
+
+            out_h = flash_attention(q_h, k_h, v_h, causal=causal, scale=scale)
+        else:
+            out_h = jax.vmap(
+                lambda q, k, v: _attend(q, k, v, scale, causal),
+                in_axes=1,
+                out_axes=1,
+            )(q_h, k_h, v_h)
         return head_to_seq(out_h)
 
+    # check_vma=False with the flash kernel: interpret-mode pallas_call
+    # can't yet propagate varying-mesh-axes through its internal
+    # dynamic_slice (jax hlo_interpreter limitation); the vma check is a
+    # static lint, not a runtime semantic, and the xla variant keeps it on.
+    # (The jax.experimental fallback shard_map predates the kwarg — only
+    # pass it where it exists.)
+    kwargs = {}
+    if "check_vma" in inspect.signature(_shard_map).parameters:
+        kwargs["check_vma"] = not flash
     f = _shard_map(
         kernel,
         mesh=mesh,
         in_specs=(P(axes, None, None),) * 3,
         out_specs=P(axes, None, None),
+        **kwargs,
     )
     return jax.jit(f)
 
@@ -95,12 +113,16 @@ def ulysses_self_attention(
     mesh: Optional[Mesh] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    local_kernel: str = "auto",
 ) -> jax.Array:
     """Multi-head attention with sequence sharding via two all-to-alls.
 
     Shapes: q/k/v are (seq, n_heads, head_dim); seq and n_heads must both be
     divisible by the device count (all_to_all re-shards each of them once).
     Returns (seq, n_heads, head_dim_v) with the same sequence sharding.
+
+    ``local_kernel``: per-device attention after the re-shard — "flash"
+    (Pallas VMEM-tiled), "xla", or "auto" (flash on TPU).
     """
     mesh = mesh or default_mesh()
     n_dev = len(mesh.devices.flat)
@@ -117,10 +139,16 @@ def ulysses_self_attention(
         )
     if scale is None:
         scale = 1.0 / np.sqrt(d)
+    if local_kernel not in ("auto", "flash", "xla"):
+        raise ValueError(f"unknown local_kernel {local_kernel!r}")
+    flash = (
+        local_kernel == "flash"
+        or (local_kernel == "auto" and mesh.devices.flat[0].platform == "tpu")
+    )
     axes = _mesh_axes(mesh)
     sh = NamedSharding(mesh, P(axes, None, None))
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    return _ulysses_fn(mesh, n_dev, causal, float(scale))(q, k, v)
+    return _ulysses_fn(mesh, n_dev, causal, float(scale), flash)(q, k, v)
 
 
 def sequence_parallel_attention(

@@ -132,3 +132,17 @@ def reblock_plan(old_starts, new_block: int):
             plan.append((oi, pos - ostart, ni, pos - nstart, length))
             pos += length
     return plan
+
+
+def pad_to_multiple(x, axis: int, mult: int):
+    """Zero-pad ``x`` along ``axis`` up to the next multiple of ``mult``
+    (shared by the ring engines and the Pallas kernels; uneven shards don't
+    exist in JAX, so edge blocks pad-to-uniform — SURVEY.md §7 hard parts)."""
+    import jax.numpy as jnp
+
+    extra = (-x.shape[axis]) % mult
+    if not extra:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, extra)
+    return jnp.pad(x, pads)

@@ -1,0 +1,142 @@
+"""Pallas flash attention vs the NumPy oracle (interpret mode on the CPU mesh).
+
+Golden-value pattern of the reference suite: kernel output vs a hand-computed
+oracle (LocalMatrixSuite.scala:8-72 style), plus composition with the
+all-to-all sequence-parallel engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marlin_tpu.ops.flash_attention import flash_attention
+
+
+def oracle(q, k, v, scale=None, causal=False):
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = scale * (q @ k.T)
+    if causal:
+        mask = np.arange(k.shape[0])[None, :] <= np.arange(q.shape[0])[:, None]
+        logits = np.where(mask, logits, -np.inf)
+    logits -= logits.max(axis=1, keepdims=True)
+    p = np.exp(logits)
+    return (p / p.sum(axis=1, keepdims=True)) @ v
+
+
+def oracle_mh(q, k, v, **kw):
+    return np.stack(
+        [oracle(q[:, h], k[:, h], v[:, h], **kw) for h in range(q.shape[1])], axis=1
+    )
+
+
+def rand(seed, *shape):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+class TestFlashAttention:
+    def test_single_head_full(self):
+        q, k, v = rand(0, 64, 32), rand(1, 64, 32), rand(2, 64, 32)
+        out = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), oracle(q, k, v), rtol=2e-5, atol=2e-5)
+
+    def test_causal(self):
+        q, k, v = rand(3, 48, 16), rand(4, 48, 16), rand(5, 48, 16)
+        out = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), oracle(q, k, v, causal=True), rtol=2e-5, atol=2e-5
+        )
+
+    def test_cross_attention_lengths(self):
+        q, k, v = rand(6, 40, 24), rand(7, 72, 24), rand(8, 72, 24)
+        out = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), oracle(q, k, v), rtol=2e-5, atol=2e-5)
+
+    def test_multihead(self):
+        q, k, v = rand(9, 32, 4, 16), rand(10, 32, 4, 16), rand(11, 32, 4, 16)
+        out = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), oracle_mh(q, k, v, causal=True), rtol=2e-5, atol=2e-5
+        )
+
+    def test_unaligned_lengths_and_dim(self):
+        # Neither S (113/37) nor D (19) aligned to tiles: exercises padding.
+        q, k, v = rand(12, 113, 19), rand(13, 37, 19), rand(14, 37, 19)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), oracle(q, k, v), rtol=2e-5, atol=2e-5)
+
+    def test_multiple_kv_blocks_online_merge(self):
+        # Force several k blocks so the running-max/denominator merge runs.
+        q, k, v = rand(15, 64, 8), rand(16, 256, 8), rand(17, 256, 8)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), oracle(q, k, v), rtol=2e-5, atol=2e-5)
+
+    def test_custom_scale(self):
+        q, k, v = rand(18, 32, 8), rand(19, 32, 8), rand(20, 32, 8)
+        out = flash_attention(q, k, v, scale=0.25)
+        np.testing.assert_allclose(
+            np.asarray(out), oracle(q, k, v, scale=0.25), rtol=2e-5, atol=2e-5
+        )
+
+    def test_matches_xla_attend_bitwise_shape(self):
+        q, k, v = rand(21, 32, 8), rand(22, 32, 8), rand(23, 32, 8)
+        assert flash_attention(q, k, v).shape == (32, 8)
+        qh = rand(24, 32, 2, 8)
+        assert flash_attention(qh, qh, qh).shape == (32, 2, 8)
+
+
+class TestUlyssesFlashComposition:
+    def test_flash_local_kernel_under_shard_map(self, mesh):
+        from marlin_tpu.parallel import ulysses_self_attention
+
+        q, k, v = (rand(s, 32, 8, 16).astype(jnp.float64) for s in (25, 26, 27))
+        out = ulysses_self_attention(q, k, v, mesh=mesh, local_kernel="flash")
+        want = ulysses_self_attention(q, k, v, mesh=mesh, local_kernel="xla")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), oracle_mh(q, k, v), rtol=1e-5, atol=1e-5
+        )
+
+    def test_flash_causal_under_shard_map(self, mesh):
+        from marlin_tpu.parallel import ulysses_self_attention
+
+        q, k, v = (rand(s, 32, 8, 16).astype(jnp.float64) for s in (28, 29, 30))
+        out = ulysses_self_attention(
+            q, k, v, mesh=mesh, causal=True, local_kernel="flash"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), oracle_mh(q, k, v, causal=True), rtol=1e-5, atol=1e-5
+        )
+
+    def test_bad_kernel_name(self, mesh):
+        from marlin_tpu.parallel import ulysses_self_attention
+
+        q = rand(31, 32, 8, 16)
+        with pytest.raises(ValueError, match="local_kernel"):
+            ulysses_self_attention(q, q, q, mesh=mesh, local_kernel="mxu")
+
+
+class TestWideV:
+    def test_v_head_dim_differs(self):
+        # head_dim_v != head_dim: v pads to a different lane multiple.
+        q, k = rand(32, 48, 24), rand(33, 48, 24)
+        v = rand(34, 48, 40)
+        out = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), oracle(q, k, v), rtol=2e-5, atol=2e-5)
+        assert out.shape == (48, 40)
+
+    def test_v_wider_than_lane_tile(self):
+        q, k = rand(35, 32, 128), rand(36, 32, 128)
+        v = rand(37, 32, 192)
+        out = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), oracle(q, k, v), rtol=2e-5, atol=2e-5)
+        assert out.shape == (32, 192)
+
+    def test_qk_dim_mismatch_rejected(self):
+        q, k, v = rand(38, 32, 16), rand(39, 32, 24), rand(40, 32, 16)
+        with pytest.raises(ValueError, match="head_dim"):
+            flash_attention(q, k, v)
