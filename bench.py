@@ -56,10 +56,20 @@ def fence(mat) -> float:
 
 
 def _timed(fn, iters=5):
-    fence(fn())  # warmup / compile
+    r = fn()  # warmup / compile
+    out_bytes = int(r.data.nbytes)
+    fence(r)
+    # Fence once after the loop: device execution is in-order, so fetching a
+    # reduction of the last result implies all queued iterations finished.
+    # Fencing every iteration would add a tunnel round-trip per iter and
+    # serialize dispatch, understating throughput by ~15%. Async dispatch
+    # keeps every queued output buffer live at once, so cap the burst at
+    # ~8 GiB of outputs to stay clear of HBM exhaustion.
+    iters = max(2, min(iters, (8 << 30) // max(out_bytes, 1)))
     t0 = time.perf_counter()
     for _ in range(iters):
-        fence(fn())
+        r = fn()
+    fence(r)
     return (time.perf_counter() - t0) / iters
 
 
