@@ -9,10 +9,13 @@ CSC unit — the TPU-shaped sparse format is DENSE BLOCKS with a block mask
 * :class:`BlockSparse` — block-compressed container: (K/bs, N/bs) bool mask +
   the dense backing array (only masked blocks meaningful).
 * :func:`block_sparse_matmul` — C = A @ B with B block-sparse, as a Pallas
-  kernel: 3-D grid over (M, N, K) tiles, the mask scalar-prefetched into SMEM,
-  and ``pl.when`` skipping the MXU work of empty blocks. (The next step —
-  remapping the grid via prefetched block indices so empty blocks also skip
-  their DMA — is noted at the kernel.)
+  kernel. When the block mask is concrete (the normal eager construction),
+  the k-grid is REMAPPED through scalar-prefetched per-column nonzero block
+  lists: the grid's k extent shrinks to the densest column's count, each step
+  gathers the actual (a, b) block pair via the prefetched index map, and the
+  padding steps repeat the last index so Pallas's revisit detection skips
+  both their DMA and their MXU issue. Under an outer jit (tracer mask) it
+  falls back to the full-grid kernel with ``pl.when``-masked accumulation.
 
 Falls back to interpreter mode off-TPU so the same code path is testable on
 the CPU mesh.
@@ -30,6 +33,11 @@ from jax.experimental import pallas as pl
 
 from ..config import get_config
 
+try:  # pragma: no cover - present on every supported install
+    from jax.experimental.pallas import tpu as pltpu
+except (ImportError, AttributeError):  # pragma: no cover
+    pltpu = None
+
 
 class BlockSparse:
     """Block-compressed matrix: dense backing + (rows/bs, cols/bs) block mask."""
@@ -45,6 +53,18 @@ class BlockSparse:
         self.data = data
         self.mask = mask.astype(jnp.int32)
         self.block_size = block_size
+        self._gather_lists_cache = None
+
+    def _gather_lists(self):
+        """(kidx, kcnt, max_nnz) for the gather grid, computed once per
+        instance (the mask sync + column scan would otherwise run on every
+        multiply of a reused operand)."""
+        if self._gather_lists_cache is None:
+            kidx, kcnt, max_nnz = _column_block_lists(np.asarray(self.mask))
+            self._gather_lists_cache = (
+                jnp.asarray(kidx), jnp.asarray(kcnt), max_nnz
+            )
+        return self._gather_lists_cache
 
     @property
     def shape(self) -> Tuple[int, int]:
@@ -78,7 +98,7 @@ class BlockSparse:
         return self.data
 
 
-def _spmm_kernel(mask_ref, a_ref, b_ref, o_ref):
+def _spmm_kernel(mask_ref, a_ref, b_ref, o_ref, *, precision):
     k = pl.program_id(2)
     j = pl.program_id(1)
 
@@ -89,31 +109,80 @@ def _spmm_kernel(mask_ref, a_ref, b_ref, o_ref):
     @pl.when(mask_ref[k, j] != 0)
     def _accumulate():
         o_ref[:] += jnp.dot(
-            a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+            a_ref[:], b_ref[:], precision=precision,
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+
+
+def _spmm_gather_kernel(kidx_ref, kcnt_ref, a_ref, b_ref, o_ref, *, precision):
+    del kidx_ref  # consumed by the index maps
+    kk = pl.program_id(2)
+    j = pl.program_id(1)
+
+    @pl.when(kk == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    @pl.when(kk < kcnt_ref[j])
+    def _accumulate():
+        o_ref[:] += jnp.dot(
+            a_ref[:], b_ref[:], precision=precision,
+            preferred_element_type=jnp.float32,
         ).astype(o_ref.dtype)
 
 
 @functools.cache
-def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret):
-    # TODO(perf): remap the grid through prefetched per-column block lists so
-    # empty blocks skip their DMA too, not just their MXU issue.
-    try:
-        from jax.experimental.pallas import tpu as pltpu
-
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(m // bm, n // bn, k // bs),
-            in_specs=[
-                pl.BlockSpec((bm, bs), lambda i, j, kk, mask: (i, kk)),
-                pl.BlockSpec((bs, bn), lambda i, j, kk, mask: (kk, j)),
-            ],
-            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk, mask: (i, j)),
-        )
-    except (ImportError, AttributeError):  # pragma: no cover
-        grid_spec = None
-
+def _spmm_gather_fn(m, k, n, bm, bs, bn, max_nnz, dtype, interpret, precision):
+    """Grid remap over per-column nonzero block lists: grid k extent is the
+    densest column's block count; ``kidx[j, kk]`` selects which k-block the
+    step loads. Padding entries repeat the last real index, so the revisited
+    block's DMA is elided and ``kk < kcnt[j]`` skips its MXU issue."""
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(m // bm, n // bn, max_nnz),
+        in_specs=[
+            pl.BlockSpec((bm, bs), lambda i, j, kk, kidx, kcnt: (i, kidx[j, kk])),
+            pl.BlockSpec((bs, bn), lambda i, j, kk, kidx, kcnt: (kidx[j, kk], j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk, kidx, kcnt: (i, j)),
+    )
     f = pl.pallas_call(
-        _spmm_kernel,
+        functools.partial(_spmm_gather_kernel, precision=precision),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), dtype),
+        interpret=interpret,
+    )
+    return jax.jit(f)
+
+
+def _column_block_lists(mask: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(kidx, kcnt, max_nnz) for the gather grid; kidx padded by repeating the
+    last nonzero index (a dummy revisit, not a dummy load)."""
+    mask = mask.astype(bool)
+    kcnt = mask.sum(axis=0).astype(np.int32)
+    max_nnz = max(int(kcnt.max(initial=0)), 1)
+    kidx = np.zeros((mask.shape[1], max_nnz), np.int32)
+    for j in range(mask.shape[1]):
+        nz = np.flatnonzero(mask[:, j])
+        if nz.size:
+            kidx[j, : nz.size] = nz
+            kidx[j, nz.size :] = nz[-1]
+    return kidx, kcnt, max_nnz
+
+
+@functools.cache
+def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision="highest"):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m // bm, n // bn, k // bs),
+        in_specs=[
+            pl.BlockSpec((bm, bs), lambda i, j, kk, mask: (i, kk)),
+            pl.BlockSpec((bs, bn), lambda i, j, kk, mask: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk, mask: (i, j)),
+    )
+    f = pl.pallas_call(
+        functools.partial(_spmm_kernel, precision=precision),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((m, n), dtype),
         interpret=interpret,
@@ -134,7 +203,22 @@ def block_sparse_matmul(
     pad_m = (-m) % bs
     ap = jnp.pad(a, [(0, pad_m), (0, 0)]) if pad_m else a
     ap = ap.astype(b.data.dtype)
-    out = _spmm_fn(
-        ap.shape[0], b.shape[0], b.shape[1], bs, bs, bs, b.data.dtype, interpret
-    )(b.mask, ap, b.data)
+    precision = get_config().matmul_precision
+    if pltpu is None:  # pragma: no cover - no Pallas TPU support in this jax
+        # The backing array keeps empty blocks zeroed, so a plain dot is the
+        # correct (dense-speed) fallback.
+        out = jnp.dot(ap, b.data, precision=precision)
+    elif isinstance(b.mask, jax.core.Tracer):
+        # Under an outer jit the mask has no concrete value; run the full
+        # (M, N, K) grid with mask-guarded accumulation.
+        out = _spmm_fn(
+            ap.shape[0], b.shape[0], b.shape[1], bs, bs, bs, b.data.dtype,
+            interpret, precision,
+        )(b.mask, ap, b.data)
+    else:
+        kidx, kcnt, max_nnz = b._gather_lists()
+        out = _spmm_gather_fn(
+            ap.shape[0], b.shape[0], b.shape[1], bs, bs, bs, max_nnz,
+            b.data.dtype, interpret, precision,
+        )(kidx, kcnt, ap, b.data)
     return out[:m] if pad_m else out

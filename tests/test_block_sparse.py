@@ -60,6 +60,34 @@ class TestBlockSparse:
         out = block_sparse_matmul(jnp.asarray(a), b)
         np.testing.assert_allclose(np.asarray(out), 0.0)
 
+    def test_matmul_under_jit_tracer_mask(self, rng):
+        # Inside jit the mask is a tracer -> full-grid masked kernel path.
+        import jax
+
+        arr = _block_sparse_dense(rng, 24, 16)
+        a = rng.standard_normal((16, 24)).astype(np.float32)
+
+        @jax.jit
+        def f(a, data, mask):
+            from marlin_tpu.ops.block_sparse import BlockSparse
+
+            return block_sparse_matmul(a, BlockSparse(data, mask, BS))
+
+        b = BlockSparse.from_dense(arr, block_size=BS)
+        out = f(jnp.asarray(a), b.data, b.mask)
+        np.testing.assert_allclose(np.asarray(out), a @ arr, rtol=1e-4, atol=1e-4)
+
+    def test_empty_column_blocks(self, rng):
+        # A column with zero nonzero blocks must come out exactly zero even
+        # though the gather grid still visits it once (dummy revisit step).
+        arr = _block_sparse_dense(rng, 32, 24, keep=1.0)
+        arr[:, 8:16] = 0  # middle block-column entirely empty
+        b = BlockSparse.from_dense(arr, block_size=BS)
+        a = rng.standard_normal((8, 32)).astype(np.float32)
+        out = block_sparse_matmul(jnp.asarray(a), b)
+        np.testing.assert_allclose(np.asarray(out), a @ arr, rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(out)[:, 8:16], 0.0)
+
     def test_dimension_mismatch(self, rng):
         b = BlockSparse.from_dense(np.ones((16, 16), np.float32), block_size=BS)
         with pytest.raises(ValueError):
