@@ -10,10 +10,14 @@ device; the O(n·m) recurrence bookkeeping stays on host, exactly where the
 reference's driver-side ARPACK workspace lived.
 
 Implementation: Lanczos with full reorthogonalization (numerically the blunt
-but robust choice — ARPACK's implicit restarts are replaced by taking a Krylov
-space comfortably larger than k), tridiagonal eigendecomposition, Ritz-residual
-convergence test |beta_m * s_{m,i}| <= tol * |theta_i|, and basis growth until
-``max_iter`` steps or convergence.
+but robust choice — a Krylov space comfortably larger than k replaces
+ARPACK's implicit restarts in the common case), tridiagonal
+eigendecomposition, Ritz-residual convergence test
+|beta_m * s_{m,i}| <= tol * |theta_i|, and basis growth until ``max_iter``
+steps or convergence. When the Krylov space hits an exact invariant subspace
+before k pairs exist (identity-like or low-rank operators — the case ARPACK
+handles with deflation), every Ritz pair of that subspace is locked as exact
+and Lanczos restarts in the orthogonal complement until k pairs accumulate.
 """
 
 from __future__ import annotations
@@ -21,6 +25,8 @@ from __future__ import annotations
 from typing import Callable, Tuple
 
 import numpy as np
+
+_BREAKDOWN = 1e-14
 
 
 def symmetric_eigs(
@@ -39,33 +45,91 @@ def symmetric_eigs(
     if not (0 < k < n):
         raise ValueError(f"Requested k singular values but got k={k} and n={n}.")
     rng = np.random.default_rng(seed)
-    m_max = int(min(n, max(max_iter, 3 * k + 10)))
+
+    locked_vals: list = []
+    locked_vecs: list = []  # orthonormal columns spanning exact invariant subspaces
+    for _restart in range(k + 2):
+        need = k - len(locked_vals)
+        if need <= 0:
+            break
+        L = (
+            np.stack(locked_vecs, axis=1)
+            if locked_vecs
+            else np.zeros((n, 0))
+        )
+        if n - L.shape[1] <= 0:
+            break
+        vals, vecs, exact = _lanczos_run(
+            matvec, n, min(need, n - L.shape[1]), L, tol, max_iter, rng
+        )
+        if exact:
+            # Breakdown: the Krylov space is an exact invariant subspace, so
+            # every Ritz pair is an eigenpair. Lock them all and restart in
+            # the orthogonal complement (deflation).
+            locked_vals.extend(vals)
+            locked_vecs.extend(vecs.T)
+            continue
+        locked_vals.extend(vals[:need])
+        locked_vecs.extend(vecs[:, :need].T)
+        break
+
+    order = np.argsort(locked_vals)[::-1][:k]
+    evals = np.asarray(locked_vals)[order]
+    evecs = np.stack(locked_vecs, axis=1)[:, order]
+    return evals, evecs
+
+
+def _lanczos_run(
+    matvec: Callable[[np.ndarray], np.ndarray],
+    n: int,
+    k: int,
+    L: np.ndarray,
+    tol: float,
+    max_iter: int,
+    rng: np.random.Generator,
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """One Lanczos sweep in the orthogonal complement of the locked basis L.
+
+    Returns (eigenvalues desc, Ritz vectors, exact): ``exact`` means the sweep
+    hit an invariant subspace, so ALL returned pairs are exact eigenpairs;
+    otherwise the top-k converged (or best-effort at max_iter) pairs come back.
+    """
+    m_max = int(min(n - L.shape[1], max(max_iter, 3 * k + 10)))
 
     q = rng.standard_normal(n)
-    q /= np.linalg.norm(q)
+    q -= L @ (L.T @ q)
+    nrm = np.linalg.norm(q)
+    while nrm < 1e-8:  # pathological draw inside span(L); redraw
+        q = rng.standard_normal(n)
+        q -= L @ (L.T @ q)
+        nrm = np.linalg.norm(q)
+    q /= nrm
     Q = np.zeros((n, m_max + 1))
     Q[:, 0] = q
     alphas: list = []
     betas: list = []
 
     m = 0
-    evals = np.zeros(k)
-    evecs_T = None
+    exact = False
     for j in range(m_max):
         w = np.array(matvec(Q[:, j]), dtype=np.float64)  # copy: device buffers are read-only
         a_j = float(Q[:, j] @ w)
         w -= a_j * Q[:, j]
         if j > 0:
             w -= betas[-1] * Q[:, j - 1]
-        # Full reorthogonalization against the current basis (twice is enough).
+        # Full reorthogonalization against the locked basis (deflation) and
+        # the current Krylov basis (twice is enough).
         for _ in range(2):
+            if L.shape[1]:
+                w -= L @ (L.T @ w)
             w -= Q[:, : j + 1] @ (Q[:, : j + 1].T @ w)
         b_j = float(np.linalg.norm(w))
         alphas.append(a_j)
         m = j + 1
-        if b_j < 1e-14:
+        if b_j < _BREAKDOWN:
             # Invariant subspace found — Krylov space is exact.
             betas.append(0.0)
+            exact = True
             break
         betas.append(b_j)
         Q[:, j + 1] = w / b_j
@@ -78,13 +142,14 @@ def symmetric_eigs(
                 break
 
     theta, s = _tridiag_eigh(alphas, betas[: m - 1])
-    # Top-k by descending eigenvalue.
-    order = np.argsort(theta)[::-1][:k]
+    order = np.argsort(theta)[::-1]
+    if not exact:
+        order = order[:k]
     evals = theta[order]
     evecs = Q[:, :m] @ s[:, order]
     # Normalize (full reorth keeps these near-orthonormal already).
     evecs /= np.linalg.norm(evecs, axis=0, keepdims=True)
-    return evals, evecs
+    return evals, evecs, exact
 
 
 def _tridiag_eigh(alphas, betas) -> Tuple[np.ndarray, np.ndarray]:

@@ -40,7 +40,13 @@ except (ImportError, AttributeError):  # pragma: no cover
 
 
 class BlockSparse:
-    """Block-compressed matrix: dense backing + (rows/bs, cols/bs) block mask."""
+    """Block-compressed matrix: dense backing + (rows/bs, cols/bs) block mask.
+
+    Unmasked blocks are zeroed at construction, so every execution path
+    (gather grid, masked grid, plain-dot fallback) computes the same result.
+    Instances are immutable: do not reassign ``data``/``mask`` after
+    construction — the gather block lists are cached per instance.
+    """
 
     def __init__(self, data: jax.Array, mask: jax.Array, block_size: int):
         if data.shape[0] % block_size or data.shape[1] % block_size:
@@ -50,8 +56,12 @@ class BlockSparse:
         expect = (data.shape[0] // block_size, data.shape[1] // block_size)
         if tuple(mask.shape) != expect:
             raise ValueError(f"mask shape {mask.shape} != block grid {expect}")
-        self.data = data
-        self.mask = mask.astype(jnp.int32)
+        mask = mask.astype(jnp.int32)
+        block_mask = jnp.repeat(
+            jnp.repeat(mask, block_size, axis=0), block_size, axis=1
+        )
+        self.data = jnp.where(block_mask != 0, data, jnp.zeros((), data.dtype))
+        self.mask = mask
         self.block_size = block_size
         self._gather_lists_cache = None
 
@@ -85,14 +95,7 @@ class BlockSparse:
             r // block_size, block_size, c // block_size, block_size
         )
         mask = jnp.any(blocks != 0, axis=(1, 3))
-        data = jnp.where(
-            jnp.repeat(
-                jnp.repeat(mask, block_size, axis=0), block_size, axis=1
-            ),
-            arr,
-            jnp.zeros((), arr.dtype),
-        )
-        return cls(data, mask, block_size)
+        return cls(arr, mask, block_size)  # ctor zeroes unmasked blocks
 
     def to_dense(self) -> jax.Array:
         return self.data

@@ -44,6 +44,13 @@ def mesh():
     return mt.default_mesh()
 
 
-@pytest.fixture(scope="session")
-def rng():
-    return np.random.default_rng(42)
+@pytest.fixture()
+def rng(request):
+    # Function-scoped and seeded per test id: each test sees the same stream
+    # on every run REGARDLESS of which other tests exist or ran first. A
+    # session-scoped stream made tolerance tests fail whenever a test was
+    # added earlier in collection order.
+    import zlib
+
+    seed = zlib.crc32(request.node.nodeid.encode())
+    return np.random.default_rng(seed)

@@ -15,7 +15,8 @@ from marlin_tpu.utils import random as mrand
 
 
 @pytest.fixture(scope="module")
-def abn(rng):
+def abn():
+    rng = np.random.default_rng(1742)
     a = rng.standard_normal((23, 17))
     b = rng.standard_normal((17, 29))
     return a, b

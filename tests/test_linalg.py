@@ -112,6 +112,42 @@ class TestLanczos:
         with pytest.raises(ValueError):
             symmetric_eigs(lambda x: x, 10, 10)
 
+    def test_identity_deflation_restart(self):
+        # Krylov space of the identity collapses after ONE step; without
+        # deflation restarts only a single pair comes back.
+        n, k = 8, 3
+        evals, evecs = symmetric_eigs(lambda v: v, n, k)
+        assert evals.shape == (k,) and evecs.shape == (n, k)
+        np.testing.assert_allclose(evals, np.ones(k), atol=1e-10)
+        np.testing.assert_allclose(evecs.T @ evecs, np.eye(k), atol=1e-8)
+
+    def test_low_rank_deflation(self, rng):
+        # Rank-2 PSD operator, k=4: two zero eigenpairs only reachable via
+        # restart in the orthogonal complement.
+        n, k = 12, 4
+        u = np.linalg.qr(rng.standard_normal((n, 2)))[0]
+        g = u @ np.diag([7.0, 3.0]) @ u.T
+        evals, evecs = symmetric_eigs(lambda v: g @ v, n, k)
+        np.testing.assert_allclose(evals, [7.0, 3.0, 0.0, 0.0], atol=1e-8)
+        np.testing.assert_allclose(evecs.T @ evecs, np.eye(k), atol=1e-8)
+        for i in range(k):
+            r = g @ evecs[:, i] - evals[i] * evecs[:, i]
+            assert np.linalg.norm(r) < 1e-8
+
+    def test_clustered_eigenvalues(self, rng):
+        # Near-multiplicity cluster at the top; full reorth + restarts must
+        # resolve all three pairs to tolerance.
+        n, k = 50, 3
+        d = np.concatenate([[5.0, 5.0 - 1e-9, 5.0 - 2e-9], rng.uniform(0, 1, n - 3)])
+        q = np.linalg.qr(rng.standard_normal((n, n)))[0]
+        g = q @ np.diag(d) @ q.T
+        evals, evecs = symmetric_eigs(lambda v: g @ v, n, k, tol=1e-12)
+        np.testing.assert_allclose(evals, d[:3], rtol=1e-8)
+        np.testing.assert_allclose(evecs.T @ evecs, np.eye(k), atol=1e-6)
+        for i in range(k):
+            r = g @ evecs[:, i] - evals[i] * evecs[:, i]
+            assert np.linalg.norm(r) < 1e-6
+
 
 class TestSVD:
     @pytest.fixture()
