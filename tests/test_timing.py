@@ -1,0 +1,82 @@
+"""Timing/metrics subsystem tests (utils/timing.py) — the structured
+replacement for the reference's ad-hoc currentTimeMillis prints
+(DenseVecMatrix.scala:348-350) and MTUtils.evaluate (MTUtils.scala:218)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.utils import timing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    timing.metrics.reset()
+    yield
+    timing.metrics.reset()
+
+
+class TestMetrics:
+    def test_counters_and_timings(self):
+        timing.metrics.incr("ops")
+        timing.metrics.incr("ops", 2)
+        timing.metrics.record("gemm", 0.5)
+        timing.metrics.record("gemm", 1.5)
+        s = timing.metrics.summary()
+        assert s["counters"]["ops"] == 3
+        g = s["timings"]["gemm"]
+        assert g["count"] == 2
+        assert g["total_s"] == pytest.approx(2.0)
+        assert g["mean_s"] == pytest.approx(1.0)
+        assert (g["min_s"], g["max_s"]) == (0.5, 1.5)
+
+    def test_dump_is_json(self):
+        timing.metrics.incr("x")
+        parsed = json.loads(timing.metrics.dump())
+        assert parsed["counters"]["x"] == 1
+
+    def test_reset(self):
+        timing.metrics.incr("x")
+        timing.metrics.reset()
+        assert timing.metrics.summary()["counters"] == {}
+
+
+class TestTimed:
+    def test_context_records(self):
+        mat = DenseVecMatrix(np.ones((4, 4)))
+        with timing.timed("block", mat):
+            mat.add(mat)
+        s = timing.metrics.summary()
+        assert s["timings"]["block"]["count"] == 1
+        assert s["counters"]["block.calls"] == 1
+
+    def test_records_even_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with timing.timed("boom"):
+                raise RuntimeError("x")
+        assert timing.metrics.summary()["timings"]["boom"]["count"] == 1
+
+    def test_decorator_fences_return(self):
+        @timing.timeit(name="f")
+        def f():
+            return jnp.ones((8, 8))
+
+        out = f()
+        assert out.shape == (8, 8)
+        assert timing.metrics.summary()["timings"]["f"]["count"] == 1
+
+    def test_fence_accepts_distributed_and_raw(self):
+        timing.fence(DenseVecMatrix(np.ones((3, 3))), jnp.ones(4), "not-an-array")
+
+
+class TestProfileTrace:
+    def test_trace_roundtrip(self, tmp_path):
+        with timing.profile_trace(str(tmp_path)) as d:
+            jnp.ones((16, 16)).sum().block_until_ready()
+        assert d == str(tmp_path)
+        # A trace directory with at least one event file appears.
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "profiler produced no trace files"
