@@ -1,0 +1,87 @@
+"""Mesh-runtime tests (mesh.py): grid factorization, mesh construction,
+and the layout shardings that define the distributed types."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu import mesh as mmesh
+
+
+class TestSquarestGrid:
+    @pytest.mark.parametrize(
+        "n,expect",
+        [(1, (1, 1)), (2, (2, 1)), (4, (2, 2)), (6, (3, 2)), (8, (4, 2)),
+         (7, (7, 1)), (12, (4, 3)), (16, (4, 4)), (64, (8, 8))],
+    )
+    def test_factorization(self, n, expect):
+        assert mmesh.squarest_grid(n) == expect
+
+
+class TestCreateMesh:
+    def test_default_uses_all_devices_squarest(self):
+        m = mmesh.create_mesh()
+        assert dict(m.shape) == {"mr": 4, "mc": 2}
+
+    def test_explicit_shape(self):
+        m = mmesh.create_mesh((2, 4))
+        assert mmesh.axis_sizes(m) == (2, 4)
+
+    def test_submesh(self):
+        m = mmesh.create_mesh((2, 2), devices=jax.devices()[:4])
+        assert len(list(m.devices.flat)) == 4
+
+    def test_shape_device_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            mmesh.create_mesh((3, 2), devices=jax.devices()[:4])
+
+    def test_custom_axis_names(self):
+        m = mmesh.create_mesh((2, 2), axis_names=("a", "b"),
+                              devices=jax.devices()[:4])
+        assert m.axis_names == ("a", "b")
+
+    def test_default_mesh_is_cached(self):
+        assert mmesh.default_mesh() is mmesh.default_mesh()
+
+
+class TestShardings:
+    """Each layout must place the shards its distributed type promises."""
+
+    def _shard_shapes(self, arr, sharding):
+        placed = jax.device_put(arr, sharding)
+        return {s.data.shape for s in placed.addressable_shards}
+
+    def test_row_sharding_stripes_rows(self):
+        m = mmesh.default_mesh()
+        shapes = self._shard_shapes(jnp.zeros((16, 6)), mmesh.row_sharding(m))
+        assert shapes == {(2, 6)}  # 16 rows / 8 devices, cols whole
+
+    def test_block_sharding_grid(self):
+        m = mmesh.default_mesh()
+        shapes = self._shard_shapes(jnp.zeros((16, 6)), mmesh.block_sharding(m))
+        assert shapes == {(4, 3)}  # (16/4, 6/2)
+
+    def test_col_sharding_stripes_cols(self):
+        m = mmesh.default_mesh()
+        shapes = self._shard_shapes(jnp.zeros((6, 16)), mmesh.col_sharding(m))
+        assert shapes == {(6, 2)}
+
+    def test_replicated_every_device_has_all(self):
+        m = mmesh.default_mesh()
+        shapes = self._shard_shapes(jnp.zeros((5, 7)), mmesh.replicated_sharding(m))
+        assert shapes == {(5, 7)}
+
+    def test_vector_sharding_chunks(self):
+        m = mmesh.default_mesh()
+        shapes = self._shard_shapes(jnp.zeros((24,)), mmesh.vector_sharding(m))
+        assert shapes == {(3,)}
+
+    def test_round_trip_preserves_values(self):
+        m = mmesh.default_mesh()
+        arr = np.arange(48.0).reshape(8, 6)
+        for sh in (mmesh.row_sharding(m), mmesh.block_sharding(m),
+                   mmesh.replicated_sharding(m)):
+            placed = jax.device_put(jnp.asarray(arr), sh)
+            np.testing.assert_array_equal(np.asarray(placed), arr)
