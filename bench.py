@@ -48,16 +48,24 @@ def guess_peak() -> float:
 _fence = None
 
 
+def _raw(x) -> jax.Array:
+    """Unwrap a distributed type to its device array; pass arrays through.
+    (An attribute check on .data would misfire: ndarray.data is a memoryview.)"""
+    from marlin_tpu.matrix.base import DistributedMatrix
+
+    return x.data if isinstance(x, DistributedMatrix) else x
+
+
 def fence(mat) -> float:
     global _fence
     if _fence is None:
         _fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
-    return float(_fence(mat.data))
+    return float(_fence(_raw(mat)))
 
 
 def _timed(fn, iters=5):
     r = fn()  # warmup / compile
-    out_bytes = int(r.data.nbytes)
+    out_bytes = int(_raw(r).nbytes)
     fence(r)
     # Fence once after the loop: device execution is in-order, so fetching a
     # reduction of the last result implies all queued iterations finished.
@@ -119,12 +127,64 @@ def config_chained():
             "unit": "TFLOPS", "vs_baseline": 0}
 
 
+def config_summa_mesh():
+    """BASELINE config #5 (scaled to the available mesh): explicit SUMMA over
+    the full device mesh. The side scales as 8192 * sqrt(n_dev), so a v5e-64
+    runs the named 65536^2 config and per-chip MEMORY stays constant
+    (per-chip FLOPs grow as sqrt(n_dev) — memory-weak scaling, matching how
+    the baseline config was sized)."""
+    import math
+
+    n_dev = len(jax.devices())
+    n = int(8192 * math.sqrt(n_dev))
+    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b, mode="summa"), iters=3)
+    tflops_chip = 2.0 * n**3 / dt / 1e12 / n_dev
+    return {"metric": f"summa_weak_scaling_tflops_chip_n{n_dev}",
+            "value": round(tflops_chip, 2), "unit": "TFLOPS/chip",
+            "vs_baseline": round(tflops_chip / (0.5 * guess_peak()), 3)}
+
+
+def config_attention():
+    """Pallas flash attention (ops/flash_attention.py) at S=8k, H=8, D=128."""
+    from marlin_tpu.ops import flash_attention
+
+    s, h, d = 8192, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
+    dt = _timed(lambda: flash_attention(q, k, v), iters=10)
+    tflops = 4.0 * s * s * h * d / dt / 1e12  # QK^T + PV
+    return {"metric": "flash_attention_tflops", "value": round(tflops, 2),
+            "unit": "TFLOPS", "vs_baseline": 0}
+
+
+def config_sparse():
+    """Block-sparse GEMM (gather-grid Pallas kernel) at 12% block density."""
+    import numpy as np
+
+    from marlin_tpu.ops.block_sparse import BlockSparse, block_sparse_matmul
+
+    n, bs = 8192, 512
+    rng = np.random.default_rng(0)
+    mask = rng.random((n // bs, n // bs)) < 0.12
+    arr = rng.standard_normal((n, n)).astype(np.float32)
+    # The ctor zeroes unmasked blocks itself — no host-side mask expansion.
+    b = BlockSparse(jnp.asarray(arr, DTYPE), jnp.asarray(mask), bs)
+    a = jnp.asarray(rng.standard_normal((n, n)), DTYPE)
+    dt = _timed(lambda: block_sparse_matmul(a, b), iters=10)
+    eff = 2.0 * n**3 * b.block_density / dt / 1e12
+    return {"metric": "block_sparse_effective_tflops", "value": round(eff, 2),
+            "unit": "TFLOPS", "vs_baseline": 0}
+
+
 def main():
     import argparse
 
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="headline",
-                   choices=["headline", "square8k", "tallskinny", "chained", "all"])
+                   choices=["headline", "square8k", "tallskinny", "chained",
+                            "summa", "attention", "sparse", "all"])
     args = p.parse_args()
     mt.set_config(default_dtype=DTYPE, matmul_precision="default")
     runs = {
@@ -132,7 +192,11 @@ def main():
         "square8k": [config_square_8k],
         "tallskinny": [config_tall_skinny],
         "chained": [config_chained],
-        "all": [headline, config_square_8k, config_tall_skinny, config_chained],
+        "summa": [config_summa_mesh],
+        "attention": [config_attention],
+        "sparse": [config_sparse],
+        "all": [headline, config_square_8k, config_tall_skinny, config_chained,
+                config_summa_mesh, config_attention, config_sparse],
     }[args.config]
     for fn in runs:
         print(json.dumps(fn()))
