@@ -48,6 +48,7 @@ def symmetric_eigs(
 
     locked_vals: list = []
     locked_vecs: list = []  # orthonormal columns spanning exact invariant subspaces
+    had_exact = False
     for _restart in range(k + 2):
         need = k - len(locked_vals)
         if need <= 0:
@@ -66,12 +67,38 @@ def symmetric_eigs(
             # Breakdown: the Krylov space is an exact invariant subspace, so
             # every Ritz pair is an eigenpair. Lock them all and restart in
             # the orthogonal complement (deflation).
+            had_exact = True
             locked_vals.extend(vals)
             locked_vecs.extend(vecs.T)
             continue
         locked_vals.extend(vals[:need])
         locked_vecs.extend(vecs[:, :need].T)
         break
+
+    if had_exact:
+        # An exact breakdown sees each distinct eigenvalue of the swept
+        # subspace once, so a repeated top eigenvalue (multiplicity > 1) is
+        # under-counted: its other copies live in the orthogonal complement.
+        # Keep sweeping the complement while it still holds a Ritz value that
+        # belongs in the top k; each productive sweep locks at least one more
+        # vector, so this terminates (capped defensively).
+        for _verify in range(3 * k + 8):
+            if len(locked_vals) < k:
+                break  # quota unmet: nothing to verify against
+            L = np.stack(locked_vecs, axis=1)
+            comp = n - L.shape[1]
+            if comp <= 0:
+                break
+            kth = np.sort(np.asarray(locked_vals))[::-1][k - 1]
+            vals, vecs, exact = _lanczos_run(
+                matvec, n, min(k, comp), L, tol, max_iter, rng
+            )
+            gate = kth + tol * max(abs(kth), 1.0)
+            keep = [i for i, v in enumerate(vals) if v > gate]
+            if not keep:
+                break
+            locked_vals.extend(vals[i] for i in keep)
+            locked_vecs.extend(vecs[:, i] for i in keep)
 
     order = np.argsort(locked_vals)[::-1][:k]
     evals = np.asarray(locked_vals)[order]

@@ -174,7 +174,7 @@ def _column_block_lists(mask: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
 
 
 @functools.cache
-def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision="highest"):
+def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(m // bm, n // bn, k // bs),
@@ -191,6 +191,17 @@ def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision="highest"):
         interpret=interpret,
     )
     return jax.jit(f)
+
+
+def _is_concrete(x) -> bool:
+    """True when ``x`` has a concrete value (not an abstract tracer). Probed
+    via np.asarray rather than an isinstance on jax.core.Tracer — the
+    jax.core public namespace is being pruned and the class may move."""
+    try:
+        np.asarray(x)
+        return True
+    except Exception:
+        return False
 
 
 def block_sparse_matmul(
@@ -211,7 +222,7 @@ def block_sparse_matmul(
         # The backing array keeps empty blocks zeroed, so a plain dot is the
         # correct (dense-speed) fallback.
         out = jnp.dot(ap, b.data, precision=precision)
-    elif isinstance(b.mask, jax.core.Tracer):
+    elif not _is_concrete(b.mask):
         # Under an outer jit the mask has no concrete value; run the full
         # (M, N, K) grid with mask-guarded accumulation.
         out = _spmm_fn(

@@ -134,6 +134,41 @@ class TestLanczos:
             r = g @ evecs[:, i] - evals[i] * evecs[:, i]
             assert np.linalg.norm(r) < 1e-8
 
+    def test_repeated_top_eigenvalue_multiplicity(self):
+        # Exact multiplicity > 1 AT THE TOP with a distinct eigenvalue below:
+        # the exact-breakdown sweep sees each distinct value once, so without
+        # the complement re-search the answer would be (10, 5) instead of
+        # (10, 10).
+        n, k = 3, 2
+        g = np.diag([10.0, 10.0, 5.0])
+        evals, evecs = symmetric_eigs(lambda v: g @ v, n, k)
+        np.testing.assert_allclose(evals, [10.0, 10.0], atol=1e-8)
+        np.testing.assert_allclose(evecs.T @ evecs, np.eye(k), atol=1e-8)
+        for i in range(k):
+            r = g @ evecs[:, i] - evals[i] * evecs[:, i]
+            assert np.linalg.norm(r) < 1e-8
+
+    def test_equal_eigenvalue_projector(self, rng):
+        # Rank-2 projector u u^T + v v^T: both nonzero eigenvalues equal (1.0);
+        # k=2 must return (1, 1), not (1, 0).
+        n, k = 10, 2
+        q = np.linalg.qr(rng.standard_normal((n, 2)))[0]
+        g = q @ q.T
+        evals, evecs = symmetric_eigs(lambda v: g @ v, n, k)
+        np.testing.assert_allclose(evals, [1.0, 1.0], atol=1e-8)
+        np.testing.assert_allclose(evecs.T @ evecs, np.eye(k), atol=1e-8)
+        for i in range(k):
+            r = g @ evecs[:, i] - evals[i] * evecs[:, i]
+            assert np.linalg.norm(r) < 1e-8
+
+    def test_repeated_top_with_larger_multiplicity(self):
+        # Multiplicity 3 at the top plus a tail value — requires more than one
+        # complement re-search sweep.
+        n, k = 5, 3
+        g = np.diag([10.0, 10.0, 10.0, 5.0, 1.0])
+        evals, _ = symmetric_eigs(lambda v: g @ v, n, k)
+        np.testing.assert_allclose(evals, [10.0, 10.0, 10.0], atol=1e-8)
+
     def test_clustered_eigenvalues(self, rng):
         # Near-multiplicity cluster at the top; full reorth + restarts must
         # resolve all three pairs to tolerance.
