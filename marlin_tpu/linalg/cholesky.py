@@ -3,13 +3,18 @@
 Counterpart of ``DenseVecMatrix.choleskyDecompose`` (DenseVecMatrix.scala:
 475-561): returns the lower-triangular L (A = L L^T) as a BlockMatrix. The
 reference's dist path mirrors its LU driver loop (driver-local ``brzCholesky``
-of the diagonal block + broadcast + distributed Schur update); here it is a
-host loop over logical panels of one sharded array — diagonal-block Cholesky
-via XLA, a right-side triangular solve for the panel below, one sharded GEMM
-for the Schur complement. No pivoting (SPD input assumed, as in the reference).
+of the diagonal block + broadcast + distributed Schur update); here the whole
+panel loop is ONE jitted XLA program (``lax.fori_loop`` over panels, like
+``lu._lu_blocked_core``): diagonal-block Cholesky at a dynamic offset, a
+fixed-shape column-stripe triangular solve with an iota mask selecting the
+trailing rows, and the Schur complement as one masked sharded GEMM. Single
+compile, no host round-trips inside the loop. No pivoting (SPD input assumed,
+as in the reference).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -32,29 +37,47 @@ def cholesky_factor_array(a: jax.Array, mode: str = "auto", base_size: int = Non
 
 
 def _cholesky_blocked(a: jax.Array, base: int) -> jax.Array:
+    from .lu import _pad_identity
+
     n = a.shape[0]
-    prec = get_config().matmul_precision
-    for j0 in range(0, n, base):
-        b = min(base, n - j0)
+    npad = -(-n // base) * base
+    if npad != n:
+        a = _pad_identity(a, npad)
+    l = _cholesky_blocked_core(
+        a, base=base, prec=get_config().matmul_precision
+    )
+    return l[:n, :n] if npad != n else l
+
+
+@functools.partial(jax.jit, static_argnames=("base", "prec"))
+def _cholesky_blocked_core(a: jax.Array, *, base: int, prec) -> jax.Array:
+    """Right-looking blocked Cholesky as one XLA program."""
+    n = a.shape[0]
+    idx = jnp.arange(n)
+
+    def body(i, a):
+        j0 = i * base
         # L11 = chol(A11) — the reference's driver-local panel factorization
         # (DenseVecMatrix.scala:498-527), staying in HBM here.
-        l11 = jnp.linalg.cholesky(a[j0 : j0 + b, j0 : j0 + b])
-        a = a.at[j0 : j0 + b, j0 : j0 + b].set(l11)
-        if j0 + b < n:
-            # L21 = A21 L11^-T — distributed right-side triangular solve.
-            l21 = jax.lax.linalg.triangular_solve(
-                l11,
-                a[j0 + b :, j0 : j0 + b],
-                left_side=False,
-                lower=True,
-                transpose_a=True,
-            )
-            a = a.at[j0 + b :, j0 : j0 + b].set(l21)
-            # Schur: A22 -= L21 L21^T — one sharded GEMM (the reference's
-            # shuffle-based trailing update).
-            a = a.at[j0 + b :, j0 + b :].add(
-                -jnp.dot(l21, l21.T, precision=prec)
-            )
+        l11 = jnp.linalg.cholesky(
+            jax.lax.dynamic_slice(a, (j0, j0), (base, base))
+        )
+        # L21 = A21 L11^-T on the whole column stripe; trailing rows only.
+        cstripe = jax.lax.dynamic_slice(a, (0, j0), (n, base))
+        l21 = jax.lax.linalg.triangular_solve(
+            l11, cstripe, left_side=False, lower=True, transpose_a=True
+        )
+        trailing = idx >= j0 + base
+        cstripe = jnp.where(trailing[:, None], l21, cstripe)
+        cstripe = jax.lax.dynamic_update_slice(cstripe, l11, (j0, 0))
+        a = jax.lax.dynamic_update_slice(a, cstripe, (0, j0))
+        # Schur: A22 -= L21 L21^T — one masked sharded GEMM (the reference's
+        # shuffle-based trailing update). The mask zeroes non-trailing rows,
+        # so the product only touches the trailing block.
+        lm = jnp.where(trailing[:, None], cstripe, 0)
+        return a - jnp.dot(lm, lm.T, precision=prec)
+
+    a = jax.lax.fori_loop(0, n // base, body, a)
     # Zero the (stale) upper triangle so the result is exactly L.
     return jnp.tril(a)
 

@@ -6,14 +6,27 @@ reference's driver loop collects the diagonal block to the driver, runs LAPACK
 ``dgetrf`` locally, broadcasts (L, U, perm), runs distributed triangular solves
 and a shuffle-based Schur update per panel (call stack SURVEY.md §3.2).
 
-TPU-native restatement: a host-Python loop over logical panels of ONE sharded
-array. Per panel: XLA's ``lax.linalg.lu`` factors the *tall pivot panel*
-in place (rows j.. x panel cols — this also does the reference's
-``rowExchange`` pivot search across all blocks below the diagonal), the row
-permutation is applied to the trailing columns as a gather (XLA lowers it to
-ICI ppermute of stripes), the U row-block comes from a unit-lower triangular
-solve, and the Schur complement is one sharded GEMM. "Collect diag block to
-driver + broadcast" disappears: blocks never leave HBM.
+TPU-native restatement: the WHOLE panel loop is ONE jitted XLA program — a
+``lax.fori_loop`` over panels in which every per-panel operation is a
+fixed-shape stripe update at a dynamic offset:
+
+* diagonal ``base x base`` block factored by ``lax.linalg.lu`` with pivoting
+  local to the block — exactly the reference's semantics (it collects only the
+  diagonal block to the driver and runs ``brzLU`` on it,
+  DenseVecMatrix.scala:345-349), with "collect + broadcast" deleted: the block
+  never leaves HBM;
+* the panel's row permutation applied to the full ``base``-row stripe as a
+  gather (the reference's ``rowExchange`` bookkeeping, :438-460);
+* U12 / L21 via full-stripe triangular solves with iota masks selecting the
+  trailing region (fixed shapes keep XLA from recompiling per panel);
+* the Schur complement as one masked GEMM over the sharded array — the
+  reference's emit-join-outer-product shuffle (:392-428) becomes a GEMM whose
+  sharding GSPMD propagates over the mesh.
+
+Single compile for any n, zero host round-trips inside the loop (the
+fori_loop carry updates in place; the caller's input is left intact). The masked full-shape Schur GEMM trades ~3x the minimal FLOPs
+for fixed shapes; on the MXU that is the winning trade (panel-shaped GEMMs
+would recompile n/base times and tile poorly).
 
 Permutation convention: returns ``perm`` with ``A[perm] = L @ U`` (row ``i`` of
 the factorization came from original row ``perm[i]``).
@@ -21,6 +34,7 @@ the factorization came from original row ``perm[i]``).
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -57,41 +71,86 @@ def lu_factor_array(a: jax.Array, mode: str = "auto", base_size: int = None):
     return _lu_blocked(a, base)
 
 
-def _lu_blocked(a: jax.Array, base: int) -> Tuple[jax.Array, np.ndarray]:
-    """Right-looking blocked LU over logical panels of the sharded array."""
+def _pad_identity(a: jax.Array, npad: int) -> jax.Array:
+    """Embed a in the top-left of an npad x npad matrix with an identity tail:
+    the padded factorization is block-diagonal, so real panels are unaffected
+    and the pad block factors trivially (its local pivots stay in place)."""
     n = a.shape[0]
-    perm = jnp.arange(n)
-    for j0 in range(0, n, base):
-        b = min(base, n - j0)
-        # Factor the tall pivot panel (rows j0.., panel columns).
-        panel = a[j0:, j0 : j0 + b]
-        plu, _, pperm = jax.lax.linalg.lu(panel)
-        # Apply the panel's row permutation to ALL columns of rows j0.. —
-        # the reference's rowExchange bookkeeping (DenseVecMatrix.scala:438-460)
-        # as one gather.
-        a = a.at[j0:, :].set(a[j0:, :][pperm, :])
-        perm = perm.at[j0:].set(perm[j0:][pperm])
-        # Write the packed panel (L21 below, L11\U11 on the diagonal block).
-        a = a.at[j0:, j0 : j0 + b].set(plu)
-        if j0 + b < n:
-            # U12 = unit_lower(L11)^-1 A12 — the distributed triangular solve
-            # (A2 <- L \ A2, DenseVecMatrix.scala:370-387).
-            l11 = plu[:b, :b]
-            u12 = jax.lax.linalg.triangular_solve(
-                l11,
-                a[j0 : j0 + b, j0 + b :],
-                left_side=True,
-                lower=True,
-                unit_diagonal=True,
-            )
-            a = a.at[j0 : j0 + b, j0 + b :].set(u12)
-            # Schur complement: A22 -= L21 @ U12 — the reference's
-            # emit-join-outer-product shuffle (:392-428) as one sharded GEMM.
-            l21 = plu[b:, :b]
-            a = a.at[j0 + b :, j0 + b :].add(
-                -jnp.dot(l21, u12, precision=get_config().matmul_precision)
-            )
-    return a, np.asarray(jax.device_get(perm))
+    out = jnp.zeros((npad, npad), a.dtype)
+    out = jax.lax.dynamic_update_slice(out, a, (0, 0))
+    tail = jnp.eye(npad - n, dtype=a.dtype)
+    return jax.lax.dynamic_update_slice(out, tail, (n, n))
+
+
+def _lu_blocked(a: jax.Array, base: int) -> Tuple[jax.Array, np.ndarray]:
+    n = a.shape[0]
+    npad = -(-n // base) * base
+    ap = _pad_identity(a, npad) if npad != n else a
+    packed, perm = _lu_blocked_core(
+        ap, base=base, prec=get_config().matmul_precision
+    )
+    if npad != n:
+        packed, perm = packed[:n, :n], perm[:n]
+    # Pivoting is local to the diagonal block (the reference's semantics —
+    # it factors only the collected diag block). A (near-)singular leading
+    # base x base block then divides by a (near-)zero pivot: exactly zero
+    # gives non-finite values, tiny-but-nonzero gives finite garbage whose
+    # signature is huge element growth in L21 (~1/pivot). Trip on either —
+    # growth for true partial pivoting is ~n^(2/3) in practice, orders of
+    # magnitude under the 100*sqrt(n) gate — and fall back to XLA's fully
+    # pivoted LU so such inputs still factor (one host sync, once).
+    finite = bool(jnp.isfinite(packed).all())
+    scale = float(jnp.max(jnp.abs(a)))
+    growth = float(jnp.max(jnp.abs(packed))) / max(scale, 1e-30)
+    if not finite or growth > 100.0 * np.sqrt(n):
+        packed, _, perm = jax.lax.linalg.lu(a)
+    return packed, np.asarray(jax.device_get(perm))
+
+
+@functools.partial(jax.jit, static_argnames=("base", "prec"))
+def _lu_blocked_core(a: jax.Array, *, base: int, prec) -> Tuple[jax.Array, jax.Array]:
+    """Right-looking blocked LU as one XLA program (see module docstring)."""
+    n = a.shape[0]
+    idx = jnp.arange(n)
+
+    def body(i, carry):
+        a, perm = carry
+        j0 = i * base
+        diag = jax.lax.dynamic_slice(a, (j0, j0), (base, base))
+        plu, _, pp = jax.lax.linalg.lu(diag)
+        # Permute the panel's full rows (pivoting local to the diagonal
+        # block — the reference's driver-side getrf of the collected block).
+        rows = jax.lax.dynamic_slice(a, (j0, 0), (base, n))[pp, :]
+        rows = jax.lax.dynamic_update_slice(rows, plu, (0, j0))
+        # U12 = unit_lower(L11)^-1 A12, computed on the whole row stripe and
+        # written only to trailing columns (the already-final L values to the
+        # left keep their permuted contents).
+        l11 = jnp.tril(plu, -1) + jnp.eye(base, dtype=a.dtype)
+        solved = jax.lax.linalg.triangular_solve(
+            l11, rows, left_side=True, lower=True, unit_diagonal=True
+        )
+        trailing_col = idx >= j0 + base
+        rows = jnp.where(trailing_col[None, :], solved, rows)
+        a = jax.lax.dynamic_update_slice(a, rows, (j0, 0))
+        # L21 = A21 U11^-1 on the whole column stripe, trailing rows only.
+        cstripe = jax.lax.dynamic_slice(a, (0, j0), (n, base))
+        u11 = jnp.triu(plu)
+        l21 = jax.lax.linalg.triangular_solve(
+            u11, cstripe, left_side=False, lower=False
+        )
+        trailing_row = idx >= j0 + base
+        cstripe = jnp.where(trailing_row[:, None], l21, cstripe)
+        a = jax.lax.dynamic_update_slice(a, cstripe, (0, j0))
+        # Schur complement A22 -= L21 @ U12 as one masked sharded GEMM.
+        lm = jnp.where(trailing_row[:, None], cstripe, 0)
+        um = jnp.where(trailing_col[None, :], rows, 0)
+        a = a - jnp.dot(lm, um, precision=prec)
+        # Compose the panel's local permutation into the global pivot array.
+        pseg = jax.lax.dynamic_slice(perm, (j0,), (base,))
+        perm = jax.lax.dynamic_update_slice(perm, pseg[pp], (j0,))
+        return a, perm
+
+    return jax.lax.fori_loop(0, n // base, body, (a, idx))
 
 
 def lu_decompose(mat, mode: str = "auto"):

@@ -63,6 +63,15 @@ class BlockSparse:
         self.data = jnp.where(block_mask != 0, data, jnp.zeros((), data.dtype))
         self.mask = mask
         self.block_size = block_size
+        # Probe concreteness ONCE at construction (a per-multiply probe would
+        # add a blocking device sync to every call): under a trace the
+        # conversion raises; eagerly it yields the host mask the gather
+        # lists need anyway.
+        try:
+            self._host_mask = np.asarray(mask)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            self._host_mask = None
         self._gather_lists_cache = None
 
     def _gather_lists(self):
@@ -70,7 +79,7 @@ class BlockSparse:
         instance (the mask sync + column scan would otherwise run on every
         multiply of a reused operand)."""
         if self._gather_lists_cache is None:
-            kidx, kcnt, max_nnz = _column_block_lists(np.asarray(self.mask))
+            kidx, kcnt, max_nnz = _column_block_lists(self._host_mask)
             self._gather_lists_cache = (
                 jnp.asarray(kidx), jnp.asarray(kcnt), max_nnz
             )
@@ -193,17 +202,6 @@ def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision):
     return jax.jit(f)
 
 
-def _is_concrete(x) -> bool:
-    """True when ``x`` has a concrete value (not an abstract tracer). Probed
-    via np.asarray rather than an isinstance on jax.core.Tracer — the
-    jax.core public namespace is being pruned and the class may move."""
-    try:
-        np.asarray(x)
-        return True
-    except Exception:
-        return False
-
-
 def block_sparse_matmul(
     a: jax.Array, b: BlockSparse, interpret: Optional[bool] = None
 ) -> jax.Array:
@@ -222,7 +220,7 @@ def block_sparse_matmul(
         # The backing array keeps empty blocks zeroed, so a plain dot is the
         # correct (dense-speed) fallback.
         out = jnp.dot(ap, b.data, precision=precision)
-    elif not _is_concrete(b.mask):
+    elif b._host_mask is None:
         # Under an outer jit the mask has no concrete value; run the full
         # (M, N, K) grid with mask-guarded accumulation.
         out = _spmm_fn(

@@ -52,6 +52,36 @@ class TestLU:
         with pytest.raises(ValueError):
             DenseVecMatrix(rng.standard_normal((4, 4))).lu_decompose(mode="gpu")
 
+    def test_singular_leading_block_falls_back(self, rng):
+        # Nonsingular matrix whose leading base x base block is singular:
+        # diagonal-block-local pivoting divides by a zero pivot, so the
+        # non-finite tripwire must reroute to XLA's fully pivoted LU.
+        n, b = 16, 4
+        a = np.zeros((n, n))
+        a[: n // 2, n // 2 :] = np.eye(n // 2)
+        a[n // 2 :, : n // 2] = np.eye(n // 2)
+        a += 0.01 * rng.standard_normal((n, n))
+        # Make the leading 4x4 exactly singular (one zero column).
+        a[:, 0] = 0.0
+        a[n - 1, 0] = 1.0  # keep A itself nonsingular
+        with mt.config_override(lu_base_size=b):
+            packed, perm = lu_factor_array(DenseVecMatrix(a).logical, mode="dist")
+        l, u = unpack_lu(np.asarray(packed))
+        assert np.all(np.isfinite(np.asarray(packed)))
+        np.testing.assert_allclose(l @ u, a[perm], rtol=1e-9, atol=1e-9)
+
+    def test_near_singular_leading_block_falls_back(self, rng):
+        # Tiny-but-nonzero leading block: values stay finite but element
+        # growth explodes (~1/pivot); the growth tripwire must reroute to
+        # the fully pivoted XLA path instead of returning garbage.
+        n, b = 16, 4
+        a = rng.standard_normal((n, n))
+        a[:b, :b] *= 1e-7
+        with mt.config_override(lu_base_size=b):
+            packed, perm = lu_factor_array(DenseVecMatrix(a).logical, mode="dist")
+        l, u = unpack_lu(np.asarray(packed))
+        np.testing.assert_allclose(l @ u, a[perm], rtol=1e-8, atol=1e-8)
+
     def test_pivoting_needed(self):
         # Zero on the diagonal forces a row exchange.
         a = np.array([[0.0, 1.0], [1.0, 0.0]])
