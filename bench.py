@@ -352,6 +352,54 @@ def _sized(env, default):
     return int(os.environ.get(env, default))
 
 
+def config_sparse_dist():
+    """Distributed sparse x sparse: row-sharded COO ring engine
+    (matrix/dist_sparse.py) at the reference SparseMultiply regime
+    (SparseMultiply.scala:31-82: random sparse operands, sparse COO result).
+    Effective throughput counts the algorithm's real work, nnz(A) * n MACs.
+    Oracle: dense product at 2048 on hardware."""
+    import numpy as np
+
+    from marlin_tpu.matrix.dist_sparse import DistSparseVecMatrix
+
+    def make(m, n, density, seed):
+        r = np.random.default_rng(seed)
+        nnz = int(m * n * density)
+        rows = r.integers(0, m, nnz)
+        cols = r.integers(0, n, nnz)
+        vals = r.standard_normal(nnz).astype(np.float32)
+        return rows, cols, vals
+
+    # Oracle at 2048.
+    no = 2048
+    ra, ca, va = make(no, no, 5e-3, 1)
+    rb, cb, vb = make(no, no, 5e-3, 2)
+    a = DistSparseVecMatrix.from_coo(ra, ca, va, (no, no))
+    b = DistSparseVecMatrix.from_coo(rb, cb, vb, (no, no))
+    got = a.multiply_sparse(b).to_numpy()
+    da = np.zeros((no, no), np.float64); np.add.at(da, (ra, ca), va)
+    db = np.zeros((no, no), np.float64); np.add.at(db, (rb, cb), vb)
+    ref = da @ db
+    scale = max(float(np.max(np.abs(ref))), 1e-30)
+    err = float(np.max(np.abs(got - ref))) / scale
+
+    n = _sized("BENCH_SPARSE_DIST_N", 16384)
+    density = 1e-3
+    ra, ca, va = make(n, n, density, 3)
+    rb, cb, vb = make(n, n, density, 4)
+    a = DistSparseVecMatrix.from_coo(ra, ca, va, (n, n))
+    b = DistSparseVecMatrix.from_coo(rb, cb, vb, (n, n))
+    a.multiply_sparse(b).nnz  # warmup: compiles ring + extraction kernels
+    t0 = time.perf_counter()
+    out = a.multiply_sparse(b)
+    nnz_out = out.nnz  # forces the sharded extraction
+    dt = time.perf_counter() - t0
+    eff = 2.0 * len(va) * n / dt / 1e9
+    return {"metric": f"sparse_dist_ring_{n//1024}k_gflops", "value": round(eff, 2),
+            "unit": "GFLOP/s", "vs_baseline": 0, "nnz_out": int(nnz_out),
+            "oracle_max_err": round(err, 9), "oracle_ok": err < 1e-3}
+
+
 def config_lu():
     """Blocked LU (single-jit fori_loop panel sweep) vs raw XLA lu at 16k f32.
 
@@ -454,6 +502,7 @@ CONFIGS = {
     "summa": [config_summa_mesh],
     "attention": [config_attention],
     "sparse": [config_sparse],
+    "sparsedist": [config_sparse_dist],
     "lu": [config_lu],
     "cholesky": [config_cholesky],
     "inverse": [config_inverse],

@@ -43,13 +43,22 @@ class MatrixEntry:
 
 
 class CoordinateMatrix:
-    """COO-format distributed matrix."""
+    """COO-format distributed matrix.
 
-    def __init__(self, rows, cols, values, shape: Optional[Tuple[int, int]] = None, mesh=None):
+    The triple arrays may be mesh-sharded jax Arrays (the distributed sparse
+    product returns them that way — each device holds its output stripe's
+    entries); all metadata ops are reductions that run sharded. With
+    ``padded=True`` the arrays carry fixed-size per-stripe padding — pad
+    entries have value 0 at index (0, 0) — and logical views (``nnz``,
+    ``entries``) exclude them."""
+
+    def __init__(self, rows, cols, values, shape: Optional[Tuple[int, int]] = None, mesh=None,
+                 padded: bool = False):
         self.mesh = mesh or default_mesh()
         self.row_idx = jnp.asarray(rows, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
         self.col_idx = jnp.asarray(cols, self.row_idx.dtype)
         self.values = jnp.asarray(values)
+        self.padded = bool(padded)
         if self.row_idx.shape != self.col_idx.shape or self.row_idx.shape != self.values.shape:
             raise ValueError("rows/cols/values must have equal lengths")
         self._shape = shape
@@ -78,12 +87,17 @@ class CoordinateMatrix:
 
     @property
     def nnz(self) -> int:
+        if self.padded:
+            return int(jnp.sum(self.values != 0))
         return int(self.values.shape[0])
 
     def entries(self):
         r = np.asarray(self.row_idx)
         c = np.asarray(self.col_idx)
         v = np.asarray(self.values)
+        if self.padded:
+            keep = v != 0
+            r, c, v = r[keep], c[keep], v[keep]
         return [MatrixEntry(*t) for t in zip(r, c, v)]
 
     # -- conversions --------------------------------------------------------
@@ -117,8 +131,32 @@ class CoordinateMatrix:
         return DenseVecMatrix(out, mesh=mesh)
 
     def to_bcoo(self) -> jsparse.BCOO:
+        if self.padded:
+            # Pads are explicit zeros at (0, 0); leaking them would inflate
+            # nse and duplicate-index every downstream bcoo op.
+            v = np.asarray(self.values)
+            keep = v != 0
+            idx = jnp.stack(
+                [jnp.asarray(np.asarray(self.row_idx)[keep]),
+                 jnp.asarray(np.asarray(self.col_idx)[keep])], axis=1,
+            )
+            return jsparse.BCOO((jnp.asarray(v[keep]), idx), shape=self.shape)
         idx = jnp.stack([self.row_idx, self.col_idx], axis=1)
         return jsparse.BCOO((self.values, idx), shape=self.shape)
+
+    def to_dist_sparse(self, mesh=None):
+        """Row-partitioned distributed sparse form (dist_sparse module)."""
+        from .dist_sparse import DistSparseVecMatrix
+
+        r = np.asarray(self.row_idx)
+        c = np.asarray(self.col_idx)
+        v = np.asarray(self.values)
+        if self.padded:
+            keep = v != 0
+            r, c, v = r[keep], c[keep], v[keep]
+        return DistSparseVecMatrix.from_coo(
+            r, c, v, self.shape, mesh=mesh or self.mesh
+        )
 
     def to_sparse_vec_matrix(self, mesh=None):
         return SparseVecMatrix(self.to_bcoo(), mesh=mesh or self.mesh)
@@ -205,21 +243,25 @@ class SparseVecMatrix:
     # -- ops ----------------------------------------------------------------
     def multiply_sparse(self, other: "SparseVecMatrix") -> CoordinateMatrix:
         """Sparse x sparse -> COO result (``multiplySparse``,
-        SparseVecMatrix.scala:22-50). The reference emits per-k outer products
-        and reduces by (i, j); here the contraction is one bcoo_dot_general and
-        the result is re-sparsified."""
+        SparseVecMatrix.scala:22-50). Routed through the distributed ring
+        engine (dist_sparse): operands are row-partitioned over the mesh, B's
+        COO shards rotate over ICI, and the result's triples come back
+        mesh-sharded — no device holds the full operands or an O(m*n)
+        densified product."""
         if self.num_cols != other.num_rows:
             raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
-        out_dense = jsparse.bcoo_dot_general(
-            self._bcoo,
-            other._bcoo,
-            dimension_numbers=(((1,), (0,)), ((), ())),
+        a = self.distribute()
+        b = other.distribute(mesh=self.mesh)
+        return a.multiply_sparse(b)
+
+    def distribute(self, mesh=None):
+        """Row-partitioned distributed form (dist_sparse module) — the
+        counterpart of the reference's partitioned RDD[(Long, BSV)]."""
+        from .dist_sparse import DistSparseVecMatrix
+
+        return DistSparseVecMatrix.from_sparse_vec_matrix(
+            self, mesh=mesh or self.mesh
         )
-        if isinstance(out_dense, jsparse.BCOO):
-            out_dense = out_dense.todense()
-        r, c = jnp.nonzero(out_dense)
-        v = out_dense[r, c]
-        return CoordinateMatrix(r, c, v, shape=(self.num_rows, other.num_cols), mesh=self.mesh)
 
     def multiply(self, other):
         """Sparse x (sparse | dense): dense operand uses the densified row

@@ -1,0 +1,156 @@
+"""Distributed sparse layer: row-sharded COO over the 8-device mesh.
+
+VERDICT round-1 item #2: sparse must be *actually* distributed — operands and
+result spread over the mesh, no O(m*n) single-device densify. Golden pattern:
+ring product vs NumPy oracle on the dense forms; sharding asserted on the
+triple arrays themselves."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.matrix.dense import DenseVecMatrix
+from marlin_tpu.matrix.dist_sparse import DistSparseVecMatrix
+from marlin_tpu.matrix.sparse import CoordinateMatrix, SparseVecMatrix
+
+
+def _random_coo(rng, m, n, density):
+    mask = rng.random((m, n)) < density
+    r, c = np.nonzero(mask)
+    v = rng.standard_normal(r.shape[0])
+    return r, c, v
+
+
+def _dense(r, c, v, shape):
+    a = np.zeros(shape)
+    np.add.at(a, (r, c), v)
+    return a
+
+
+class TestDistSparseVecMatrix:
+    def test_construction_shards_over_all_devices(self, rng, mesh):
+        r, c, v = _random_coo(rng, 40, 32, 0.2)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (40, 32))
+        n_dev = len(mesh.devices.flat)
+        assert a.rows.shape[0] == n_dev
+        # Each device holds exactly one stripe of the triples.
+        assert len(a.vals.sharding.device_set) == n_dev
+        assert a.nnz == len(v)
+        np.testing.assert_allclose(a.to_numpy(), _dense(r, c, v, (40, 32)))
+
+    def test_round_trip_sparse_vec_matrix(self, rng):
+        r, c, v = _random_coo(rng, 24, 16, 0.15)
+        svm = SparseVecMatrix.from_coo(r, c, v, (24, 16))
+        dist = svm.distribute()
+        back = dist.to_sparse_vec_matrix()
+        np.testing.assert_allclose(back.to_numpy(), svm.to_numpy())
+
+    @pytest.mark.parametrize("shape_a,shape_b,density", [
+        ((48, 40), (40, 56), 0.15),
+        ((17, 23), (23, 9), 0.3),    # uneven stripes
+        ((64, 64), (64, 64), 0.02),  # sparse enough for empty stripes
+    ])
+    def test_multiply_sparse_vs_oracle(self, rng, shape_a, shape_b, density):
+        ra, ca, va = _random_coo(rng, *shape_a, density)
+        rb, cb, vb = _random_coo(rng, *shape_b, density)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, shape_a)
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, shape_b)
+        out = a.multiply_sparse(b)
+        assert isinstance(out, CoordinateMatrix)
+        oracle = _dense(ra, ca, va, shape_a) @ _dense(rb, cb, vb, shape_b)
+        np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
+
+    def test_result_triples_stay_sharded(self, rng, mesh):
+        ra, ca, va = _random_coo(rng, 48, 40, 0.2)
+        rb, cb, vb = _random_coo(rng, 40, 32, 0.2)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (48, 40))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, (40, 32))
+        out = a.multiply_sparse(b)
+        # The product's triple arrays are themselves mesh-sharded: the COO
+        # result never lands on one device.
+        assert len(out.values.sharding.device_set) == len(mesh.devices.flat)
+        assert out.padded
+        # Logical nnz excludes stripe padding.
+        oracle = _dense(ra, ca, va, (48, 40)) @ _dense(rb, cb, vb, (40, 32))
+        assert out.nnz == int(np.count_nonzero(oracle))
+
+    def test_multiply_dense_vs_oracle(self, rng):
+        ra, ca, va = _random_coo(rng, 40, 48, 0.2)
+        bd = rng.standard_normal((48, 24))
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (40, 48))
+        out = a.multiply_dense(DenseVecMatrix(bd))
+        assert isinstance(out, DenseVecMatrix)
+        oracle = _dense(ra, ca, va, (40, 48)) @ bd
+        np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
+
+    def test_unaligned_cap_repadded(self, mesh):
+        # Direct __init__ with cap not a multiple of the entry chunk: the
+        # ctor must re-pad, or entries past the last full chunk are silently
+        # dropped by the chunked accumulator.
+        nd = len(mesh.devices.flat)
+        n = 16
+        for cap in (1, 129):
+            r = np.zeros((nd, cap), np.int32)
+            c = np.zeros((nd, cap), np.int32)
+            v = np.zeros((nd, cap))
+            # One real entry per shard, in the LAST slot.
+            stripe = -(-n // nd)
+            for d in range(nd):
+                row = min(d * stripe, n - 1)
+                r[d, :] = row
+                r[d, -1] = row
+                c[d, -1] = row
+                v[d, -1] = 1.0
+            a = DistSparseVecMatrix(r, c, v, (n, n))
+            eye_r, eye_c = np.arange(n), np.arange(n)
+            b = DistSparseVecMatrix.from_coo(eye_r, eye_c, np.ones(n), (n, n))
+            out = a.multiply_sparse(b)
+            np.testing.assert_allclose(out.to_numpy(), a.to_numpy())
+
+    def test_padded_to_bcoo_filters_pads(self, rng, mesh):
+        ra, ca, va = _random_coo(rng, 32, 40, 0.2)
+        rb, cb, vb = _random_coo(rng, 40, 24, 0.2)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (32, 40))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, (40, 24))
+        out = a.multiply_sparse(b)
+        svm = out.to_sparse_vec_matrix()
+        oracle = _dense(ra, ca, va, (32, 40)) @ _dense(rb, cb, vb, (40, 24))
+        assert svm.nnz == int(np.count_nonzero(oracle))
+        np.testing.assert_allclose(svm.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
+
+    def test_dimension_mismatch_raises(self, rng):
+        r, c, v = _random_coo(rng, 8, 8, 0.3)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (8, 8))
+        b = DistSparseVecMatrix.from_coo(r, c, v, (8, 8))
+        b._shape = (9, 8)
+        with pytest.raises(ValueError):
+            a.multiply_sparse(b)
+
+    def test_empty_operand(self, mesh):
+        a = DistSparseVecMatrix.from_coo([], [], np.zeros(0), (16, 16))
+        b = DistSparseVecMatrix.from_coo([0], [0], [1.0], (16, 16))
+        out = a.multiply_sparse(b)
+        assert out.nnz == 0
+        np.testing.assert_allclose(out.to_numpy(), np.zeros((16, 16)))
+
+
+class TestSparseVecMatrixRouting:
+    def test_multiply_sparse_routes_distributed(self, rng, mesh):
+        # The legacy single-BCOO type's sparse x sparse now runs the ring
+        # engine and returns mesh-sharded triples (round-1 VERDICT: the old
+        # path densified O(m*n) on one device).
+        ra, ca, va = _random_coo(rng, 32, 40, 0.2)
+        rb, cb, vb = _random_coo(rng, 40, 24, 0.2)
+        a = SparseVecMatrix.from_coo(ra, ca, va, (32, 40))
+        b = SparseVecMatrix.from_coo(rb, cb, vb, (40, 24))
+        out = a.multiply_sparse(b)
+        assert isinstance(out, CoordinateMatrix)
+        assert len(out.values.sharding.device_set) == len(mesh.devices.flat)
+        oracle = _dense(ra, ca, va, (32, 40)) @ _dense(rb, cb, vb, (40, 24))
+        np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
+
+    def test_coordinate_to_dist_sparse(self, rng):
+        r, c, v = _random_coo(rng, 20, 20, 0.2)
+        coo = CoordinateMatrix(r, c, v, shape=(20, 20))
+        dist = coo.to_dist_sparse()
+        np.testing.assert_allclose(dist.to_numpy(), _dense(r, c, v, (20, 20)))
