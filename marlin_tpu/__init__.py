@@ -11,7 +11,7 @@ ICI collectives instead of RDDs and shuffles.
 """
 
 from .config import MarlinConfig, config_override, enable_x64, get_config, set_config
-from .mesh import create_mesh, default_mesh, set_default_mesh
+from .mesh import create_mesh, default_mesh, init_distributed, set_default_mesh
 from .matrix.base import DistributedMatrix
 from .matrix.block import BlockMatrix
 from .matrix.dense import DenseVecMatrix
@@ -28,6 +28,7 @@ __all__ = [
     "set_config",
     "create_mesh",
     "default_mesh",
+    "init_distributed",
     "set_default_mesh",
     "DistributedMatrix",
     "BlockMatrix",

@@ -1,0 +1,114 @@
+"""Worker process for the 2-process ``jax.distributed`` test.
+
+The reference's multi-node substrate is Spark's driver/executor RPC + shuffle
+service (SURVEY.md §2.8); ours is ``mesh.init_distributed`` →
+``jax.distributed.initialize``. This worker is launched twice (process_id 0/1)
+by ``tests/test_multihost.py``; each process owns 4 virtual CPU devices, and
+the two build ONE spanning 8-device mesh. Everything below then runs on a mesh
+whose collectives genuinely cross a process boundary — the closest CPU-only
+analogue of a DCN-spanning TPU pod:
+
+* sharded-type GEMM through the full auto-dispatch ``multiply`` path,
+* the explicit shard_map SUMMA engine,
+* a cross-process ``psum`` (tree-reduce analogue),
+* orbax checkpoint save + restore INTO the spanning mesh (each process
+  writes/reads only its addressable shards).
+
+Prints ``MULTIHOST_OK pid=<i>`` on success; any assertion kills the process
+and fails the parent test.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = int(sys.argv[3])
+    ckpt_dir = sys.argv[4]
+
+    # 4 virtual CPU devices per process -> 8 global. Must be set before the
+    # backend initializes; overrides any value inherited from the parent
+    # (the pytest conftest forces 8 in-process).
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+
+    # sitecustomize pins the axon TPU platform via jax.config; override back.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    import marlin_tpu as mt
+
+    mt.init_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    n_local = len(jax.local_devices())
+    n_global = len(jax.devices())
+    assert n_global == nproc * n_local, (n_global, n_local)
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from marlin_tpu import mesh as mesh_mod
+
+    mesh = mt.create_mesh()  # spans both processes: (4, 2) over 8 devices
+    mt.set_default_mesh(mesh)
+    spanning = {d.process_index for d in mesh.devices.flat}
+    assert spanning == set(range(nproc)), spanning
+
+    rng = np.random.default_rng(0)  # identical stream on every process
+
+    # --- cross-process psum: the treeReduce analogue ----------------------
+    x = jnp.arange(float(n_global))
+    xs = jax.device_put(x, mesh_mod.vector_sharding(mesh))
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(xs)
+    # The result is replicated over the spanning mesh: every process reads its
+    # own addressable copy (the "collect to driver" of a tree reduce).
+    np.testing.assert_allclose(
+        np.asarray(total.addressable_shards[0].data),
+        n_global * (n_global - 1) / 2.0,
+    )
+
+    # --- explicit SUMMA engine over the spanning mesh ---------------------
+    from marlin_tpu.parallel import summa
+
+    a = rng.standard_normal((48, 40))
+    b = rng.standard_normal((40, 24))
+    out = summa.matmul(jnp.asarray(a), jnp.asarray(b), mesh=mesh, engine="summa")
+    out_h = multihost_utils.process_allgather(out, tiled=True)
+    np.testing.assert_allclose(out_h, a @ b, rtol=1e-10, atol=1e-10)
+
+    # --- sharded-type GEMM (the SUMMA arm of the dispatch) ----------------
+    a2 = rng.standard_normal((32, 24))
+    b2 = rng.standard_normal((24, 16))
+    am = mt.DenseVecMatrix(a2, mesh=mesh)
+    bm = mt.DenseVecMatrix(b2, mesh=mesh)
+    cm = am.multiply(bm, mode="summa")
+    c_h = multihost_utils.process_allgather(cm.data, tiled=True)
+    np.testing.assert_allclose(
+        c_h[: cm.shape[0], : cm.shape[1]], a2 @ b2, rtol=1e-10, atol=1e-10
+    )
+
+    # --- checkpoint save/restore across the spanning mesh -----------------
+    from marlin_tpu.utils import checkpoint as ckpt
+
+    path = os.path.join(ckpt_dir, "mat")
+    ckpt.save_matrix(cm, path)
+    restored = ckpt.load_matrix(path, mesh=mesh)
+    assert restored.shape == cm.shape
+    r_h = multihost_utils.process_allgather(restored.data, tiled=True)
+    np.testing.assert_allclose(r_h, c_h)
+
+    print(f"MULTIHOST_OK pid={pid} local={n_local} global={n_global}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

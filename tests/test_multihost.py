@@ -1,0 +1,56 @@
+"""Multi-host (DCN) evidence: a REAL 2-process ``jax.distributed`` run.
+
+The reference's multi-node story is Spark's driver/executor backend; the
+framework's is ``mesh.init_distributed`` (SURVEY.md §2.8 DCN mapping). The
+8-device single-process mesh used everywhere else exercises collectives but
+not the process boundary — this test launches two actual OS processes, each
+with 4 virtual CPU devices, that rendezvous through the JAX coordination
+service and build one spanning 8-device mesh. See ``multihost_worker.py`` for
+what runs on it (cross-process psum, SUMMA, sharded-type GEMM, checkpoint
+save/restore).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_spanning_mesh(tmp_path):
+    port = _free_port()
+    nproc = 2
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(nproc), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out")
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {i} rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
+        assert f"MULTIHOST_OK pid={i}" in out, (out, err[-2000:])
