@@ -26,9 +26,17 @@ The sparse x sparse product is a shard_map ring:
 
 Peak per-device scratch: one (k/n_dev, n) B stripe + the (m/n_dev, n) C
 stripe accumulator + an (entry-chunk, n) expansion buffer. A's sparsity
-scales the FLOPs (work = nnz(A) * n / n_dev per device); B's sparsity scales
-the ring traffic. Column-blocking the n axis would bound the stripes further;
-not needed at reference bench sizes.
+scales the FLOPs (work = nnz(A) * n / n_dev per device): each stripe's
+entries are stored sorted by column, so every hop visits only the entry
+chunks whose k lives in the visiting B stripe (``searchsorted`` bounds into
+the chunk loop), not the whole local entry set. B's sparsity scales the ring
+traffic. Column-blocking the n axis would bound the stripes further; not
+needed at reference bench sizes.
+
+Contract: value-0 entries are STRUCTURAL throughout this module — pad slots
+carry value 0, and every consumer (``nnz``, extraction, conversions) treats
+value 0 as absent. An explicitly stored 0 entry of a BCOO operand is
+therefore not preserved across the distributed form.
 """
 
 from __future__ import annotations
@@ -133,13 +141,23 @@ class DistSparseVecMatrix:
                 [vals, np.zeros((nd, short), vals.dtype)], axis=1
             )
         sh = _triple_sharding(self.mesh)
-        self.rows = jax.device_put(jnp.asarray(rows, jnp.int32), sh)
-        self.cols = jax.device_put(jnp.asarray(cols, jnp.int32), sh)
-        self.vals = jax.device_put(jnp.asarray(vals), sh)
+        rows = jax.device_put(jnp.asarray(rows, jnp.int32), sh)
+        cols = jax.device_put(jnp.asarray(cols, jnp.int32), sh)
+        vals = jax.device_put(jnp.asarray(vals), sh)
+        # Sort each stripe's entries by column (shard-local: axis 1 is
+        # unsharded) so the ring kernels can bound each hop's chunk loop with
+        # a searchsorted on the k range instead of re-scanning every entry.
+        order = jnp.argsort(cols, axis=1)
+        self.rows = jnp.take_along_axis(rows, order, axis=1)
+        self.cols = jnp.take_along_axis(cols, order, axis=1)
+        self.vals = jnp.take_along_axis(vals, order, axis=1)
 
     # -- constructors -------------------------------------------------------
     @classmethod
     def from_coo(cls, rows, cols, vals, shape: Tuple[int, int], mesh=None):
+        """Partition host COO triples over the mesh. Value-0 entries are
+        structural here (indistinguishable from padding — see module
+        contract); callers wanting them must carry an explicit epsilon."""
         mesh = mesh or default_mesh()
         r, c, v, stripe = _partition_coo(
             rows, cols, vals, int(shape[0]), _n_dev(mesh)
@@ -222,16 +240,21 @@ class DistSparseVecMatrix:
                   other.rows, other.cols, other.vals)
 
     # -- conversions --------------------------------------------------------
+    def to_coordinate_matrix(self):
+        """Padded COO view over the same sharded triple arrays (no copy)."""
+        from .sparse import CoordinateMatrix
+
+        return CoordinateMatrix(
+            self.rows.reshape(-1), self.cols.reshape(-1),
+            self.vals.reshape(-1), shape=self.shape, mesh=self.mesh,
+            padded=True,
+        )
+
     def to_sparse_vec_matrix(self):
         from .sparse import SparseVecMatrix
 
-        r = np.asarray(self.rows).ravel()
-        c = np.asarray(self.cols).ravel()
-        v = np.asarray(self.vals).ravel()
-        keep = v != 0
-        return SparseVecMatrix.from_coo(
-            r[keep], c[keep], v[keep], self.shape, mesh=self.mesh
-        )
+        r, c, v = self.to_coordinate_matrix().compact_triples()
+        return SparseVecMatrix.from_coo(r, c, v, self.shape, mesh=self.mesh)
 
     def to_numpy(self) -> np.ndarray:
         arr = np.zeros(self.shape, dtype=self.vals.dtype)
@@ -257,11 +280,18 @@ class DistSparseVecMatrix:
 def _chunked_accumulate(acc, a_r, a_c, a_v, stripe_src, k0, row0):
     """acc += segment-sum over A entries of a_v * B_stripe[a_c - k0, :],
     processed in _ENTRY_CHUNK-row slices so the (chunk, n) expansion buffer —
-    not (cap, n) — is the peak temporary."""
-    cap = a_r.shape[0]
-    n_chunks = cap // _ENTRY_CHUNK
+    not (cap, n) — is the peak temporary.
 
+    ``a_c`` is sorted (constructor invariant), so only the chunks overlapping
+    the [k0, k0 + k_stripe) column range are visited — per hop that is
+    ~nnz_local/n_dev entries plus at most two boundary chunks, restoring the
+    nnz(A)*n/n_dev total-work claim instead of re-scanning every entry on
+    every hop."""
     k_stripe = stripe_src.shape[0]
+    lo = jnp.searchsorted(a_c, k0, side="left")
+    hi = jnp.searchsorted(a_c, k0 + k_stripe, side="left")
+    first = lo // _ENTRY_CHUNK
+    last = (hi + _ENTRY_CHUNK - 1) // _ENTRY_CHUNK
 
     def chunk_step(ci, acc):
         sl = lambda x: jax.lax.dynamic_slice_in_dim(x, ci * _ENTRY_CHUNK,
@@ -279,7 +309,7 @@ def _chunked_accumulate(acc, a_r, a_c, a_v, stripe_src, k0, row0):
         contrib = vv[:, None].astype(acc.dtype) * gathered.astype(acc.dtype)
         return acc.at[rr - row0].add(contrib, mode="drop")
 
-    return jax.lax.fori_loop(0, n_chunks, chunk_step, acc)
+    return jax.lax.fori_loop(first, last, chunk_step, acc)
 
 
 @functools.cache

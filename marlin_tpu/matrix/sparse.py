@@ -91,14 +91,23 @@ class CoordinateMatrix:
             return int(jnp.sum(self.values != 0))
         return int(self.values.shape[0])
 
-    def entries(self):
+    def compact_triples(self):
+        """Host ``(rows, cols, values)`` with pad slots removed.
+
+        This is THE pad-filtering point — every consumer of possibly-padded
+        triples routes through it. Pads are value-0 slots, so the distributed
+        forms treat value 0 as structural (an explicitly stored 0 entry is
+        not preserved across them; see ``DistSparseVecMatrix``)."""
         r = np.asarray(self.row_idx)
         c = np.asarray(self.col_idx)
         v = np.asarray(self.values)
         if self.padded:
             keep = v != 0
             r, c, v = r[keep], c[keep], v[keep]
-        return [MatrixEntry(*t) for t in zip(r, c, v)]
+        return r, c, v
+
+    def entries(self):
+        return [MatrixEntry(*t) for t in zip(*self.compact_triples())]
 
     # -- conversions --------------------------------------------------------
     def to_numpy(self) -> np.ndarray:
@@ -132,15 +141,11 @@ class CoordinateMatrix:
 
     def to_bcoo(self) -> jsparse.BCOO:
         if self.padded:
-            # Pads are explicit zeros at (0, 0); leaking them would inflate
-            # nse and duplicate-index every downstream bcoo op.
-            v = np.asarray(self.values)
-            keep = v != 0
-            idx = jnp.stack(
-                [jnp.asarray(np.asarray(self.row_idx)[keep]),
-                 jnp.asarray(np.asarray(self.col_idx)[keep])], axis=1,
-            )
-            return jsparse.BCOO((jnp.asarray(v[keep]), idx), shape=self.shape)
+            # Pads leaking through would inflate nse and duplicate-index
+            # every downstream bcoo op.
+            r, c, v = self.compact_triples()
+            idx = jnp.stack([jnp.asarray(r), jnp.asarray(c)], axis=1)
+            return jsparse.BCOO((jnp.asarray(v), idx), shape=self.shape)
         idx = jnp.stack([self.row_idx, self.col_idx], axis=1)
         return jsparse.BCOO((self.values, idx), shape=self.shape)
 
@@ -148,12 +153,7 @@ class CoordinateMatrix:
         """Row-partitioned distributed sparse form (dist_sparse module)."""
         from .dist_sparse import DistSparseVecMatrix
 
-        r = np.asarray(self.row_idx)
-        c = np.asarray(self.col_idx)
-        v = np.asarray(self.values)
-        if self.padded:
-            keep = v != 0
-            r, c, v = r[keep], c[keep], v[keep]
+        r, c, v = self.compact_triples()
         return DistSparseVecMatrix.from_coo(
             r, c, v, self.shape, mesh=mesh or self.mesh
         )

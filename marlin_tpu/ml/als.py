@@ -112,9 +112,17 @@ def als_run(
     mesh = mesh or ratings.mesh
     dtype = jnp.float32 if jnp.dtype(cfg.default_dtype) == jnp.bfloat16 else cfg.default_dtype
     m, n = ratings.shape
-    ui = ratings.row_idx
-    pj = ratings.col_idx
-    r = ratings.values.astype(dtype)
+    if getattr(ratings, "padded", False):
+        # A padded CoordinateMatrix (the distributed sparse product's output)
+        # carries value-0 pad slots at index (0, 0); fed raw they would pile
+        # phantom observations onto user 0 / product 0's normal equations.
+        ui, pj, r = ratings.compact_triples()
+        ui, pj = jnp.asarray(ui), jnp.asarray(pj)
+        r = jnp.asarray(r, dtype)
+    else:
+        ui = ratings.row_idx
+        pj = ratings.col_idx
+        r = ratings.values.astype(dtype)
 
     key = jax.random.PRNGKey(hash_seed(seed))
     ku, kp = jax.random.split(key)

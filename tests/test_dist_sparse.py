@@ -154,3 +154,52 @@ class TestSparseVecMatrixRouting:
         coo = CoordinateMatrix(r, c, v, shape=(20, 20))
         dist = coo.to_dist_sparse()
         np.testing.assert_allclose(dist.to_numpy(), _dense(r, c, v, (20, 20)))
+
+
+class TestPaddedCoordinateConsumers:
+    def test_als_on_padded_result_ignores_pads(self, rng):
+        # Regression: a padded CoordinateMatrix (the ring product's output)
+        # must not feed its value-0 pad slots to ALS as real (0, 0, 0.0)
+        # observations — they piled phantom normal-equation terms onto
+        # user 0 / product 0.
+        ra, ca, va = _random_coo(rng, 24, 16, 0.3)
+        rb, cb, vb = _random_coo(rng, 16, 12, 0.3)
+        a = DistSparseVecMatrix.from_coo(ra, ca, np.abs(va) + 0.5, (24, 16))
+        b = DistSparseVecMatrix.from_coo(rb, cb, np.abs(vb) + 0.5, (16, 12))
+        padded = a.multiply_sparse(b)
+        assert padded.padded and padded.values.shape[0] > padded.nnz
+        r, c, v = padded.compact_triples()
+        compacted = CoordinateMatrix(r, c, v, shape=padded.shape)
+        uf_p, pf_p = padded.als(rank=3, iterations=3, seed=7)
+        uf_c, pf_c = compacted.als(rank=3, iterations=3, seed=7)
+        np.testing.assert_allclose(uf_p.to_numpy(), uf_c.to_numpy(), rtol=1e-8)
+        np.testing.assert_allclose(pf_p.to_numpy(), pf_c.to_numpy(), rtol=1e-8)
+
+    def test_compact_triples_single_filter_point(self, rng):
+        r = np.array([3, 0, 7]); c = np.array([1, 0, 2]); v = np.array([2.0, 0.0, 1.0])
+        coo = CoordinateMatrix(r, c, v, shape=(8, 8), padded=True)
+        rr, cc, vv = coo.compact_triples()
+        assert list(vv) == [2.0, 1.0]
+        # Unpadded matrices pass through untouched (explicit zeros kept).
+        coo2 = CoordinateMatrix(r, c, v, shape=(8, 8), padded=False)
+        assert len(coo2.compact_triples()[2]) == 3
+
+
+class TestHopBounding:
+    def test_entries_sorted_by_column_per_stripe(self, rng):
+        r, c, v = _random_coo(rng, 40, 64, 0.3)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (40, 64))
+        cols = np.asarray(a.cols)
+        assert all(np.all(np.diff(row) >= 0) for row in cols)
+
+    def test_product_correct_when_columns_span_all_stripes(self, rng):
+        # Entries in every k-stripe of every output stripe: the searchsorted
+        # chunk bounds must not skip boundary chunks.
+        m = k = n = 64
+        ra, ca, va = _random_coo(rng, m, k, 0.5)
+        rb, cb, vb = _random_coo(rng, k, n, 0.5)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (m, k))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, (k, n))
+        out = a.multiply_sparse(b)
+        oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
+        np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
