@@ -67,8 +67,16 @@ def compute_svd(
         g = np.asarray(mat.compute_gramian_matrix(), np.float64)
         lam, v = symmetric_eigs(lambda x: g @ x, n, k, tol=tol, max_iter=max_iter)
     elif mode == "dist-eigs":
+        # Device-resident sweep when the matrix exposes a traceable operator
+        # (the chunked recurrence — one dispatch per 16 steps, not per step).
+        op = (
+            mat.gramian_matvec_operator()
+            if hasattr(mat, "gramian_matvec_operator")
+            else None
+        )
         lam, v = symmetric_eigs(
-            mat.multiply_gramian_matrix_by, n, k, tol=tol, max_iter=max_iter
+            mat.multiply_gramian_matrix_by, n, k, tol=tol, max_iter=max_iter,
+            matvec_jax=op,
         )
     else:
         raise ValueError(f"Do not support mode {mode}.")

@@ -278,6 +278,23 @@ class DenseVecMatrix(DistributedMatrix):
         f = _gramian_matvec_fn(self.mesh, get_config().matmul_precision)
         return np.asarray(jax.device_get(f(self._data, jnp.asarray(v, self.dtype))))
 
+    def gramian_matvec_operator(self):
+        """Jit-traceable ``v -> (A^T A) v`` closing over the sharded data —
+        feeds the device-resident Lanczos sweep (lanczos.py), which keeps the
+        whole recurrence on device and removes the per-step host round-trip
+        of the reference's ARPACK ido loop (DenseVecMatrix.scala:1779-1797).
+        Cached per instance so the sweep's compiled-chunk cache hits."""
+        op = getattr(self, "_gramian_op", None)
+        if op is None:
+            f = _gramian_matvec_fn(self.mesh, get_config().matmul_precision)
+            data = self._data
+
+            def op(v):
+                return f(data, v.astype(data.dtype))
+
+            self._gramian_op = op
+        return op
+
     def compute_gramian_matrix(self) -> np.ndarray:
         """G = A^T A as a host array (``computeGramianMatrix``,
         DenseVecMatrix.scala:1464-1484; the per-row dspr accumulation becomes a
