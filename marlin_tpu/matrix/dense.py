@@ -398,10 +398,87 @@ class DenseVecMatrix(DistributedMatrix):
             raise ValueError("cannot construct a distributed matrix from empty data")
         max_idx = max(int(i) for i, _ in rows)
         width = num_cols or max(len(np.atleast_1d(v)) for _, v in rows)
-        arr = np.zeros((max_idx + 1, width), dtype=np.asarray(rows[0][1]).dtype)
-        for i, v in rows:
-            arr[int(i), : len(np.atleast_1d(v))] = v
-        return cls(arr, mesh=mesh)
+        return cls.from_row_stream(
+            iter(rows), (max_idx + 1, width), mesh=mesh,
+            dtype=np.asarray(rows[0][1]).dtype,
+        )
+
+    @classmethod
+    def from_row_stream(cls, rows, shape: Tuple[int, int], mesh=None, dtype=None):
+        """Build from a STREAM of (row_index, vector) pairs without ever
+        holding the global matrix on host.
+
+        The scalable counterpart of the reference's RDD-of-rows ingestion
+        (DenseVecMatrix.scala:41; loaders MTUtils.scala:286-399): rows are
+        routed to per-device stripe buffers (``layout.stripe_for_row`` — the
+        partitioner inverse), and each stripe ships to ITS device the moment
+        its last row arrives, so an in-order stream peaks at ~one stripe of
+        host memory. Out-of-order or gappy streams still work (unshipped
+        stripes flush, missing rows stay zero). The global array is assembled
+        from the per-device shards in place — no host-side concatenation.
+        """
+        from ..parallel.layout import stripe_for_row
+
+        cfg = get_config()
+        mesh = mesh or default_mesh()
+        n_rows, width = (int(s) for s in shape)
+        if n_rows <= 0 or width <= 0:
+            raise ValueError(f"bad stream shape {shape}")
+        dtype = np.dtype(dtype or cfg.default_dtype)
+        devs = list(mesh.devices.flat)
+        nd = len(devs)
+        stripe_h = -(-n_rows // nd)
+        padded = stripe_h * nd
+
+        def rows_in(d: int) -> int:
+            return max(0, min(stripe_h, n_rows - d * stripe_h))
+
+        buffers: dict = {}
+        remaining = {d: rows_in(d) for d in range(nd)}
+        seen: dict = {}
+        shipped: dict = {}
+
+        def ship(d: int) -> None:
+            buf = buffers.pop(d, None)
+            if buf is None:  # stripe with no arrived rows (or all-pad tail)
+                buf = np.zeros((stripe_h, width), dtype)
+            shipped[d] = jax.device_put(buf, devs[d])
+            seen.pop(d, None)
+
+        for idx, v in rows:
+            i = int(idx)
+            if not (0 <= i < n_rows):
+                raise ValueError(f"row index {i} outside shape {shape}")
+            d = stripe_for_row(i, n_rows, mesh)
+            if d in shipped:
+                raise ValueError(
+                    f"row {i} arrived after its stripe shipped (duplicate row?)"
+                )
+            if d not in buffers:
+                buffers[d] = np.zeros((stripe_h, width), dtype)
+                seen[d] = np.zeros(stripe_h, bool)
+            vec = np.atleast_1d(np.asarray(v))
+            local = i - d * stripe_h
+            buffers[d][local, : vec.shape[0]] = vec
+            if not seen[d][local]:
+                seen[d][local] = True
+                remaining[d] -= 1
+                if remaining[d] == 0:
+                    ship(d)
+        for d in range(nd):
+            if d not in shipped:
+                ship(d)
+
+        sh = row_sharding(mesh)
+        global_shape = (padded, width)
+        amap = sh.addressable_devices_indices_map(global_shape)
+        arrays = [shipped[devs.index(dev)] for dev in amap]
+        # Each device's shard slice must be the stripe we routed to it.
+        for dev, idx in amap.items():
+            start = idx[0].start or 0
+            assert start == devs.index(dev) * stripe_h, (dev, idx)
+        data = jax.make_array_from_single_device_arrays(global_shape, sh, arrays)
+        return cls(data, mesh=mesh, _logical_shape=(n_rows, width))
 
 
 def size_mb(mat: DistributedMatrix) -> float:

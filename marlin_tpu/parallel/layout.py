@@ -61,12 +61,20 @@ def device_for_block(
     return mesh.devices[di][dj]
 
 
+def stripe_for_row(row: int, num_rows: int, mesh: Mesh = None) -> int:
+    """Stripe (= flat device) index of a logical row under the row-striped
+    layout — the routing function the streaming loaders use to feed each
+    device only its own rows (the partitioner's answer, inverted)."""
+    mesh = mesh or default_mesh()
+    n_dev = len(mesh.devices.flat)
+    stripe = -(-num_rows // n_dev)
+    return min(row // stripe, n_dev - 1)
+
+
 def device_for_row(row: int, num_rows: int, mesh: Mesh = None) -> jax.Device:
     """Owning device of a logical row under the row-striped layout."""
     mesh = mesh or default_mesh()
-    devs = list(mesh.devices.flat)
-    stripe = -(-num_rows // len(devs))
-    return devs[min(row // stripe, len(devs) - 1)]
+    return list(mesh.devices.flat)[stripe_for_row(row, num_rows, mesh)]
 
 
 def colocated(row: int, chunk: int, num_rows: int, num_chunks: int, mesh: Mesh = None) -> bool:

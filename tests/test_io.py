@@ -94,3 +94,74 @@ class TestArrayHelpers:
         m = mio.array_to_matrix(a)
         assert isinstance(m, DenseVecMatrix)
         np.testing.assert_allclose(mio.matrix_to_array(m), a)
+
+
+class TestStreamingLoader:
+    def test_matches_buffered_loader(self, tmp_path, rng):
+        a = rng.standard_normal((23, 7))
+        m = DenseVecMatrix(a)
+        path = str(tmp_path / "mat")
+        mio.save_dense_matrix(m, path)
+        buffered = mio.load_dense_matrix(path, streaming=False)
+        streamed = mio.load_dense_matrix_streaming(path)
+        np.testing.assert_allclose(streamed.to_numpy(), buffered.to_numpy())
+        np.testing.assert_allclose(streamed.to_numpy(), a)
+        assert streamed.shape == (23, 7)
+
+    def test_streaming_flag_forces_path(self, tmp_path, rng):
+        a = rng.standard_normal((9, 3))
+        path = str(tmp_path / "mat")
+        mio.save_dense_matrix(DenseVecMatrix(a), path)
+        m = mio.load_dense_matrix(path, streaming=True)
+        np.testing.assert_allclose(m.to_numpy(), a)
+
+    def test_result_is_sharded_over_all_devices(self, tmp_path, rng, mesh):
+        a = rng.standard_normal((33, 5))
+        path = str(tmp_path / "mat")
+        mio.save_dense_matrix(DenseVecMatrix(a), path)
+        m = mio.load_dense_matrix_streaming(path)
+        assert len(m.data.sharding.device_set) == len(mesh.devices.flat)
+        # The streamed result feeds compute directly.
+        out = m.multiply(m.to_numpy().T)
+        np.testing.assert_allclose(out.to_numpy(), a @ a.T, rtol=1e-10)
+
+    def test_out_of_order_and_gappy_rows(self, tmp_path):
+        p = tmp_path / "scattered.txt"
+        # Rows out of order, row 1 missing entirely (stays zero).
+        p.write_text("3:1.0,2.0\n0:5.0,6.0\n2:7.0,8.0\n")
+        m = mio.load_dense_matrix_streaming(str(p))
+        np.testing.assert_allclose(
+            m.to_numpy(), [[5, 6], [0, 0], [7, 8], [1, 2]]
+        )
+
+    def test_explicit_shape_skips_prepass(self, tmp_path):
+        p = tmp_path / "m.txt"
+        p.write_text("0:1.0,2.0\n1:3.0,4.0\n")
+        m = mio.load_dense_matrix_streaming(str(p), shape=(4, 2))
+        np.testing.assert_allclose(m.to_numpy(), [[1, 2], [3, 4], [0, 0], [0, 0]])
+
+
+class TestFromRowStream:
+    def test_from_rows_routes_through_stream(self, rng):
+        vecs = [(i, rng.standard_normal(4)) for i in range(11)]
+        m = DenseVecMatrix.from_rows(vecs)
+        expect = np.stack([v for _, v in vecs])
+        np.testing.assert_allclose(m.to_numpy(), expect)
+
+    def test_duplicate_row_after_ship_raises(self, mesh):
+        # In-order stream ships each stripe when complete; a duplicate row
+        # arriving later must fail loudly, not silently overwrite.
+        n_dev = len(mesh.devices.flat)
+        rows = [(i, np.ones(2)) for i in range(n_dev * 2)] + [(0, np.zeros(2))]
+        with pytest.raises(ValueError, match="shipped"):
+            DenseVecMatrix.from_row_stream(iter(rows), (n_dev * 2, 2))
+
+    def test_stream_larger_than_stripe_ships_incrementally(self, mesh):
+        # Ordered stream: once a stripe's rows all arrive it must leave the
+        # host buffer dict (the bounded-memory property).
+        n_dev = len(mesh.devices.flat)
+        m = DenseVecMatrix.from_row_stream(
+            ((i, np.full(3, i)) for i in range(n_dev * 4)), (n_dev * 4, 3)
+        )
+        expect = np.repeat(np.arange(n_dev * 4)[:, None], 3, 1)
+        np.testing.assert_allclose(m.to_numpy(), expect)
