@@ -129,6 +129,21 @@ class BlockMatrix(DistributedMatrix):
         if self.num_cols != other.num_rows:
             raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
 
+        n_dev = len(self.mesh.devices.flat)
+        par = min(parallelism, n_dev) if parallelism else n_dev
+        if par < n_dev:
+            # `cores` caps the device count on every arm (the reference's
+            # partition-count cap, BlockMatrix.scala:87): reshard both
+            # operands onto a submesh and dispatch there.
+            from ..mesh import submesh
+
+            sub = submesh(self.mesh, par)
+            return BlockMatrix(self.logical, mesh=sub).multiply(
+                BlockMatrix(other.logical, mesh=sub),
+                broadcast_threshold_mb=broadcast_threshold_mb,
+                mode=mode,
+            )
+
         if isinstance(mode, tuple):
             out = summa.matmul_3d(
                 self.logical, other.logical, mode, devices=list(self.mesh.devices.flat)

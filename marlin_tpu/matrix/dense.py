@@ -95,6 +95,25 @@ class DenseVecMatrix(DistributedMatrix):
         if self.num_cols != other.num_rows:
             raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
 
+        n_dev = len(self.mesh.devices.flat)
+        par = min(parallelism, n_dev) if parallelism else n_dev
+        if par < n_dev:
+            # The reference's `cores` knob shrinks the partition count on
+            # EVERY arm (DenseVecMatrix.scala:196-231); here it becomes a
+            # submesh — both operands reshard onto the first `par` devices
+            # and the whole dispatch (forced mode or auto: broadcast /
+            # SUMMA / CARMA grid) runs there. An explicit resharding cost,
+            # exactly like the reference's repartition-to-fewer-cores
+            # shuffle.
+            from ..mesh import submesh
+
+            sub = submesh(self.mesh, par)
+            return DenseVecMatrix(self.logical, mesh=sub).multiply(
+                DenseVecMatrix(other.logical, mesh=sub),
+                broadcast_threshold_mb=broadcast_threshold_mb,
+                mode=mode,
+            )
+
         if isinstance(mode, tuple):
             return self._multiply_grid(other, mode)
         if mode == "broadcast":
@@ -113,8 +132,6 @@ class DenseVecMatrix(DistributedMatrix):
             else cfg.broadcast_threshold_mb
         )
         m, k, n = self.num_rows, self.num_cols, other.num_cols
-        n_dev = len(self.mesh.devices.flat)
-        par = min(parallelism, n_dev) if parallelism else n_dev
 
         if size_mb(other) < threshold:
             # Branch A (:203-205): other is small — replicate it.
@@ -131,7 +148,7 @@ class DenseVecMatrix(DistributedMatrix):
             )
         # Branch D (:215-217): general — CARMA grid over the matrix's devices
         # (capped by the caller's parallelism hint, the reference's `cores`).
-        grid = grid_for_devices(m, k, n, par)
+        grid = grid_for_devices(m, k, n, n_dev)
         return self._multiply_grid(other, grid)
 
     def _multiply_grid(self, other: DistributedMatrix, grid: Tuple[int, int, int]):

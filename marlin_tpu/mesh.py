@@ -88,6 +88,29 @@ def create_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+_submesh_cache: dict = {}
+
+
+def submesh(mesh: Mesh, n_devices: int) -> Mesh:
+    """A mesh over the first ``n_devices`` of ``mesh`` (squarest grid, same
+    axis names) — how the ``parallelism`` knob (the reference's ``cores``
+    argument, DenseVecMatrix.scala:196) maps to hardware: fewer Spark
+    partitions become a smaller device grid. Cached per (mesh, n)."""
+    n_avail = len(mesh.devices.flat)
+    if not (0 < n_devices <= n_avail):
+        raise ValueError(f"need 1..{n_avail} devices, got {n_devices}")
+    if n_devices == n_avail:
+        return mesh
+    key = (mesh, n_devices)
+    if key not in _submesh_cache:
+        devs = list(mesh.devices.flat)[:n_devices]
+        _submesh_cache[key] = create_mesh(
+            shape=squarest_grid(n_devices), axis_names=mesh.axis_names,
+            devices=devs,
+        )
+    return _submesh_cache[key]
+
+
 def default_mesh() -> Mesh:
     """The process-wide default mesh, created lazily from all devices."""
     global _default_mesh

@@ -233,3 +233,45 @@ class TestRandomGeneration:
         sp = mrand.random_spa_vec_matrix(100, 100, sparsity=0.1, seed=6)
         dens = (sp.to_numpy() != 0).mean()
         assert 0.05 < dens < 0.15
+
+
+class TestParallelismHint:
+    """The reference's `cores` argument caps partitions on EVERY dispatch arm
+    (DenseVecMatrix.scala:196-231); here it routes through a submesh."""
+
+    def test_dense_all_arms_honor_hint(self, rng):
+        a = DenseVecMatrix(rng.standard_normal((48, 40)))
+        b = DenseVecMatrix(rng.standard_normal((40, 32)))
+        oracle = a.to_numpy() @ b.to_numpy()
+        for mode in (None, "summa", "gspmd", "broadcast"):
+            out = a.multiply(b, parallelism=2, mode=mode)
+            assert len(out.data.sharding.device_set) == 2, mode
+            np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10)
+
+    def test_dense_auto_big_vs_small_threshold(self, rng):
+        # Force the non-broadcast arm with a tiny threshold: the submesh must
+        # carry the SUMMA path too.
+        a = DenseVecMatrix(rng.standard_normal((64, 64)))
+        b = DenseVecMatrix(rng.standard_normal((64, 64)))
+        out = a.multiply(b, parallelism=4, broadcast_threshold_mb=1e-9)
+        assert len(out.data.sharding.device_set) == 4
+        np.testing.assert_allclose(
+            out.to_numpy(), a.to_numpy() @ b.to_numpy(), rtol=1e-10
+        )
+
+    def test_block_honors_hint(self, rng):
+        a = BlockMatrix(rng.standard_normal((32, 24)))
+        b = BlockMatrix(rng.standard_normal((24, 16)))
+        out = a.multiply(b, parallelism=2, broadcast_threshold_mb=1e-9)
+        assert len(out.data.sharding.device_set) == 2
+        np.testing.assert_allclose(
+            out.to_numpy(), a.to_numpy() @ b.to_numpy(), rtol=1e-10
+        )
+
+    def test_hint_capped_at_device_count(self, rng):
+        a = DenseVecMatrix(rng.standard_normal((16, 8)))
+        b = DenseVecMatrix(rng.standard_normal((8, 8)))
+        out = a.multiply(b, parallelism=999)  # clamps, full mesh
+        np.testing.assert_allclose(
+            out.to_numpy(), a.to_numpy() @ b.to_numpy(), rtol=1e-10
+        )
