@@ -209,7 +209,9 @@ def block_sparse_matmul(
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"dimension mismatch: {a.shape} x {b.shape}")
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        from ..utils.hw import is_tpu
+
+        interpret = not is_tpu()
     bs = b.block_size
     m = a.shape[0]
     pad_m = (-m) % bs

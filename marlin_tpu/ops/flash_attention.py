@@ -160,7 +160,12 @@ def flash_attention(
     the padded sequence lengths so short inputs don't over-pad.
     """
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        # NOT platform == "tpu": the axon plugin names its platform "axon"
+        # while serving a real TPU — that check ran this kernel in interpret
+        # mode on hardware (24 vs 150+ TFLOPS, round-2 bench).
+        from ..utils.hw import is_tpu
+
+        interpret = not is_tpu()
     single = q.ndim == 2
     if single:
         q, k, v = q[:, None, :], k[:, None, :], v[:, None, :]

@@ -141,9 +141,11 @@ def ulysses_self_attention(
         scale = 1.0 / np.sqrt(d)
     if local_kernel not in ("auto", "flash", "xla"):
         raise ValueError(f"unknown local_kernel {local_kernel!r}")
+    from ..utils.hw import is_tpu
+
     flash = (
         local_kernel == "flash"
-        or (local_kernel == "auto" and mesh.devices.flat[0].platform == "tpu")
+        or (local_kernel == "auto" and is_tpu(mesh.devices.flat[0]))
     )
     axes = _mesh_axes(mesh)
     sh = NamedSharding(mesh, P(axes, None, None))

@@ -1,0 +1,22 @@
+"""Hardware detection helpers.
+
+This image's TPU access goes through the experimental 'axon' PJRT plugin,
+whose platform string is "axon" — NOT "tpu" — while the device itself
+reports ``device_kind = "TPU v5 lite"``. Any ``platform == "tpu"`` check
+therefore silently misclassifies the real chip (observed: the Pallas flash
+kernel running in interpret mode ON the TPU, 24 instead of 150+ TFLOPS).
+Always detect TPUs through here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def is_tpu(dev: Optional[jax.Device] = None) -> bool:
+    """True when ``dev`` (default: first visible device) is a TPU, however
+    the hosting PJRT plugin names its platform."""
+    d = dev if dev is not None else jax.devices()[0]
+    return d.platform == "tpu" or "tpu" in d.device_kind.lower()
