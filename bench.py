@@ -538,6 +538,37 @@ def config_svd():
             "unit": "s", "vs_baseline": 0, "oracle_ok": ok}
 
 
+def config_dispatch_sweep():
+    """Broadcast-vs-SUMMA crossover sweep (VERDICT next-6): times both arms
+    for a row-striped A (m x k) times (k x n) B over a range of B sizes, and
+    reports the measured crossover in MB — the data the 300 MB
+    Spark-derived default must be re-derived from (SURVEY §7 hard parts:
+    HBM residency vs ICI gather volume, not shuffle cost). Emits one line
+    per operand size on stderr and ONE summary JSON line."""
+    import math
+
+    m = _sized("BENCH_SWEEP_M", 16384)
+    results = []
+    for n in (256, 512, 1024, 2048, 4096, 8192):
+        k = n
+        a = mrand.random_den_vec_matrix(m, k, seed=1, dtype=DTYPE)
+        b = mrand.random_den_vec_matrix(k, n, seed=2, dtype=DTYPE)
+        size_mb = k * n * jnp.dtype(DTYPE).itemsize / 1e6
+        dt_b = _timed(lambda: a.multiply(b, mode="broadcast"), iters=5)
+        dt_s = _timed(lambda: a.multiply(b, mode="summa"), iters=5)
+        results.append((size_mb, dt_b, dt_s))
+        print(f"sweep n={n} B={size_mb:.1f}MB broadcast={dt_b*1e3:.2f}ms "
+              f"summa={dt_s*1e3:.2f}ms", file=sys.stderr, flush=True)
+    # Crossover: smallest operand size where SUMMA beats broadcast (None if
+    # broadcast always wins — then the threshold should exceed the sweep).
+    cross = next((mb for mb, db, ds in results if ds < db), None)
+    return {"metric": "dispatch_crossover_mb",
+            "value": round(cross, 1) if cross else -1.0,
+            "unit": "MB", "vs_baseline": 0,
+            "points": [[round(mb, 1), round(db, 5), round(ds, 5)]
+                       for mb, db, ds in results]}
+
+
 CONFIGS = {
     "headline": [headline],
     "square8k": [config_square_8k],
@@ -551,8 +582,11 @@ CONFIGS = {
     "cholesky": [config_cholesky],
     "inverse": [config_inverse],
     "svd": [config_svd],
+    "sweep": [config_dispatch_sweep],
 }
-CONFIGS["all"] = [fns[0] for fns in CONFIGS.values()]
+# "all" = the artifact configs; the sweep is a policy-derivation tool, run
+# explicitly.
+CONFIGS["all"] = [fns[0] for k, fns in CONFIGS.items() if k != "sweep"]
 
 
 def main():
