@@ -610,7 +610,9 @@ def main():
     global _succeeded
     # A config must not START unless this much budget remains — letting the
     # hard watchdog kill a dispatch in flight wedges the TPU tunnel lease.
-    soft_floor = float(os.environ.get("BENCH_SOFT_FLOOR", "240"))
+    budget = float(os.environ.get("BENCH_WATCHDOG", "3000"))
+    soft_floor = min(float(os.environ.get("BENCH_SOFT_FLOOR", "240")),
+                     0.5 * budget)
     for fn in CONFIGS[args.config]:
         name = fn.__name__.removeprefix("config_") or fn.__name__
         if _remaining() < soft_floor:
