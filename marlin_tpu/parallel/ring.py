@@ -130,17 +130,26 @@ def _ring_attention_fn(
     axes = _ring_axes(mesh)
 
     def kernel(q_blk, k_blk, v_blk):
-        # q_blk: (sq/P, d); k_blk, v_blk: (skv/P, d) — K/V rotate.
+        # q_blk: (sq/P, d); k_blk, v_blk: (skv/P, d) — K/V rotate. The
+        # online-softmax state (running max / denominator / accumulator)
+        # lives in f32 whatever the input dtype — bf16 accumulation across
+        # n_dev hops loses ~3 decimal digits (the flash kernel makes the
+        # same choice, ops/flash_attention.py); only the final output casts
+        # back.
         i = jax.lax.axis_index(axes)
         perm = [(s, (s - 1) % n_dev) for s in range(n_dev)]
         sq = q_blk.shape[0]
         skv = k_blk.shape[0]
-        neg = jnp.asarray(-1e30, q_blk.dtype)
+        acc_t = jnp.promote_types(q_blk.dtype, jnp.float32)
+        neg = jnp.asarray(-1e30, acc_t)
 
         def step(t, carry):
             k_cur, v_cur, m_run, l_run, o_run = carry
             src = (i + t) % n_dev  # which kv block we currently hold
-            logits = scale * jnp.dot(q_blk, k_cur.T)  # (sq/P, skv/P)
+            logits = scale * jax.lax.dot_general(
+                q_blk, k_cur, (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_t,
+            )  # (sq/P, skv/P) f32
             if causal:
                 q_pos = i * sq + jnp.arange(sq)[:, None]
                 k_pos = src * skv + jnp.arange(skv)[None, :]
@@ -150,18 +159,23 @@ def _ring_attention_fn(
             corr = jnp.exp(m_run - m_new)
             p = jnp.exp(logits - m_new[:, None])
             l_new = l_run * corr + jnp.sum(p, axis=1)
-            o_new = o_run * corr[:, None] + jnp.dot(p, v_cur)
+            pv = jax.lax.dot_general(
+                p, v_cur, (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_t,
+            )
+            o_new = o_run * corr[:, None] + pv
             k_next = jax.lax.ppermute(k_cur, axes, perm)
             v_next = jax.lax.ppermute(v_cur, axes, perm)
             return k_next, v_next, m_new, l_new, o_new
 
-        m0 = _pvary(jnp.full((sq,), neg, q_blk.dtype), axes)
-        l0 = _pvary(jnp.zeros((sq,), q_blk.dtype), axes)
-        o0 = _pvary(jnp.zeros((sq, v_blk.shape[1]), q_blk.dtype), axes)
+        m0 = _pvary(jnp.full((sq,), neg, acc_t), axes)
+        l0 = _pvary(jnp.zeros((sq,), acc_t), axes)
+        o0 = _pvary(jnp.zeros((sq, v_blk.shape[1]), acc_t), axes)
         _, _, _, l_fin, o_fin = jax.lax.fori_loop(
             0, n_dev, step, (k_blk, v_blk, m0, l0, o0)
         )
-        return o_fin / jnp.maximum(l_fin, 1e-30)[:, None]
+        out = o_fin / jnp.maximum(l_fin, 1e-30)[:, None]
+        return out.astype(q_blk.dtype)
 
     if multihead:
         # (S/P, H, D) blocks: one dispatch, head axis vmapped through the

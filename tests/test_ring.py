@@ -69,3 +69,27 @@ class TestRingAttention:
                 rng.standard_normal((9, 4)),
                 rng.standard_normal((9, 4)),
             )
+
+
+class TestAccumulatorPrecision:
+    def test_bf16_inputs_accumulate_in_f32(self, rng):
+        # bf16 carries ~3 decimal digits: accumulating the online-softmax
+        # state in input dtype across 8 hops drifts ~1e-2; f32 accumulators
+        # keep the result near the f64 oracle at bf16-rounding tolerance.
+        import jax.numpy as jnp
+
+        s, d = 256, 64
+        q = rng.standard_normal((s, d)).astype(np.float32)
+        k = rng.standard_normal((s, d)).astype(np.float32)
+        v = rng.standard_normal((s, d)).astype(np.float32)
+        qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+        got = np.asarray(
+            ring_self_attention(qb, kb, vb), np.float64
+        )
+        # Oracle on the bf16-rounded operands (isolates accumulation error).
+        qf, kf, vf = (np.asarray(x, np.float64) for x in (qb, kb, vb))
+        logits = qf @ kf.T / np.sqrt(d)
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        oracle = (p / p.sum(1, keepdims=True)) @ vf
+        err = np.max(np.abs(got - oracle)) / np.max(np.abs(oracle))
+        assert err < 8e-3, err
