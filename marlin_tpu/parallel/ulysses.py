@@ -43,15 +43,23 @@ def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
 
 
 def _attend(q, k, v, scale, causal):
-    """Full-sequence attention: softmax(q k^T * scale) v. q: (sq, d); k/v: (skv, d)."""
-    logits = scale * jnp.dot(q, k.T)
+    """Full-sequence attention: softmax(q k^T * scale) v. q: (sq, d); k/v:
+    (skv, d). Logits/softmax in f32 whatever the input dtype (same choice as
+    the flash kernel and the ring engine); output casts back."""
+    acc_t = jnp.promote_types(q.dtype, jnp.float32)
+    logits = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=acc_t
+    )
     if causal:
         q_pos = jnp.arange(q.shape[0])[:, None]
         k_pos = jnp.arange(k.shape[0])[None, :]
-        logits = jnp.where(k_pos <= q_pos, logits, jnp.asarray(-1e30, q.dtype))
+        logits = jnp.where(k_pos <= q_pos, logits, jnp.asarray(-1e30, acc_t))
     logits = logits - jnp.max(logits, axis=1, keepdims=True)
     p = jnp.exp(logits)
-    return jnp.dot(p, v) / jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=acc_t
+    )
+    return (pv / jnp.sum(p, axis=1, keepdims=True)).astype(q.dtype)
 
 
 @functools.cache
