@@ -2,6 +2,7 @@
 §4: LU/Cholesky dist paths, SVD, and inverse beyond the 3x3 permutation-matrix
 case were untested there). Golden pattern: distributed op vs NumPy oracle."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -299,3 +300,36 @@ class TestDeviceSweep:
             lambda v: g @ v, len(d), 2, matvec_jax=lambda v: gj @ v
         )
         np.testing.assert_allclose(evals, [10.0, 10.0], rtol=1e-8)
+
+
+class TestShardedDecompositions:
+    """VERDICT next-3: the Schur GEMM must RUN sharded — feed the single-jit
+    panel sweeps block-sharded inputs and require the factor to come back
+    sharded over every device (GSPMD propagates (mr, mc) through the whole
+    fori_loop) with the oracle still satisfied."""
+
+    def test_lu_on_sharded_input_stays_sharded(self, rng, mesh):
+        from marlin_tpu.mesh import block_sharding
+
+        n = 192
+        a = rng.standard_normal((n, n))
+        a_sh = jax.device_put(jnp.asarray(a), block_sharding(mesh))
+        with mt.config_override(lu_base_size=48):
+            packed, perm = lu_factor_array(a_sh, mode="dist")
+        assert len(packed.sharding.device_set) == len(mesh.devices.flat)
+        l, u = unpack_lu(np.asarray(packed))
+        np.testing.assert_allclose(a[perm], l @ u, atol=1e-10)
+
+    def test_cholesky_on_sharded_input_stays_sharded(self, rng, mesh):
+        from marlin_tpu.mesh import block_sharding
+        from marlin_tpu.linalg.cholesky import cholesky_factor_array
+
+        n = 192
+        g = rng.standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        a_sh = jax.device_put(jnp.asarray(a), block_sharding(mesh))
+        with mt.config_override(cholesky_base_size=48):
+            l = cholesky_factor_array(a_sh, mode="dist")
+        assert len(l.sharding.device_set) == len(mesh.devices.flat)
+        ln = np.asarray(l)
+        np.testing.assert_allclose(ln @ ln.T, a, rtol=1e-10, atol=1e-8)
