@@ -58,7 +58,7 @@ def main(argv=None):
     if 4 in args.modes:
         run("4_block_dense", lambda: da.to_block_matrix().multiply(db.to_block_matrix(), mode="summa"))
     if 5 in args.modes:
-        run("5_dense_x_sparse", lambda: da.multiply(sb.to_dense_vec_matrix(), mode="broadcast"))
+        run("5_dense_x_sparse", lambda: da.multiply(sb))  # BCOO, no densify
     if 6 in args.modes:
         run("6_dense_x_densified", lambda: da.multiply(sb.to_dense_vec_matrix()))
 

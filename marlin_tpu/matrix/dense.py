@@ -72,11 +72,27 @@ class DenseVecMatrix(DistributedMatrix):
         DenseVecMatrix.scala:109).
         """
         from .block import BlockMatrix
+        from .sparse import SparseVecMatrix
         from .vector import DistributedVector
 
         cfg = get_config()
         if isinstance(other, (int, float)):
             return self._like(self._data * other)
+        if isinstance(other, SparseVecMatrix):
+            # Dense x sparse without densifying B — the multDenseSparse mode
+            # (LibMatrixMult.scala:15-41; SparseMultiply.scala mode 5) as a
+            # BCOO contraction on the row-striped left operand.
+            from jax.experimental import sparse as jsparse
+
+            if self.num_cols != other.num_rows:
+                raise ValueError(
+                    f"dimension mismatch: {self.shape} x {other.shape}"
+                )
+            out = jsparse.bcoo_dot_general(
+                self.logical, other.bcoo.astype(self.dtype),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+            )
+            return DenseVecMatrix(out, mesh=self.mesh)
         if isinstance(other, DistributedVector):
             return self._times_vector(other)
         if isinstance(other, np.ndarray) or (

@@ -83,3 +83,24 @@ class TestSparseVecMatrix:
         np.testing.assert_allclose(
             out.to_numpy(), a.to_numpy() @ b.to_numpy(), rtol=1e-10
         )
+
+
+class TestDenseTimesSparse:
+    def test_dense_multiply_sparse_no_densify(self, rng):
+        # multDenseSparse parity (LibMatrixMult.scala:15-41): dense row
+        # matrix times BCOO without materializing B dense.
+        from marlin_tpu.matrix.dense import DenseVecMatrix
+
+        a = rng.standard_normal((12, 10))
+        bd = rng.standard_normal((10, 8)) * (rng.random((10, 8)) < 0.4)
+        sb = SparseVecMatrix.from_dense_array(bd)
+        out = DenseVecMatrix(a).multiply(sb)
+        assert isinstance(out, DenseVecMatrix)
+        np.testing.assert_allclose(out.to_numpy(), a @ bd, rtol=1e-10)
+
+    def test_dense_multiply_sparse_dim_mismatch(self, rng):
+        from marlin_tpu.matrix.dense import DenseVecMatrix
+
+        sb = SparseVecMatrix.from_dense_array(rng.standard_normal((5, 4)))
+        with pytest.raises(ValueError):
+            DenseVecMatrix(rng.standard_normal((3, 6))).multiply(sb)
