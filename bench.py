@@ -434,6 +434,19 @@ def config_sparse_dist():
             "oracle_max_err": round(err, 9), "oracle_ok": err < 1e-3}
 
 
+def _xla_ref(out: dict, label: str, fn, our_dt: float) -> dict:
+    """Attach the raw-XLA reference timing to a config line, defensively:
+    the baseline's own failure (e.g. XLA's LuDecompositionBlock scoped-vmem
+    bug at 16k on v5e) must not discard OUR measurement."""
+    try:
+        dt_xla = _timed(fn, iters=2)
+        out.update(vs_baseline=round(dt_xla / our_dt, 3),
+                   **{f"xla_{label}_seconds": round(dt_xla, 4)})
+    except Exception as e:  # noqa: BLE001
+        out.update(vs_baseline=0, **{f"xla_{label}_error": _trim_err(e, 160)})
+    return out
+
+
 def config_lu():
     """Blocked LU (single-jit fori_loop panel sweep) vs raw XLA lu at 16k f32.
 
@@ -461,16 +474,7 @@ def config_lu():
     out = {"metric": f"lu_dist_{n//1024}k_seconds", "value": round(dt, 4),
            "unit": "s", "oracle_max_err": round(err, 9),
            "oracle_ok": err < 1e-3}
-    # The raw-XLA reference is measured LAST and defensively: at 16k on v5e
-    # XLA's own LuDecompositionBlock custom-call can blow its scoped-vmem
-    # limit (an XLA bug) — that must not discard OUR measurement.
-    try:
-        dt_xla = _timed(lambda: jax.lax.linalg.lu(a)[0], iters=2)
-        out.update(vs_baseline=round(dt_xla / dt, 3),
-                   xla_lu_seconds=round(dt_xla, 4))
-    except Exception as e:  # noqa: BLE001
-        out.update(vs_baseline=0, xla_lu_error=_trim_err(e, 160))
-    return out
+    return _xla_ref(out, "lu", lambda: jax.lax.linalg.lu(a)[0], dt)
 
 
 def config_cholesky():
@@ -497,13 +501,7 @@ def config_cholesky():
     out = {"metric": f"cholesky_dist_{n//1024}k_seconds", "value": round(dt, 4),
            "unit": "s", "oracle_max_err": round(err, 9),
            "oracle_ok": err < 1e-3}
-    try:
-        dt_xla = _timed(lambda: jnp.linalg.cholesky(a), iters=2)
-        out.update(vs_baseline=round(dt_xla / dt, 3),
-                   xla_cholesky_seconds=round(dt_xla, 4))
-    except Exception as e:  # noqa: BLE001
-        out.update(vs_baseline=0, xla_cholesky_error=_trim_err(e, 160))
-    return out
+    return _xla_ref(out, "cholesky", lambda: jnp.linalg.cholesky(a), dt)
 
 
 def config_inverse():
@@ -519,13 +517,7 @@ def config_inverse():
     out = {"metric": f"inverse_dist_{n//1024}k_seconds", "value": round(dt, 4),
            "unit": "s", "oracle_max_err": round(resid, 9),
            "oracle_ok": resid < 1e-2}
-    try:
-        dt_xla = _timed(lambda: jnp.linalg.inv(a), iters=2)
-        out.update(vs_baseline=round(dt_xla / dt, 3),
-                   xla_inv_seconds=round(dt_xla, 4))
-    except Exception as e:  # noqa: BLE001
-        out.update(vs_baseline=0, xla_inv_error=_trim_err(e, 160))
-    return out
+    return _xla_ref(out, "inv", lambda: jnp.linalg.inv(a), dt)
 
 
 def config_svd():

@@ -248,9 +248,6 @@ def _device_chunk_fn(matvec_jax, m_cap: int, l_cols: int, n: int, dtype):
     return jax.jit(chunk)
 
 
-_chunk_cache: dict = {}
-
-
 def _lanczos_sweep_device(
     matvec_jax, q0: np.ndarray, k: int, L: np.ndarray, tol: float, m_max: int
 ) -> Tuple[np.ndarray, np.ndarray, bool]:
@@ -261,10 +258,18 @@ def _lanczos_sweep_device(
 
     n = q0.shape[0]
     dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    key = (matvec_jax, m_max, L.shape[1], n, dtype)
-    if key not in _chunk_cache:
-        _chunk_cache[key] = _device_chunk_fn(matvec_jax, m_max, L.shape[1], n, dtype)
-    chunk = _chunk_cache[key]
+    # Compiled chunks ride ON the operator object (not a module-global
+    # cache): the operator closes over the matrix's device buffers, so a
+    # global cache keyed by it would pin those buffers for the process
+    # lifetime. Attribute storage dies with the operator.
+    try:
+        cache = matvec_jax.__dict__.setdefault("_lanczos_chunks", {})
+    except AttributeError:  # bound methods / partials without a __dict__
+        cache = {}
+    key = (m_max, L.shape[1], n, dtype)
+    if key not in cache:
+        cache[key] = _device_chunk_fn(matvec_jax, m_max, L.shape[1], n, dtype)
+    chunk = cache[key]
 
     Q = jnp.zeros((m_max + 1, n), dtype).at[0].set(jnp.asarray(q0, dtype))
     carry = (

@@ -504,12 +504,13 @@ class DenseVecMatrix(DistributedMatrix):
 
         sh = row_sharding(mesh)
         global_shape = (padded, width)
+        stripe_of = {dev: d for d, dev in enumerate(devs)}
         amap = sh.addressable_devices_indices_map(global_shape)
-        arrays = [shipped[devs.index(dev)] for dev in amap]
+        arrays = [shipped[stripe_of[dev]] for dev in amap]
         # Each device's shard slice must be the stripe we routed to it.
         for dev, idx in amap.items():
             start = idx[0].start or 0
-            assert start == devs.index(dev) * stripe_h, (dev, idx)
+            assert start == stripe_of[dev] * stripe_h, (dev, idx)
         data = jax.make_array_from_single_device_arrays(global_shape, sh, arrays)
         return cls(data, mesh=mesh, _logical_shape=(n_rows, width))
 

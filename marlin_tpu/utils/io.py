@@ -35,21 +35,7 @@ _SEP = re.compile(r",\s?|\s+")
 
 def _data_lines(path: str) -> List[str]:
     """All non-empty lines of a file, or of every non-hidden file in a dir."""
-    paths = []
-    if os.path.isdir(path):
-        for name in sorted(os.listdir(path)):
-            if name.startswith("_") or name.startswith("."):
-                continue
-            full = os.path.join(path, name)
-            if os.path.isfile(full):
-                paths.append(full)
-    else:
-        paths.append(path)
-    lines: List[str] = []
-    for p in paths:
-        with open(p) as f:
-            lines.extend(l for l in (ln.strip() for ln in f) if l)
-    return lines
+    return list(_iter_lines(path))
 
 
 def _fmt(v: float) -> str:
