@@ -1,0 +1,149 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over the mesh.
+
+The reference has NO pipeline parallelism (SURVEY.md §2.8 marks PP absent —
+its parallelism is data decomposition over matrix dimensions). This engine
+goes beyond that inventory the TPU-native way: stages live one-per-device
+along the flattened mesh ring, activations hop stage-to-stage with
+``ppermute`` over ICI, and the whole schedule — fill, steady state, drain —
+is ONE jitted ``fori_loop`` under ``shard_map`` (no per-microbatch dispatch
+from the host).
+
+Schedule (classic GPipe): with P stages and M microbatches, step t has
+device i processing microbatch ``t - i`` (when 0 <= t - i < M); after
+M + P - 1 steps every microbatch has crossed every stage. Device i holds
+only its own stage's parameters (the pytree's leading axis is sharded over
+the ring), so model memory scales 1/P per device — the pipeline analogue of
+the row-striped matrix types.
+
+Constraint: every stage maps activations (microbatch, d) -> (microbatch, d)
+with one shared shape/dtype (the transformer-block regime); stage functions
+are arbitrary jittable callables of (stage_params, x).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mesh import default_mesh
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _pvary(x, axes):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)  # pragma: no cover
+
+
+def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+@functools.cache
+def _gpipe_fn(mesh: Mesh, apply_fn: Callable, n_stages: int, n_micro: int):
+    axes = _ring_axes(mesh)
+
+    def kernel(params, x):
+        # params: this stage's slice, leading axis 1 — unstack it.
+        params_i = jax.tree.map(lambda p: p[0], params)
+        # x: (M, mb, d) microbatches, replicated (every device sees the
+        # input; only stage 0 consumes it).
+        i = jax.lax.axis_index(axes)
+        mb, d = x.shape[1], x.shape[2]
+        perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+        def step(t, carry):
+            incoming, outputs = carry
+            k = t - i  # which microbatch this stage works on at step t
+            active = (k >= 0) & (k < n_micro)
+            # Stage 0 reads microbatch t from the input; others read the
+            # activation that just hopped in from stage i-1.
+            src = jnp.where(
+                i == 0,
+                jax.lax.dynamic_index_in_dim(
+                    x, jnp.clip(t, 0, n_micro - 1), keepdims=False
+                ),
+                incoming,
+            )
+            out = apply_fn(params_i, src)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            # Last stage banks its finished microbatch.
+            bank = (i == n_stages - 1) & active
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(bank, out, jax.lax.dynamic_index_in_dim(
+                    outputs, jnp.clip(t - i, 0, n_micro - 1), keepdims=False
+                )),
+                jnp.clip(t - i, 0, n_micro - 1),
+                0,
+            )
+            # Activations hop one stage forward around the ring.
+            incoming = jax.lax.ppermute(out, axes, perm)
+            return incoming, outputs
+
+        incoming0 = _pvary(jnp.zeros((mb, d), x.dtype), axes)
+        outputs0 = _pvary(jnp.zeros((n_micro, mb, d), x.dtype), axes)
+        _, outputs = jax.lax.fori_loop(
+            0, n_micro + n_stages - 1, step, (incoming0, outputs0)
+        )
+        # Only the last stage holds real outputs; psum broadcasts them (all
+        # other contributions are zero), leaving the result replicated.
+        is_last = (i == n_stages - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * is_last, axes)
+
+    f = _shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes), P(None, None, None)),
+        out_specs=P(None, None, None),
+    )
+    return jax.jit(f)
+
+
+def gpipe(
+    apply_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    n_microbatches: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Run ``x`` through ``n_stages`` sequential stages, pipelined.
+
+    ``apply_fn(params_i, x_mb) -> y_mb`` is one stage; ``stage_params`` is a
+    pytree whose leaves have leading axis ``n_stages`` (= mesh device
+    count — each device keeps ONE stage's slice). ``x`` is (batch, d) with
+    batch divisible into ``n_microbatches`` equal microbatches (default:
+    one per stage). Returns (batch, d), numerically identical to applying
+    the stages sequentially.
+    """
+    mesh = mesh or default_mesh()
+    axes = _ring_axes(mesh)
+    n_stages = len(mesh.devices.flat)
+    leaves = jax.tree.leaves(stage_params)
+    if not leaves or any(l.shape[0] != n_stages for l in leaves):
+        raise ValueError(
+            f"stage_params leaves need leading axis {n_stages} (one slice "
+            f"per device), got {[l.shape for l in leaves]}"
+        )
+    batch, d = x.shape
+    n_micro = n_microbatches or n_stages
+    if batch % n_micro != 0:
+        raise ValueError(
+            f"batch {batch} must divide into {n_micro} microbatches"
+        )
+    xm = x.reshape(n_micro, batch // n_micro, d)
+    params_sh = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P(axes))), stage_params
+    )
+    xm = jax.device_put(xm, NamedSharding(mesh, P(None, None, None)))
+    out = _gpipe_fn(mesh, apply_fn, n_stages, n_micro)(params_sh, xm)
+    return out.reshape(batch, d)
