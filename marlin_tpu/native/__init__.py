@@ -67,6 +67,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double),
             ctypes.c_int64,
         ]
+        lib.marlin_textio_parse_chunk.restype = ctypes.c_int64
+        lib.marlin_textio_parse_chunk.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
         lib.marlin_textio_format.restype = ctypes.c_int
         lib.marlin_textio_format.argtypes = [
             ctypes.POINTER(ctypes.c_double),
@@ -108,6 +116,48 @@ def parse_dense_text(data: bytes) -> Optional[np.ndarray]:
     if rc != 0:
         raise ValueError("malformed matrix text")
     return out
+
+
+def parse_dense_chunk(
+    data: bytes, width: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse a chunk of complete ``row:v,v,...`` lines into (row indices,
+    values) in file order — the streaming loader's unit (indices stay global;
+    the caller routes them to device stripes). None if the codec is
+    unavailable; ValueError on malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    cap = data.count(b"\n") + 1
+    idx = np.zeros(cap, dtype=np.int64)
+    vals = np.zeros((cap, width), dtype=np.float64)
+    n = lib.marlin_textio_parse_chunk(
+        data, len(data),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        width,
+    )
+    if n < 0:
+        raise ValueError("malformed matrix text in chunk")
+    return idx[:n], vals[:n]
+
+
+def probe_dense_text(data: bytes) -> Optional[Tuple[int, int, int]]:
+    """(n_lines, max_index, width) for a text buffer, or None if the codec
+    is unavailable. Used by the streaming loader's metadata pre-pass."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_lines = ctypes.c_int64()
+    max_index = ctypes.c_int64()
+    width = ctypes.c_int64()
+    rc = lib.marlin_textio_probe(
+        data, len(data), ctypes.byref(n_lines), ctypes.byref(max_index),
+        ctypes.byref(width),
+    )
+    if rc != 0:
+        raise ValueError(f"malformed matrix text at line {n_lines.value}")
+    return n_lines.value, max_index.value, width.value
 
 
 def format_dense_text(arr: np.ndarray) -> Optional[bytes]:

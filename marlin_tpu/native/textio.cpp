@@ -118,6 +118,45 @@ int marlin_textio_parse(const char* buf, int64_t len, double* out,
   return 0;
 }
 
+// Parse a chunk of lines in FILE ORDER into caller-allocated idx
+// (>= line count) and vals (>= line count x width, zeroed) arrays — the
+// streaming loader's unit of work: row indices stay untranslated, the
+// caller routes them to device stripes. Returns the number of rows parsed,
+// or -1 on malformed input.
+int64_t marlin_textio_parse_chunk(const char* buf, int64_t len, int64_t* idx,
+                                  double* vals, int64_t width) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t r = 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* eol = nl ? nl : end;
+    p = skip_ws(p, eol);
+    if (p < eol) {
+      char* after = nullptr;
+      const long long row_idx = strtoll(p, &after, 10);
+      if (after == p || *after != ':' || row_idx < 0) return -1;
+      idx[r] = row_idx;
+      double* row = vals + r * width;
+      int64_t c = 0;
+      const char* q = after + 1;
+      while (q < eol && c < width) {
+        q = skip_ws(q, eol);
+        if (q >= eol) break;
+        char* vend = nullptr;
+        const double v = strtod(q, &vend);
+        if (vend == q) return -1;
+        row[c++] = v;
+        q = skip_ws(vend, eol);
+        if (q < eol && *q == ',') ++q;
+      }
+      ++r;
+    }
+    p = eol + 1;
+  }
+  return r;
+}
+
 // Format a row-major rows x cols array into `row:v,v,...` lines. Allocates
 // *out_buf (caller frees with marlin_textio_free); stores the byte length in
 // *out_len. Returns 0 on success.

@@ -165,3 +165,36 @@ class TestFromRowStream:
         )
         expect = np.repeat(np.arange(n_dev * 4)[:, None], 3, 1)
         np.testing.assert_allclose(m.to_numpy(), expect)
+
+
+class TestChunkedStreaming:
+    def test_python_fallback_matches_native(self, tmp_path, rng, monkeypatch):
+        from marlin_tpu import native as native_mod
+
+        a = rng.standard_normal((19, 6))
+        path = str(tmp_path / "m")
+        mio.save_dense_matrix(DenseVecMatrix(a), path)
+        via_native = mio.load_dense_matrix_streaming(path).to_numpy()
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        via_python = mio.load_dense_matrix_streaming(path).to_numpy()
+        np.testing.assert_allclose(via_native, via_python)
+        np.testing.assert_allclose(via_python, a)
+
+    def test_chunk_boundary_mid_file(self, tmp_path, rng, monkeypatch):
+        # Force tiny chunks so lines split across read boundaries.
+        a = rng.standard_normal((37, 4))
+        path = str(tmp_path / "m")
+        mio.save_dense_matrix(DenseVecMatrix(a), path)
+        monkeypatch.setattr(mio, "STREAM_CHUNK_BYTES", 64)
+        m = mio.load_dense_matrix_streaming(path)
+        np.testing.assert_allclose(m.to_numpy(), a)
+
+    def test_from_row_chunks_direct(self, rng):
+        idx = np.array([2, 0, 5, 1, 3, 4])
+        vals = rng.standard_normal((6, 3))
+        m = DenseVecMatrix.from_row_chunks(
+            [(idx[:3], vals[:3]), (idx[3:], vals[3:])], (6, 3)
+        )
+        expect = np.zeros((6, 3))
+        expect[idx] = vals
+        np.testing.assert_allclose(m.to_numpy(), expect)

@@ -85,3 +85,26 @@ class TestGenerateMatrixTool:
     def test_usage_error(self, binary):
         r = subprocess.run([binary], capture_output=True, timeout=60)
         assert r.returncode == 1 and b"usage" in r.stderr
+
+
+class TestChunkParse:
+    def test_parse_chunk_golden(self):
+        if not native.available():
+            pytest.skip("no toolchain")
+        data = b"3:1.5,2.5\n0:7.0\n"
+        idx, vals = native.parse_dense_chunk(data, 2)
+        np.testing.assert_array_equal(idx, [3, 0])
+        np.testing.assert_allclose(vals, [[1.5, 2.5], [7.0, 0.0]])
+
+    def test_parse_chunk_malformed_raises(self):
+        if not native.available():
+            pytest.skip("no toolchain")
+        with pytest.raises(ValueError):
+            native.parse_dense_chunk(b"nonsense line\n", 2)
+
+    def test_probe_matches_python(self):
+        if not native.available():
+            pytest.skip("no toolchain")
+        data = b"0:1,2,3\n5:4\n"
+        n_lines, max_idx, width = native.probe_dense_text(data)
+        assert (n_lines, max_idx, width) == (2, 5, 3)
