@@ -333,3 +333,44 @@ class TestShardedDecompositions:
         assert len(l.sharding.device_set) == len(mesh.devices.flat)
         ln = np.asarray(l)
         np.testing.assert_allclose(ln @ ln.T, a, rtol=1e-10, atol=1e-8)
+
+
+class TestSolve:
+    def test_lu_solve_matrix_rhs(self, rng):
+        from marlin_tpu.linalg import solve
+
+        n = 96
+        a = rng.standard_normal((n, n)) + n * np.eye(n)
+        b = rng.standard_normal((n, 5))
+        with mt.config_override(lu_base_size=32):
+            x = np.asarray(solve(jnp.asarray(a), jnp.asarray(b), mode="dist"))
+        np.testing.assert_allclose(a @ x, b, rtol=1e-8, atol=1e-8)
+
+    def test_vector_rhs_and_local_mode(self, rng):
+        from marlin_tpu.linalg import solve
+
+        a = rng.standard_normal((12, 12)) + 12 * np.eye(12)
+        b = rng.standard_normal(12)
+        x = np.asarray(solve(jnp.asarray(a), jnp.asarray(b)))
+        assert x.shape == (12,)
+        np.testing.assert_allclose(a @ x, b, rtol=1e-9)
+
+    def test_spd_route(self, rng):
+        from marlin_tpu.linalg import solve
+
+        n = 64
+        g = rng.standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        b = rng.standard_normal((n, 3))
+        with mt.config_override(cholesky_base_size=32):
+            x = np.asarray(solve(jnp.asarray(a), jnp.asarray(b),
+                                 mode="dist", assume_spd=True))
+        np.testing.assert_allclose(a @ x, b, rtol=1e-8, atol=1e-8)
+
+    def test_shape_errors(self, rng):
+        from marlin_tpu.linalg import solve
+
+        with pytest.raises(ValueError):
+            solve(jnp.zeros((3, 4)), jnp.zeros(3))
+        with pytest.raises(ValueError):
+            solve(jnp.eye(3), jnp.zeros(4))
