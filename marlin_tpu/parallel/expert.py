@@ -1,0 +1,148 @@
+"""Expert parallelism: top-1 token routing over the mesh (MoE dispatch).
+
+Absent from the reference (SURVEY.md §2.8 marks EP "—"); built the TPU-native
+way to complete the parallelism inventory alongside DP/TP/SP/PP: experts live
+one-per-device on the flattened mesh ring (each device holds only its
+expert's parameter slice), tokens travel to their expert with ONE
+``all_to_all`` and come back with another — the same two-reshard pattern as
+Ulysses attention, applied to capacity-bucketed token batches.
+
+Semantics (standard capacity-factor MoE):
+
+* router: top-1 expert per token from caller-provided gate logits, output
+  scaled by the softmax gate probability;
+* capacity: each (source shard, expert) bucket holds
+  ``ceil(local_tokens * capacity_factor / n_experts)`` tokens; tokens beyond
+  a bucket's capacity are NOT routed — they pass through unchanged
+  (identity residual), the usual dropped-token convention;
+* everything — bucketing scatter, the two all_to_alls, the expert apply,
+  the un-scatter — is one jitted shard_map program; no host round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mesh import default_mesh
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+@functools.cache
+def _ep_fn(mesh: Mesh, expert_fn: Callable, n_exp: int, cap: int):
+    axes = _ring_axes(mesh)
+
+    def kernel(params, x, gates):
+        # params: (1, ...) this device's expert slice; x: (t_loc, d) local
+        # token shard; gates: (t_loc, n_exp) local gate logits.
+        params_i = jax.tree.map(lambda p: p[0], params)
+        t_loc, d = x.shape
+
+        # At least f32 for the softmax; keep f64 gates at f64.
+        probs = jax.nn.softmax(
+            gates.astype(jnp.promote_types(gates.dtype, jnp.float32)), axis=-1
+        )
+        expert = jnp.argmax(gates, axis=-1)  # (t_loc,)
+        prob = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        # Position of each token within its expert's bucket (by local order).
+        onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # (t_loc, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot, 0 elsewhere
+        slot = jnp.sum(pos, axis=1) - 1  # (t_loc,), 0-based within bucket
+        keep = slot < cap
+
+        # Scatter kept tokens into the (E, cap, d) dispatch buffer.
+        flat_idx = jnp.where(keep, expert * cap + slot, n_exp * cap)
+        buf = jnp.zeros((n_exp * cap + 1, d), x.dtype).at[flat_idx].set(x)
+        dispatch = buf[: n_exp * cap].reshape(n_exp, cap, d)
+
+        # To the experts and back: split the expert axis across devices,
+        # concat the source axis (tiled) — each device ends with the
+        # (n_src * cap, d) tokens addressed to ITS expert.
+        arrived = jax.lax.all_to_all(
+            dispatch, axes, split_axis=0, concat_axis=0, tiled=True
+        )  # (n_exp * cap, d) — n_exp source shards' buckets for expert i
+        out = expert_fn(params_i, arrived)
+        if out.shape != arrived.shape:
+            raise ValueError(
+                f"expert_fn must preserve (tokens, d) shape, got {out.shape}"
+            )
+        returned = jax.lax.all_to_all(
+            out.reshape(n_exp, cap, d), axes, split_axis=0, concat_axis=0,
+            tiled=True,
+        ).reshape(n_exp, cap, d)  # (E, cap, d) back at the source shard
+
+        # Un-scatter: token t reads its expert's bucket slot; dropped tokens
+        # keep their input (identity passthrough).
+        gathered = returned.reshape(n_exp * cap, d)[
+            jnp.clip(expert * cap + slot, 0, n_exp * cap - 1)
+        ]
+        routed = gathered * prob[:, None].astype(x.dtype)
+        return jnp.where(keep[:, None], routed, x)
+
+    f = _shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes), P(axes, None), P(axes, None)),
+        out_specs=P(axes, None),
+    )
+    return jax.jit(f)
+
+
+def expert_parallel_apply(
+    expert_fn: Callable,
+    expert_params,
+    x: jax.Array,
+    gate_logits: jax.Array,
+    capacity_factor: float = 1.25,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Route each token to its top-1 expert, apply, and return in place.
+
+    ``expert_fn(params_e, tokens) -> tokens`` applies ONE expert to a
+    (tokens, d) batch; ``expert_params`` leaves have leading axis
+    ``n_experts`` = mesh device count (device e keeps expert e's slice).
+    ``x`` is (tokens, d) with tokens divisible by the device count;
+    ``gate_logits`` is (tokens, n_experts). Tokens over a bucket's capacity
+    pass through unchanged; routed outputs are scaled by the gate
+    probability.
+    """
+    mesh = mesh or default_mesh()
+    axes = _ring_axes(mesh)
+    n_exp = len(mesh.devices.flat)
+    leaves = jax.tree.leaves(expert_params)
+    if not leaves or any(l.shape[0] != n_exp for l in leaves):
+        raise ValueError(
+            f"expert_params leaves need leading axis {n_exp} (one expert "
+            f"per device), got {[l.shape for l in leaves]}"
+        )
+    t, d = x.shape
+    if t % n_exp != 0:
+        raise ValueError(f"token count {t} must divide by {n_exp} devices")
+    if gate_logits.shape != (t, n_exp):
+        raise ValueError(
+            f"gate_logits must be ({t}, {n_exp}), got {gate_logits.shape}"
+        )
+    t_loc = t // n_exp
+    cap = max(1, int(np.ceil(t_loc * capacity_factor / n_exp)))
+
+    params_sh = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P(axes))), expert_params
+    )
+    sh = NamedSharding(mesh, P(axes, None))
+    xs = jax.device_put(x, sh)
+    gs = jax.device_put(gate_logits, sh)
+    return _ep_fn(mesh, expert_fn, n_exp, cap)(params_sh, xs, gs)
