@@ -71,19 +71,24 @@ def _ring_matmul_fn(mesh: Mesh, n_dev: int, precision: str):
         chunk = b_blk.shape[0]
         perm = [(s, (s - 1) % n_dev) for s in range(n_dev)]
 
+        # Cross-chunk accumulator in >= f32 (each dot's MXU pass already
+        # accumulates f32 internally; a bf16 carry would round per hop).
+        acc_t = jnp.promote_types(a_blk.dtype, jnp.float32)
+
         def step(t, carry):
             b_cur, acc = carry
             src = (i + t) % n_dev  # which k-chunk we hold at step t
             a_chunk = jax.lax.dynamic_slice_in_dim(a_blk, src * chunk, chunk, axis=1)
-            acc = acc + jnp.dot(a_chunk, b_cur, precision=precision)
+            acc = acc + jnp.dot(a_chunk, b_cur, precision=precision,
+                                preferred_element_type=acc_t)
             b_next = jax.lax.ppermute(b_cur, axes, perm)
             return b_next, acc
 
         acc0 = _pvary(
-            jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=a_blk.dtype), axes
+            jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=acc_t), axes
         )
         _, acc = jax.lax.fori_loop(0, n_dev, step, (b_blk, acc0))
-        return acc
+        return acc.astype(a_blk.dtype)
 
     f = _shard_map(
         kernel,
