@@ -6,6 +6,7 @@ Each case is seeded from the test id (see conftest ``rng``), so failures
 reproduce exactly.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -163,3 +164,61 @@ class TestLargerDecompositions:
             proj = np.linalg.svd(arr, full_matrices=False)
             best6 = (proj[0][:, :6] * proj[1][:6]) @ proj[2][:6]
             np.testing.assert_allclose(recon, best6, atol=1e-5)
+
+
+class TestParallelEnginesPropertySweep:
+    """Randomized shape sweeps for the round-2 engines (gpipe, expert,
+    streaming ingestion) — the same sweep-the-shapes style as above."""
+
+    def test_gpipe_random_shapes(self, rng):
+        from marlin_tpu.parallel.pipeline import gpipe
+
+        n_stages = 8
+        for _ in range(4):
+            d = int(rng.integers(3, 20))
+            micro = int(rng.choice([2, 4, 8, 16]))
+            batch = micro * int(rng.integers(1, 5))
+            ws = rng.standard_normal((n_stages, d, d)) * 0.3
+            x = rng.standard_normal((batch, d))
+            got = np.asarray(gpipe(
+                lambda w, xx: jnp.tanh(xx @ w), jnp.asarray(ws),
+                jnp.asarray(x), n_microbatches=micro,
+            ))
+            ref = x.copy()
+            for i in range(n_stages):
+                ref = np.tanh(ref @ ws[i])
+            np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-9)
+
+    def test_expert_random_gates(self, rng):
+        from marlin_tpu.parallel.expert import expert_parallel_apply
+
+        n_exp = 8
+        for _ in range(4):
+            d = int(rng.integers(2, 24))
+            t = n_exp * int(rng.integers(1, 6))
+            ws = rng.standard_normal((n_exp, d, d)) * 0.4
+            x = rng.standard_normal((t, d))
+            gates = rng.standard_normal((t, n_exp))
+            got = np.asarray(expert_parallel_apply(
+                lambda w, xx: xx @ w, jnp.asarray(ws), jnp.asarray(x),
+                jnp.asarray(gates), capacity_factor=float(n_exp),
+            ))
+            probs = np.exp(gates - gates.max(1, keepdims=True))
+            probs /= probs.sum(1, keepdims=True)
+            top = gates.argmax(1)
+            ref = np.stack([x[i] @ ws[top[i]] * probs[i, top[i]]
+                            for i in range(t)])
+            np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-10)
+
+    def test_streaming_loader_random_shapes(self, rng, tmp_path):
+        from marlin_tpu.utils import io as mio
+
+        for trial in range(3):
+            m = int(rng.integers(3, 60))
+            n = int(rng.integers(1, 12))
+            a = rng.standard_normal((m, n))
+            path = str(tmp_path / f"mat{trial}")
+            mio.save_dense_matrix(DenseVecMatrix(a), path)
+            got = mio.load_dense_matrix_streaming(path)
+            np.testing.assert_allclose(got.to_numpy(), a)
+            assert got.shape == (m, n)
