@@ -162,6 +162,11 @@ def _spmm_gather_fn(m, k, n, bm, bs, bn, max_nnz, dtype, interpret, precision):
         functools.partial(_spmm_gather_kernel, precision=precision),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((m, n), dtype),
+        # (i, j) output tiles are independent; only the k sweep carries the
+        # output accumulation.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )
     return jax.jit(f)
@@ -197,6 +202,9 @@ def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision):
         functools.partial(_spmm_kernel, precision=precision),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((m, n), dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )
     return jax.jit(f)

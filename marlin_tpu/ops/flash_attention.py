@@ -130,6 +130,11 @@ def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denominator
             pltpu.VMEM((block_q, dv), jnp.float32),  # output accumulator
         ],
+        # Mosaic may parallelize/pipeline head and q-block grid steps freely;
+        # only the innermost k sweep carries state (the VMEM scratch).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :sq]
