@@ -201,23 +201,32 @@ class DistributedMatrix:
         return self._like(self._data * self._coerce(other))
 
     # -- reductions (computed on the logical view) --------------------------
+    def _acc_dtype(self):
+        """Reduction accumulator dtype: >= f32 whatever the element type —
+        the reference reduces in Double everywhere; a bf16 fast-mode matrix
+        must not also SUM in bf16 (3 decimal digits over n*m addends)."""
+        return jnp.promote_types(self.dtype, jnp.float32)
+
     def sum(self) -> float:
         """Sum of all elements (DenseVecMatrix.scala:889; BlockMatrix.scala:467).
         The reference's treeReduce-to-driver becomes an on-device reduction +
         scalar device_get."""
-        return float(jnp.sum(self.logical))
+        return float(jnp.sum(self.logical, dtype=self._acc_dtype()))
 
     def dot_product(self, other: "DistributedMatrix") -> float:
         """Sum of the elementwise product (DenseVecMatrix.scala:905;
         BlockMatrix.scala:486) — defined for all 4 type pairings."""
         self._check_same_shape(other, "dot_product")
-        return float(jnp.sum(self._data * self._coerce(other)))
+        acc = self._acc_dtype()
+        return float(
+            jnp.sum(self._data.astype(acc) * self._coerce(other).astype(acc))
+        )
 
     def norm(self, kind: str = "1") -> float:
         """Matrix norm: "1" (max abs col sum) or "inf" (max abs row sum)
         (DenseVecMatrix.scala:975; the reference's inf arm drops the abs — a
         bug not carried over)."""
-        a = jnp.abs(self.logical)
+        a = jnp.abs(self.logical).astype(self._acc_dtype())
         if kind == "1":
             return float(jnp.max(jnp.sum(a, axis=0)))
         if kind in ("inf", "Inf"):

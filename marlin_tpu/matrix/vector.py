@@ -151,11 +151,15 @@ class DistributedVector:
         self._check_len(other)
         cfg = get_config()
         # Physical dot is safe: pad regions are zero on both sides.
+        # Accumulate >= f32 even for bf16 elements (the reference reduces
+        # in Double).
+        acc = jnp.promote_types(self.dtype, jnp.float32)
         return float(
             jnp.dot(
                 self._data,
                 other._data.astype(self.dtype),
                 precision=cfg.matmul_precision,
+                preferred_element_type=acc,
             )
         )
 

@@ -215,3 +215,25 @@ class TestVector:
         assert covered[0][0] == 0 and covered[-1][1] == 10
         total = sum(d[4] for d in plan)
         assert total == 10
+
+
+class TestReductionAccumulators:
+    def test_bf16_sum_accumulates_f32(self, rng):
+        # 40k bf16 ones sum exactly to 40960 only with a wide accumulator
+        # (bf16 integer representability ends at 256; a bf16-carried sum
+        # saturates far below the true value).
+        import jax.numpy as jnp
+
+        a = DenseVecMatrix(jnp.ones((160, 256), jnp.bfloat16))
+        assert a.sum() == 160 * 256
+        b = DenseVecMatrix(jnp.ones((160, 256), jnp.bfloat16))
+        assert a.dot_product(b) == 160 * 256
+        assert a.norm("1") == 160
+        assert a.norm("inf") == 256
+
+    def test_bf16_vector_dot(self):
+        import jax.numpy as jnp
+        from marlin_tpu.matrix.vector import DistributedVector
+
+        v = DistributedVector(jnp.ones((4096,), jnp.bfloat16))
+        assert v.dot(v) == 4096
