@@ -569,6 +569,61 @@ def config_dispatch_sweep():
                        for mb, db, ds in results]}
 
 
+def config_attention_sweep():
+    """Flash-attention block-size sweep at the bench shape (S=8k, H=8,
+    D=128): times each (block_q, block_k) candidate plus the XLA
+    softmax-attention reference, prints per-point lines on stderr, and
+    returns the best point — the autotune data for picking kernel defaults
+    on this chip generation."""
+    from marlin_tpu.ops import flash_attention
+
+    s, h, d = _sized("BENCH_ATTN_S", 8192), 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
+    flops = 4.0 * s * s * h * d
+
+    def xla_ref():
+        logits = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(float(d))
+        return jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, axis=-1),
+                          v.astype(jnp.float32))
+
+    try:
+        dt_xla = _timed(jax.jit(xla_ref), iters=3)
+        print(f"attn sweep xla_ref {flops / dt_xla / 1e12:.1f} TFLOPS",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 - S x S logits can OOM; sweep on
+        dt_xla = None
+        print(f"attn sweep xla_ref failed: {_trim_err(e, 120)}",
+              file=sys.stderr, flush=True)
+
+    best = (None, 0.0)
+    for bq, bk in ((512, 512), (512, 1024), (1024, 512), (1024, 1024),
+                   (2048, 1024), (1024, 2048), (2048, 2048)):
+        try:
+            dt = _timed(
+                lambda: flash_attention(q, k, v, block_q=bq, block_k=bk),
+                iters=10,
+            )
+            tf = flops / dt / 1e12
+        except Exception as e:  # noqa: BLE001
+            print(f"attn sweep ({bq},{bk}) failed: {_trim_err(e, 120)}",
+                  file=sys.stderr, flush=True)
+            continue
+        print(f"attn sweep ({bq},{bk}) {tf:.1f} TFLOPS", file=sys.stderr,
+              flush=True)
+        if tf > best[1]:
+            best = ((bq, bk), tf)
+    if best[0] is None:
+        raise RuntimeError("every block-size candidate failed")
+    out = {"metric": "flash_attention_best_tflops", "value": round(best[1], 2),
+           "unit": "TFLOPS", "vs_baseline": 0,
+           "best_block": list(best[0])}
+    if dt_xla:
+        out["xla_ref_tflops"] = round(flops / dt_xla / 1e12, 2)
+    return out
+
+
 CONFIGS = {
     "headline": [headline],
     "square8k": [config_square_8k],
@@ -583,10 +638,13 @@ CONFIGS = {
     "inverse": [config_inverse],
     "svd": [config_svd],
     "sweep": [config_dispatch_sweep],
+    "attnsweep": [config_attention_sweep],
 }
-# "all" = the artifact configs; the sweep is a policy-derivation tool, run
+# "all" = the artifact configs; the sweeps are policy/tuning tools, run
 # explicitly.
-CONFIGS["all"] = [fns[0] for k, fns in CONFIGS.items() if k != "sweep"]
+CONFIGS["all"] = [
+    fns[0] for k, fns in CONFIGS.items() if k not in ("sweep", "attnsweep")
+]
 
 
 def main():
