@@ -1,0 +1,17 @@
+"""Model families built on the framework's parallel engines."""
+
+from .transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    train_step,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "train_step",
+]

@@ -1,0 +1,140 @@
+"""Causal transformer LM — the flagship composition of the parallel engines.
+
+The reference's only neural model is a driver-coordinated 1-hidden-layer MLP
+(examples/NeuralNetwork.scala); this goes beyond it the way the framework's
+parallelism inventory goes beyond Spark's: a pre-LN causal transformer whose
+attention is the Pallas flash kernel (``ops/flash_attention``, interpret
+fallback off-TPU), trainable under any mix of the engines —
+
+* dp: shard the batch axis of ``tokens`` over the mesh (the caller places
+  inputs; the model is a pure function and GSPMD propagates);
+* sp: swap ``_attend_local`` for ``parallel.ulysses.sequence_parallel_attention``
+  via ``TransformerConfig.sequence_parallel`` for sequences sharded over the
+  mesh;
+* pp/ep: blocks are (params, x) -> x maps of one shared activation shape, so
+  ``parallel.pipeline.gpipe`` can stream them stage-per-device, and the MLP
+  can be swapped for ``parallel.expert.expert_parallel_apply`` routing.
+
+Pure-functional params (nested dict pytree), jittable end to end; one
+``train_step`` = value_and_grad + SGD, the same shape as the reference NN's
+iteration (NeuralNetwork.scala:218-249) with the driver-held weights replaced
+by sharded pytree leaves.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TransformerConfig(NamedTuple):
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 512
+    sequence_parallel: bool = False  # route attention through the SP engines
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0):
+    """Nested-dict param pytree; scaled-normal init."""
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 4 + 6 * cfg.n_layers)
+    d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+
+    def norm(key, *shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    params = {
+        "embed": norm(ks[0], cfg.vocab, d, scale=0.02),
+        "pos": norm(ks[1], cfg.max_len, d, scale=0.02),
+        "ln_f": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        b = 4 + 6 * i
+        params["blocks"].append({
+            "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "wqkv": norm(ks[b], d, 3 * d),
+            "wo": norm(ks[b + 1], d, d),
+            "w1": norm(ks[b + 2], d, f),
+            "b1": jnp.zeros((f,)),
+            "w2": norm(ks[b + 3], f, d),
+            "b2": jnp.zeros((d,)),
+        })
+    return params
+
+
+def _layer_norm(p, x, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+
+
+def _attend_local(q, k, v, cfg: TransformerConfig):
+    """(S, H, Dh) causal attention — flash kernel (interpret off-TPU)."""
+    from ..ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=True)
+
+
+def _attend_sp(q, k, v, cfg: TransformerConfig):
+    from ..parallel.ulysses import sequence_parallel_attention
+
+    return sequence_parallel_attention(q, k, v, causal=True)
+
+
+def _block(bp, x, cfg: TransformerConfig):
+    """One pre-LN block on (S, D) activations."""
+    s, d = x.shape
+    h = cfg.n_heads
+    dh = d // h
+    qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (S, 3D)
+    q, k, v = (a.reshape(s, h, dh) for a in jnp.split(qkv, 3, axis=1))
+    attend = _attend_sp if cfg.sequence_parallel else _attend_local
+    att = attend(q, k, v, cfg).reshape(s, d)
+    x = x + att @ bp["wo"]
+    y = _layer_norm(bp["ln2"], x)
+    y = jax.nn.gelu(y @ bp["w1"] + bp["b1"]) @ bp["w2"] + bp["b2"]
+    return x + y
+
+
+def forward(params, tokens, cfg: TransformerConfig):
+    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    b, s = tokens.shape
+    x = params["embed"][tokens] + params["pos"][None, :s, :]
+
+    def per_seq(xi):
+        for bp in params["blocks"]:
+            xi = _block(bp, xi, cfg)
+        return _layer_norm(params["ln_f"], xi)
+
+    if cfg.sequence_parallel:
+        # The SP engines place their own shardings (device_put inside) — not
+        # vmappable; long-context batches are small, unroll them.
+        x = jnp.stack([per_seq(x[i]) for i in range(b)])
+    else:
+        x = jax.vmap(per_seq)(x)
+    return x @ params["embed"].T  # weight-tied readout
+
+
+def loss_fn(params, tokens, targets, cfg: TransformerConfig):
+    """Mean next-token cross-entropy; targets (B, S) int32."""
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def train_step(params, tokens, targets, cfg: TransformerConfig,
+               lr: float = 0.1):
+    """One SGD step; jit with cfg static (hashable NamedTuple)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return loss, new_params

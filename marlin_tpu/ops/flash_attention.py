@@ -102,7 +102,7 @@ def _out_struct(x: jax.Array, shape) -> jax.ShapeDtypeStruct:
     jax.jit,
     static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
 )
-def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     """(H, Sq, D) x (H, Skv, D) x (H, Skv, Dv) -> (H, Sq, Dv); D and Dv
     already lane-padded (Dv may differ from D)."""
     h, sq, d = q.shape
@@ -138,6 +138,41 @@ def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret):
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :sq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Differentiable wrapper: forward is the Pallas kernel; backward
+    recomputes the attention in f32 with XLA and applies the closed-form
+    softmax-attention gradients (the standard flash training trade — no
+    (Sq, Skv) matrix in the forward, one per head in the backward)."""
+    return _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_hsd_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf = g.astype(jnp.float32)
+    logits = jnp.einsum("hsd,htd->hst", qf, kf) * scale
+    if causal:
+        sq, skv = q.shape[1], k.shape[1]
+        mask = jnp.arange(skv)[None, :] <= jnp.arange(sq)[:, None]
+        logits = jnp.where(mask[None], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)  # (H, Sq, Skv)
+    dv = jnp.einsum("hst,hsd->htd", p, gf)
+    dp = jnp.einsum("hsd,htd->hst", gf, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("hst,htd->hsd", ds, kf) * scale
+    dk = jnp.einsum("hst,hsd->htd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_hsd.defvjp(_flash_hsd_fwd, _flash_hsd_bwd)
 
 
 def flash_attention(
