@@ -538,6 +538,43 @@ def config_svd():
             "unit": "s", "vs_baseline": 0, "oracle_ok": ok}
 
 
+def config_transformer():
+    """Flagship transformer LM train step (models/): tokens/sec on the chip
+    through the differentiable flash-attention path. Model-scale knobs via
+    BENCH_TF_* (default ~125M params, S=2048, B=8, bf16 activations via the
+    global default dtype)."""
+    import numpy as np
+
+    from marlin_tpu.models import TransformerConfig, init_params, train_step
+
+    d = _sized("BENCH_TF_D", 1024)
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_TF_VOCAB", 32768), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_TF_L", 8),
+        d_ff=4 * d, max_len=_sized("BENCH_TF_S", 2048),
+    )
+    b, s = _sized("BENCH_TF_B", 8), cfg.max_len
+    params = init_params(cfg, seed=0)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    step = jax.jit(train_step, static_argnames="cfg")
+    loss0, params = step(params, tokens, targets, cfg=cfg)
+    fence(loss0)
+    # Time the step against fixed params (throughput, not a training run);
+    # fetch only the scalar loss.
+    dt, loss = _timed_r(
+        lambda: step(params, tokens, targets, cfg=cfg)[0], iters=5
+    )
+    # ~6 * params * tokens FLOPs per step (fwd + bwd).
+    n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    tflops = 6.0 * n_par * b * s / dt / 1e12
+    return {"metric": "transformer_train_tokens_per_s",
+            "value": round(b * s / dt, 1), "unit": "tok/s",
+            "vs_baseline": 0, "model_tflops_est": round(tflops, 2),
+            "params_m": round(n_par / 1e6, 1),
+            "loss_finite": bool(np.isfinite(float(loss)))}
+
+
 def config_dispatch_sweep():
     """Broadcast-vs-SUMMA crossover sweep (VERDICT next-6): times both arms
     for a row-striped A (m x k) times (k x n) B over a range of B sizes, and
@@ -637,6 +674,7 @@ CONFIGS = {
     "cholesky": [config_cholesky],
     "inverse": [config_inverse],
     "svd": [config_svd],
+    "transformer": [config_transformer],
     "sweep": [config_dispatch_sweep],
     "attnsweep": [config_attention_sweep],
 }

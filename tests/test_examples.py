@@ -150,3 +150,11 @@ def test_neural_network_learns(rng):
     pred = np.asarray(forward(params, jnp.asarray(images, jnp.float32)))
     acc = (pred.argmax(1) == classes).mean()
     assert acc > 0.9, f"NN failed to learn, acc={acc}, loss={loss}"
+
+
+def test_transformer_lm(capsys):
+    from marlin_tpu.examples import transformer_lm
+
+    assert transformer_lm.main(["3", "2", "32", "32"]) == 0
+    out = capsys.readouterr().out
+    assert "TransformerLM" in out and "tok/s" in out
