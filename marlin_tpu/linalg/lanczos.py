@@ -262,14 +262,13 @@ def _lanczos_sweep_device(
     # cache): the operator closes over the matrix's device buffers, so a
     # global cache keyed by it would pin those buffers for the process
     # lifetime. Attribute storage dies with the operator.
-    try:
-        cache = matvec_jax.__dict__.setdefault("_lanczos_chunks", {})
-    except AttributeError:  # bound methods / partials without a __dict__
-        cache = {}
-    key = (m_max, L.shape[1], n, dtype)
-    if key not in cache:
-        cache[key] = _device_chunk_fn(matvec_jax, m_max, L.shape[1], n, dtype)
-    chunk = cache[key]
+    from ..utils.fn_cache import cached_on
+
+    l_cols = L.shape[1]
+    chunk = cached_on(
+        matvec_jax, (m_max, l_cols, n, dtype),
+        lambda: _device_chunk_fn(matvec_jax, m_max, l_cols, n, dtype),
+    )
 
     Q = jnp.zeros((m_max + 1, n), dtype).at[0].set(jnp.asarray(q0, dtype))
     carry = (

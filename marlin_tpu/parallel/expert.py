@@ -21,7 +21,6 @@ Semantics (standard capacity-factor MoE):
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -41,7 +40,6 @@ def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-@functools.cache
 def _ep_fn(mesh: Mesh, expert_fn: Callable, n_exp: int, cap: int):
     axes = _ring_axes(mesh)
 
@@ -70,20 +68,21 @@ def _ep_fn(mesh: Mesh, expert_fn: Callable, n_exp: int, cap: int):
         dispatch = buf[: n_exp * cap].reshape(n_exp, cap, d)
 
         # To the experts and back: split the expert axis across devices,
-        # concat the source axis (tiled) — each device ends with the
-        # (n_src * cap, d) tokens addressed to ITS expert.
+        # concat the source axis (tiled; rank-preserving) — each device ends
+        # with (n_src, cap, d): every source shard's bucket for ITS expert.
         arrived = jax.lax.all_to_all(
             dispatch, axes, split_axis=0, concat_axis=0, tiled=True
-        )  # (n_exp * cap, d) — n_exp source shards' buckets for expert i
-        out = expert_fn(params_i, arrived)
-        if out.shape != arrived.shape:
+        )  # (n_exp, cap, d)
+        tokens_in = arrived.reshape(n_exp * cap, d)
+        out = expert_fn(params_i, tokens_in)  # the documented (tokens, d) batch
+        if out.shape != tokens_in.shape:
             raise ValueError(
                 f"expert_fn must preserve (tokens, d) shape, got {out.shape}"
             )
         returned = jax.lax.all_to_all(
             out.reshape(n_exp, cap, d), axes, split_axis=0, concat_axis=0,
             tiled=True,
-        ).reshape(n_exp, cap, d)  # (E, cap, d) back at the source shard
+        )  # (E, cap, d) back at the source shard
 
         # Un-scatter: token t reads its expert's bucket slot; dropped tokens
         # keep their input (identity passthrough).
@@ -145,4 +144,10 @@ def expert_parallel_apply(
     sh = NamedSharding(mesh, P(axes, None))
     xs = jax.device_put(x, sh)
     gs = jax.device_put(gate_logits, sh)
-    return _ep_fn(mesh, expert_fn, n_exp, cap)(params_sh, xs, gs)
+    # Compiled program rides on expert_fn (not a global cache): pass a STABLE
+    # function to reuse compiles across calls — jax.jit semantics.
+    from ..utils.fn_cache import cached_on
+
+    f = cached_on(expert_fn, (mesh, n_exp, cap),
+                  lambda: _ep_fn(mesh, expert_fn, n_exp, cap))
+    return f(params_sh, xs, gs)

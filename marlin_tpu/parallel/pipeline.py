@@ -22,7 +22,6 @@ are arbitrary jittable callables of (stage_params, x).
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -48,7 +47,6 @@ def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-@functools.cache
 def _gpipe_fn(mesh: Mesh, apply_fn: Callable, n_stages: int, n_micro: int):
     axes = _ring_axes(mesh)
 
@@ -145,5 +143,11 @@ def gpipe(
         lambda p: jax.device_put(p, NamedSharding(mesh, P(axes))), stage_params
     )
     xm = jax.device_put(xm, NamedSharding(mesh, P(None, None, None)))
-    out = _gpipe_fn(mesh, apply_fn, n_stages, n_micro)(params_sh, xm)
+    # Compiled program rides on apply_fn (not a global cache): pass a STABLE
+    # function to reuse compiles across calls — jax.jit semantics.
+    from ..utils.fn_cache import cached_on
+
+    f = cached_on(apply_fn, (mesh, n_stages, n_micro),
+                  lambda: _gpipe_fn(mesh, apply_fn, n_stages, n_micro))
+    out = f(params_sh, xm)
     return out.reshape(batch, d)

@@ -1,0 +1,28 @@
+"""Compiled-program caching keyed by a USER callable.
+
+``functools.cache`` with a user function in the key pins the compiled
+executable and the callable's closure (often closing over large arrays) for
+the process lifetime, and a lambda recreated per call defeats it anyway.
+Instead, ride the cache on the callable object itself: it dies with the
+callable, and a stable function reuses its compiles exactly like ``jax.jit``
+semantics. (Same pattern as the Lanczos device sweep's chunk cache.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Hashable
+
+
+def cached_on(fn: Callable, key: Hashable, build: Callable[[], object]):
+    """Return ``build()`` memoized on ``fn``'s ``__dict__`` under ``key``.
+
+    Falls back to building uncached for callables without a ``__dict__``
+    (bound methods, partials) — correct, just recompiles per call there.
+    """
+    try:
+        cache = fn.__dict__.setdefault("_marlin_compiled", {})
+    except AttributeError:
+        return build()
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]

@@ -124,7 +124,7 @@ def _parse_chunk_python(data: bytes, width: int):
 
 
 def load_dense_matrix_streaming(path: str, mesh=None, dtype=None,
-                                shape=None):
+                                shape=None, use_native: bool = True):
     """``row:csv`` text -> DenseVecMatrix without a host-resident global
     buffer: fixed-size byte chunks of complete lines parse through the C++
     codec's chunk API (``native.parse_dense_chunk``; pure-Python fallback)
@@ -138,7 +138,7 @@ def load_dense_matrix_streaming(path: str, mesh=None, dtype=None,
     from ..config import get_config
     from ..matrix.dense import DenseVecMatrix
 
-    use_native = native.available()
+    use_native = use_native and native.available()
 
     if shape is None:
         n_rows = width = 0
@@ -192,7 +192,9 @@ def load_dense_matrix(path: str, mesh=None, dtype=None, use_native: bool = True,
     if streaming is None:
         streaming = _input_size_mb(path) > STREAMING_THRESHOLD_MB
     if streaming:
-        return load_dense_matrix_streaming(path, mesh=mesh, dtype=dtype)
+        return load_dense_matrix_streaming(
+            path, mesh=mesh, dtype=dtype, use_native=use_native
+        )
 
     if use_native:
         from .. import native

@@ -81,3 +81,37 @@ class TestExpertParallel:
                 jnp.asarray(rng.standard_normal((3, d, d))),
                 jnp.zeros((n_exp * 2, d)), jnp.zeros((n_exp * 2, n_exp)),
             )
+
+
+class TestExpertFnContract:
+    def test_expert_fn_receives_flat_token_batch(self, rng, mesh):
+        # The documented contract: expert_fn sees (tokens, d), 2-D — a
+        # per-token mean-subtraction must act over ALL arrived tokens, and
+        # an ndim assert must hold (regression: it used to get (src, cap, d)).
+        n_exp = len(mesh.devices.flat)
+        d = 4
+        seen_ndim = []
+
+        def expert(w, xx):
+            seen_ndim.append(xx.ndim)
+            assert xx.ndim == 2
+            return xx @ w
+
+        ws = jnp.asarray(rng.standard_normal((n_exp, d, d)))
+        x = jnp.asarray(rng.standard_normal((n_exp * 2, d)))
+        g = jnp.asarray(rng.standard_normal((n_exp * 2, n_exp)))
+        expert_parallel_apply(expert, ws, x, g, capacity_factor=float(n_exp))
+        assert seen_ndim and all(nd == 2 for nd in seen_ndim)
+
+    def test_stable_fn_reuses_compile(self, rng, mesh):
+        n_exp = len(mesh.devices.flat)
+        d = 4
+        ws = jnp.asarray(rng.standard_normal((n_exp, d, d)))
+        x = jnp.asarray(rng.standard_normal((n_exp * 2, d)))
+        g = jnp.asarray(rng.standard_normal((n_exp * 2, n_exp)))
+        expert_parallel_apply(_linear_expert, ws, x, g)
+        cache = _linear_expert.__dict__.get("_marlin_compiled")
+        assert cache  # rides on the callable, not a module global
+        n0 = len(cache)
+        expert_parallel_apply(_linear_expert, ws, x, g)
+        assert len(cache) == n0  # same compiled program reused

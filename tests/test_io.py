@@ -198,3 +198,20 @@ class TestChunkedStreaming:
         expect = np.zeros((6, 3))
         expect[idx] = vals
         np.testing.assert_allclose(m.to_numpy(), expect)
+
+
+def test_streaming_honors_use_native_false(tmp_path, rng, monkeypatch):
+    # use_native=False must bypass the codec on the auto-streaming route too.
+    from marlin_tpu import native as native_mod
+
+    a = rng.standard_normal((9, 3))
+    path = str(tmp_path / "m")
+    mio.save_dense_matrix(DenseVecMatrix(a), path)
+
+    def boom(*args, **kwargs):
+        raise AssertionError("native codec used despite use_native=False")
+
+    monkeypatch.setattr(native_mod, "parse_dense_chunk", boom)
+    monkeypatch.setattr(native_mod, "probe_dense_text", boom)
+    m = mio.load_dense_matrix(path, use_native=False, streaming=True)
+    np.testing.assert_allclose(m.to_numpy(), a)
