@@ -10,7 +10,9 @@ fallback off-TPU), trainable under any mix of the engines —
   inputs; the model is a pure function and GSPMD propagates);
 * sp: swap ``_attend_local`` for ``parallel.ulysses.sequence_parallel_attention``
   via ``TransformerConfig.sequence_parallel`` for sequences sharded over the
-  mesh;
+  mesh (run SP-mode steps under ``jax.jit`` — the engines' internal
+  placements become sharding constraints there; eager execution would mix
+  committed devices);
 * pp/ep: blocks are (params, x) -> x maps of one shared activation shape, so
   ``parallel.pipeline.gpipe`` can stream them stage-per-device, and the MLP
   can be swapped for ``parallel.expert.expert_parallel_apply`` routing.
