@@ -18,6 +18,11 @@ the row-striped matrix types.
 Constraint: every stage maps activations (microbatch, d) -> (microbatch, d)
 with one shared shape/dtype (the transformer-block regime); stage functions
 are arbitrary jittable callables of (stage_params, x).
+
+Trainable as-is: the schedule's trip count is static, so reverse-mode
+differentiates straight through the fori_loop and the ppermute transposes —
+``jax.grad`` of a gpipe loss equals the sequential model's gradients
+exactly (tested).
 """
 
 from __future__ import annotations
