@@ -16,7 +16,10 @@ Semantics (standard capacity-factor MoE):
   a bucket's capacity are NOT routed — they pass through unchanged
   (identity residual), the usual dropped-token convention;
 * everything — bucketing scatter, the two all_to_alls, the expert apply,
-  the un-scatter — is one jitted shard_map program; no host round-trips.
+  the un-scatter — is one jitted shard_map program; no host round-trips;
+* trainable as-is: reverse-mode flows through the dispatch, and the
+  gate-probability scaling carries the standard top-1 router gradient —
+  grads for params/tokens/gates match the dense oracle exactly (tested).
 """
 
 from __future__ import annotations
