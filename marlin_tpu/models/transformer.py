@@ -13,9 +13,11 @@ fallback off-TPU), trainable under any mix of the engines —
   mesh (run SP-mode steps under ``jax.jit`` — the engines' internal
   placements become sharding constraints there; eager execution would mix
   committed devices);
-* pp/ep: blocks are (params, x) -> x maps of one shared activation shape, so
-  ``parallel.pipeline.gpipe`` can stream them stage-per-device, and the MLP
-  can be swapped for ``parallel.expert.expert_parallel_apply`` routing.
+* ep: ``TransformerConfig.n_experts = device count`` swaps the MLP for
+  top-1 MoE routing through ``parallel.expert`` (per-block router; jit-only
+  like SP);
+* pp: blocks are (params, x) -> x maps of one shared activation shape, so
+  ``parallel.pipeline.gpipe`` can stream them stage-per-device.
 
 Pure-functional params (nested dict pytree), jittable end to end; one
 ``train_step`` = value_and_grad + SGD, the same shape as the reference NN's
@@ -40,6 +42,8 @@ class TransformerConfig(NamedTuple):
     d_ff: int = 512
     max_len: int = 512
     sequence_parallel: bool = False  # route attention through the SP engines
+    n_experts: int = 0  # >0: MoE MLP via parallel.expert (set = device count)
+    moe_capacity: float = 2.0
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0):
@@ -60,16 +64,32 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
     }
     for i in range(cfg.n_layers):
         b = 4 + 6 * i
-        params["blocks"].append({
+        blk = {
             "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
             "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
             "wqkv": norm(ks[b], d, 3 * d),
             "wo": norm(ks[b + 1], d, d),
-            "w1": norm(ks[b + 2], d, f),
-            "b1": jnp.zeros((f,)),
-            "w2": norm(ks[b + 3], f, d),
-            "b2": jnp.zeros((d,)),
-        })
+        }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            kw1, kw2, kr = jax.random.split(ks[b + 2], 3)
+            blk.update({
+                "router": norm(kr, d, e, scale=0.02),
+                "w1": jax.vmap(lambda k: norm(k, d, f))(
+                    jax.random.split(kw1, e)),
+                "b1": jnp.zeros((e, f)),
+                "w2": jax.vmap(lambda k: norm(k, f, d))(
+                    jax.random.split(kw2, e)),
+                "b2": jnp.zeros((e, d)),
+            })
+        else:
+            blk.update({
+                "w1": norm(ks[b + 2], d, f),
+                "b1": jnp.zeros((f,)),
+                "w2": norm(ks[b + 3], f, d),
+                "b2": jnp.zeros((d,)),
+            })
+        params["blocks"].append(blk)
     return params
 
 
@@ -92,6 +112,13 @@ def _attend_sp(q, k, v, cfg: TransformerConfig):
     return sequence_parallel_attention(q, k, v, causal=True)
 
 
+def _moe_expert(p, tok):
+    """One expert's MLP on a (tokens, d) batch (module-level for stable
+    compile caching in parallel.expert)."""
+    w1, b1, w2, b2 = p
+    return jax.nn.gelu(tok @ w1 + b1) @ w2 + b2
+
+
 def _block(bp, x, cfg: TransformerConfig):
     """One pre-LN block on (S, D) activations."""
     s, d = x.shape
@@ -103,7 +130,16 @@ def _block(bp, x, cfg: TransformerConfig):
     att = attend(q, k, v, cfg).reshape(s, d)
     x = x + att @ bp["wo"]
     y = _layer_norm(bp["ln2"], x)
-    y = jax.nn.gelu(y @ bp["w1"] + bp["b1"]) @ bp["w2"] + bp["b2"]
+    if cfg.n_experts:
+        from ..parallel.expert import expert_parallel_apply
+
+        gates = y @ bp["router"]  # (S, E)
+        y = expert_parallel_apply(
+            _moe_expert, (bp["w1"], bp["b1"], bp["w2"], bp["b2"]), y, gates,
+            capacity_factor=cfg.moe_capacity,
+        )
+    else:
+        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"]) @ bp["w2"] + bp["b2"]
     return x + y
 
 
@@ -117,9 +153,10 @@ def forward(params, tokens, cfg: TransformerConfig):
             xi = _block(bp, xi, cfg)
         return _layer_norm(params["ln_f"], xi)
 
-    if cfg.sequence_parallel:
-        # The SP engines place their own shardings (device_put inside) — not
-        # vmappable; long-context batches are small, unroll them.
+    if cfg.sequence_parallel or cfg.n_experts:
+        # The SP/EP engines place their own shardings (device_put inside) —
+        # not vmappable; such batches are small, unroll them. (Run these
+        # modes under jit, like SP.)
         x = jnp.stack([per_seq(x[i]) for i in range(b)])
     else:
         x = jax.vmap(per_seq)(x)
