@@ -114,6 +114,9 @@ def _cannon_fn(mesh: Mesh, precision: str):
     def kernel(a_blk, b_blk):
         i = jax.lax.axis_index(ar)
         j = jax.lax.axis_index(ac)
+        # Cross-step accumulator >= f32 (a bf16 carry would round per ring
+        # step); cast back once at the end.
+        acc_t = jnp.promote_types(a_blk.dtype, jnp.float32)
 
         def shift(x, axis_name, steps):
             # Rotate shards ``steps`` positions left along ``axis_name``.
@@ -135,17 +138,18 @@ def _cannon_fn(mesh: Mesh, precision: str):
 
         a = skew(a_blk, ac, i)
         b = skew(b_blk, ar, j)
-        acc = jnp.dot(a, b, precision=precision)
+        acc = jnp.dot(a, b, precision=precision, preferred_element_type=acc_t)
 
         def step(_, carry):
             a, b, acc = carry
             a = shift(a, ac, 1)
             b = shift(b, ar, 1)
-            acc = acc + jnp.dot(a, b, precision=precision)
+            acc = acc + jnp.dot(a, b, precision=precision,
+                                preferred_element_type=acc_t)
             return a, b, acc
 
         _, _, acc = jax.lax.fori_loop(0, p - 1, step, (a, b, acc))
-        return acc
+        return acc.astype(a_blk.dtype)
 
     spec = P(ar, ac)
     f = _shard_map(kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
@@ -168,9 +172,13 @@ def _gemm3d_fn(mesh3: Mesh, precision: str):
     def kernel(a_blk, b_blk):
         # a_blk: (m/pm, k/pk) replicated over gn; b_blk: (k/pk, n/pn)
         # replicated over gm. Local MXU matmul then contract the k grid axis —
-        # the reduceByKey of BlockMatrix.scala:132 as an ICI psum.
-        part = jnp.dot(a_blk, b_blk, precision=precision)
-        return jax.lax.psum(part, "gk")
+        # the reduceByKey of BlockMatrix.scala:132 as an ICI psum. Partials
+        # ride >= f32 through the psum (bf16 partial sums would round per
+        # summand).
+        acc_t = jnp.promote_types(a_blk.dtype, jnp.float32)
+        part = jnp.dot(a_blk, b_blk, precision=precision,
+                       preferred_element_type=acc_t)
+        return jax.lax.psum(part, "gk").astype(a_blk.dtype)
 
     f = _shard_map(
         kernel,

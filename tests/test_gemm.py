@@ -275,3 +275,25 @@ class TestParallelismHint:
         np.testing.assert_allclose(
             out.to_numpy(), a.to_numpy() @ b.to_numpy(), rtol=1e-10
         )
+
+
+class TestEngineAccumulators:
+    def test_bf16_cannon_and_3d_accumulate_f32(self, rng):
+        # Ones matrices: the exact product is k (= 1024), representable in
+        # f32 but NOT in bf16 increments past 256 — a bf16 cross-step carry
+        # would stall below the true value.
+        import jax.numpy as jnp
+
+        import jax
+
+        import marlin_tpu as mt
+
+        n = 1024
+        a = jnp.ones((n, n), jnp.bfloat16)
+        b = jnp.ones((n, n), jnp.bfloat16)
+        square = mt.create_mesh(shape=(2, 2), devices=jax.devices()[:4])
+        for engine in ("cannon", "summa"):
+            out = summa.matmul(a, b, mesh=square, engine=engine)
+            assert float(jnp.max(out.astype(jnp.float32))) == n, engine
+        out3 = summa.matmul_3d(a, b, (2, 2, 2))
+        assert float(jnp.max(out3.astype(jnp.float32))) == n
