@@ -110,37 +110,50 @@ class BlockSparse:
         return self.data
 
 
-def _spmm_kernel(mask_ref, a_ref, b_ref, o_ref, *, precision):
+def _spmm_kernel(mask_ref, a_ref, b_ref, o_ref, acc_ref, *, precision):
     k = pl.program_id(2)
     j = pl.program_id(1)
 
     @pl.when(k == 0)
     def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
     @pl.when(mask_ref[k, j] != 0)
     def _accumulate():
-        o_ref[:] += jnp.dot(
+        # Accumulate across k steps in the f32 VMEM scratch — += into a
+        # bf16 o_ref would round per step.
+        acc_ref[:] += jnp.dot(
             a_ref[:], b_ref[:], precision=precision,
             preferred_element_type=jnp.float32,
-        ).astype(o_ref.dtype)
+        )
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
-def _spmm_gather_kernel(kidx_ref, kcnt_ref, a_ref, b_ref, o_ref, *, precision):
+def _spmm_gather_kernel(kidx_ref, kcnt_ref, a_ref, b_ref, o_ref, acc_ref, *,
+                        precision):
     del kidx_ref  # consumed by the index maps
     kk = pl.program_id(2)
     j = pl.program_id(1)
 
     @pl.when(kk == 0)
     def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
     @pl.when(kk < kcnt_ref[j])
     def _accumulate():
-        o_ref[:] += jnp.dot(
+        acc_ref[:] += jnp.dot(
             a_ref[:], b_ref[:], precision=precision,
             preferred_element_type=jnp.float32,
-        ).astype(o_ref.dtype)
+        )
+
+    @pl.when(kk == pl.num_programs(2) - 1)
+    def _finalize():
+        # Runs on the grid's final step even when the column's real blocks
+        # ended earlier (padded steps skip only the accumulate).
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
 @functools.cache
@@ -157,6 +170,7 @@ def _spmm_gather_fn(m, k, n, bm, bs, bn, max_nnz, dtype, interpret, precision):
             pl.BlockSpec((bs, bn), lambda i, j, kk, kidx, kcnt: (kidx[j, kk], j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk, kidx, kcnt: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
     )
     f = pl.pallas_call(
         functools.partial(_spmm_gather_kernel, precision=precision),
@@ -197,6 +211,7 @@ def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision):
             pl.BlockSpec((bs, bn), lambda i, j, kk, mask: (kk, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk, mask: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
     )
     f = pl.pallas_call(
         functools.partial(_spmm_kernel, precision=precision),

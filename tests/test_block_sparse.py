@@ -96,3 +96,22 @@ class TestBlockSparse:
     def test_mask_shape_contract(self):
         with pytest.raises(ValueError):
             BlockSparse(jnp.ones((16, 16)), jnp.ones((3, 2)), BS)
+
+
+class TestBf16Accumulation:
+    def test_bf16_output_accumulates_f32_across_k(self, rng):
+        # B filled with 1 + 2^-6 (exact in bf16): each 128-wide k-block
+        # contributes exactly 130.0 per output element; the exact product
+        # over 8 k-steps is 1040.0 (bf16-representable). A bf16
+        # (7-mantissa-bit) running accumulator rounds intermediates and
+        # lands on 1032.0 (verified by simulating the old += path); the f32
+        # VMEM scratch keeps every partial exact.
+        import jax.numpy as jnp
+
+        n, bs = 1024, 128
+        val = 1.0 + 2.0 ** -6
+        b = BlockSparse(jnp.full((n, n), val, jnp.bfloat16),
+                        jnp.ones((n // bs, n // bs), bool), bs)
+        a = jnp.ones((n, n), jnp.bfloat16)
+        out = np.asarray(block_sparse_matmul(a, b), np.float64)
+        assert out.min() == out.max() == 1040.0, (out.min(), out.max())
