@@ -231,9 +231,13 @@ class DistSparseVecMatrix:
         return DenseVecMatrix(out[: self.num_rows], mesh=self.mesh)
 
     def _product_stripes(self, other: "DistSparseVecMatrix") -> jax.Array:
-        """Row-sharded dense stripes of A @ B (padded rows at the tail)."""
+        """Row-sharded dense stripes of A @ B (padded rows at the tail).
+        Accumulation >= f32 even for low-precision values (segment sums over
+        nnz addends must not round per entry)."""
         nd = _n_dev(self.mesh)
-        out_dtype = jnp.result_type(self.vals.dtype, other.vals.dtype)
+        out_dtype = jnp.promote_types(
+            jnp.result_type(self.vals.dtype, other.vals.dtype), jnp.float32
+        )
         fn = _spsp_ring(self.mesh, nd, self.stripe, other.stripe,
                         other.num_cols, jnp.dtype(out_dtype))
         return fn(self.rows, self.cols, self.vals,
@@ -356,7 +360,7 @@ def _spmm_ring_dense(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
         i = jax.lax.axis_index(axes)
         row0 = i * m_stripe
         perm = [(s, (s - 1) % nd) for s in range(nd)]
-        out_dtype = b.dtype
+        out_dtype = jnp.promote_types(b.dtype, jnp.float32)
 
         def step(t, carry):
             b_cur, acc = carry
