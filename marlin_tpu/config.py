@@ -28,8 +28,15 @@ class MarlinConfig:
     """
 
     # Broadcast-vs-split GEMM threshold, in megabytes of the smaller operand.
-    # Reference default: 300 MB (DenseVecMatrix.scala:196-198). On TPU the real
-    # constraint is HBM residency of a replicated operand, but the knob is kept.
+    # The reference's 300 MB (DenseVecMatrix.scala:196-198) priced a Spark
+    # shuffle; the TPU cost model (docs/design.md §2) re-derives the arm
+    # choice as HBM residency vs ICI gather volume: replicating B costs its
+    # full size of HBM on EVERY chip but zero inter-device bytes per GEMM,
+    # so broadcast wins whenever B fits comfortably beside the stripes —
+    # roughly an eighth of per-chip HBM (v5e: 16 GB -> ~2000 MB ceiling).
+    # The conservative 300 MB default keeps headroom for chained products
+    # and async dispatch buffers; `bench.py --config sweep` measures the
+    # actual crossover on the target chip for tuning this knob upward.
     broadcast_threshold_mb: float = 300.0
 
     # Panel ("base") block sizes for the blocked decompositions; reference reads
