@@ -228,7 +228,12 @@ def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision):
 def block_sparse_matmul(
     a: jax.Array, b: BlockSparse, interpret: Optional[bool] = None
 ) -> jax.Array:
-    """C = A @ B with B block-sparse; empty B blocks issue no MXU work."""
+    """C = A @ B with B block-sparse; empty B blocks issue no MXU work.
+
+    Differentiable: the forward runs the Pallas kernel; the backward is the
+    closed-form dense recompute — dA = g B^T rides the zero-masked backing
+    (exact), dB = A^T g projected onto the block mask (gradient exists only
+    where blocks do, matching the container's zeroing invariant)."""
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"dimension mismatch: {a.shape} x {b.shape}")
     if interpret is None:
@@ -243,19 +248,48 @@ def block_sparse_matmul(
     precision = get_config().matmul_precision
     if pltpu is None:  # pragma: no cover - no Pallas TPU support in this jax
         # The backing array keeps empty blocks zeroed, so a plain dot is the
-        # correct (dense-speed) fallback.
+        # correct (dense-speed, natively differentiable) fallback.
         out = jnp.dot(ap, b.data, precision=precision)
     elif b._host_mask is None:
         # Under an outer jit the mask has no concrete value; run the full
         # (M, N, K) grid with mask-guarded accumulation.
-        out = _spmm_fn(
+        run = _spmm_fn(
             ap.shape[0], b.shape[0], b.shape[1], bs, bs, bs, b.data.dtype,
             interpret, precision,
-        )(b.mask, ap, b.data)
+        )
+        out = _diff_spmm(lambda aa, dd: run(b.mask, aa, dd), b.mask, bs,
+                         precision)(ap, b.data)
     else:
         kidx, kcnt, max_nnz = b._gather_lists()
-        out = _spmm_gather_fn(
+        run = _spmm_gather_fn(
             ap.shape[0], b.shape[0], b.shape[1], bs, bs, bs, max_nnz,
             b.data.dtype, interpret, precision,
-        )(kidx, kcnt, ap, b.data)
+        )
+        out = _diff_spmm(lambda aa, dd: run(kidx, kcnt, aa, dd), b.mask, bs,
+                         precision)(ap, b.data)
     return out[:m] if pad_m else out
+
+
+def _diff_spmm(run, mask, bs: int, precision):
+    """Wrap a (a, data) -> out kernel call with the SpMM custom VJP."""
+
+    @jax.custom_vjp
+    def f(a, data):
+        return run(a, data)
+
+    def fwd(a, data):
+        return run(a, data), (a, data)
+
+    def bwd(res, g):
+        a, data = res
+        gf = g.astype(jnp.float32)
+        af = a.astype(jnp.float32)
+        df = data.astype(jnp.float32)
+        da = jnp.dot(gf, df.T, precision=precision)
+        db = jnp.dot(af.T, gf, precision=precision)
+        block_mask = jnp.repeat(jnp.repeat(mask, bs, axis=0), bs, axis=1)
+        db = jnp.where(block_mask != 0, db, 0.0)
+        return da.astype(a.dtype), db.astype(data.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
