@@ -30,6 +30,8 @@ def main(argv=None) -> int:
     from marlin_tpu.models import TransformerConfig, init_params, train_step
     from marlin_tpu.utils.timing import fence
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     mesh = mt.default_mesh()
     cfg = TransformerConfig(
         vocab=128, d_model=d_model, n_heads=max(2, d_model // 32),
@@ -37,7 +39,13 @@ def main(argv=None) -> int:
     )
     params = init_params(cfg, seed=0)
     key = jax.random.PRNGKey(1)
-    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab)
+    n_dev = len(mesh.devices.flat)
+    if batch % n_dev:
+        batch = max(n_dev, batch - batch % n_dev)  # dp wants even shards
+    tokens = jax.device_put(
+        jax.random.randint(key, (batch, seq), 0, cfg.vocab),
+        NamedSharding(mesh, P(tuple(mesh.axis_names), None)),  # dp over all
+    )
     targets = jnp.roll(tokens, -1, axis=1)
 
     step = jax.jit(train_step, static_argnames="cfg")

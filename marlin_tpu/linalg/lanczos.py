@@ -266,7 +266,7 @@ def _lanczos_sweep_device(
 
     l_cols = L.shape[1]
     chunk = cached_on(
-        matvec_jax, (m_max, l_cols, n, dtype),
+        matvec_jax, ("lanczos", m_max, l_cols, n, dtype),
         lambda: _device_chunk_fn(matvec_jax, m_max, l_cols, n, dtype),
     )
 

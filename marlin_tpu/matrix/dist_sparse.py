@@ -232,14 +232,13 @@ class DistSparseVecMatrix:
 
     def _product_stripes(self, other: "DistSparseVecMatrix") -> jax.Array:
         """Row-sharded dense stripes of A @ B (padded rows at the tail).
-        Accumulation >= f32 even for low-precision values (segment sums over
-        nnz addends must not round per entry)."""
+        Accumulates >= f32 internally (segment sums over nnz addends must
+        not round per entry) and casts back to the operands' result dtype
+        once at the engine boundary."""
         nd = _n_dev(self.mesh)
-        out_dtype = jnp.promote_types(
-            jnp.result_type(self.vals.dtype, other.vals.dtype), jnp.float32
-        )
+        res_dtype = jnp.result_type(self.vals.dtype, other.vals.dtype)
         fn = _spsp_ring(self.mesh, nd, self.stripe, other.stripe,
-                        other.num_cols, jnp.dtype(out_dtype))
+                        other.num_cols, jnp.dtype(res_dtype))
         return fn(self.rows, self.cols, self.vals,
                   other.rows, other.cols, other.vals)
 
@@ -326,6 +325,8 @@ def _spsp_ring(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
         i = jax.lax.axis_index(axes)
         row0 = i * m_stripe
         perm = [(s, (s - 1) % nd) for s in range(nd)]
+        # Accumulate >= f32, cast back to the result dtype once at the end.
+        acc_t = jnp.promote_types(out_dtype, jnp.float32)
 
         def step(t, carry):
             (br, bc, bv), acc = carry
@@ -333,17 +334,17 @@ def _spsp_ring(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
             k0 = src * k_stripe
             # Scatter the visiting COO shard into its dense k-stripe; pads
             # add value 0.
-            bstripe = jnp.zeros((k_stripe, n_cols), out_dtype)
+            bstripe = jnp.zeros((k_stripe, n_cols), acc_t)
             bstripe = bstripe.at[br[0] - k0, bc[0]].add(
-                bv[0].astype(out_dtype), mode="drop"
+                bv[0].astype(acc_t), mode="drop"
             )
             acc = _chunked_accumulate(acc, a_r, a_c, a_v, bstripe, k0, row0)
             nxt = tuple(jax.lax.ppermute(x, axes, perm) for x in (br, bc, bv))
             return nxt, acc
 
-        acc0 = _pvary(jnp.zeros((m_stripe, n_cols), out_dtype), axes)
+        acc0 = _pvary(jnp.zeros((m_stripe, n_cols), acc_t), axes)
         _, acc = jax.lax.fori_loop(0, nd, step, ((b_r, b_c, b_v), acc0))
-        return acc
+        return acc.astype(out_dtype)
 
     spec = P(axes, None)
     f = _shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6, out_specs=spec)
@@ -360,7 +361,7 @@ def _spmm_ring_dense(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
         i = jax.lax.axis_index(axes)
         row0 = i * m_stripe
         perm = [(s, (s - 1) % nd) for s in range(nd)]
-        out_dtype = jnp.promote_types(b.dtype, jnp.float32)
+        acc_t = jnp.promote_types(b.dtype, jnp.float32)
 
         def step(t, carry):
             b_cur, acc = carry
@@ -369,9 +370,9 @@ def _spmm_ring_dense(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
             acc = _chunked_accumulate(acc, a_r, a_c, a_v, b_cur, k0, row0)
             return jax.lax.ppermute(b_cur, axes, perm), acc
 
-        acc0 = _pvary(jnp.zeros((m_stripe, n_cols), out_dtype), axes)
+        acc0 = _pvary(jnp.zeros((m_stripe, n_cols), acc_t), axes)
         _, acc = jax.lax.fori_loop(0, nd, step, (b, acc0))
-        return acc
+        return acc.astype(b.dtype)
 
     spec = P(axes, None)
     f = _shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4, out_specs=spec)

@@ -248,8 +248,13 @@ def block_sparse_matmul(
     precision = get_config().matmul_precision
     if pltpu is None:  # pragma: no cover - no Pallas TPU support in this jax
         # The backing array keeps empty blocks zeroed, so a plain dot is the
-        # correct (dense-speed, natively differentiable) fallback.
-        out = jnp.dot(ap, b.data, precision=precision)
+        # correct (dense-speed) fallback — routed through the same VJP so
+        # dB stays mask-projected (raw autodiff would grow gradients in
+        # unmasked blocks, breaking the zeroing invariant after an update).
+        out = _diff_spmm(
+            lambda aa, dd: jnp.dot(aa, dd, precision=precision), b.mask, bs,
+            precision,
+        )(ap, b.data)
     elif b._host_mask is None:
         # Under an outer jit the mask has no concrete value; run the full
         # (M, N, K) grid with mask-guarded accumulation.

@@ -151,6 +151,6 @@ def expert_parallel_apply(
     # function to reuse compiles across calls — jax.jit semantics.
     from ..utils.fn_cache import cached_on
 
-    f = cached_on(expert_fn, (mesh, n_exp, cap),
+    f = cached_on(expert_fn, ("ep", mesh, n_exp, cap),
                   lambda: _ep_fn(mesh, expert_fn, n_exp, cap))
     return f(params_sh, xs, gs)

@@ -152,7 +152,7 @@ def gpipe(
     # function to reuse compiles across calls — jax.jit semantics.
     from ..utils.fn_cache import cached_on
 
-    f = cached_on(apply_fn, (mesh, n_stages, n_micro),
+    f = cached_on(apply_fn, ("pp", mesh, n_stages, n_micro),
                   lambda: _gpipe_fn(mesh, apply_fn, n_stages, n_micro))
     out = f(params_sh, xm)
     return out.reshape(batch, d)

@@ -16,6 +16,10 @@ from typing import Callable, Hashable
 def cached_on(fn: Callable, key: Hashable, build: Callable[[], object]):
     """Return ``build()`` memoized on ``fn``'s ``__dict__`` under ``key``.
 
+    All users share ONE per-callable dict, so ``key`` must start with a
+    caller-unique namespace tag (e.g. ``("ep", ...)``) — the same callable
+    may legitimately serve several engines.
+
     Falls back to building uncached for callables without a ``__dict__``
     (bound methods, partials) — correct, just recompiles per call there.
     """

@@ -203,3 +203,24 @@ class TestHopBounding:
         out = a.multiply_sparse(b)
         oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
         np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
+
+
+class TestOutputDtypeContract:
+    def test_bf16_operands_keep_bf16_results(self, rng, mesh):
+        # The engines accumulate in f32 internally but cast back at the
+        # boundary — bf16 in, bf16 out (the framework's cast-back-once
+        # convention).
+        import jax.numpy as jnp
+
+        r, c, v = _random_coo(rng, 24, 16, 0.3)
+        rb, cb, vb = _random_coo(rng, 16, 12, 0.3)
+        a = DistSparseVecMatrix.from_coo(r, c, v.astype(np.float32), (24, 16))
+        a.vals = a.vals.astype(jnp.bfloat16)
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb.astype(np.float32), (16, 12))
+        b.vals = b.vals.astype(jnp.bfloat16)
+        out = a.multiply_sparse(b)
+        assert out.values.dtype == jnp.bfloat16
+        dm = DenseVecMatrix(rng.standard_normal((16, 6)).astype(np.float32))
+        dm._data = dm._data.astype(jnp.bfloat16)
+        out2 = a.multiply_dense(dm)
+        assert out2.dtype == jnp.bfloat16
