@@ -215,3 +215,27 @@ def test_streaming_honors_use_native_false(tmp_path, rng, monkeypatch):
     monkeypatch.setattr(native_mod, "probe_dense_text", boom)
     m = mio.load_dense_matrix(path, use_native=False, streaming=True)
     np.testing.assert_allclose(m.to_numpy(), a)
+
+
+class TestLoaderEdgeCases:
+    def test_no_trailing_newline(self, tmp_path):
+        p = tmp_path / "m.txt"
+        p.write_bytes(b"0:1.0,2.0\n1:3.0,4.0")  # no final \n
+        m = mio.load_dense_matrix_streaming(str(p))
+        np.testing.assert_allclose(m.to_numpy(), [[1, 2], [3, 4]])
+
+    def test_multifile_dir_boundaries(self, tmp_path, rng):
+        # Rows split across part files; a line must never straddle files.
+        d = tmp_path / "dir"
+        d.mkdir()
+        (d / "part-00000").write_text("0:1.0\n1:2.0\n")
+        (d / "part-00001").write_text("2:3.0")
+        (d / "_SUCCESS").write_text("")
+        m = mio.load_dense_matrix_streaming(str(d))
+        np.testing.assert_allclose(m.to_numpy(), [[1.0], [2.0], [3.0]])
+
+    def test_streaming_empty_input_raises(self, tmp_path):
+        p = tmp_path / "empty.txt"
+        p.write_text("")
+        with pytest.raises(ValueError, match="no matrix rows"):
+            mio.load_dense_matrix_streaming(str(p))
