@@ -250,6 +250,8 @@ def headline():
         "value": round(tflops_per_chip, 2),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(tflops_per_chip / target, 3),
+        "device": jax.devices()[0].device_kind,
+        "n": N,
     }
 
 

@@ -108,7 +108,12 @@ def _partition_coo(rows, cols, vals, n_rows: int, n_dev: int):
 
 
 class DistSparseVecMatrix:
-    """Row-partitioned distributed sparse matrix (see module docstring)."""
+    """Row-partitioned distributed sparse matrix (see module docstring).
+
+    Instances are immutable: do not reassign ``rows``/``cols``/``vals``
+    after construction — the ring kernels rely on the constructor's
+    per-stripe column-sorted invariant for their searchsorted hop bounds.
+    """
 
     def __init__(self, rows, cols, vals, shape: Tuple[int, int], mesh=None,
                  stripe: Optional[int] = None):
