@@ -211,16 +211,18 @@ class TestOutputDtypeContract:
         # boundary — bf16 in, bf16 out (the framework's cast-back-once
         # convention).
         import jax.numpy as jnp
+        import ml_dtypes
 
+        bf16 = ml_dtypes.bfloat16
         r, c, v = _random_coo(rng, 24, 16, 0.3)
         rb, cb, vb = _random_coo(rng, 16, 12, 0.3)
-        a = DistSparseVecMatrix.from_coo(r, c, v.astype(np.float32), (24, 16))
-        a.vals = a.vals.astype(jnp.bfloat16)
-        b = DistSparseVecMatrix.from_coo(rb, cb, vb.astype(np.float32), (16, 12))
-        b.vals = b.vals.astype(jnp.bfloat16)
+        a = DistSparseVecMatrix.from_coo(r, c, v.astype(bf16), (24, 16))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb.astype(bf16), (16, 12))
+        assert a.vals.dtype == jnp.bfloat16
         out = a.multiply_sparse(b)
         assert out.values.dtype == jnp.bfloat16
-        dm = DenseVecMatrix(rng.standard_normal((16, 6)).astype(np.float32))
-        dm._data = dm._data.astype(jnp.bfloat16)
+        dm = DenseVecMatrix(
+            jnp.asarray(rng.standard_normal((16, 6)), jnp.bfloat16)
+        )
         out2 = a.multiply_dense(dm)
         assert out2.dtype == jnp.bfloat16
