@@ -341,10 +341,25 @@ def config_attention():
     s, h, d = 8192, 8, 128
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
-    dt = _timed(lambda: flash_attention(q, k, v), iters=10)
+    # Device-side scan loop: one dispatch covers LOOP invocations, so the
+    # per-call tunnel RTT (~comparable to the 6 ms kernel itself) drops out.
+    # The carry perturbs q so XLA cannot hoist the kernel out of the scan.
+    loop = 10
+
+    @jax.jit
+    def scan_loop(q, k, v):
+        def body(c, _):
+            o = flash_attention(q + (c * 1e-8).astype(q.dtype), k, v)
+            return jnp.sum(o[0, 0, :2].astype(jnp.float32)), None
+        return jax.lax.scan(body, jnp.float32(0), None, length=loop)[0]
+
+    float(scan_loop(q, k, v))  # warmup; float() is the tunnel-safe fence
+    t0 = time.perf_counter()
+    float(scan_loop(q, k, v))
+    dt = (time.perf_counter() - t0) / loop
     tflops = 4.0 * s * s * h * d / dt / 1e12  # QK^T + PV
     return {"metric": "flash_attention_tflops", "value": round(tflops, 2),
-            "unit": "TFLOPS", "vs_baseline": 0,
+            "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
             "oracle_max_err": round(err, 6), "oracle_ok": err < 0.02}
 
 
