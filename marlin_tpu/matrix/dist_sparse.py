@@ -25,13 +25,17 @@ The sparse x sparse product is a shard_map ring:
   mesh — no device ever holds the full operand or the full result.
 
 Peak per-device scratch: one (k/n_dev, n) B stripe + the (m/n_dev, n) C
-stripe accumulator + an (entry-chunk, n) expansion buffer. A's sparsity
-scales the FLOPs (work = nnz(A) * n / n_dev per device): each stripe's
-entries are stored sorted by column, so every hop visits only the entry
-chunks whose k lives in the visiting B stripe (``searchsorted`` bounds into
-the chunk loop), not the whole local entry set. B's sparsity scales the ring
-traffic. Column-blocking the n axis would bound the stripes further; not
-needed at reference bench sizes.
+stripe accumulator + an (entry-chunk, n) expansion buffer, the last sized
+by a byte budget (``_CHUNK_BUDGET_BYTES``) because every chunk-loop step
+costs a full pass over the C-stripe carry. Entries are stored sorted by
+column so ``searchsorted`` bounds each hop's chunk loop to the chunks
+overlapping the visiting B stripe's k-range; when the whole local entry
+set fits one budget-sized chunk (the common single-host case) that bound
+degenerates to scanning all local entries each hop — expansion work
+cap * n per hop — which is still the cheaper regime because the loop-step
+cost, not the expansion arithmetic, dominates. B's sparsity scales the
+ring traffic. Column-blocking the n axis would bound the stripes further;
+not needed at reference bench sizes.
 
 Contract: value-0 entries are STRUCTURAL throughout this module — pad slots
 carry value 0, and every consumer (``nnz``, extraction, conversions) treats
@@ -57,7 +61,39 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-_ENTRY_CHUNK = 128  # A-entry expansion buffer rows; caps the (chunk, n) temp
+_ENTRY_CHUNK = 128  # storage-cap quantum for the padded (n_dev, cap) triples
+# The ring kernels expand A entries into a (chunk, n) buffer per loop step.
+# Each fori_loop step costs a full accumulator-stripe pass (the functional
+# scatter-add rewrites the (m_stripe, n) carry), so FEWER, LARGER chunks win
+# until the expansion buffer itself dominates HBM traffic: the chunk is sized
+# to _CHUNK_BUDGET_BYTES of f32 expansion rows, not fixed at the 128-row
+# storage quantum (measured 16k/1e-3 bench: 128-row chunks -> ~2.1k steps).
+_CHUNK_BUDGET_BYTES = 256 << 20
+
+
+def _kernel_chunk(cap: int, n_cols: int) -> int:
+    """Entry-chunk rows for the ring kernels: as many _ENTRY_CHUNK quanta as
+    fit the expansion-buffer budget, clamped to [128, cap]."""
+    by_budget = _CHUNK_BUDGET_BYTES // max(4 * n_cols, 1)
+    chunk = min(max(by_budget, _ENTRY_CHUNK), max(cap, 1))
+    return max(chunk // _ENTRY_CHUNK, 1) * _ENTRY_CHUNK
+
+
+def _pad_triples_to_chunk(a_r, a_c, a_v, chunk: int):
+    """Pad per-stripe triples so the kernel chunk divides the (padded) cap.
+    Pad entries use col = int32 max — at or beyond every real column
+    whatever A's k-extent, so the column-sorted invariant holds and every
+    hop's searchsorted range excludes them — and value 0 (harmless even if
+    ever visited)."""
+    short = (-a_r.shape[0]) % chunk
+    if not short:
+        return a_r, a_c, a_v
+    return (
+        jnp.pad(a_r, (0, short)),
+        jnp.pad(a_c, (0, short),
+                constant_values=jnp.iinfo(jnp.int32).max),
+        jnp.pad(a_v, (0, short)),
+    )
 
 
 def _pvary(x: jax.Array, axes) -> jax.Array:
@@ -285,25 +321,26 @@ class DistSparseVecMatrix:
 # ---------------------------------------------------------------------------
 
 
-def _chunked_accumulate(acc, a_r, a_c, a_v, stripe_src, k0, row0):
+def _chunked_accumulate(acc, a_r, a_c, a_v, stripe_src, k0, row0, chunk):
     """acc += segment-sum over A entries of a_v * B_stripe[a_c - k0, :],
-    processed in _ENTRY_CHUNK-row slices so the (chunk, n) expansion buffer —
-    not (cap, n) — is the peak temporary.
+    processed in ``chunk``-row slices so the (chunk, n) expansion buffer —
+    not (cap, n) — is the peak temporary (the engine pads the triples with
+    col-int32max/value-0 entries first so chunk divides the padded cap).
 
     ``a_c`` is sorted (constructor invariant), so only the chunks overlapping
-    the [k0, k0 + k_stripe) column range are visited — per hop that is
-    ~nnz_local/n_dev entries plus at most two boundary chunks, restoring the
-    nnz(A)*n/n_dev total-work claim instead of re-scanning every entry on
-    every hop."""
+    the [k0, k0 + k_stripe) column range are visited. With many chunks per
+    stripe that bounds each hop to ~nnz_local/n_dev entries plus two boundary
+    chunks; with one budget-sized chunk (common on small meshes) every hop
+    scans all local entries — see the module docstring for why that trade
+    wins."""
     k_stripe = stripe_src.shape[0]
     lo = jnp.searchsorted(a_c, k0, side="left")
     hi = jnp.searchsorted(a_c, k0 + k_stripe, side="left")
-    first = lo // _ENTRY_CHUNK
-    last = (hi + _ENTRY_CHUNK - 1) // _ENTRY_CHUNK
+    first = lo // chunk
+    last = (hi + chunk - 1) // chunk
 
     def chunk_step(ci, acc):
-        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, ci * _ENTRY_CHUNK,
-                                                    _ENTRY_CHUNK)
+        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, ci * chunk, chunk)
         rr, cc, vv = sl(a_r), sl(a_c), sl(a_v)
         # Entries whose k lives in another hop's stripe contribute nothing.
         # NOTE: negative indices WRAP in jax gather/scatter even under
@@ -327,6 +364,8 @@ def _spsp_ring(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
 
     def kernel(a_r, a_c, a_v, b_r, b_c, b_v):
         a_r, a_c, a_v = a_r[0], a_c[0], a_v[0]
+        chunk = _kernel_chunk(a_r.shape[0], n_cols)
+        a_r, a_c, a_v = _pad_triples_to_chunk(a_r, a_c, a_v, chunk)
         i = jax.lax.axis_index(axes)
         row0 = i * m_stripe
         perm = [(s, (s - 1) % nd) for s in range(nd)]
@@ -343,7 +382,8 @@ def _spsp_ring(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
             bstripe = bstripe.at[br[0] - k0, bc[0]].add(
                 bv[0].astype(acc_t), mode="drop"
             )
-            acc = _chunked_accumulate(acc, a_r, a_c, a_v, bstripe, k0, row0)
+            acc = _chunked_accumulate(acc, a_r, a_c, a_v, bstripe, k0, row0,
+                                      chunk)
             nxt = tuple(jax.lax.ppermute(x, axes, perm) for x in (br, bc, bv))
             return nxt, acc
 
@@ -363,6 +403,8 @@ def _spmm_ring_dense(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
 
     def kernel(a_r, a_c, a_v, b):
         a_r, a_c, a_v = a_r[0], a_c[0], a_v[0]
+        chunk = _kernel_chunk(a_r.shape[0], n_cols)
+        a_r, a_c, a_v = _pad_triples_to_chunk(a_r, a_c, a_v, chunk)
         i = jax.lax.axis_index(axes)
         row0 = i * m_stripe
         perm = [(s, (s - 1) % nd) for s in range(nd)]
@@ -372,7 +414,8 @@ def _spmm_ring_dense(mesh: Mesh, nd: int, m_stripe: int, k_stripe: int,
             b_cur, acc = carry
             src = (i + t) % nd
             k0 = src * k_stripe
-            acc = _chunked_accumulate(acc, a_r, a_c, a_v, b_cur, k0, row0)
+            acc = _chunked_accumulate(acc, a_r, a_c, a_v, b_cur, k0, row0,
+                                      chunk)
             return jax.lax.ppermute(b_cur, axes, perm), acc
 
         acc0 = _pvary(jnp.zeros((m_stripe, n_cols), acc_t), axes)

@@ -133,6 +133,28 @@ class TestDistSparseVecMatrix:
         assert out.nnz == 0
         np.testing.assert_allclose(out.to_numpy(), np.zeros((16, 16)))
 
+    def test_wide_k_narrow_n_chunk_padding(self, rng):
+        # Regression: the kernel-chunk pad sentinel must sort AFTER every
+        # real column of A (k-extent), not after the OUTPUT width n. With
+        # K >> n and a cap that doesn't divide the budget-sized chunk, a
+        # sentinel of n would land mid-range, break the column-sorted
+        # invariant, and silently drop contributions via the searchsorted
+        # hop bounds.
+        m, k, n = 64, 4096, 32
+        nnz = 3000  # cap 3072 -> chunk padding path taken
+        ra = rng.integers(0, m, nnz)
+        ca = rng.integers(0, k, nnz)
+        va = rng.standard_normal(nnz)
+        rb = rng.integers(0, k, nnz)
+        cb = rng.integers(0, n, nnz)
+        vb = rng.standard_normal(nnz)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (m, k))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, (k, n))
+        oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
+        np.testing.assert_allclose(
+            a.multiply_sparse(b).to_numpy(), oracle, rtol=1e-10, atol=1e-10
+        )
+
 
 class TestSparseVecMatrixRouting:
     def test_multiply_sparse_routes_distributed(self, rng, mesh):
