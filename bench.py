@@ -237,6 +237,28 @@ def _timed(fn, iters=5):
     return _timed_r(fn, iters)[0]
 
 
+def _scan_timed(fn, x, *rest, loop=10):
+    """Device-side scan-loop timing: ONE dispatch covers ``loop`` chained
+    invocations of ``fn(x, *rest)``, so the per-call tunnel RTT (comparable
+    to the kernel itself for ~10 ms ops) drops out of the measurement. The
+    scan carry perturbs ``x`` by a tiny amount so XLA cannot hoist the call
+    out of the loop; ``float()`` of the final carry is the tunnel-safe fence
+    (block_until_ready can return early on the axon platform). Returns
+    seconds per invocation."""
+
+    @jax.jit
+    def scan_loop(x, *rest):
+        def body(c, _):
+            o = fn(x + (c * 1e-8).astype(x.dtype), *rest)
+            return jnp.sum(jnp.ravel(o)[:2].astype(jnp.float32)), None
+        return jax.lax.scan(body, jnp.float32(0), None, length=loop)[0]
+
+    float(scan_loop(x, *rest))  # warmup compile + fence
+    t0 = time.perf_counter()
+    float(scan_loop(x, *rest))
+    return (time.perf_counter() - t0) / loop
+
+
 def headline():
     """Config: 32k x 32k auto-dispatch multiply (the MatrixMultiply shape)."""
     n_dev = len(jax.devices())
@@ -341,22 +363,7 @@ def config_attention():
     s, h, d = 8192, 8, 128
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
-    # Device-side scan loop: one dispatch covers LOOP invocations, so the
-    # per-call tunnel RTT (~comparable to the 6 ms kernel itself) drops out.
-    # The carry perturbs q so XLA cannot hoist the kernel out of the scan.
-    loop = 10
-
-    @jax.jit
-    def scan_loop(q, k, v):
-        def body(c, _):
-            o = flash_attention(q + (c * 1e-8).astype(q.dtype), k, v)
-            return jnp.sum(o[0, 0, :2].astype(jnp.float32)), None
-        return jax.lax.scan(body, jnp.float32(0), None, length=loop)[0]
-
-    float(scan_loop(q, k, v))  # warmup; float() is the tunnel-safe fence
-    t0 = time.perf_counter()
-    float(scan_loop(q, k, v))
-    dt = (time.perf_counter() - t0) / loop
+    dt = _scan_timed(flash_attention, q, k, v)
     tflops = 4.0 * s * s * h * d / dt / 1e12  # QK^T + PV
     return {"metric": "flash_attention_tflops", "value": round(tflops, 2),
             "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
@@ -392,10 +399,10 @@ def config_sparse():
     # The ctor zeroes unmasked blocks itself — no host-side mask expansion.
     b = BlockSparse(jnp.asarray(arr, DTYPE), jnp.asarray(mask), bs)
     a = jnp.asarray(rng.standard_normal((n, n)), DTYPE)
-    dt = _timed(lambda: block_sparse_matmul(a, b), iters=10)
+    dt = _scan_timed(lambda a: block_sparse_matmul(a, b), a)
     eff = 2.0 * n**3 * b.block_density / dt / 1e12
     return {"metric": "block_sparse_effective_tflops", "value": round(eff, 2),
-            "unit": "TFLOPS", "vs_baseline": 0,
+            "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
             "oracle_max_err": round(err, 6), "oracle_ok": err < 0.05}
 
 

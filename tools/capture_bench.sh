@@ -1,0 +1,18 @@
+#!/bin/sh
+# Sequential on-hardware bench capture, one config per process so a wedged
+# tunnel or a killed config can't erase the session's earlier lines. Appends
+# raw JSON lines to the capture file; stderr per config goes to /tmp.
+#
+# Usage: tools/capture_bench.sh [outfile] [config ...]
+set -u
+OUT=${1:-docs/bench_captures/capture_$(date +%Y%m%d_%H%M).jsonl}
+shift 2>/dev/null || true
+CONFIGS=${*:-headline square8k tallskinny chained summa attention sparse sparsedist lu cholesky inverse svd transformer}
+for cfg in $CONFIGS; do
+  echo "=== $cfg ===" >&2
+  BENCH_WATCHDOG=${BENCH_WATCHDOG:-1500} \
+    timeout 1800 python bench.py --config "$cfg" \
+    >>"$OUT" 2>"/tmp/bench_$cfg.err"
+  echo "rc=$? ($cfg)" >&2
+done
+echo "capture -> $OUT" >&2
