@@ -7,6 +7,9 @@ step throughput.
 
 Usage:
   python -m marlin_tpu.examples.transformer_lm [steps] [batch] [seq] [d_model]
+
+After training, generates a short continuation with the KV-cache decode path
+(models.generate) — train and serve from the same checkpointable params.
 """
 
 from __future__ import annotations
@@ -61,7 +64,24 @@ def main(argv=None) -> int:
         f"devices={len(mesh.devices.flat)}: final loss {float(loss):.4f}, "
         f"{dt * 1e3:.2f} ms/step ({batch * seq / dt:.0f} tok/s)"
     )
-    return 0 if np.isfinite(float(loss)) else 1
+
+    from marlin_tpu.models import generate
+
+    prompt_len = min(4, seq - 1)
+    gen_steps = min(8, cfg.max_len - prompt_len)
+    if gen_steps <= 0:
+        print("sequence too short for a decode demo; skipping generation")
+        return 0 if np.isfinite(float(loss)) else 1
+    prompt = tokens[:1, :prompt_len]
+    t0 = time.perf_counter()
+    out = generate(params, prompt, gen_steps, cfg, temperature=0.0)
+    out = np.asarray(out)
+    dt_gen = (time.perf_counter() - t0) / gen_steps
+    print(
+        f"greedy decode {gen_steps} tokens (KV cache): "
+        f"{dt_gen * 1e3:.2f} ms/token -> {out[0].tolist()}"
+    )
+    return 0 if np.isfinite(float(loss)) and out.shape == (1, gen_steps) else 1
 
 
 if __name__ == "__main__":

@@ -2,16 +2,24 @@
 
 from .transformer import (
     TransformerConfig,
+    decode_step,
     forward,
+    generate,
+    init_kv_cache,
     init_params,
     loss_fn,
+    prefill,
     train_step,
 )
 
 __all__ = [
     "TransformerConfig",
+    "decode_step",
     "forward",
+    "generate",
+    "init_kv_cache",
     "init_params",
     "loss_fn",
+    "prefill",
     "train_step",
 ]

@@ -27,6 +27,7 @@ by sharded pytree leaves.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -119,8 +120,43 @@ def _moe_expert(p, tok):
     return jax.nn.gelu(tok @ w1 + b1) @ w2 + b2
 
 
-def _block(bp, x, cfg: TransformerConfig):
-    """One pre-LN block on (S, D) activations."""
+def _moe_apply(bp, y, cfg: TransformerConfig):
+    """Route (T, D) activations through the expert engine, padding T up to
+    the engine's device-count multiple (decode steps and short prompts are
+    rarely divisible). Pad tokens get one-hot round-robin gates so no single
+    expert's capacity bucket absorbs them all; their outputs are sliced off."""
+    from ..parallel.expert import expert_parallel_apply
+
+    t = y.shape[0]
+    n = cfg.n_experts
+    gates = y @ bp["router"]  # (T, E)
+    pad = (-t) % n
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad, y.shape[1]), y.dtype)])
+        rr = jax.nn.one_hot(jnp.arange(pad) % n, n, dtype=gates.dtype) * 1e9
+        gates = jnp.concatenate([gates, rr])
+    out = expert_parallel_apply(
+        _moe_expert, (bp["w1"], bp["b1"], bp["w2"], bp["b2"]), y, gates,
+        capacity_factor=cfg.moe_capacity,
+    )
+    return out[:t]
+
+
+def _mlp_residual(bp, x, cfg: TransformerConfig):
+    """ln2 -> (dense MLP | MoE routing) -> residual; shared by the training
+    block, prefill, and decode so the block math exists once."""
+    y = _layer_norm(bp["ln2"], x)
+    if cfg.n_experts:
+        y = _moe_apply(bp, y, cfg)
+    else:
+        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"]) @ bp["w2"] + bp["b2"]
+    return x + y
+
+
+def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
+    """One pre-LN block on (S, D) activations. ``return_kv`` additionally
+    yields this block's per-position K/V (S, H, Dh) — prefill primes the
+    decode cache from the exact training-path computation."""
     s, d = x.shape
     h = cfg.n_heads
     dh = d // h
@@ -128,19 +164,8 @@ def _block(bp, x, cfg: TransformerConfig):
     q, k, v = (a.reshape(s, h, dh) for a in jnp.split(qkv, 3, axis=1))
     attend = _attend_sp if cfg.sequence_parallel else _attend_local
     att = attend(q, k, v, cfg).reshape(s, d)
-    x = x + att @ bp["wo"]
-    y = _layer_norm(bp["ln2"], x)
-    if cfg.n_experts:
-        from ..parallel.expert import expert_parallel_apply
-
-        gates = y @ bp["router"]  # (S, E)
-        y = expert_parallel_apply(
-            _moe_expert, (bp["w1"], bp["b1"], bp["w2"], bp["b2"]), y, gates,
-            capacity_factor=cfg.moe_capacity,
-        )
-    else:
-        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"]) @ bp["w2"] + bp["b2"]
-    return x + y
+    x = _mlp_residual(bp, x + att @ bp["wo"], cfg)
+    return (x, k, v) if return_kv else x
 
 
 def forward(params, tokens, cfg: TransformerConfig):
@@ -177,3 +202,152 @@ def train_step(params, tokens, targets, cfg: TransformerConfig,
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return loss, new_params
+
+
+# ---------------------------------------------------------------------------
+# Inference: KV-cache decode (TPU-shaped: static cache shapes, lax.scan loop)
+# ---------------------------------------------------------------------------
+#
+# The cache holds every layer's K/V at the full (B, max_len, H, Dh) extent
+# from step zero — XLA never sees a growing shape, each step writes one
+# position with dynamic_update_slice and attends against the fixed-extent
+# cache under a position mask. Decode is one jitted scan; a whole generation
+# is a single dispatch (the per-call tunnel RTT would otherwise dominate the
+# ~ms decode steps the same way it did the kernel benches).
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
+    """Per-layer K/V buffers at the static (B, max_len, H, Dh) extent."""
+    dh = cfg.d_model // cfg.n_heads
+    shape = (batch, cfg.max_len, cfg.n_heads, dh)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def _attend_cached(q, ck, cv, pos):
+    """One query position against a padded cache: q (H, Dh), ck/cv
+    (T, H, Dh); positions > pos masked out. f32 softmax (the framework's
+    accumulate->=f32 convention)."""
+    dh = q.shape[-1]
+    logits = jnp.einsum(
+        "hd,thd->ht", q.astype(jnp.float32), ck.astype(jnp.float32)
+    ) / np.sqrt(dh)
+    mask = jnp.arange(ck.shape[0]) <= pos  # (T,)
+    logits = jnp.where(mask[None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("ht,thd->hd", p, cv.astype(jnp.float32)).astype(q.dtype)
+
+
+def _decode_qkv(bp, x, cfg: TransformerConfig):
+    """(B, D) activations -> per-position q, k, v as (B, H, Dh)."""
+    b, d = x.shape
+    h = cfg.n_heads
+    qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (B, 3D)
+    return tuple(a.reshape(b, h, d // h) for a in jnp.split(qkv, 3, axis=1))
+
+
+def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
+    """One decode step: tokens (B,) int32 at position ``pos`` -> (logits
+    (B, vocab), updated cache). Writes each layer's K/V at ``pos`` and
+    attends against the cache prefix."""
+    x = params["embed"][tokens] + params["pos"][pos]  # (B, D)
+    new_cache = []
+    for bp, layer in zip(params["blocks"], cache):
+        q, k, v = _decode_qkv(bp, x, cfg)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            layer["k"], k[:, None].astype(layer["k"].dtype), pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            layer["v"], v[:, None].astype(layer["v"].dtype), pos, axis=1)
+        att = jax.vmap(_attend_cached, in_axes=(0, 0, 0, None))(q, ck, cv, pos)
+        x = _mlp_residual(bp, x + att.reshape(x.shape) @ bp["wo"], cfg)
+        new_cache.append({"k": ck, "v": cv})
+    x = _layer_norm(params["ln_f"], x)
+    return x @ params["embed"].T, new_cache
+
+
+def prefill(params, tokens, cfg: TransformerConfig):
+    """Run the prompt (B, S) through the model once, filling the cache for
+    positions [0, S): returns (last-position logits (B, vocab), cache).
+    Attention over the prompt is the training path's flash kernel — the
+    cache is primed from the same per-block K/V the causal forward uses."""
+    if cfg.sequence_parallel:
+        raise NotImplementedError(
+            "sequence-parallel decode is not meaningful: decode steps are "
+            "single positions; shard the batch instead")
+    b, s = tokens.shape
+    if s > cfg.max_len:
+        raise ValueError(f"prompt length {s} > max_len {cfg.max_len}")
+    x = params["embed"][tokens] + params["pos"][None, :s, :]
+    cache = init_kv_cache(cfg, b, dtype=x.dtype)
+
+    for i, bp in enumerate(params["blocks"]):
+        if cfg.n_experts:
+            # The expert engine places its own shardings — not vmappable
+            # (same constraint as forward()); unroll the batch.
+            outs = [_block(bp, x[j], cfg, return_kv=True) for j in range(b)]
+            x, k, v = (jnp.stack([o[t] for o in outs]) for t in range(3))
+        else:
+            x, k, v = jax.vmap(
+                lambda xi: _block(bp, xi, cfg, return_kv=True)
+            )(x)
+        cache[i]["k"] = cache[i]["k"].at[:, :s].set(k.astype(cache[i]["k"].dtype))
+        cache[i]["v"] = cache[i]["v"].at[:, :s].set(v.astype(cache[i]["v"].dtype))
+    x = _layer_norm(params["ln_f"], x)
+    return x[:, -1] @ params["embed"].T, cache
+
+
+def _sample(logits, temperature, key):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "temperature")
+)
+def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
+                 steps: int, temperature: float):
+    """The jitted decode loop, module-level so the compile caches across
+    ``generate`` calls (a fresh ``jit(lambda)`` per call would recompile the
+    whole scan every time and bake params in as constants)."""
+
+    def step(carry, _):
+        tok, pos, cache, key = carry
+        key, ks = jax.random.split(key)
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        nxt = _sample(logits, temperature, ks)
+        return (nxt, pos + 1, cache, key), tok
+
+    _, toks = jax.lax.scan(
+        step, (first, pos0, cache, key), None, length=steps)
+    return toks
+
+
+def generate(params, prompt, steps: int, cfg: TransformerConfig,
+             temperature: float = 0.0, seed: int = 0):
+    """Autoregressive generation: prompt (B, S) int32 -> (B, steps) int32.
+
+    Prefill primes the cache in one forward; the decode loop is a single
+    jitted ``lax.scan`` dispatch (temperature 0 = greedy, else categorical
+    sampling). S + steps must fit ``cfg.max_len``.
+
+    Dense configs are oracle-exact against the full ``forward``; with
+    ``n_experts`` > 0 the routing batches differ between decode (B
+    current-position tokens per step) and the per-sequence training path,
+    so capacity-overflow passthrough decisions — and therefore sampled
+    continuations — can legitimately diverge."""
+    b, s = prompt.shape
+    if s + steps > cfg.max_len:
+        raise ValueError(
+            f"prompt {s} + steps {steps} exceeds max_len {cfg.max_len}")
+    logits, cache = prefill(params, prompt, cfg)
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    first = _sample(logits, temperature, k0)
+    toks = _decode_scan(params, first, jnp.int32(s), cache, key, cfg,
+                        int(steps), float(temperature))
+    return jnp.moveaxis(toks, 0, 1)  # (steps, B) -> (B, steps)
