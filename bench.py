@@ -599,6 +599,36 @@ def config_transformer():
             "loss_finite": bool(np.isfinite(float(loss)))}
 
 
+def config_decode():
+    """KV-cache autoregressive decode on the flagship transformer
+    (models.generate): tokens/sec/sequence at B=8. The whole decode loop is
+    ONE jitted lax.scan dispatch, so the tunnel RTT amortizes over all
+    generated tokens by construction."""
+    from marlin_tpu.models import TransformerConfig, generate, init_params
+
+    d = _sized("BENCH_DEC_D", 1024)
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_DEC_VOCAB", 32768), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_DEC_L", 8),
+        d_ff=4 * d, max_len=_sized("BENCH_DEC_S", 1024),
+    )
+    b = _sized("BENCH_DEC_B", 8)
+    prompt_len, steps = 64, cfg.max_len - 64
+    params = init_params(cfg, seed=0)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (b, prompt_len), 0, cfg.vocab)
+    out = generate(params, prompt, steps, cfg)  # warmup: prefill+scan compile
+    int(jnp.sum(out))  # host fetch — block_until_ready can return early here
+    t0 = time.perf_counter()
+    out = generate(params, prompt, steps, cfg)
+    n_out = int(jnp.sum(out >= 0))  # host fetch = the fence
+    dt = (time.perf_counter() - t0) / steps
+    return {"metric": "decode_tokens_per_s_per_seq", "value": round(1.0 / dt, 1),
+            "unit": "tok/s", "vs_baseline": 0, "batch": b,
+            "total_tok_s": round(b / dt, 1),
+            "out_ok": n_out == b * steps}
+
+
 def config_dispatch_sweep():
     """Broadcast-vs-SUMMA crossover sweep (VERDICT next-6): times both arms
     for a row-striped A (m x k) times (k x n) B over a range of B sizes, and
@@ -699,6 +729,7 @@ CONFIGS = {
     "inverse": [config_inverse],
     "svd": [config_svd],
     "transformer": [config_transformer],
+    "decode": [config_decode],
     "sweep": [config_dispatch_sweep],
     "attnsweep": [config_attention_sweep],
 }
