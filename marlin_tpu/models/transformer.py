@@ -45,13 +45,29 @@ class TransformerConfig(NamedTuple):
     sequence_parallel: bool = False  # route attention through the SP engines
     n_experts: int = 0  # >0: MoE MLP via parallel.expert (set = device count)
     moe_capacity: float = 2.0
+    n_kv_heads: int = 0  # 0 = n_heads; fewer = GQA/MQA (must divide n_heads)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0):
-    """Nested-dict param pytree; scaled-normal init."""
+    """Nested-dict param pytree; scaled-normal init. ``wqkv`` packs the Q
+    projection (D cols) followed by K and V (kv_heads * Dh cols each) — for
+    n_kv_heads == n_heads that is the plain (D, 3D) fused projection; for
+    GQA the K/V columns shrink with the head count."""
+    if cfg.n_heads % cfg.kv_heads:
+        raise ValueError(
+            f"n_kv_heads {cfg.kv_heads} must divide n_heads {cfg.n_heads}")
+    if cfg.sequence_parallel and cfg.kv_heads != cfg.n_heads:
+        raise ValueError(
+            "GQA + sequence_parallel is unsupported: the SP engines shard "
+            "the full head axis")
     k = jax.random.PRNGKey(seed)
     ks = jax.random.split(k, 4 + 6 * cfg.n_layers)
     d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+    kv_d = cfg.kv_heads * (d // h)
 
     def norm(key, *shape, scale=None):
         scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
@@ -68,7 +84,7 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
         blk = {
             "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
             "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-            "wqkv": norm(ks[b], d, 3 * d),
+            "wqkv": norm(ks[b], d, d + 2 * kv_d),
             "wo": norm(ks[b + 1], d, d),
         }
         if cfg.n_experts:
@@ -110,6 +126,14 @@ def _attend_local(q, k, v, cfg: TransformerConfig):
 def _attend_sp(q, k, v, cfg: TransformerConfig):
     from ..parallel.ulysses import sequence_parallel_attention
 
+    if cfg.kv_heads != cfg.n_heads:
+        # Also guarded at init; re-checked here because sequence_parallel is
+        # a runtime flag (cfg._replace) while params are shape-identical
+        # across it — without this, ulysses' head-axis all_to_all fails with
+        # a cryptic shape error instead of the contract.
+        raise ValueError(
+            "GQA + sequence_parallel is unsupported: the SP engines shard "
+            "the full head axis")
     return sequence_parallel_attention(q, k, v, causal=True)
 
 
@@ -153,15 +177,22 @@ def _mlp_residual(bp, x, cfg: TransformerConfig):
     return x + y
 
 
+def _split_qkv(bp, x, cfg: TransformerConfig):
+    """ln1 -> fused projection -> q (T, H, Dh), k/v (T, Hk, Dh)."""
+    t, d = x.shape
+    h, hk = cfg.n_heads, cfg.kv_heads
+    dh = d // h
+    qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (T, D + 2 Hk Dh)
+    q, k, v = jnp.split(qkv, [d, d + hk * dh], axis=1)
+    return q.reshape(t, h, dh), k.reshape(t, hk, dh), v.reshape(t, hk, dh)
+
+
 def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
     """One pre-LN block on (S, D) activations. ``return_kv`` additionally
-    yields this block's per-position K/V (S, H, Dh) — prefill primes the
+    yields this block's per-position K/V (S, Hk, Dh) — prefill primes the
     decode cache from the exact training-path computation."""
     s, d = x.shape
-    h = cfg.n_heads
-    dh = d // h
-    qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (S, 3D)
-    q, k, v = (a.reshape(s, h, dh) for a in jnp.split(qkv, 3, axis=1))
+    q, k, v = _split_qkv(bp, x, cfg)
     attend = _attend_sp if cfg.sequence_parallel else _attend_local
     att = attend(q, k, v, cfg).reshape(s, d)
     x = _mlp_residual(bp, x + att @ bp["wo"], cfg)
@@ -217,9 +248,11 @@ def train_step(params, tokens, targets, cfg: TransformerConfig,
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
-    """Per-layer K/V buffers at the static (B, max_len, H, Dh) extent."""
+    """Per-layer K/V buffers at the static (B, max_len, Hk, Dh) extent —
+    with GQA the cache (the HBM cost that bounds decode batch x context)
+    shrinks by n_heads / n_kv_heads."""
     dh = cfg.d_model // cfg.n_heads
-    shape = (batch, cfg.max_len, cfg.n_heads, dh)
+    shape = (batch, cfg.max_len, cfg.kv_heads, dh)
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.n_layers)
@@ -228,24 +261,19 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
 
 def _attend_cached(q, ck, cv, pos):
     """One query position against a padded cache: q (H, Dh), ck/cv
-    (T, H, Dh); positions > pos masked out. f32 softmax (the framework's
+    (T, Hk, Dh) with Hk dividing H (GQA: q-head group g reads K/V head g);
+    positions > pos masked out. f32 softmax (the framework's
     accumulate->=f32 convention)."""
-    dh = q.shape[-1]
+    h, dh = q.shape
+    hk = ck.shape[1]
+    qg = q.reshape(hk, h // hk, dh).astype(jnp.float32)  # (Hk, G, Dh)
     logits = jnp.einsum(
-        "hd,thd->ht", q.astype(jnp.float32), ck.astype(jnp.float32)
-    ) / np.sqrt(dh)
+        "kgd,tkd->kgt", qg, ck.astype(jnp.float32)) / np.sqrt(dh)
     mask = jnp.arange(ck.shape[0]) <= pos  # (T,)
-    logits = jnp.where(mask[None, :], logits, -1e30)
+    logits = jnp.where(mask[None, None, :], logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("ht,thd->hd", p, cv.astype(jnp.float32)).astype(q.dtype)
-
-
-def _decode_qkv(bp, x, cfg: TransformerConfig):
-    """(B, D) activations -> per-position q, k, v as (B, H, Dh)."""
-    b, d = x.shape
-    h = cfg.n_heads
-    qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (B, 3D)
-    return tuple(a.reshape(b, h, d // h) for a in jnp.split(qkv, 3, axis=1))
+    out = jnp.einsum("kgt,tkd->kgd", p, cv.astype(jnp.float32))
+    return out.reshape(h, dh).astype(q.dtype)
 
 
 def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
@@ -255,7 +283,7 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     x = params["embed"][tokens] + params["pos"][pos]  # (B, D)
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
-        q, k, v = _decode_qkv(bp, x, cfg)
+        q, k, v = _split_qkv(bp, x, cfg)
         ck = jax.lax.dynamic_update_slice_in_dim(
             layer["k"], k[:, None].astype(layer["k"].dtype), pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(

@@ -117,11 +117,14 @@ def _out_struct(x: jax.Array, shape) -> jax.ShapeDtypeStruct:
     static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
 )
 def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
-    """(H, Sq, D) x (H, Skv, D) x (H, Skv, Dv) -> (H, Sq, Dv); D and Dv
-    already lane-padded (Dv may differ from D)."""
+    """(H, Sq, D) x (Hk, Skv, D) x (Hk, Skv, Dv) -> (H, Sq, Dv); D and Dv
+    already lane-padded (Dv may differ from D). Hk may divide H (GQA/MQA):
+    q-head h reads K/V head h // (H // Hk) — pure index-map grouping, the
+    K/V tiles are never physically replicated."""
     h, sq, d = q.shape
     dv = v.shape[2]
     kv_len = k.shape[1]
+    group = h // k.shape[0]
     # Fold scale and the exp->exp2 change of base into Q once, outside the
     # kernel (>= f32 multiply, cast back so the MXU runs its native input
     # dtype; f64 stays f64 on the interpret/test path). The kernel's softmax
@@ -140,8 +143,8 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((1, block_k, dv), lambda h, i, j: (h // group, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, dv), lambda h, i, j: (h, i, 0)),
         out_shape=_out_struct(qp, (h, qp.shape[1], dv)),
@@ -176,7 +179,11 @@ def _flash_hsd_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v = res
+    group = q.shape[0] // k.shape[0]
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    if group > 1:  # GQA: broadcast K/V heads for the recompute...
+        kf = jnp.repeat(kf, group, axis=0)
+        vf = jnp.repeat(vf, group, axis=0)
     gf = g.astype(jnp.float32)
     logits = jnp.einsum("hsd,htd->hst", qf, kf) * scale
     if causal:
@@ -189,6 +196,10 @@ def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, res, g):
     ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
     dq = jnp.einsum("hst,htd->hsd", ds, kf) * scale
     dk = jnp.einsum("hst,hsd->htd", ds, qf) * scale
+    if group > 1:  # ...and sum each group's gradients back to its K/V head
+        hk, skv, d = k.shape[0], k.shape[1], dk.shape[2]
+        dk = dk.reshape(hk, group, skv, d).sum(axis=1)
+        dv = dv.reshape(hk, group, skv, dv.shape[2]).sum(axis=1)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -208,10 +219,14 @@ def flash_attention(
     """softmax(Q K^T * scale) V, flash-tiled, single device.
 
     Shapes: (S, D) single-head or (S, H, D) multi-head; K/V lengths may
-    differ from Q's (cross attention). The head dimension is zero-padded to
-    the 128-lane tile (padding contributes nothing to q·k logits and is
-    sliced off the output). ``interpret`` defaults to True off-TPU so the
-    same kernel runs under the CPU test mesh.
+    differ from Q's (cross attention), and K/V may carry FEWER heads than Q
+    (grouped-query / multi-query attention: Hk must divide H; q-head h uses
+    K/V head h // (H // Hk) via index-map grouping — the K/V tiles are not
+    physically replicated, so the HBM-side KV footprint shrinks by H/Hk).
+    The head dimension is zero-padded to the 128-lane tile (padding
+    contributes nothing to q·k logits and is sliced off the output).
+    ``interpret`` defaults to True off-TPU so the same kernel runs under
+    the CPU test mesh.
 
     Default 1024x1024 blocks measure ~50 TFLOPS device-side on a v5e chip
     at S=8k, H=8, D=128 (scan-loop timing, dispatch overhead excluded) — 6x
@@ -239,6 +254,12 @@ def flash_attention(
         scale = 1.0 / np.sqrt(q.shape[-1])
     if k.shape[-1] != q.shape[-1]:
         raise ValueError(f"q/k head_dim mismatch: {q.shape} vs {k.shape}")
+    if k.shape[1] != v.shape[1] or k.shape[0] != v.shape[0]:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"GQA needs kv_heads ({k.shape[1]}) to divide heads "
+            f"({q.shape[1]})")
     # (S, H, D) -> (H, S, D); pad D (and v's Dv independently) to lane tiles.
     qt, kt, vt = (jnp.swapaxes(x, 0, 1) for x in (q, k, v))
     d0 = vt.shape[-1]

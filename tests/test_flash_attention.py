@@ -187,3 +187,73 @@ class TestGradients:
         for a, b in zip(gf, go):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestGQA:
+    """Grouped-query attention: Hk < H via index-map grouping."""
+
+    def _oracle(self, q, k, v, causal=False):
+        # Broadcast K/V heads to the full count and run plain attention.
+        import numpy as np
+
+        g = q.shape[1] // k.shape[1]
+        kf = np.repeat(np.asarray(k, np.float64), g, axis=1)
+        vf = np.repeat(np.asarray(v, np.float64), g, axis=1)
+        qf = np.asarray(q, np.float64)
+        logits = np.einsum("shd,thd->hst", qf, kf) / np.sqrt(q.shape[-1])
+        if causal:
+            m = np.arange(k.shape[0])[None, :] <= np.arange(q.shape[0])[:, None]
+            logits = np.where(m[None], logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("hst,thd->shd", p, vf)
+
+    def test_gqa_matches_broadcast_oracle(self, rng):
+        import numpy as np
+
+        for hk, causal in [(2, False), (2, True), (1, False)]:  # GQA + MQA
+            q = jnp.asarray(rng.standard_normal((192, 4, 32)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((192, hk, 32)), jnp.float32)
+            v = jnp.asarray(rng.standard_normal((192, hk, 32)), jnp.float32)
+            got = np.asarray(flash_attention(q, k, v, causal=causal))
+            ref = self._oracle(q, k, v, causal)
+            np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_gqa_grad_matches_broadcast_model(self, rng):
+        # d/dk of GQA == sum over the group of the broadcast model's d/dk.
+        import numpy as np
+
+        q = jnp.asarray(rng.standard_normal((48, 4, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((48, 2, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((48, 2, 16)), jnp.float32)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        def loss_bcast(q, kb, vb):
+            return jnp.sum(flash_attention(q, kb, vb, causal=True) ** 2)
+
+        kb = jnp.repeat(k, 2, axis=1)
+        vb = jnp.repeat(v, 2, axis=1)
+        gqb, gkb, gvb = jax.grad(loss_bcast, argnums=(0, 1, 2))(q, kb, vb)
+        np.testing.assert_allclose(np.asarray(gq), np.asarray(gqb),
+                                   rtol=1e-4, atol=1e-5)
+        # Broadcast-model K/V grads per group sum back to the GQA grads.
+        np.testing.assert_allclose(
+            np.asarray(gk),
+            np.asarray(gkb).reshape(48, 2, 2, 16).sum(axis=2),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(gv),
+            np.asarray(gvb).reshape(48, 2, 2, 16).sum(axis=2),
+            rtol=1e-4, atol=1e-5)
+
+    def test_bad_head_ratio_raises(self, rng):
+        import pytest
+
+        q = jnp.zeros((16, 4, 8), jnp.float32)
+        k = jnp.zeros((16, 3, 8), jnp.float32)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, k)
