@@ -46,6 +46,7 @@ class TransformerConfig(NamedTuple):
     n_experts: int = 0  # >0: MoE MLP via parallel.expert (set = device count)
     moe_capacity: float = 2.0
     n_kv_heads: int = 0  # 0 = n_heads; fewer = GQA/MQA (must divide n_heads)
+    rope: bool = False  # rotary position embeddings instead of learned ones
 
     @property
     def kv_heads(self) -> int:
@@ -64,6 +65,11 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
         raise ValueError(
             "GQA + sequence_parallel is unsupported: the SP engines shard "
             "the full head axis")
+    if cfg.rope and (cfg.d_model // cfg.n_heads) % 2:
+        raise ValueError(
+            f"rope needs an even per-head dim, got "
+            f"{cfg.d_model // cfg.n_heads} (rotation pairs dim i with "
+            f"i + Dh/2)")
     k = jax.random.PRNGKey(seed)
     ks = jax.random.split(k, 4 + 6 * cfg.n_layers)
     d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
@@ -75,10 +81,11 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
 
     params = {
         "embed": norm(ks[0], cfg.vocab, d, scale=0.02),
-        "pos": norm(ks[1], cfg.max_len, d, scale=0.02),
         "ln_f": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
         "blocks": [],
     }
+    if not cfg.rope:  # rope rotates Q/K per block; no learned table
+        params["pos"] = norm(ks[1], cfg.max_len, d, scale=0.02)
     for i in range(cfg.n_layers):
         b = 4 + 6 * i
         blk = {
@@ -177,14 +184,41 @@ def _mlp_residual(bp, x, cfg: TransformerConfig):
     return x + y
 
 
-def _split_qkv(bp, x, cfg: TransformerConfig):
-    """ln1 -> fused projection -> q (T, H, Dh), k/v (T, Hk, Dh)."""
+def _rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on (T, H, Dh) with per-row ``positions``
+    (T,). Rotation pairs dimension i with i + Dh/2; computed in f32, cast
+    back (the framework's >= f32 convention for transcendental chains)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]  # (T, half)
+    cos = jnp.cos(ang)[:, None, :]  # (T, 1, half)
+    sin = jnp.sin(ang)[:, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _split_qkv(bp, x, cfg: TransformerConfig, positions=None):
+    """ln1 -> fused projection -> q (T, H, Dh), k/v (T, Hk, Dh). With
+    ``cfg.rope``, Q and K are rotated by ``positions`` (required then);
+    cached keys are therefore stored ROTATED — decode rotates only its own
+    query/key at the current position and attends directly."""
     t, d = x.shape
     h, hk = cfg.n_heads, cfg.kv_heads
     dh = d // h
     qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (T, D + 2 Hk Dh)
     q, k, v = jnp.split(qkv, [d, d + hk * dh], axis=1)
-    return q.reshape(t, h, dh), k.reshape(t, hk, dh), v.reshape(t, hk, dh)
+    q = q.reshape(t, h, dh)
+    k = k.reshape(t, hk, dh)
+    if cfg.rope:
+        if positions is None:
+            raise ValueError("cfg.rope requires positions")
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+    return q, k, v.reshape(t, hk, dh)
 
 
 def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
@@ -192,7 +226,8 @@ def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
     yields this block's per-position K/V (S, Hk, Dh) — prefill primes the
     decode cache from the exact training-path computation."""
     s, d = x.shape
-    q, k, v = _split_qkv(bp, x, cfg)
+    positions = jnp.arange(s) if cfg.rope else None  # full prefix from 0
+    q, k, v = _split_qkv(bp, x, cfg, positions=positions)
     attend = _attend_sp if cfg.sequence_parallel else _attend_local
     att = attend(q, k, v, cfg).reshape(s, d)
     x = _mlp_residual(bp, x + att @ bp["wo"], cfg)
@@ -202,7 +237,9 @@ def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
 def forward(params, tokens, cfg: TransformerConfig):
     """tokens (B, S) int32 -> logits (B, S, vocab)."""
     b, s = tokens.shape
-    x = params["embed"][tokens] + params["pos"][None, :s, :]
+    x = params["embed"][tokens]
+    if not cfg.rope:  # rope rotates Q/K per block instead
+        x = x + params["pos"][None, :s, :]
 
     def per_seq(xi):
         for bp in params["blocks"]:
@@ -280,10 +317,15 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     """One decode step: tokens (B,) int32 at position ``pos`` -> (logits
     (B, vocab), updated cache). Writes each layer's K/V at ``pos`` and
     attends against the cache prefix."""
-    x = params["embed"][tokens] + params["pos"][pos]  # (B, D)
+    x = params["embed"][tokens]  # (B, D)
+    if not cfg.rope:
+        x = x + params["pos"][pos]
+    positions = (
+        jnp.full((x.shape[0],), pos, jnp.int32) if cfg.rope else None
+    )
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
-        q, k, v = _split_qkv(bp, x, cfg)
+        q, k, v = _split_qkv(bp, x, cfg, positions=positions)
         ck = jax.lax.dynamic_update_slice_in_dim(
             layer["k"], k[:, None].astype(layer["k"].dtype), pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(
@@ -307,7 +349,9 @@ def prefill(params, tokens, cfg: TransformerConfig):
     b, s = tokens.shape
     if s > cfg.max_len:
         raise ValueError(f"prompt length {s} > max_len {cfg.max_len}")
-    x = params["embed"][tokens] + params["pos"][None, :s, :]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][None, :s, :]
     cache = init_kv_cache(cfg, b, dtype=x.dtype)
 
     for i, bp in enumerate(params["blocks"]):
