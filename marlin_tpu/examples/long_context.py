@@ -1,0 +1,85 @@
+"""LongContext — sequence-parallel attention over the device mesh.
+
+The reference scales one logical dimension past single-node memory by
+row-chunking RDDs (SURVEY.md §5 long-context); the modern counterpart this
+framework makes first-class is sequence/context parallelism: a sequence
+sharded across devices, attended with either the ring engine (K/V blocks
+stream over ICI with online-softmax accumulation; per-device memory
+O(seq / n_dev)) or the Ulysses all-to-all engine (re-shard to head-parallel,
+attend locally, re-shard back). This CLI runs both on the same sharded
+input, checks them against each other, and reports per-device memory vs the
+monolithic S x S logits a naive attention would need.
+
+Usage:
+  python -m marlin_tpu.examples.long_context [seq] [heads] [head_dim]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    seq = int(argv[0]) if len(argv) > 0 else 4096
+    heads = int(argv[1]) if len(argv) > 1 else 8
+    head_dim = int(argv[2]) if len(argv) > 2 else 64
+
+    import marlin_tpu as mt
+    from marlin_tpu.parallel.ulysses import sequence_parallel_attention
+    from marlin_tpu.utils.timing import fence
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mt.default_mesh()
+    n_dev = len(mesh.devices.flat)
+    seq = max(n_dev, seq - seq % n_dev)  # both engines want divisible seq
+    if heads % n_dev:
+        heads = max(n_dev, heads - heads % n_dev)  # all_to_all shards heads
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    shard = NamedSharding(mesh, P(tuple(mesh.axis_names), None, None))
+    q, k, v = (
+        jax.device_put(
+            jax.random.normal(kk, (seq, heads, head_dim), jnp.float32), shard
+        )
+        for kk in ks
+    )
+
+    results = {}
+    for strategy in ("ring", "all_to_all"):
+        fn = jax.jit(
+            lambda q, k, v, s=strategy: sequence_parallel_attention(
+                q, k, v, causal=True, strategy=s
+            )
+        )
+        out = fn(q, k, v)
+        fence(out)  # compile + settle
+        t0 = time.perf_counter()
+        out = fn(q, k, v)
+        fence(out)
+        dt = time.perf_counter() - t0
+        results[strategy] = (np.asarray(out), dt)
+        print(f"{strategy:>10}: {dt * 1e3:8.2f} ms  "
+              f"(seq {seq} sharded {n_dev}-way, {seq // n_dev} rows/device)")
+
+    a, b = results["ring"][0], results["all_to_all"][0]
+    err = float(np.max(np.abs(a - b)) / max(np.max(np.abs(b)), 1e-30))
+    ok = err < 1e-4
+    logits_bytes = seq * seq * heads * 4
+    # Ring: one (S/P, S/P) logits block is live per scan step (ring.py step).
+    per_dev = (seq // n_dev) ** 2 * 4
+    verdict = "engines agree" if ok else "ENGINES DISAGREE"
+    print(f"{verdict}: max rel err {err:.2e}")
+    print(f"naive S x S logits would be {logits_bytes / 1e9:.2f} GB; "
+          f"ring peak per device ~{per_dev / 1e6:.1f} MB per head-step")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -158,3 +158,11 @@ def test_transformer_lm(capsys):
     assert transformer_lm.main(["3", "2", "32", "32"]) == 0
     out = capsys.readouterr().out
     assert "TransformerLM" in out and "tok/s" in out
+
+
+def test_long_context(capsys):
+    from marlin_tpu.examples import long_context
+
+    assert long_context.main(["256", "8", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "engines agree" in out
