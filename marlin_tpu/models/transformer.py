@@ -23,6 +23,13 @@ Pure-functional params (nested dict pytree), jittable end to end; one
 ``train_step`` = value_and_grad + SGD, the same shape as the reference NN's
 iteration (NeuralNetwork.scala:218-249) with the driver-held weights replaced
 by sharded pytree leaves.
+
+Architecture options: GQA/MQA (``n_kv_heads`` — grouped KV projections; the
+flash kernel groups heads in its index map, the decode cache shrinks by
+H/Hk) and RoPE (``rope=True`` — rotary Q/K in place of the learned position
+table). Inference is first-class: ``prefill``/``decode_step``/``generate``
+run a static-shape KV cache with the whole decode loop in one jitted
+``lax.scan`` dispatch; greedy decode is oracle-exact against ``forward``.
 """
 
 from __future__ import annotations
