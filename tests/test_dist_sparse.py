@@ -248,3 +248,36 @@ class TestOutputDtypeContract:
         )
         out2 = a.multiply_dense(dm)
         assert out2.dtype == jnp.bfloat16
+
+    def test_multi_chunk_path_forced_by_small_budget(self, rng, monkeypatch):
+        # With the default 256 MB budget, test-size matrices always get
+        # chunk == cap (single-chunk); shrink the budget so the kernels run
+        # the multi-chunk searchsorted hop-bounding path, and clear the
+        # engine caches so the kernels rebuild under the patched budget.
+        import marlin_tpu.matrix.dist_sparse as ds
+
+        monkeypatch.setattr(ds, "_CHUNK_BUDGET_BYTES", 128 * 64 * 4)
+        ds._spsp_ring.cache_clear()
+        ds._spmm_ring_dense.cache_clear()
+        try:
+            m = k = n = 64
+            ra, ca, va = _random_coo(rng, m, k, 0.5)  # ~2k entries: cap 2048
+            rb, cb, vb = _random_coo(rng, k, n, 0.5)
+            a = DistSparseVecMatrix.from_coo(ra, ca, va, (m, k))
+            b = DistSparseVecMatrix.from_coo(rb, cb, vb, (k, n))
+            assert ds._kernel_chunk(a.rows.shape[1], n) < a.rows.shape[1]
+            oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
+            np.testing.assert_allclose(
+                a.multiply_sparse(b).to_numpy(), oracle,
+                rtol=1e-10, atol=1e-10)
+            # sparse x dense through the same chunk loop
+            import jax.numpy as jnp
+
+            dm = DenseVecMatrix(
+                jnp.asarray(rng.standard_normal((k, 24)), jnp.float64))
+            got = a.multiply_dense(dm).to_numpy()
+            ref = _dense(ra, ca, va, (m, k)) @ np.asarray(dm.to_numpy())
+            np.testing.assert_allclose(got, ref, rtol=1e-8, atol=1e-8)
+        finally:
+            ds._spsp_ring.cache_clear()
+            ds._spmm_ring_dense.cache_clear()
