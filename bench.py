@@ -673,14 +673,14 @@ def config_attention_sweep():
     q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
     flops = 4.0 * s * s * h * d
 
-    def xla_ref():
+    def xla_ref(q, k, v):
         logits = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
                             k.astype(jnp.float32)) / jnp.sqrt(float(d))
         return jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, axis=-1),
                           v.astype(jnp.float32))
 
     try:
-        dt_xla = _timed(jax.jit(xla_ref), iters=3)
+        dt_xla = _scan_timed(xla_ref, q, k, v, loop=3)
         print(f"attn sweep xla_ref {flops / dt_xla / 1e12:.1f} TFLOPS",
               file=sys.stderr, flush=True)
     except Exception as e:  # noqa: BLE001 - S x S logits can OOM; sweep on
@@ -692,9 +692,12 @@ def config_attention_sweep():
     for bq, bk in ((512, 512), (512, 1024), (1024, 512), (1024, 1024),
                    (2048, 1024), (1024, 2048), (2048, 2048)):
         try:
-            dt = _timed(
-                lambda: flash_attention(q, k, v, block_q=bq, block_k=bk),
-                iters=10,
+            # Device-side scan timing: per-dispatch RTT noise (±2x between
+            # sessions) would otherwise pick blocks by tunnel weather.
+            dt = _scan_timed(
+                lambda q, k, v: flash_attention(
+                    q, k, v, block_q=bq, block_k=bk),
+                q, k, v,
             )
             tf = flops / dt / 1e12
         except Exception as e:  # noqa: BLE001
