@@ -241,25 +241,36 @@ def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
     return (x, k, v) if return_kv else x
 
 
+def _embed_prefix(params, tokens, cfg: TransformerConfig):
+    """(B, S) tokens -> (B, S, D) embeddings, plus the learned position
+    table for positions [0, S) unless rope rotates Q/K per block instead."""
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][None, : tokens.shape[1], :]
+    return x
+
+
+def _map_seqs(fn, x, cfg: TransformerConfig):
+    """Apply a per-sequence function over the batch axis: vmap normally;
+    unroll when the SP/EP engines are active (they place their own
+    shardings via device_put — not vmappable; such batches are small).
+    Handles pytree-valued ``fn`` (prefill's (x, k, v) triples)."""
+    if cfg.sequence_parallel or cfg.n_experts:
+        outs = [fn(x[i]) for i in range(x.shape[0])]
+        return jax.tree.map(lambda *ls: jnp.stack(ls), *outs)
+    return jax.vmap(fn)(x)
+
+
 def forward(params, tokens, cfg: TransformerConfig):
     """tokens (B, S) int32 -> logits (B, S, vocab)."""
-    b, s = tokens.shape
-    x = params["embed"][tokens]
-    if not cfg.rope:  # rope rotates Q/K per block instead
-        x = x + params["pos"][None, :s, :]
+    x = _embed_prefix(params, tokens, cfg)
 
     def per_seq(xi):
         for bp in params["blocks"]:
             xi = _block(bp, xi, cfg)
         return _layer_norm(params["ln_f"], xi)
 
-    if cfg.sequence_parallel or cfg.n_experts:
-        # The SP/EP engines place their own shardings (device_put inside) —
-        # not vmappable; such batches are small, unroll them. (Run these
-        # modes under jit, like SP.)
-        x = jnp.stack([per_seq(x[i]) for i in range(b)])
-    else:
-        x = jax.vmap(per_seq)(x)
+    x = _map_seqs(per_seq, x, cfg)
     return x @ params["embed"].T  # weight-tied readout
 
 
@@ -356,25 +367,22 @@ def prefill(params, tokens, cfg: TransformerConfig):
     b, s = tokens.shape
     if s > cfg.max_len:
         raise ValueError(f"prompt length {s} > max_len {cfg.max_len}")
-    x = params["embed"][tokens]
-    if not cfg.rope:
-        x = x + params["pos"][None, :s, :]
+    x = _embed_prefix(params, tokens, cfg)
     cache = init_kv_cache(cfg, b, dtype=x.dtype)
 
     for i, bp in enumerate(params["blocks"]):
-        if cfg.n_experts:
-            # The expert engine places its own shardings — not vmappable
-            # (same constraint as forward()); unroll the batch.
-            outs = [_block(bp, x[j], cfg, return_kv=True) for j in range(b)]
-            x, k, v = (jnp.stack([o[t] for o in outs]) for t in range(3))
-        else:
-            x, k, v = jax.vmap(
-                lambda xi: _block(bp, xi, cfg, return_kv=True)
-            )(x)
+        x, k, v = _map_seqs(
+            lambda xi: _block(bp, xi, cfg, return_kv=True), x, cfg)
         cache[i]["k"] = cache[i]["k"].at[:, :s].set(k.astype(cache[i]["k"].dtype))
         cache[i]["v"] = cache[i]["v"].at[:, :s].set(v.astype(cache[i]["v"].dtype))
     x = _layer_norm(params["ln_f"], x)
     return x[:, -1] @ params["embed"].T, cache
+
+
+# Jitted prefill for generate(): eager per-op dispatch through a remote
+# tunnel costs an RTT per op; one compiled dispatch covers the whole prompt
+# pass. (prefill stays callable eagerly for tests/debugging.)
+_prefill_jit = functools.partial(jax.jit, static_argnames=("cfg",))(prefill)
 
 
 def _sample(logits, temperature, key):
@@ -423,7 +431,7 @@ def generate(params, prompt, steps: int, cfg: TransformerConfig,
     if s + steps > cfg.max_len:
         raise ValueError(
             f"prompt {s} + steps {steps} exceeds max_len {cfg.max_len}")
-    logits, cache = prefill(params, prompt, cfg)
+    logits, cache = _prefill_jit(params, prompt, cfg=cfg)
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
     first = _sample(logits, temperature, k0)

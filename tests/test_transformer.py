@@ -16,6 +16,18 @@ CFG = TransformerConfig(vocab=31, d_model=32, n_heads=2, n_layers=2,
                         d_ff=64, max_len=64)
 
 
+def _greedy_reforward(params, prompt, steps, cfg):
+    """Oracle for generate(): grow the sequence one token at a time through
+    the full causal forward (no cache), argmax of the last position."""
+    seq = np.asarray(prompt)
+    for _ in range(steps):
+        logits = forward(params, jnp.asarray(seq, jnp.int32), cfg)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    return seq[:, prompt.shape[1]:]
+
+
+
 class TestTransformer:
     def test_forward_shape(self, rng):
         params = init_params(CFG, seed=0)
@@ -121,14 +133,8 @@ class TestDecode:
         prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 9)), jnp.int32)
         steps = 7
         got = np.asarray(generate(params, prompt, steps, CFG))
-        # Oracle: grow the sequence one token at a time through the full
-        # causal forward (no cache), taking argmax of the last position.
-        seq = np.asarray(prompt)
-        for _ in range(steps):
-            logits = forward(params, jnp.asarray(seq, jnp.int32), CFG)
-            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
-            seq = np.concatenate([seq, nxt[:, None]], axis=1)
-        np.testing.assert_array_equal(got, seq[:, 9:])
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, steps, CFG))
 
     def test_prefill_cache_matches_decode_steps(self, rng):
         # Feeding the prompt token-by-token through decode_step must build
@@ -223,12 +229,8 @@ class TestGQA:
         params = init_params(self.GCFG, seed=2)
         prompt = jnp.asarray(rng.integers(0, 31, (2, 7)), jnp.int32)
         got = np.asarray(generate(params, prompt, 6, self.GCFG))
-        seq = np.asarray(prompt)
-        for _ in range(6):
-            logits = forward(params, jnp.asarray(seq, jnp.int32), self.GCFG)
-            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
-            seq = np.concatenate([seq, nxt[:, None]], axis=1)
-        np.testing.assert_array_equal(got, seq[:, 7:])
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, 6, self.GCFG))
 
     def test_invalid_ratios_raise(self):
         import pytest
@@ -287,12 +289,8 @@ class TestRoPE:
         params = init_params(self.RCFG, seed=2)
         prompt = jnp.asarray(rng.integers(0, 31, (2, 7)), jnp.int32)
         got = np.asarray(generate(params, prompt, 6, self.RCFG))
-        seq = np.asarray(prompt)
-        for _ in range(6):
-            logits = forward(params, jnp.asarray(seq, jnp.int32), self.RCFG)
-            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
-            seq = np.concatenate([seq, nxt[:, None]], axis=1)
-        np.testing.assert_array_equal(got, seq[:, 7:])
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, 6, self.RCFG))
 
     def test_rope_attention_is_translation_invariant(self, rng):
         # RoPE scores depend only on relative offsets: rotating two vectors
@@ -314,12 +312,8 @@ class TestRoPE:
         params = init_params(cfg, seed=3)
         prompt = jnp.asarray(rng.integers(0, 31, (1, 5)), jnp.int32)
         got = np.asarray(generate(params, prompt, 4, cfg))
-        seq = np.asarray(prompt)
-        for _ in range(4):
-            logits = forward(params, jnp.asarray(seq, jnp.int32), cfg)
-            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
-            seq = np.concatenate([seq, nxt[:, None]], axis=1)
-        np.testing.assert_array_equal(got, seq[:, 5:])
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, 4, cfg))
 
     def test_odd_head_dim_raises_at_init(self):
         import pytest
