@@ -9,6 +9,7 @@ from .transformer import (
     init_params,
     loss_fn,
     prefill,
+    shard_params,
     train_step,
 )
 
@@ -21,5 +22,6 @@ __all__ = [
     "init_params",
     "loss_fn",
     "prefill",
+    "shard_params",
     "train_step",
 ]

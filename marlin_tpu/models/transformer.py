@@ -414,6 +414,62 @@ def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
     return toks
 
 
+def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):
+    """Tensor-parallel parameter placement (Megatron layout): the QKV and
+    first MLP projections split their OUTPUT features over ``axis``
+    (column-parallel), ``wo`` and the second MLP projection split their
+    INPUT features (row-parallel), so each block needs exactly one
+    all-reduce per sub-layer — which GSPMD inserts from these shardings
+    when ``train_step``/``forward`` run under jit. Embedding splits the
+    vocab row axis (the readout's ``embed.T`` contraction all-reduces);
+    norms/biases of row-parallel layers replicate. MoE expert params are
+    left untouched — ``parallel.expert`` places them itself (one expert per
+    device).
+
+    Compose dp x tp by also sharding the token batch over the other mesh
+    axis. Returns a new params pytree placed with ``jax.device_put``."""
+    from ..mesh import default_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh or default_mesh()
+    axis_size = dict(mesh.shape)[axis]
+
+    def put(x, spec):
+        # Degrade per-dimension to replication when the dim doesn't divide
+        # the axis (e.g. an odd vocab): XLA shards cannot be uneven.
+        fixed = tuple(
+            a if a is None or x.shape[i] % axis_size == 0 else None
+            for i, a in enumerate(spec)
+        )
+        return jax.device_put(x, NamedSharding(mesh, P(*fixed)))
+
+    rep = P()
+    out = {
+        "embed": put(params["embed"], P(axis, None)),
+        "ln_f": jax.tree.map(lambda x: put(x, rep), params["ln_f"]),
+        "blocks": [],
+    }
+    if "pos" in params:
+        out["pos"] = put(params["pos"], rep)
+    for bp in params["blocks"]:
+        nb = {
+            "ln1": jax.tree.map(lambda x: put(x, rep), bp["ln1"]),
+            "ln2": jax.tree.map(lambda x: put(x, rep), bp["ln2"]),
+            "wqkv": put(bp["wqkv"], P(None, axis)),  # column-parallel
+            "wo": put(bp["wo"], P(axis, None)),      # row-parallel
+        }
+        if cfg.n_experts:
+            for k in ("router", "w1", "b1", "w2", "b2"):
+                nb[k] = bp[k]  # the expert engine re-places these
+        else:
+            nb["w1"] = put(bp["w1"], P(None, axis))  # column-parallel
+            nb["b1"] = put(bp["b1"], P(axis))
+            nb["w2"] = put(bp["w2"], P(axis, None))  # row-parallel
+            nb["b2"] = put(bp["b2"], rep)
+        out["blocks"].append(nb)
+    return out
+
+
 def generate(params, prompt, steps: int, cfg: TransformerConfig,
              temperature: float = 0.0, seed: int = 0):
     """Autoregressive generation: prompt (B, S) int32 -> (B, steps) int32.
