@@ -367,3 +367,15 @@ class TestTensorParallel:
         got = jax.jit(forward, static_argnames="cfg")(tp, tok, cfg=cfg)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_tp_generate_matches_unsharded(self, rng, mesh):
+        # TP-sharded params through the full inference path: prefill + the
+        # jitted decode scan must produce the same greedy tokens.
+        from marlin_tpu.models import generate, shard_params
+
+        params = init_params(CFG, seed=3)
+        tp = shard_params(params, CFG, mesh=mesh)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 6)), jnp.int32)
+        ref = np.asarray(generate(params, prompt, 5, CFG))
+        got = np.asarray(generate(tp, prompt, 5, CFG))
+        np.testing.assert_array_equal(got, ref)
