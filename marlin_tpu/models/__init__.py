@@ -8,6 +8,7 @@ from .transformer import (
     init_kv_cache,
     init_params,
     loss_fn,
+    make_train_step,
     prefill,
     shard_params,
     train_step,
@@ -21,6 +22,7 @@ __all__ = [
     "init_kv_cache",
     "init_params",
     "loss_fn",
+    "make_train_step",
     "prefill",
     "shard_params",
     "train_step",

@@ -290,6 +290,25 @@ def train_step(params, tokens, targets, cfg: TransformerConfig,
     return loss, new_params
 
 
+def make_train_step(cfg: TransformerConfig, optimizer):
+    """Bind an optax GradientTransformation to the model: returns
+    ``(step_fn, init_opt_state)`` where
+    ``step_fn(params, opt_state, tokens, targets) -> (loss, params,
+    opt_state)`` is jittable. Optimizer state is built per-leaf from the
+    params pytree, so under jit with TP-placed params (``shard_params``)
+    GSPMD gives each moment buffer its parameter's sharding — optimizer
+    state scales out with the model instead of replicating."""
+    import optax  # baked into the image; imported lazily like the engines
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                                  cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    return step, optimizer.init
+
+
 # ---------------------------------------------------------------------------
 # Inference: KV-cache decode (TPU-shaped: static cache shapes, lax.scan loop)
 # ---------------------------------------------------------------------------

@@ -379,3 +379,27 @@ class TestTensorParallel:
         ref = np.asarray(generate(params, prompt, 5, CFG))
         got = np.asarray(generate(tp, prompt, 5, CFG))
         np.testing.assert_array_equal(got, ref)
+
+
+class TestOptax:
+    def test_adamw_trains_and_moments_inherit_tp_sharding(self, rng, mesh):
+        import optax
+
+        from marlin_tpu.models import make_train_step, shard_params
+
+        step, init_opt = make_train_step(CFG, optax.adamw(3e-3))
+        params = shard_params(init_params(CFG, seed=0), CFG, mesh=mesh)
+        jstep = jax.jit(step)
+        opt_state = jax.jit(init_opt)(params)
+        tok = jnp.asarray(rng.integers(0, CFG.vocab, (4, 16)), jnp.int32)
+        tgt = jnp.roll(tok, -1, axis=1)
+        l0, params, opt_state = jstep(params, opt_state, tok, tgt)
+        lN = l0
+        for _ in range(8):
+            lN, params, opt_state = jstep(params, opt_state, tok, tgt)
+        assert np.isfinite(float(lN)) and float(lN) < float(l0)
+        # Adam moment buffers for the column-parallel wqkv carry the same
+        # TP sharding as the parameter itself (optimizer state scales out).
+        mu_w = opt_state[0].mu["blocks"][0]["wqkv"]
+        assert mu_w.sharding == params["blocks"][0]["wqkv"].sharding
+        assert not mu_w.sharding.is_fully_replicated
