@@ -611,9 +611,13 @@ def config_decode():
         vocab=_sized("BENCH_DEC_VOCAB", 32768), d_model=d,
         n_heads=max(2, d // 128), n_layers=_sized("BENCH_DEC_L", 8),
         d_ff=4 * d, max_len=_sized("BENCH_DEC_S", 1024),
+        # GQA/RoPE knobs: BENCH_DEC_KV=2 shows the cache shrink on hardware.
+        n_kv_heads=_sized("BENCH_DEC_KV", 0),
+        rope=bool(_sized("BENCH_DEC_ROPE", 0)),
     )
     b = _sized("BENCH_DEC_B", 8)
-    prompt_len, steps = 64, cfg.max_len - 64
+    prompt_len = min(64, max(1, cfg.max_len // 2))
+    steps = cfg.max_len - prompt_len
     params = init_params(cfg, seed=0)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (b, prompt_len), 0, cfg.vocab)
