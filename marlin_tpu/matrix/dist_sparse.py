@@ -182,16 +182,25 @@ class DistSparseVecMatrix:
                 [vals, np.zeros((nd, short), vals.dtype)], axis=1
             )
         sh = _triple_sharding(self.mesh)
-        rows = jax.device_put(jnp.asarray(rows, jnp.int32), sh)
-        cols = jax.device_put(jnp.asarray(cols, jnp.int32), sh)
-        vals = jax.device_put(jnp.asarray(vals), sh)
-        # Sort each stripe's entries by column (shard-local: axis 1 is
-        # unsharded) so the ring kernels can bound each hop's chunk loop with
-        # a searchsorted on the k range instead of re-scanning every entry.
-        order = jnp.argsort(cols, axis=1)
-        self.rows = jnp.take_along_axis(rows, order, axis=1)
-        self.cols = jnp.take_along_axis(cols, order, axis=1)
-        self.vals = jnp.take_along_axis(vals, order, axis=1)
+        # ensure_compile_time_eval: construction must yield CONCRETE sharded
+        # arrays even when it happens under an active trace (e.g. spmm's
+        # backward building the cached transpose inside a jitted train step
+        # — a traced device_put would cache tracers on the instance and leak
+        # into the next call). Tracer *inputs* are rejected by this block,
+        # matching the host-arrays contract above.
+        with jax.ensure_compile_time_eval():
+            rows = jax.device_put(jnp.asarray(rows, jnp.int32), sh)
+            cols = jax.device_put(jnp.asarray(cols, jnp.int32), sh)
+            vals = jax.device_put(jnp.asarray(vals), sh)
+            # Sort each stripe's entries by column (shard-local: axis 1 is
+            # unsharded) so the ring kernels can bound each hop's chunk loop
+            # with a searchsorted on the k range instead of re-scanning
+            # every entry.
+            order = jnp.argsort(cols, axis=1)
+            self.rows = jnp.take_along_axis(rows, order, axis=1)
+            self.cols = jnp.take_along_axis(cols, order, axis=1)
+            self.vals = jnp.take_along_axis(vals, order, axis=1)
+        self._transpose: Optional["DistSparseVecMatrix"] = None
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -254,22 +263,32 @@ class DistSparseVecMatrix:
         ring with B's resident dense stripes rotating (the reference's
         sparse-times-densified-rows mode, SparseMultiply.scala:44-56)."""
         from .dense import DenseVecMatrix
-        from ..mesh import row_sharding
 
         if self.num_cols != other.num_rows:
             raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
-        nd = _n_dev(self.mesh)
-        k_stripe = -(-self.num_cols // nd)
-        b = other.logical
-        pad = nd * k_stripe - b.shape[0]
-        if pad:
-            b = jnp.pad(b, ((0, pad), (0, 0)))
-        b = jax.device_put(b, row_sharding(self.mesh))
-        out = _spmm_ring_dense(self.mesh, nd, self.stripe, k_stripe,
-                               int(b.shape[1]))(
-            self.rows, self.cols, self.vals, b
-        )
-        return DenseVecMatrix(out[: self.num_rows], mesh=self.mesh)
+        return DenseVecMatrix(_spmm_array(self, other.logical), mesh=self.mesh)
+
+    def transpose(self) -> "DistSparseVecMatrix":
+        """A^T as a new row-partitioned instance, cached both ways
+        (construction-time host re-partition of the triples by column —
+        the ring engines need their left operand partitioned by OUTPUT
+        row, so ``spmm``'s backward runs on this cached transpose)."""
+        if self._transpose is None:
+            r = np.asarray(self.rows).ravel()
+            c = np.asarray(self.cols).ravel()
+            v = np.asarray(self.vals).ravel()
+            keep = v != 0  # pads are structural zeros
+            t = DistSparseVecMatrix.from_coo(
+                c[keep], r[keep], v[keep],
+                (self.num_cols, self.num_rows), mesh=self.mesh,
+            )
+            t._transpose = self
+            self._transpose = t
+        return self._transpose
+
+    @property
+    def T(self) -> "DistSparseVecMatrix":
+        return self.transpose()
 
     def _product_stripes(self, other: "DistSparseVecMatrix") -> jax.Array:
         """Row-sharded dense stripes of A @ B (padded rows at the tail).
@@ -314,6 +333,52 @@ class DistSparseVecMatrix:
     def __repr__(self):
         return (f"DistSparseVecMatrix(shape={self.shape}, nnz={self.nnz}, "
                 f"devices={_n_dev(self.mesh)})")
+
+
+def _spmm_array(a: "DistSparseVecMatrix", b: jax.Array) -> jax.Array:
+    """Core sparse x dense ring on a plain (k, n) array -> (m, n) array
+    (row-sharded). Jit-safe: the device_put becomes a sharding constraint
+    under an outer jit, like the other engines."""
+    from ..mesh import row_sharding
+
+    nd = _n_dev(a.mesh)
+    k_stripe = -(-a.num_cols // nd)
+    pad = nd * k_stripe - b.shape[0]
+    if pad:
+        b = jnp.pad(b, ((0, pad), (0, 0)))
+    b = jax.device_put(b, row_sharding(a.mesh))
+    out = _spmm_ring_dense(a.mesh, nd, a.stripe, k_stripe, int(b.shape[1]))(
+        a.rows, a.cols, a.vals, b
+    )
+    return out[: a.num_rows]
+
+
+def spmm(a: "DistSparseVecMatrix", b: jax.Array) -> jax.Array:
+    """DIFFERENTIABLE distributed sparse x dense: (m, k) COO ring times a
+    (k, n) array -> (m, n) array.
+
+    The ring engine's fori_loop isn't reverse-differentiable, so the
+    gradient is supplied in closed form: dL/dB = A^T @ dY — the same engine
+    run on the cached :meth:`DistSparseVecMatrix.transpose`. A itself is
+    treated as structural (no gradient to its values), which is the
+    training contract sparse models need (e.g. a GCN's normalized
+    adjacency: ``models/gcn.py``). The backward calls ``spmm`` recursively,
+    so higher-order derivatives w.r.t. ``b`` also work."""
+    if a.num_cols != b.shape[0]:
+        raise ValueError(f"dimension mismatch: {a.shape} x {b.shape}")
+
+    @jax.custom_vjp
+    def f(b):
+        return _spmm_array(a, b)
+
+    def fwd(b):
+        return f(b), None
+
+    def bwd(_, g):
+        return (spmm(a.transpose(), g),)
+
+    f.defvjp(fwd, bwd)
+    return f(b)
 
 
 # ---------------------------------------------------------------------------

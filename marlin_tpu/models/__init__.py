@@ -1,5 +1,7 @@
 """Model families built on the framework's parallel engines."""
 
+from . import gcn
+
 from .transformer import (
     TransformerConfig,
     decode_step,

@@ -166,3 +166,10 @@ def test_long_context(capsys):
     assert long_context.main(["256", "8", "16"]) == 0
     out = capsys.readouterr().out
     assert "engines agree" in out
+
+
+def test_gcn_example(capsys):
+    from marlin_tpu.examples import gcn
+
+    assert gcn.main(["128", "40"]) == 0
+    assert "test accuracy" in capsys.readouterr().out
