@@ -54,6 +54,7 @@ class TransformerConfig(NamedTuple):
     moe_capacity: float = 2.0
     n_kv_heads: int = 0  # 0 = n_heads; fewer = GQA/MQA (must divide n_heads)
     rope: bool = False  # rotary position embeddings instead of learned ones
+    window: int = 0  # >0: sliding-window (causal) attention span
 
     @property
     def kv_heads(self) -> int:
@@ -72,6 +73,12 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
         raise ValueError(
             "GQA + sequence_parallel is unsupported: the SP engines shard "
             "the full head axis")
+    if cfg.sequence_parallel and cfg.window:
+        raise ValueError(
+            "window + sequence_parallel is unsupported: the SP engines "
+            "attend the full sequence")
+    if cfg.window < 0:
+        raise ValueError(f"window must be >= 0, got {cfg.window}")
     if cfg.rope and (cfg.d_model // cfg.n_heads) % 2:
         raise ValueError(
             f"rope needs an even per-head dim, got "
@@ -134,7 +141,7 @@ def _attend_local(q, k, v, cfg: TransformerConfig):
     """(S, H, Dh) causal attention — flash kernel (interpret off-TPU)."""
     from ..ops.flash_attention import flash_attention
 
-    return flash_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True, window=cfg.window)
 
 
 def _attend_sp(q, k, v, cfg: TransformerConfig):
@@ -148,6 +155,12 @@ def _attend_sp(q, k, v, cfg: TransformerConfig):
         raise ValueError(
             "GQA + sequence_parallel is unsupported: the SP engines shard "
             "the full head axis")
+    if cfg.window:
+        # Same runtime-flag rationale as the GQA re-check above: without
+        # this, an SP _replace would silently attend the full sequence.
+        raise ValueError(
+            "window + sequence_parallel is unsupported: the SP engines "
+            "attend the full sequence")
     return sequence_parallel_attention(q, k, v, causal=True)
 
 
@@ -333,17 +346,21 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
     ]
 
 
-def _attend_cached(q, ck, cv, pos):
+def _attend_cached(q, ck, cv, pos, window=0):
     """One query position against a padded cache: q (H, Dh), ck/cv
     (T, Hk, Dh) with Hk dividing H (GQA: q-head group g reads K/V head g);
-    positions > pos masked out. f32 softmax (the framework's
-    accumulate->=f32 convention)."""
+    positions > pos masked out, and positions <= pos - window with a
+    sliding window. f32 softmax (the framework's accumulate->=f32
+    convention)."""
     h, dh = q.shape
     hk = ck.shape[1]
     qg = q.reshape(hk, h // hk, dh).astype(jnp.float32)  # (Hk, G, Dh)
     logits = jnp.einsum(
         "kgd,tkd->kgt", qg, ck.astype(jnp.float32)) / np.sqrt(dh)
-    mask = jnp.arange(ck.shape[0]) <= pos  # (T,)
+    t_pos = jnp.arange(ck.shape[0])
+    mask = t_pos <= pos  # (T,)
+    if window:
+        mask = jnp.logical_and(mask, t_pos > pos - window)
     logits = jnp.where(mask[None, None, :], logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("kgt,tkd->kgd", p, cv.astype(jnp.float32))
@@ -367,7 +384,10 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
             layer["k"], k[:, None].astype(layer["k"].dtype), pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(
             layer["v"], v[:, None].astype(layer["v"].dtype), pos, axis=1)
-        att = jax.vmap(_attend_cached, in_axes=(0, 0, 0, None))(q, ck, cv, pos)
+        att = jax.vmap(
+            functools.partial(_attend_cached, window=cfg.window),
+            in_axes=(0, 0, 0, None),
+        )(q, ck, cv, pos)
         x = _mlp_residual(bp, x + att.reshape(x.shape) @ bp["wo"], cfg)
         new_cache.append({"k": ck, "v": cv})
     x = _layer_norm(params["ln_f"], x)

@@ -39,7 +39,7 @@ _LOG2E = float(np.log2(np.e))
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
-            block_q, block_k, kv_len):
+            block_q, block_k, kv_len, window):
     """One (head, q_block, k_block) grid step of the online-softmax sweep.
 
     VPU economy (measured ~5% on v5e at S=8k): the softmax runs in base 2
@@ -62,8 +62,19 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: skip blocks strictly above the diagonal.
+    # Causal: skip blocks strictly above the diagonal. Sliding window
+    # (window > 0, implies causal): also skip blocks strictly BELOW the
+    # band — their MXU/VPU work never issues (pl.when gates compute only;
+    # the pipeline still DMAs every k-block's tiles). Rows
+    # whose real keys haven't arrived yet accumulate p=1 garbage against
+    # the -1e30 running max; the online-softmax discards it the moment a
+    # real key lands (corr = exp2(-1e30 - m_real) = 0), and causal
+    # guarantees every row eventually sees its diagonal key.
     run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    if window:  # static; run is a traced bool — combine with logical_and
+        run = jnp.logical_and(
+            run, j * block_k + block_k - 1 > i * block_q - window
+        )
 
     @pl.when(run)
     def _step():
@@ -80,6 +91,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
                 q_pos = i * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, s.shape, 0)
                 mask = jnp.logical_and(mask, k_pos <= q_pos)
+                if window:
+                    mask = jnp.logical_and(mask, k_pos > q_pos - window)
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]  # (block_q, 1), log2 units
@@ -114,9 +127,11 @@ def _out_struct(x: jax.Array, shape) -> jax.ShapeDtypeStruct:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "window"),
 )
-def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
+                    window):
     """(H, Sq, D) x (Hk, Skv, D) x (Hk, Skv, Dv) -> (H, Sq, Dv); D and Dv
     already lane-padded (Dv may differ from D). Hk may divide H (GQA/MQA):
     q-head h reads K/V head h // (H // Hk) — pure index-map grouping, the
@@ -138,7 +153,7 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     out = pl.pallas_call(
         functools.partial(
             _kernel, causal=causal,
-            block_q=block_q, block_k=block_k, kv_len=kv_len,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, window=window,
         ),
         grid=grid,
         in_specs=[
@@ -163,21 +178,25 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     return out[:, :sq]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret, window):
     """Differentiable wrapper: forward is the Pallas kernel; backward
     recomputes the attention in f32 with XLA and applies the closed-form
     softmax-attention gradients (the standard flash training trade — no
     (Sq, Skv) matrix in the forward, one per head in the backward)."""
-    return _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k,
+                           interpret, window)
 
 
-def _flash_hsd_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_hsd_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window):
+    out = _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k,
+                          interpret, window)
     return out, (q, k, v)
 
 
-def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, window,
+                   res, g):
     q, k, v = res
     group = q.shape[0] // k.shape[0]
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
@@ -188,7 +207,11 @@ def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, res, g):
     logits = jnp.einsum("hsd,htd->hst", qf, kf) * scale
     if causal:
         sq, skv = q.shape[1], k.shape[1]
-        mask = jnp.arange(skv)[None, :] <= jnp.arange(sq)[:, None]
+        k_pos = jnp.arange(skv)[None, :]
+        q_pos = jnp.arange(sq)[:, None]
+        mask = k_pos <= q_pos
+        if window:
+            mask = jnp.logical_and(mask, k_pos > q_pos - window)
         logits = jnp.where(mask[None], logits, _NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)  # (H, Sq, Skv)
     dv = jnp.einsum("hst,hsd->htd", p, gf)
@@ -215,8 +238,16 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: int = 0,
 ) -> jax.Array:
     """softmax(Q K^T * scale) V, flash-tiled, single device.
+
+    ``window`` > 0 (requires ``causal``) restricts each query to the last
+    ``window`` key positions (sliding-window attention). K-blocks outside
+    the band skip their compute entirely (``pl.when``), so MXU/VPU work
+    scales with S * window instead of S^2; their tiles are still DMA'd by
+    the pipeline, so HBM reads are NOT reduced — shrink the grid via a
+    prefetch scheme if bandwidth ever becomes the windowed bottleneck.
 
     Shapes: (S, D) single-head or (S, H, D) multi-head; K/V lengths may
     differ from Q's (cross attention), and K/V may carry FEWER heads than Q
@@ -264,9 +295,13 @@ def flash_attention(
     qt, kt, vt = (jnp.swapaxes(x, 0, 1) for x in (q, k, v))
     d0 = vt.shape[-1]
     qt, kt, vt = (pad_to_multiple(x, 2, _LANES) for x in (qt, kt, vt))
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     out = _flash_hsd(
         qt, kt, vt, bool(causal), float(scale), int(block_q), int(block_k),
-        bool(interpret),
+        bool(interpret), int(window),
     )
     out = jnp.swapaxes(out[..., :d0], 0, 1)
     return out[:, 0] if single else out

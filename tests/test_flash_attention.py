@@ -257,3 +257,38 @@ class TestGQA:
         k = jnp.zeros((16, 3, 8), jnp.float32)
         with pytest.raises(ValueError):
             flash_attention(q, k, k)
+
+
+class TestSlidingWindow:
+    def test_window_matches_banded_oracle_and_grads(self, rng):
+        s_len, h, d, w = 200, 2, 32, 48
+
+        def banded(q, k, v):
+            qf, kf, vf = (jnp.swapaxes(x, 0, 1).astype(jnp.float32)
+                          for x in (q, k, v))
+            logits = jnp.einsum("hsd,htd->hst", qf, kf) / np.sqrt(d)
+            kp = jnp.arange(s_len)[None, :]
+            qp = jnp.arange(s_len)[:, None]
+            mask = (kp <= qp) & (kp > qp - w)
+            logits = jnp.where(mask[None], logits, -1e30)
+            return jnp.einsum(
+                "hst,htd->shd", jax.nn.softmax(logits, -1), vf)
+
+        q, k, v = (jnp.asarray(rng.standard_normal((s_len, h, d)),
+                               jnp.float32) for _ in range(3))
+        got = flash_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(banded(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+        g = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, causal=True, window=w) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(banded(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a_, b_ in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_window_requires_causal(self, rng):
+        q = jnp.zeros((16, 2, 8), jnp.float32)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, window=4)

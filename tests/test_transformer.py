@@ -403,3 +403,85 @@ class TestOptax:
         mu_w = opt_state[0].mu["blocks"][0]["wqkv"]
         assert mu_w.sharding == params["blocks"][0]["wqkv"].sharding
         assert not mu_w.sharding.is_fully_replicated
+
+
+class TestSlidingWindow:
+    """window > 0: banded causal attention, training + decode."""
+
+    WCFG = TransformerConfig(vocab=31, d_model=32, n_heads=2, n_layers=2,
+                             d_ff=64, max_len=64, window=8, rope=True)
+
+    def test_window_limits_receptive_field(self, rng):
+        # One layer, window w: logits at position t depend only on tokens
+        # in (t - w, t]. Changing token 0 must not change logits at
+        # position >= w.
+        cfg = TransformerConfig(vocab=31, d_model=32, n_heads=2, n_layers=1,
+                                d_ff=64, max_len=64, window=8)
+        params = init_params(cfg, seed=0)
+        tok = rng.integers(0, 31, (1, 32))
+        tok2 = tok.copy()
+        tok2[0, 0] = (tok2[0, 0] + 5) % 31
+        l1 = forward(params, jnp.asarray(tok, jnp.int32), cfg)
+        l2 = forward(params, jnp.asarray(tok2, jnp.int32), cfg)
+        np.testing.assert_allclose(l1[0, 8:], l2[0, 8:], atol=1e-5)
+        assert not np.allclose(l1[0, :8], l2[0, :8], atol=1e-5)
+
+    def test_windowed_forward_matches_banded_oracle(self, rng):
+        # Full model vs an explicitly banded-mask XLA attention oracle.
+        from marlin_tpu.models.transformer import _split_qkv
+
+        cfg = self.WCFG._replace(n_layers=1)
+        params = init_params(cfg, seed=1)
+        tok = jnp.asarray(rng.integers(0, 31, (1, 40)), jnp.int32)
+        got = forward(params, tok, cfg)
+
+        x = params["embed"][tok[0]]
+        q, k, v = _split_qkv(params["blocks"][0], x, cfg,
+                             positions=jnp.arange(40))
+        qf, kf, vf = (jnp.swapaxes(a, 0, 1).astype(jnp.float64)
+                      for a in (q, k, v))
+        logits = jnp.einsum("hsd,htd->hst", qf, kf) / np.sqrt(16)
+        kp = jnp.arange(40)[None, :]
+        qp = jnp.arange(40)[:, None]
+        mask = (kp <= qp) & (kp > qp - cfg.window)
+        logits = jnp.where(mask[None], logits, -1e30)
+        att = jnp.einsum("hst,htd->shd",
+                         jax.nn.softmax(logits, -1), vf).reshape(40, 32)
+        from marlin_tpu.models.transformer import _layer_norm, _mlp_residual
+        h = _mlp_residual(params["blocks"][0],
+                          x + att.astype(x.dtype) @ params["blocks"][0]["wo"],
+                          cfg)
+        ref = _layer_norm(params["ln_f"], h) @ params["embed"].T
+        np.testing.assert_allclose(
+            np.asarray(got[0]), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_windowed_greedy_decode_matches_reforward(self, rng):
+        # Decode must apply the same band against the cache: positions
+        # beyond the window are masked even though they sit in the buffer.
+        from marlin_tpu.models import generate
+
+        params = init_params(self.WCFG, seed=2)
+        prompt = jnp.asarray(rng.integers(0, 31, (2, 12)), jnp.int32)
+        got = np.asarray(generate(params, prompt, 10, self.WCFG))
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, 10, self.WCFG))
+
+    def test_window_sp_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="sequence_parallel"):
+            init_params(TransformerConfig(window=4, sequence_parallel=True))
+
+    def test_runtime_sp_flip_on_windowed_params_raises(self, rng):
+        import pytest
+
+        params = init_params(self.WCFG, seed=3)
+        tok = jnp.asarray(rng.integers(0, 31, (1, 16)), jnp.int32)
+        with pytest.raises(ValueError, match="sequence_parallel"):
+            forward(params, tok, self.WCFG._replace(sequence_parallel=True))
+
+    def test_negative_window_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match=">= 0"):
+            init_params(TransformerConfig(window=-1))
