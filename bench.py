@@ -616,6 +616,10 @@ def config_transformer():
         vocab=_sized("BENCH_TF_VOCAB", 32768), d_model=d,
         n_heads=max(2, d // 128), n_layers=_sized("BENCH_TF_L", 8),
         d_ff=4 * d, max_len=_sized("BENCH_TF_S", 2048),
+        # Architecture knobs so the capture can compare variants on chip.
+        n_kv_heads=_sized("BENCH_TF_KV", 0),
+        rope=bool(_sized("BENCH_TF_ROPE", 0)),
+        window=_sized("BENCH_TF_WINDOW", 0),
     )
     b, s = _sized("BENCH_TF_B", 8), cfg.max_len
     params = init_params(cfg, seed=0)
