@@ -61,6 +61,21 @@ class TransformerConfig(NamedTuple):
         return self.n_kv_heads or self.n_heads
 
 
+def _sp_conflict(cfg: TransformerConfig) -> Optional[str]:
+    """Why this config cannot route through the SP engines (None if it can).
+    Checked both at param init AND at attention dispatch: sequence_parallel
+    is a runtime flag (cfg._replace) while params are shape-identical
+    across it, so a late flip must hit the contract error, not a cryptic
+    engine shape error."""
+    if cfg.kv_heads != cfg.n_heads:
+        return ("GQA + sequence_parallel is unsupported: the SP engines "
+                "shard the full head axis")
+    if cfg.window:
+        return ("window + sequence_parallel is unsupported: the SP engines "
+                "attend the full sequence")
+    return None
+
+
 def init_params(cfg: TransformerConfig, seed: int = 0):
     """Nested-dict param pytree; scaled-normal init. ``wqkv`` packs the Q
     projection (D cols) followed by K and V (kv_heads * Dh cols each) — for
@@ -69,14 +84,8 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
     if cfg.n_heads % cfg.kv_heads:
         raise ValueError(
             f"n_kv_heads {cfg.kv_heads} must divide n_heads {cfg.n_heads}")
-    if cfg.sequence_parallel and cfg.kv_heads != cfg.n_heads:
-        raise ValueError(
-            "GQA + sequence_parallel is unsupported: the SP engines shard "
-            "the full head axis")
-    if cfg.sequence_parallel and cfg.window:
-        raise ValueError(
-            "window + sequence_parallel is unsupported: the SP engines "
-            "attend the full sequence")
+    if cfg.sequence_parallel and _sp_conflict(cfg):
+        raise ValueError(_sp_conflict(cfg))
     if cfg.window < 0:
         raise ValueError(f"window must be >= 0, got {cfg.window}")
     if cfg.rope and (cfg.d_model // cfg.n_heads) % 2:
@@ -147,20 +156,9 @@ def _attend_local(q, k, v, cfg: TransformerConfig):
 def _attend_sp(q, k, v, cfg: TransformerConfig):
     from ..parallel.ulysses import sequence_parallel_attention
 
-    if cfg.kv_heads != cfg.n_heads:
-        # Also guarded at init; re-checked here because sequence_parallel is
-        # a runtime flag (cfg._replace) while params are shape-identical
-        # across it — without this, ulysses' head-axis all_to_all fails with
-        # a cryptic shape error instead of the contract.
-        raise ValueError(
-            "GQA + sequence_parallel is unsupported: the SP engines shard "
-            "the full head axis")
-    if cfg.window:
-        # Same runtime-flag rationale as the GQA re-check above: without
-        # this, an SP _replace would silently attend the full sequence.
-        raise ValueError(
-            "window + sequence_parallel is unsupported: the SP engines "
-            "attend the full sequence")
+    conflict = _sp_conflict(cfg)  # see _sp_conflict on why re-checked here
+    if conflict:
+        raise ValueError(conflict)
     return sequence_parallel_attention(q, k, v, causal=True)
 
 
@@ -483,17 +481,21 @@ def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):
         return jax.device_put(x, NamedSharding(mesh, P(*fixed)))
 
     rep = P()
+
+    def replicate(tree):
+        return jax.tree.map(lambda x: put(x, rep), tree)
+
     out = {
         "embed": put(params["embed"], P(axis, None)),
-        "ln_f": jax.tree.map(lambda x: put(x, rep), params["ln_f"]),
+        "ln_f": replicate(params["ln_f"]),
         "blocks": [],
     }
     if "pos" in params:
         out["pos"] = put(params["pos"], rep)
     for bp in params["blocks"]:
         nb = {
-            "ln1": jax.tree.map(lambda x: put(x, rep), bp["ln1"]),
-            "ln2": jax.tree.map(lambda x: put(x, rep), bp["ln2"]),
+            "ln1": replicate(bp["ln1"]),
+            "ln2": replicate(bp["ln2"]),
             "wqkv": put(bp["wqkv"], P(None, axis)),  # column-parallel
             "wo": put(bp["wo"], P(axis, None)),      # row-parallel
         }
