@@ -70,9 +70,6 @@ def _sp_conflict(cfg: TransformerConfig) -> Optional[str]:
     if cfg.kv_heads != cfg.n_heads:
         return ("GQA + sequence_parallel is unsupported: the SP engines "
                 "shard the full head axis")
-    if cfg.window:
-        return ("window + sequence_parallel is unsupported: the SP engines "
-                "attend the full sequence")
     return None
 
 
@@ -159,7 +156,8 @@ def _attend_sp(q, k, v, cfg: TransformerConfig):
     conflict = _sp_conflict(cfg)  # see _sp_conflict on why re-checked here
     if conflict:
         raise ValueError(conflict)
-    return sequence_parallel_attention(q, k, v, causal=True)
+    return sequence_parallel_attention(q, k, v, causal=True,
+                                       window=cfg.window)
 
 
 def _moe_expert(p, tok):

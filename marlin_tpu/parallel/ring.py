@@ -130,9 +130,22 @@ def ring_matmul(
 
 @functools.cache
 def _ring_attention_fn(
-    mesh: Mesh, n_dev: int, causal: bool, scale: float, multihead: bool = False
+    mesh: Mesh, n_dev: int, causal: bool, scale: float,
+    multihead: bool = False, window: int = 0, skv_stripe: int = 0
 ):
     axes = _ring_axes(mesh)
+    # Sliding window (causal): only the current stripe plus the previous
+    # ceil((window - 1) / stripe) stripes can intersect any local query's
+    # band, so the ring ROTATES FORWARD (device i sees stripes i, i-1, ...)
+    # and stops after that many hops — communication and compute scale with
+    # the window, not the device count. skv_stripe is static (wrapper
+    # passes skv // n_dev) so the bound is compile-time.
+    if window:
+        hops = min(n_dev, (window + skv_stripe - 2) // max(skv_stripe, 1) + 1)
+        direction = +1
+    else:
+        hops = n_dev
+        direction = -1
 
     def kernel(q_blk, k_blk, v_blk):
         # q_blk: (sq/P, d); k_blk, v_blk: (skv/P, d) — K/V rotate. The
@@ -142,7 +155,7 @@ def _ring_attention_fn(
         # same choice, ops/flash_attention.py); only the final output casts
         # back.
         i = jax.lax.axis_index(axes)
-        perm = [(s, (s - 1) % n_dev) for s in range(n_dev)]
+        perm = [(s, (s + direction) % n_dev) for s in range(n_dev)]
         sq = q_blk.shape[0]
         skv = k_blk.shape[0]
         acc_t = jnp.promote_types(q_blk.dtype, jnp.float32)
@@ -150,7 +163,9 @@ def _ring_attention_fn(
 
         def step(t, carry):
             k_cur, v_cur, m_run, l_run, o_run = carry
-            src = (i + t) % n_dev  # which kv block we currently hold
+            # Which kv block we currently hold: rotation by `direction`
+            # means hop t holds stripe (i - direction * t) mod n_dev.
+            src = (i - direction * t) % n_dev
             logits = scale * jax.lax.dot_general(
                 q_blk, k_cur, (((1,), (1,)), ((), ())),
                 preferred_element_type=acc_t,
@@ -158,7 +173,10 @@ def _ring_attention_fn(
             if causal:
                 q_pos = i * sq + jnp.arange(sq)[:, None]
                 k_pos = src * skv + jnp.arange(skv)[None, :]
-                logits = jnp.where(k_pos <= q_pos, logits, neg)
+                mask = k_pos <= q_pos
+                if window:
+                    mask = jnp.logical_and(mask, k_pos > q_pos - window)
+                logits = jnp.where(mask, logits, neg)
             # Online softmax merge (running max + denominator).
             m_new = jnp.maximum(m_run, jnp.max(logits, axis=1))
             corr = jnp.exp(m_run - m_new)
@@ -177,7 +195,7 @@ def _ring_attention_fn(
         l0 = _pvary(jnp.zeros((sq,), acc_t), axes)
         o0 = _pvary(jnp.zeros((sq, v_blk.shape[1]), acc_t), axes)
         _, _, _, l_fin, o_fin = jax.lax.fori_loop(
-            0, n_dev, step, (k_blk, v_blk, m0, l0, o0)
+            0, hops, step, (k_blk, v_blk, m0, l0, o0)
         )
         out = o_fin / jnp.maximum(l_fin, 1e-30)[:, None]
         return out.astype(q_blk.dtype)
@@ -201,6 +219,7 @@ def ring_self_attention(
     mesh: Optional[Mesh] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: int = 0,
 ) -> jax.Array:
     """softmax(Q K^T * scale) V with the sequence dimension sharded on the
     ring; K/V blocks stream. Shapes: q (sq, d) or (sq, h, d) multi-head (the
@@ -208,13 +227,28 @@ def ring_self_attention(
     lengths (skv, ...). sq and skv must each be divisible-padded to the
     device count (zero-pad keys get masked out by the softmax max-shift only
     if padded — callers should pass divisible lengths; this wrapper pads q
-    only)."""
+    only).
+
+    ``window`` > 0 (requires ``causal`` and self-attention lengths) runs
+    the hop-bounded ring: only ceil((window-1)/stripe) + 1 stripes ever
+    rotate, so ICI traffic and compute scale with the window instead of
+    the full sequence — the long-context payoff of banded attention."""
     mesh = mesh or default_mesh()
     n_dev = len(mesh.devices.flat)
     if k.shape[0] % n_dev != 0:
         raise ValueError(
             f"key/value length {k.shape[0]} must divide by {n_dev} devices"
         )
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window:
+        if not causal:
+            raise ValueError("window > 0 requires causal=True")
+        if q.shape[0] != k.shape[0]:
+            raise ValueError(
+                "windowed ring attention needs self-attention lengths "
+                f"(q {q.shape[0]} vs kv {k.shape[0]}): the hop bound "
+                "assumes aligned q/kv stripes")
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     multihead = q.ndim == 3
@@ -225,5 +259,10 @@ def ring_self_attention(
     qp = jax.device_put(qp, sh)
     kp = jax.device_put(k, sh)
     vp = jax.device_put(v, sh)
-    out = _ring_attention_fn(mesh, n_dev, causal, float(scale), multihead)(qp, kp, vp)
+    out = _ring_attention_fn(
+        mesh, n_dev, causal, float(scale), multihead, int(window),
+        # stripe only matters for the windowed hop bound; keep it out of
+        # the cache key otherwise so one fn serves every kv length.
+        k.shape[0] // n_dev if window else 0,
+    )(qp, kp, vp)
     return out[:sq] if out.shape[0] != sq else out

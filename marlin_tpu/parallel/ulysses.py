@@ -42,7 +42,7 @@ def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-def _attend(q, k, v, scale, causal):
+def _attend(q, k, v, scale, causal, window=0):
     """Full-sequence attention: softmax(q k^T * scale) v. q: (sq, d); k/v:
     (skv, d). Logits/softmax in f32 whatever the input dtype (same choice as
     the flash kernel and the ring engine); output casts back."""
@@ -53,7 +53,10 @@ def _attend(q, k, v, scale, causal):
     if causal:
         q_pos = jnp.arange(q.shape[0])[:, None]
         k_pos = jnp.arange(k.shape[0])[None, :]
-        logits = jnp.where(k_pos <= q_pos, logits, jnp.asarray(-1e30, acc_t))
+        mask = k_pos <= q_pos
+        if window:
+            mask = jnp.logical_and(mask, k_pos > q_pos - window)
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, acc_t))
     logits = logits - jnp.max(logits, axis=1, keepdims=True)
     p = jnp.exp(logits)
     pv = jax.lax.dot_general(
@@ -63,7 +66,8 @@ def _attend(q, k, v, scale, causal):
 
 
 @functools.cache
-def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float, flash: bool):
+def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float,
+                flash: bool, window: int = 0):
     axes = _mesh_axes(mesh)
 
     def kernel(q_blk, k_blk, v_blk):
@@ -86,10 +90,11 @@ def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float, flash: bool)
         if flash:
             from ..ops.flash_attention import flash_attention
 
-            out_h = flash_attention(q_h, k_h, v_h, causal=causal, scale=scale)
+            out_h = flash_attention(q_h, k_h, v_h, causal=causal, scale=scale,
+                                    window=window)
         else:
             out_h = jax.vmap(
-                lambda q, k, v: _attend(q, k, v, scale, causal),
+                lambda q, k, v: _attend(q, k, v, scale, causal, window),
                 in_axes=1,
                 out_axes=1,
             )(q_h, k_h, v_h)
@@ -122,8 +127,13 @@ def ulysses_self_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     local_kernel: str = "auto",
+    window: int = 0,
 ) -> jax.Array:
     """Multi-head attention with sequence sharding via two all-to-alls.
+
+    ``window`` > 0 (requires ``causal``) bands the local full-sequence
+    attention (each device holds the whole sequence for its heads, so the
+    band is just the local kernel's window).
 
     Shapes: q/k/v are (seq, n_heads, head_dim); seq and n_heads must both be
     divisible by the device count (all_to_all re-shards each of them once).
@@ -158,7 +168,12 @@ def ulysses_self_attention(
     axes = _mesh_axes(mesh)
     sh = NamedSharding(mesh, P(axes, None, None))
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    return _ulysses_fn(mesh, n_dev, causal, float(scale), flash)(q, k, v)
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
+    return _ulysses_fn(mesh, n_dev, causal, float(scale), flash,
+                       int(window))(q, k, v)
 
 
 def sequence_parallel_attention(
@@ -169,8 +184,13 @@ def sequence_parallel_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     strategy: str = "auto",
+    window: int = 0,
 ) -> jax.Array:
     """Dispatch between the two sequence-parallel attention engines.
+
+    ``window`` > 0 (requires ``causal``): all_to_all bands its local
+    attention; ring runs the hop-bounded pipeline (traffic scales with the
+    window, not the sequence).
 
     ``strategy``: ``"ring"`` | ``"all_to_all"`` | ``"auto"``. Auto picks
     all-to-all when the head axis exists and divides the mesh (cheaper: two
@@ -205,8 +225,10 @@ def sequence_parallel_attention(
     if strategy == "all_to_all":
         if q.ndim != 3:
             raise ValueError("all_to_all strategy needs (seq, heads, dim) inputs")
-        return ulysses_self_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
+        return ulysses_self_attention(q, k, v, mesh=mesh, causal=causal,
+                                      scale=scale, window=window)
     if strategy == "ring":
         # ring_self_attention vmaps a 3-D head axis through one pipeline.
-        return ring_self_attention(q, k, v, mesh=mesh, causal=causal, scale=scale)
+        return ring_self_attention(q, k, v, mesh=mesh, causal=causal,
+                                   scale=scale, window=window)
     raise ValueError(f"unknown sequence-parallel strategy: {strategy!r}")

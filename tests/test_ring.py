@@ -1,6 +1,7 @@
 """Ring / streaming parallelism tests: ring GEMM and ring attention vs dense
 oracles on the 8-device mesh."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -93,3 +94,61 @@ class TestAccumulatorPrecision:
         oracle = (p / p.sum(1, keepdims=True)) @ vf
         err = np.max(np.abs(got - oracle)) / np.max(np.abs(oracle))
         assert err < 8e-3, err
+
+
+class TestWindowedRing:
+    def test_hop_bounded_ring_matches_banded_oracle(self, rng, mesh):
+        n_dev = len(mesh.devices.flat)
+        s_len, d, w = 8 * n_dev, 16, 10
+        q = jnp.asarray(rng.standard_normal((s_len, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((s_len, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((s_len, d)), jnp.float32)
+        got = np.asarray(ring_self_attention(q, k, v, causal=True, window=w))
+        qf, kf, vf = (np.asarray(x, np.float64) for x in (q, k, v))
+        logits = (qf @ kf.T) / np.sqrt(d)
+        kp = np.arange(s_len)[None, :]
+        qp = np.arange(s_len)[:, None]
+        logits = np.where((kp <= qp) & (kp > qp - w), logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, p @ vf, rtol=1e-5, atol=1e-5)
+
+    def test_windowed_ring_multihead_and_dispatch(self, rng, mesh):
+        from marlin_tpu.parallel.ulysses import sequence_parallel_attention
+
+        n_dev = len(mesh.devices.flat)
+        s_len, h, d, w = 8 * n_dev, n_dev, 16, 12
+        q, k, v = (jnp.asarray(rng.standard_normal((s_len, h, d)),
+                               jnp.float32) for _ in range(3))
+        outs = {}
+        for strat in ("ring", "all_to_all"):
+            outs[strat] = np.asarray(sequence_parallel_attention(
+                q, k, v, causal=True, strategy=strat, window=w))
+        np.testing.assert_allclose(outs["ring"], outs["all_to_all"],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_window_requires_causal_and_self_lengths(self, rng, mesh):
+        n_dev = len(mesh.devices.flat)
+        q = jnp.zeros((8 * n_dev, 8), jnp.float32)
+        with pytest.raises(ValueError, match="causal"):
+            ring_self_attention(q, q, q, window=4)
+        k = jnp.zeros((16 * n_dev, 8), jnp.float32)
+        with pytest.raises(ValueError, match="self-attention"):
+            ring_self_attention(q, k, k, causal=True, window=4)
+
+    def test_negative_window_rejected(self, mesh):
+        n_dev = len(mesh.devices.flat)
+        q = jnp.zeros((8 * n_dev, 8), jnp.float32)
+        with pytest.raises(ValueError, match=">= 0"):
+            ring_self_attention(q, q, q, causal=True, window=-4)
+
+    def test_window_one_single_hop(self, rng, mesh):
+        # window=1 attends only the diagonal: one hop, output == v.
+        import numpy as np
+
+        n_dev = len(mesh.devices.flat)
+        s_len = 4 * n_dev
+        q = jnp.asarray(rng.standard_normal((s_len, 8)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((s_len, 8)), jnp.float32)
+        got = np.asarray(ring_self_attention(q, q, v, causal=True, window=1))
+        np.testing.assert_allclose(got, np.asarray(v), rtol=1e-6, atol=1e-6)

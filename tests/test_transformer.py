@@ -466,19 +466,21 @@ class TestSlidingWindow:
         np.testing.assert_array_equal(
             got, _greedy_reforward(params, prompt, 10, self.WCFG))
 
-    def test_window_sp_rejected(self):
-        import pytest
-
-        with pytest.raises(ValueError, match="sequence_parallel"):
-            init_params(TransformerConfig(window=4, sequence_parallel=True))
-
-    def test_runtime_sp_flip_on_windowed_params_raises(self, rng):
-        import pytest
-
-        params = init_params(self.WCFG, seed=3)
-        tok = jnp.asarray(rng.integers(0, 31, (1, 16)), jnp.int32)
-        with pytest.raises(ValueError, match="sequence_parallel"):
-            forward(params, tok, self.WCFG._replace(sequence_parallel=True))
+    def test_window_sp_matches_local(self, rng, mesh):
+        # SP + window is supported: the ring runs hop-bounded, all_to_all
+        # bands its local kernel; both must match the local windowed path.
+        n_dev = len(mesh.devices.flat)
+        cfg_l = TransformerConfig(vocab=17, d_model=32, n_heads=n_dev,
+                                  n_layers=1, d_ff=32, max_len=8 * n_dev,
+                                  window=6)
+        cfg_sp = cfg_l._replace(sequence_parallel=True)
+        params = init_params(cfg_l, seed=3)
+        tok = jnp.asarray(
+            rng.integers(0, cfg_l.vocab, (2, 8 * n_dev)), jnp.int32)
+        l_local = forward(params, tok, cfg_l)
+        l_sp = forward(params, tok, cfg_sp)
+        np.testing.assert_allclose(np.asarray(l_sp), np.asarray(l_local),
+                                   rtol=2e-4, atol=2e-4)
 
     def test_negative_window_rejected(self):
         import pytest
