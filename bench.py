@@ -365,9 +365,21 @@ def config_attention():
     q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
     dt = _scan_timed(flash_attention, q, k, v)
     tflops = 4.0 * s * s * h * d / dt / 1e12  # QK^T + PV
-    return {"metric": "flash_attention_tflops", "value": round(tflops, 2),
-            "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
-            "oracle_max_err": round(err, 6), "oracle_ok": err < 0.02}
+    out = {"metric": "flash_attention_tflops", "value": round(tflops, 2),
+           "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
+           "oracle_max_err": round(err, 6), "oracle_ok": err < 0.02}
+    w = _sized("BENCH_ATTN_WINDOW", 1024)
+    if w:  # sliding-window speedup: out-of-band blocks skip their compute
+        dt_w = _scan_timed(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, window=w),
+            q, k, v)
+        dt_c = _scan_timed(
+            lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        out.update(window=w,
+                   window_speedup_vs_causal=round(dt_c / dt_w, 2),
+                   causal_ms=round(dt_c * 1e3, 2),
+                   window_ms=round(dt_w * 1e3, 2))
+    return out
 
 
 def config_sparse():
