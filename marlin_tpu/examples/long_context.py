@@ -11,7 +11,11 @@ input, checks them against each other, and reports per-device memory vs the
 monolithic S x S logits a naive attention would need.
 
 Usage:
-  python -m marlin_tpu.examples.long_context [seq] [heads] [head_dim]
+  python -m marlin_tpu.examples.long_context [seq] [heads] [head_dim] [window]
+
+With a window, the ring engine runs hop-bounded (only the stripes that can
+intersect the band rotate), so its time drops with the window while the
+full-sequence engines' does not.
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ def main(argv=None) -> int:
     seq = int(argv[0]) if len(argv) > 0 else 4096
     heads = int(argv[1]) if len(argv) > 1 else 8
     head_dim = int(argv[2]) if len(argv) > 2 else 64
+    window = int(argv[3]) if len(argv) > 3 else 0
 
     import marlin_tpu as mt
     from marlin_tpu.parallel.ulysses import sequence_parallel_attention
@@ -55,7 +60,7 @@ def main(argv=None) -> int:
     for strategy in ("ring", "all_to_all"):
         fn = jax.jit(
             lambda q, k, v, s=strategy: sequence_parallel_attention(
-                q, k, v, causal=True, strategy=s
+                q, k, v, causal=True, strategy=s, window=window
             )
         )
         out = fn(q, k, v)
@@ -65,8 +70,11 @@ def main(argv=None) -> int:
         fence(out)
         dt = time.perf_counter() - t0
         results[strategy] = (np.asarray(out), dt)
+        hopnote = " (hop-bounded)" if strategy == "ring" else ""
+        extra = f", window {window}{hopnote}" if window else ""
         print(f"{strategy:>10}: {dt * 1e3:8.2f} ms  "
-              f"(seq {seq} sharded {n_dev}-way, {seq // n_dev} rows/device)")
+              f"(seq {seq} sharded {n_dev}-way, {seq // n_dev} rows/device"
+              f"{extra})")
 
     a, b = results["ring"][0], results["all_to_all"][0]
     err = float(np.max(np.abs(a - b)) / max(np.max(np.abs(b)), 1e-30))
