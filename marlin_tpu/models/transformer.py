@@ -331,11 +331,15 @@ def make_train_step(cfg: TransformerConfig, optimizer):
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
-    """Per-layer K/V buffers at the static (B, max_len, Hk, Dh) extent —
-    with GQA the cache (the HBM cost that bounds decode batch x context)
-    shrinks by n_heads / n_kv_heads."""
+    """Per-layer K/V buffers at the static (B, cache_len, Hk, Dh) extent.
+    GQA shrinks the head axis by n_heads / n_kv_heads; a sliding window
+    shrinks the length axis to min(window, max_len) — the cache becomes a
+    RING BUFFER (slot = position mod cache_len) since banded attention
+    never reads keys older than the window. Together these bound the HBM
+    cost that limits decode batch x context."""
     dh = cfg.d_model // cfg.n_heads
-    shape = (batch, cfg.max_len, cfg.kv_heads, dh)
+    cache_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
+    shape = (batch, cache_len, cfg.kv_heads, dh)
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.n_layers)
@@ -343,20 +347,29 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
 
 
 def _attend_cached(q, ck, cv, pos, window=0):
-    """One query position against a padded cache: q (H, Dh), ck/cv
-    (T, Hk, Dh) with Hk dividing H (GQA: q-head group g reads K/V head g);
-    positions > pos masked out, and positions <= pos - window with a
-    sliding window. f32 softmax (the framework's accumulate->=f32
+    """One query position against the cache: q (H, Dh), ck/cv (T, Hk, Dh)
+    with Hk dividing H (GQA: q-head group g reads K/V head g). Without a
+    window, T = max_len and slot index == absolute position (slots > pos
+    masked). With a window the cache is a RING (T = min(window, max_len)):
+    slot s holds absolute position base + s (for s <= pos mod T) or
+    base - T + s (else), where base = pos - pos mod T; unfilled slots
+    (negative positions) are masked, and the band bound is implied by
+    T <= window. f32 softmax (the framework's accumulate->=f32
     convention)."""
     h, dh = q.shape
     hk = ck.shape[1]
     qg = q.reshape(hk, h // hk, dh).astype(jnp.float32)  # (Hk, G, Dh)
     logits = jnp.einsum(
         "kgd,tkd->kgt", qg, ck.astype(jnp.float32)) / np.sqrt(dh)
-    t_pos = jnp.arange(ck.shape[0])
-    mask = t_pos <= pos  # (T,)
+    t = ck.shape[0]
+    slots = jnp.arange(t)
     if window:
-        mask = jnp.logical_and(mask, t_pos > pos - window)
+        base = pos - pos % t
+        abs_pos = jnp.where(slots <= pos % t, base + slots,
+                            base - t + slots)
+        mask = abs_pos >= 0  # filled; abs_pos in (pos - T, pos] by design
+    else:
+        mask = slots <= pos
     logits = jnp.where(mask[None, None, :], logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("kgt,tkd->kgd", p, cv.astype(jnp.float32))
@@ -365,21 +378,32 @@ def _attend_cached(q, ck, cv, pos, window=0):
 
 def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     """One decode step: tokens (B,) int32 at position ``pos`` -> (logits
-    (B, vocab), updated cache). Writes each layer's K/V at ``pos`` and
-    attends against the cache prefix."""
+    (B, vocab), updated cache). Without a window, writes each layer's K/V
+    at ``pos`` and attends the cache prefix; with a window the cache is a
+    ring (see init_kv_cache) and the write lands at pos mod cache_len."""
     x = params["embed"][tokens]  # (B, D)
     if not cfg.rope:
         x = x + params["pos"][pos]
     positions = (
         jnp.full((x.shape[0],), pos, jnp.int32) if cfg.rope else None
     )
+    expect_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
+    if cache[0]["k"].shape[1] != expect_len:
+        # The window bound is implied by the ring length: a mismatched cache
+        # (e.g. built with a different window) would silently un-band the
+        # attention instead of erroring.
+        raise ValueError(
+            f"cache length {cache[0]['k'].shape[1]} != {expect_len} expected "
+            f"for window={cfg.window}, max_len={cfg.max_len}; build the "
+            "cache with init_kv_cache(cfg, ...)")
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
         q, k, v = _split_qkv(bp, x, cfg, positions=positions)
+        slot = pos % layer["k"].shape[1] if cfg.window else pos
         ck = jax.lax.dynamic_update_slice_in_dim(
-            layer["k"], k[:, None].astype(layer["k"].dtype), pos, axis=1)
+            layer["k"], k[:, None].astype(layer["k"].dtype), slot, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(
-            layer["v"], v[:, None].astype(layer["v"].dtype), pos, axis=1)
+            layer["v"], v[:, None].astype(layer["v"].dtype), slot, axis=1)
         att = jax.vmap(
             functools.partial(_attend_cached, window=cfg.window),
             in_axes=(0, 0, 0, None),
@@ -405,11 +429,24 @@ def prefill(params, tokens, cfg: TransformerConfig):
     x = _embed_prefix(params, tokens, cfg)
     cache = init_kv_cache(cfg, b, dtype=x.dtype)
 
+    cache_len = cache[0]["k"].shape[1]
+    # Ring cache (window): only the last cache_len prompt positions are
+    # retained, each in slot (absolute position) mod cache_len — consecutive
+    # positions land in distinct slots. The dense path keeps the contiguous
+    # slice update (an indexed scatter would be markedly slower on TPU).
+    idx = jnp.arange(max(0, s - cache_len), s)
+    slots = idx % cache_len
     for i, bp in enumerate(params["blocks"]):
         x, k, v = _map_seqs(
             lambda xi: _block(bp, xi, cfg, return_kv=True), x, cfg)
-        cache[i]["k"] = cache[i]["k"].at[:, :s].set(k.astype(cache[i]["k"].dtype))
-        cache[i]["v"] = cache[i]["v"].at[:, :s].set(v.astype(cache[i]["v"].dtype))
+        kd = k.astype(cache[i]["k"].dtype)
+        vd = v.astype(cache[i]["v"].dtype)
+        if cfg.window:
+            cache[i]["k"] = cache[i]["k"].at[:, slots].set(kd[:, idx])
+            cache[i]["v"] = cache[i]["v"].at[:, slots].set(vd[:, idx])
+        else:
+            cache[i]["k"] = cache[i]["k"].at[:, :s].set(kd)
+            cache[i]["v"] = cache[i]["v"].at[:, :s].set(vd)
     x = _layer_norm(params["ln_f"], x)
     return x[:, -1] @ params["embed"].T, cache
 

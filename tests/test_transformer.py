@@ -504,3 +504,32 @@ class TestSlidingWindow:
         for _ in range(5):
             lN, params = step(params, tok, tgt, cfg=cfg)
         assert float(lN) < float(l0)
+
+    def test_ring_cache_is_window_sized(self):
+        from marlin_tpu.models import init_kv_cache
+
+        cache = init_kv_cache(self.WCFG, batch=2)
+        # window 8 << max_len 64: the cache is a ring of 8 slots.
+        assert cache[0]["k"].shape == (2, 8, 2, 16)
+        full = init_kv_cache(self.WCFG._replace(window=0), batch=2)
+        assert full[0]["k"].shape == (2, 64, 2, 16)
+
+    def test_many_ring_wraps_stay_exact(self, rng):
+        # Generate long past several ring wraps (window 8, 40 steps).
+        from marlin_tpu.models import generate
+
+        params = init_params(self.WCFG, seed=5)
+        prompt = jnp.asarray(rng.integers(0, 31, (1, 5)), jnp.int32)
+        got = np.asarray(generate(params, prompt, 40, self.WCFG))
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, 40, self.WCFG))
+
+    def test_mismatched_cache_length_rejected(self, rng):
+        from marlin_tpu.models import decode_step, init_kv_cache
+        import pytest
+
+        params = init_params(self.WCFG, seed=6)
+        full = init_kv_cache(self.WCFG._replace(window=0), batch=1)
+        with pytest.raises(ValueError, match="cache length"):
+            decode_step(params, full, jnp.zeros((1,), jnp.int32),
+                        jnp.int32(0), self.WCFG)
