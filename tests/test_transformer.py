@@ -533,3 +533,17 @@ class TestSlidingWindow:
         with pytest.raises(ValueError, match="cache length"):
             decode_step(params, full, jnp.zeros((1,), jnp.int32),
                         jnp.int32(0), self.WCFG)
+
+    def test_window_gqa_rope_composition_decode(self, rng):
+        # Everything at once: banded attention, grouped KV heads, rotary
+        # positions, ring cache — greedy decode must stay reforward-exact.
+        from marlin_tpu.models import generate, init_kv_cache
+
+        cfg = self.WCFG._replace(n_kv_heads=1)
+        params = init_params(cfg, seed=7)
+        cache = init_kv_cache(cfg, batch=1)
+        assert cache[0]["k"].shape == (1, 8, 1, 16)  # ring x MQA shrink
+        prompt = jnp.asarray(rng.integers(0, 31, (2, 6)), jnp.int32)
+        got = np.asarray(generate(params, prompt, 14, cfg))
+        np.testing.assert_array_equal(
+            got, _greedy_reforward(params, prompt, 14, cfg))
