@@ -16,13 +16,24 @@ CFG = TransformerConfig(vocab=31, d_model=32, n_heads=2, n_layers=2,
                         d_ff=64, max_len=64)
 
 
+_reforward_jit = jax.jit(forward, static_argnames="cfg")
+
+
 def _greedy_reforward(params, prompt, steps, cfg):
     """Oracle for generate(): grow the sequence one token at a time through
-    the full causal forward (no cache), argmax of the last position."""
+    the full causal forward (no cache), argmax of the last position. The
+    sequence is zero-padded to a FIXED length so every step reuses one
+    compiled shape (causality makes the trailing padding inert for the
+    read position) — a growing shape would recompile per step."""
     seq = np.asarray(prompt)
+    b = seq.shape[0]
+    total = prompt.shape[1] + steps
     for _ in range(steps):
-        logits = forward(params, jnp.asarray(seq, jnp.int32), cfg)
-        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        cur = seq.shape[1]
+        padded = np.zeros((b, total), np.int32)
+        padded[:, :cur] = seq
+        logits = _reforward_jit(params, jnp.asarray(padded), cfg=cfg)
+        nxt = np.asarray(jnp.argmax(logits[:, cur - 1], axis=-1))
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
     return seq[:, prompt.shape[1]:]
 
