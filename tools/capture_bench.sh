@@ -7,7 +7,7 @@
 set -u
 OUT=${1:-docs/bench_captures/capture_$(date +%Y%m%d_%H%M).jsonl}
 shift 2>/dev/null || true
-CONFIGS=${*:-headline square8k tallskinny chained summa attention sparse sparsedist lu cholesky inverse svd transformer}
+CONFIGS=${*:-headline square8k tallskinny chained summa attention sparse sparsedist spmm lu cholesky inverse svd transformer decode}
 for cfg in $CONFIGS; do
   echo "=== $cfg ===" >&2
   BENCH_WATCHDOG=${BENCH_WATCHDOG:-1500} \
