@@ -457,19 +457,41 @@ def prefill(params, tokens, cfg: TransformerConfig):
 _prefill_jit = functools.partial(jax.jit, static_argnames=("cfg",))(prefill)
 
 
-def _sample(logits, temperature, key):
+def _sample(logits, temperature, key, top_k=0, top_p=0.0):
+    """Greedy (temperature <= 0) or categorical sampling with optional
+    top-k and nucleus (top-p) truncation; both truncations are applied as
+    -inf masks before the draw (k and p are static)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits.astype(jnp.float32) / temperature, axis=-1
-    ).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / temperature
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+    if top_k > 0 and top_k < lg.shape[-1]:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, neg, lg)
+    if 0.0 < top_p < 1.0:
+        # Keep the smallest prefix of the sorted distribution whose mass
+        # reaches top_p (the first token always survives).
+        srt = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        exceeded = jnp.cumsum(probs, axis=-1) - probs >= top_p
+        cutoff = jnp.min(jnp.where(exceeded, jnp.inf, srt), axis=-1,
+                         keepdims=True)
+        lg = jnp.where(lg < cutoff, neg, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+# Jitted first-token sampler for generate(): truncation is ~9 eager ops,
+# each a tunnel RTT if dispatched one by one (same rationale as _prefill_jit).
+_sample_jit = functools.partial(
+    jax.jit, static_argnames=("temperature", "top_k", "top_p"))(_sample)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "temperature")
+    jax.jit,
+    static_argnames=("cfg", "steps", "temperature", "top_k", "top_p"),
 )
 def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
-                 steps: int, temperature: float):
+                 steps: int, temperature: float, top_k: int, top_p: float):
     """The jitted decode loop, module-level so the compile caches across
     ``generate`` calls (a fresh ``jit(lambda)`` per call would recompile the
     whole scan every time and bake params in as constants)."""
@@ -478,7 +500,7 @@ def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
         tok, pos, cache, key = carry
         key, ks = jax.random.split(key)
         logits, cache = decode_step(params, cache, tok, pos, cfg)
-        nxt = _sample(logits, temperature, ks)
+        nxt = _sample(logits, temperature, ks, top_k, top_p)
         return (nxt, pos + 1, cache, key), tok
 
     _, toks = jax.lax.scan(
@@ -547,12 +569,14 @@ def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):
 
 
 def generate(params, prompt, steps: int, cfg: TransformerConfig,
-             temperature: float = 0.0, seed: int = 0):
+             temperature: float = 0.0, seed: int = 0,
+             top_k: int = 0, top_p: float = 0.0):
     """Autoregressive generation: prompt (B, S) int32 -> (B, steps) int32.
 
     Prefill primes the cache in one forward; the decode loop is a single
     jitted ``lax.scan`` dispatch (temperature 0 = greedy, else categorical
-    sampling). S + steps must fit ``cfg.max_len``.
+    sampling, optionally truncated to the ``top_k`` most likely tokens
+    and/or the ``top_p`` nucleus). S + steps must fit ``cfg.max_len``.
 
     Dense configs are oracle-exact against the full ``forward``; with
     ``n_experts`` > 0 the routing batches differ between decode (B
@@ -566,7 +590,9 @@ def generate(params, prompt, steps: int, cfg: TransformerConfig,
     logits, cache = _prefill_jit(params, prompt, cfg=cfg)
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
-    first = _sample(logits, temperature, k0)
+    first = _sample_jit(logits, float(temperature), k0, top_k=int(top_k),
+                        top_p=float(top_p))
     toks = _decode_scan(params, first, jnp.int32(s), cache, key, cfg,
-                        int(steps), float(temperature))
+                        int(steps), float(temperature), int(top_k),
+                        float(top_p))
     return jnp.moveaxis(toks, 0, 1)  # (steps, B) -> (B, steps)

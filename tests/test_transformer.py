@@ -558,3 +558,81 @@ class TestSlidingWindow:
         got = np.asarray(generate(params, prompt, 14, cfg))
         np.testing.assert_array_equal(
             got, _greedy_reforward(params, prompt, 14, cfg))
+
+
+class TestSamplingTruncation:
+    def test_top_k_one_equals_greedy(self, rng):
+        from marlin_tpu.models import generate
+
+        params = init_params(CFG, seed=8)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 5)), jnp.int32)
+        greedy = np.asarray(generate(params, prompt, 6, CFG))
+        topk1 = np.asarray(generate(params, prompt, 6, CFG, temperature=1.0,
+                                    top_k=1, seed=9))
+        np.testing.assert_array_equal(topk1, greedy)
+
+    def test_tiny_nucleus_equals_greedy(self, rng):
+        from marlin_tpu.models import generate
+
+        params = init_params(CFG, seed=8)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 5)), jnp.int32)
+        greedy = np.asarray(generate(params, prompt, 6, CFG))
+        nucleus = np.asarray(generate(params, prompt, 6, CFG, temperature=1.0,
+                                      top_p=1e-9, seed=9))
+        np.testing.assert_array_equal(nucleus, greedy)
+
+    def test_no_truncation_matches_plain_sampling(self, rng):
+        from marlin_tpu.models import generate
+
+        params = init_params(CFG, seed=8)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (1, 5)), jnp.int32)
+        plain = np.asarray(generate(params, prompt, 8, CFG, temperature=0.9,
+                                    seed=4))
+        full_k = np.asarray(generate(params, prompt, 8, CFG, temperature=0.9,
+                                     seed=4, top_k=CFG.vocab, top_p=1.0))
+        np.testing.assert_array_equal(plain, full_k)
+
+    def test_truncated_sampling_deterministic(self, rng):
+        from marlin_tpu.models import generate
+
+        params = init_params(CFG, seed=8)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 4)), jnp.int32)
+        a = np.asarray(generate(params, prompt, 5, CFG, temperature=0.8,
+                                top_k=5, top_p=0.9, seed=3))
+        b = np.asarray(generate(params, prompt, 5, CFG, temperature=0.8,
+                                top_k=5, top_p=0.9, seed=3))
+        np.testing.assert_array_equal(a, b)
+        assert a.min() >= 0 and a.max() < CFG.vocab
+
+    def test_truncation_masks_exactly(self, rng):
+        # Direct unit test with crafted logits: only the k most likely /
+        # the nucleus prefix may ever be drawn.
+        from marlin_tpu.models.transformer import _sample
+
+        logits = jnp.asarray([[5.0, 4.0, 3.0, 0.0, -1.0, -2.0]] * 4)
+        draws = set()
+        for i in range(60):
+            t = _sample(logits, 5.0, jax.random.PRNGKey(i), top_k=3)
+            draws.update(np.asarray(t).tolist())
+        assert draws <= {0, 1, 2}, draws
+        assert len(draws) > 1  # flat-ish temperature: not collapsed to argmax
+
+        # Nucleus: probs ~ (0.5, 0.25, 0.12, ...); top_p=0.6 keeps {0, 1}.
+        logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.125, 0.0625, 0.0625]] * 4))
+        draws = set()
+        for i in range(60):
+            t = _sample(logits, 1.0, jax.random.PRNGKey(i), top_p=0.6)
+            draws.update(np.asarray(t).tolist())
+        assert draws <= {0, 1}, draws
+        assert len(draws) == 2
+
+    def test_negative_top_k_is_noop(self, rng):
+        from marlin_tpu.models import generate
+
+        params = init_params(CFG, seed=8)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab, (1, 4)), jnp.int32)
+        plain = np.asarray(generate(params, prompt, 5, CFG, temperature=0.9,
+                                    seed=2))
+        negk = np.asarray(generate(params, prompt, 5, CFG, temperature=0.9,
+                                   seed=2, top_k=-1))
+        np.testing.assert_array_equal(plain, negk)
