@@ -1,0 +1,61 @@
+"""utils.fn_cache: compiled-program caching ridden on the user callable."""
+
+from marlin_tpu.utils.fn_cache import cached_on
+
+
+def test_memoizes_per_callable_and_key():
+    def f(x):
+        return x
+
+    calls = []
+
+    def build():
+        calls.append(1)
+        return object()
+
+    a = cached_on(f, ("ns", 1), build)
+    b = cached_on(f, ("ns", 1), build)
+    assert a is b and len(calls) == 1
+    c = cached_on(f, ("ns", 2), build)
+    assert c is not a and len(calls) == 2
+
+
+def test_namespaces_share_one_dict_without_collision():
+    def f(x):
+        return x
+
+    a = cached_on(f, ("ep", 4), lambda: "expert")
+    b = cached_on(f, ("pp", 4), lambda: "pipeline")
+    assert (a, b) == ("expert", "pipeline")
+    assert set(f._marlin_compiled) == {("ep", 4), ("pp", 4)}
+
+
+def test_cache_dies_with_the_callable():
+    import gc
+    import weakref
+
+    def make():
+        def f(x):
+            return x
+        return f
+
+    f = make()
+    token = object()
+    cached_on(f, ("k",), lambda: token)
+    ref = weakref.ref(f)
+    del f
+    gc.collect()
+    assert ref() is None  # nothing pins the callable (or its closure)
+
+
+def test_no_dict_callables_fall_back_to_uncached():
+    calls = []
+
+    def build():
+        calls.append(1)
+        return len(calls)
+
+    # Bound methods have no __dict__ to ride (partials do in CPython).
+    m = ("x").__len__
+    assert cached_on(m, ("k",), build) == 1
+    assert cached_on(m, ("k",), build) == 2  # rebuilt: no __dict__ to ride
