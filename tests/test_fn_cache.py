@@ -59,3 +59,27 @@ def test_no_dict_callables_fall_back_to_uncached():
     m = ("x").__len__
     assert cached_on(m, ("k",), build) == 1
     assert cached_on(m, ("k",), build) == 2  # rebuilt: no __dict__ to ride
+
+
+class TestHwDetection:
+    """utils.hw.is_tpu: the axon platform string must not defeat detection
+    (the bug that once ran the flash kernel in interpret mode ON the TPU)."""
+
+    class _Dev:
+        def __init__(self, platform, kind):
+            self.platform = platform
+            self.device_kind = kind
+
+    def test_axon_platform_with_tpu_kind_detected(self):
+        from marlin_tpu.utils.hw import is_tpu
+
+        assert is_tpu(self._Dev("axon", "TPU v5 lite"))
+        assert is_tpu(self._Dev("tpu", "TPU v4"))
+        assert not is_tpu(self._Dev("cpu", "cpu"))
+        assert not is_tpu(self._Dev("gpu", "NVIDIA H100"))
+
+    def test_default_device_path(self):
+        # On the CPU test mesh the default device is not a TPU.
+        from marlin_tpu.utils.hw import is_tpu
+
+        assert is_tpu() is False
