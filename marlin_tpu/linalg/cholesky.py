@@ -19,7 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..config import get_config
+from ..config import get_config, linalg_precision_scope
 from .lu import _resolve_mode
 
 
@@ -32,7 +32,8 @@ def cholesky_factor_array(a: jax.Array, mode: str = "auto", base_size: int = Non
         )
     base = base_size or cfg.cholesky_base_size
     if _resolve_mode(mode, n) == "local" or base >= n:
-        return jnp.linalg.cholesky(a)
+        with linalg_precision_scope():
+            return jnp.linalg.cholesky(a)
     return _cholesky_blocked(a, base)
 
 
@@ -43,14 +44,13 @@ def _cholesky_blocked(a: jax.Array, base: int) -> jax.Array:
     npad = -(-n // base) * base
     if npad != n:
         a = _pad_identity(a, npad)
-    l = _cholesky_blocked_core(
-        a, base=base, prec=get_config().matmul_precision
-    )
+    with linalg_precision_scope():
+        l = _cholesky_blocked_core(a, base=base)
     return l[:n, :n] if npad != n else l
 
 
-@functools.partial(jax.jit, static_argnames=("base", "prec"))
-def _cholesky_blocked_core(a: jax.Array, *, base: int, prec) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("base",))
+def _cholesky_blocked_core(a: jax.Array, *, base: int) -> jax.Array:
     """Right-looking blocked Cholesky as one XLA program."""
     n = a.shape[0]
     idx = jnp.arange(n)
@@ -75,7 +75,8 @@ def _cholesky_blocked_core(a: jax.Array, *, base: int, prec) -> jax.Array:
         # shuffle-based trailing update). The mask zeroes non-trailing rows,
         # so the product only touches the trailing block.
         lm = jnp.where(trailing[:, None], cstripe, 0)
-        return a - jnp.dot(lm, lm.T, precision=prec)
+        # Ambient precision (traced under linalg_precision_scope).
+        return a - jnp.dot(lm, lm.T)
 
     a = jax.lax.fori_loop(0, n // base, body, a)
     # Zero the (stale) upper triangle so the result is exactly L.

@@ -12,9 +12,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ..config import get_config
+from ..config import linalg_precision_scope
 from .lu import _resolve_mode, lu_factor_array
 
 
@@ -25,16 +24,20 @@ def inverse(a: jax.Array, mesh=None, mode: str = "auto") -> jax.Array:
             f"Inversion only support square matrix: {a.shape[0]} v.s {a.shape[1]}"
         )
     if _resolve_mode(mode, n) == "local":
-        return jnp.linalg.inv(a)
+        with linalg_precision_scope():
+            return jnp.linalg.inv(a)
     packed, perm = lu_factor_array(a, mode="dist")
     # A[perm] = P A = L U  =>  A^-1 = U^-1 (L^-1 P); P = I[perm, :] as a gather.
     eye_p = jnp.eye(n, dtype=a.dtype)[perm, :]
-    # Forward sweep: Y = unit_lower(L)^-1 P.
-    y = jax.lax.linalg.triangular_solve(
-        packed, eye_p, left_side=True, lower=True, unit_diagonal=True
-    )
-    # Backward sweep: X = U^-1 Y (the reference's second block sweep,
-    # DenseVecMatrix.scala:677-760).
-    return jax.lax.linalg.triangular_solve(
-        packed, y, left_side=True, lower=False
-    )
+    # Full-precision solves (the triangular_solve lowering's internal
+    # matmuls follow the ambient default; see config.linalg_precision).
+    with linalg_precision_scope():
+        # Forward sweep: Y = unit_lower(L)^-1 P.
+        y = jax.lax.linalg.triangular_solve(
+            packed, eye_p, left_side=True, lower=True, unit_diagonal=True
+        )
+        # Backward sweep: X = U^-1 Y (the reference's second block sweep,
+        # DenseVecMatrix.scala:677-760).
+        return jax.lax.linalg.triangular_solve(
+            packed, y, left_side=True, lower=False
+        )

@@ -280,9 +280,17 @@ def _lanczos_sweep_device(
         jnp.zeros((), jnp.bool_),
     )
     check_from = max(2 * k, k + 2)
+    from ..config import linalg_precision_scope
+
     m, exact = 0, False
     while True:
-        carry = chunk(carry)
+        # The scope governs the chunk's trace (first call) and caches by
+        # ambient precision: the reorthogonalization dots (q w, L L^T w,
+        # Q^T Q w) must not run as bf16 passes when the global GEMM
+        # precision is relaxed — orthogonality loss in the Krylov basis
+        # produces spurious Ritz values.
+        with linalg_precision_scope():
+            carry = chunk(carry)
         # Small fetches only: the (m,) recurrence scalars + flags.
         j_dev = int(carry[4])
         done = bool(carry[5])

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import get_config
+from ..config import get_config, linalg_precision_scope
 
 
 def _resolve_mode(mode: str, n: int, dist_threshold: int = 6000) -> str:
@@ -66,7 +66,8 @@ def lu_factor_array(a: jax.Array, mode: str = "auto", base_size: int = None):
         )
     base = base_size or cfg.lu_base_size
     if _resolve_mode(mode, n) == "local" or base >= n:
-        packed, _, perm = jax.lax.linalg.lu(a)
+        with linalg_precision_scope():
+            packed, _, perm = jax.lax.linalg.lu(a)
         return packed, np.asarray(jax.device_get(perm))
     return _lu_blocked(a, base)
 
@@ -86,9 +87,8 @@ def _lu_blocked(a: jax.Array, base: int) -> Tuple[jax.Array, np.ndarray]:
     n = a.shape[0]
     npad = -(-n // base) * base
     ap = _pad_identity(a, npad) if npad != n else a
-    packed, perm = _lu_blocked_core(
-        ap, base=base, prec=get_config().matmul_precision
-    )
+    with linalg_precision_scope():
+        packed, perm = _lu_blocked_core(ap, base=base)
     if npad != n:
         packed, perm = packed[:n, :n], perm[:n]
     # Pivoting is local to the diagonal block (the reference's semantics —
@@ -103,12 +103,13 @@ def _lu_blocked(a: jax.Array, base: int) -> Tuple[jax.Array, np.ndarray]:
     scale = float(jnp.max(jnp.abs(a)))
     growth = float(jnp.max(jnp.abs(packed))) / max(scale, 1e-30)
     if not finite or growth > 100.0 * np.sqrt(n):
-        packed, _, perm = jax.lax.linalg.lu(a)
+        with linalg_precision_scope():
+            packed, _, perm = jax.lax.linalg.lu(a)
     return packed, np.asarray(jax.device_get(perm))
 
 
-@functools.partial(jax.jit, static_argnames=("base", "prec"))
-def _lu_blocked_core(a: jax.Array, *, base: int, prec) -> Tuple[jax.Array, jax.Array]:
+@functools.partial(jax.jit, static_argnames=("base",))
+def _lu_blocked_core(a: jax.Array, *, base: int) -> Tuple[jax.Array, jax.Array]:
     """Right-looking blocked LU as one XLA program (see module docstring)."""
     n = a.shape[0]
     idx = jnp.arange(n)
@@ -144,7 +145,9 @@ def _lu_blocked_core(a: jax.Array, *, base: int, prec) -> Tuple[jax.Array, jax.A
         # Schur complement A22 -= L21 @ U12 as one masked sharded GEMM.
         lm = jnp.where(trailing_row[:, None], cstripe, 0)
         um = jnp.where(trailing_col[None, :], rows, 0)
-        a = a - jnp.dot(lm, um, precision=prec)
+        # Ambient precision: callers trace this under linalg_precision_scope,
+        # so the Schur GEMM and the solves share one precision source.
+        a = a - jnp.dot(lm, um)
         # Compose the panel's local permutation into the global pivot array.
         pseg = jax.lax.dynamic_slice(perm, (j0,), (base,))
         perm = jax.lax.dynamic_update_slice(perm, pseg[pp], (j0,))

@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..config import linalg_precision_scope
 from .cholesky import cholesky_factor_array
 from .lu import _resolve_mode, lu_factor_array
 
@@ -34,23 +35,28 @@ def solve(a: jax.Array, b: jax.Array, mode: str = "auto",
 
     if assume_spd:
         l = cholesky_factor_array(a, mode=mode)
-        y = jax.lax.linalg.triangular_solve(
-            l, bm.astype(l.dtype), left_side=True, lower=True
-        )
-        x = jax.lax.linalg.triangular_solve(
-            l, y, left_side=True, lower=True, transpose_a=True
-        )
+        with linalg_precision_scope():
+            y = jax.lax.linalg.triangular_solve(
+                l, bm.astype(l.dtype), left_side=True, lower=True
+            )
+            x = jax.lax.linalg.triangular_solve(
+                l, y, left_side=True, lower=True, transpose_a=True
+            )
         return x[:, 0] if vec else x
 
     if _resolve_mode(mode, n) == "local":
-        x = jnp.linalg.solve(a, bm)
+        with linalg_precision_scope():
+            x = jnp.linalg.solve(a, bm)
         return x[:, 0] if vec else x
 
     packed, perm = lu_factor_array(a, mode="dist")
     # A[perm] = L U  =>  X = U^-1 L^-1 B[perm].
     bp = bm[jnp.asarray(perm)].astype(packed.dtype)
-    y = jax.lax.linalg.triangular_solve(
-        packed, bp, left_side=True, lower=True, unit_diagonal=True
-    )
-    x = jax.lax.linalg.triangular_solve(packed, y, left_side=True, lower=False)
+    with linalg_precision_scope():
+        y = jax.lax.linalg.triangular_solve(
+            packed, bp, left_side=True, lower=True, unit_diagonal=True
+        )
+        x = jax.lax.linalg.triangular_solve(
+            packed, y, left_side=True, lower=False
+        )
     return x[:, 0] if vec else x

@@ -95,7 +95,15 @@ def compute_svd(
 
     u = None
     if compute_u:
-        # N = V Sigma^-1 ; U = A N — the broadcast GEMM arm (:1633-1648).
+        # N = V Sigma^-1 ; U = A N — the broadcast GEMM arm (:1633-1648),
+        # pinned to linalg_precision: a relaxed global matmul_precision must
+        # not hand back bf16-pass left singular vectors next to full-
+        # precision sigmas.
+        from ..config import get_config
+
         nmat = v / s[None, :]
-        u = mat.multiply(np.asarray(nmat, dtype=np.float64))
+        u = mat._multiply_broadcast(
+            np.asarray(nmat, dtype=np.float64),
+            precision=get_config().linalg_precision,
+        )
     return SVDResult(u, s, v)

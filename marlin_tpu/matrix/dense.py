@@ -182,18 +182,22 @@ class DenseVecMatrix(DistributedMatrix):
             )
         return BlockMatrix(out, mesh=self.mesh)
 
-    def _multiply_broadcast(self, b: jax.Array) -> "DenseVecMatrix":
+    def _multiply_broadcast(
+        self, b: jax.Array, precision: str = None
+    ) -> "DenseVecMatrix":
         """Broadcast-B GEMM (DenseVecMatrix.scala:1660-1680): B replicated on
         every device; each row stripe does one local matmul. No inter-device
         communication at all — the TPU analogue of broadcast + per-partition
-        DGEMM. Runs on the physical array (pad rows are zero and stay zero)."""
+        DGEMM. Runs on the physical array (pad rows are zero and stay zero).
+        ``precision`` overrides the global matmul_precision (the SVD's
+        U-recovery GEMM pins linalg_precision through this)."""
         cfg = get_config()
         if b.ndim != 2 or b.shape[0] != self.num_cols:
             raise ValueError(f"dimension mismatch: {self.shape} x {b.shape}")
         b = jax.device_put(
             jnp.asarray(b, dtype=self.dtype), replicated_sharding(self.mesh)
         )
-        f = _broadcast_matmul_fn(self.mesh, cfg.matmul_precision)
+        f = _broadcast_matmul_fn(self.mesh, precision or cfg.matmul_precision)
         out = f(self._data, b)
         return DenseVecMatrix(
             out, mesh=self.mesh, _logical_shape=(self.num_rows, int(b.shape[1]))
@@ -308,7 +312,7 @@ class DenseVecMatrix(DistributedMatrix):
         reference broadcasts v and tree-aggregates per-row axpys; here it is two
         sharded mat-vecs and a device_get. Pad rows are zero, so the physical
         array is safe to contract."""
-        f = _gramian_matvec_fn(self.mesh, get_config().matmul_precision)
+        f = _gramian_matvec_fn(self.mesh, get_config().linalg_precision)
         return np.asarray(jax.device_get(f(self._data, jnp.asarray(v, self.dtype))))
 
     def gramian_matvec_operator(self):
@@ -319,7 +323,7 @@ class DenseVecMatrix(DistributedMatrix):
         Cached per instance so the sweep's compiled-chunk cache hits."""
         op = getattr(self, "_gramian_op", None)
         if op is None:
-            f = _gramian_matvec_fn(self.mesh, get_config().matmul_precision)
+            f = _gramian_matvec_fn(self.mesh, get_config().linalg_precision)
             data = self._data
 
             def op(v):
@@ -333,7 +337,9 @@ class DenseVecMatrix(DistributedMatrix):
         DenseVecMatrix.scala:1464-1484; the per-row dspr accumulation becomes a
         single sharded matmul reduced over the row stripes)."""
         cfg = get_config()
-        g = jnp.dot(self._data.T, self._data, precision=cfg.matmul_precision)
+        # linalg_precision, not matmul_precision: the Gramian feeds the SVD
+        # (LAPACK-parity surface); bf16 passes shift the spectrum.
+        g = jnp.dot(self._data.T, self._data, precision=cfg.linalg_precision)
         return np.asarray(jax.device_get(g))
 
     def compute_svd(
