@@ -493,9 +493,20 @@ def config_sparse_dist():
 def _xla_ref(out: dict, label: str, fn, our_dt: float) -> dict:
     """Attach the raw-XLA reference timing to a config line, defensively:
     the baseline's own failure (e.g. XLA's LuDecompositionBlock scoped-vmem
-    bug at 16k on v5e) must not discard OUR measurement."""
+    bug at 16k on v5e) must not discard OUR measurement.
+
+    The reference runs under linalg_precision_scope, same as our op: an
+    ambient-default baseline would run its f32 matmuls as bf16 passes —
+    ~2x faster AND failing the very reconstruction bar our op is held to
+    (apples-to-oranges; observed cholesky 0.08s ambient vs 0.45s ours)."""
+    from marlin_tpu.config import linalg_precision_scope
+
+    def scoped():
+        with linalg_precision_scope():
+            return fn()
+
     try:
-        dt_xla = _timed(fn, iters=2)
+        dt_xla = _timed(scoped, iters=2)
         out.update(vs_baseline=round(dt_xla / our_dt, 3),
                    **{f"xla_{label}_seconds": round(dt_xla, 4)})
     except Exception as e:  # noqa: BLE001

@@ -34,6 +34,7 @@ Two sweep engines share that control structure:
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Tuple
 
 import numpy as np
@@ -200,6 +201,23 @@ def _lanczos_run(
     return evals, evecs, exact
 
 
+def _operator_protocol(matvec_jax):
+    """(apply, operand) when matvec_jax implements the operand protocol,
+    (None, ()) for a plain closure matvec. Half an implementation is a
+    loud error: .apply without .operand would crash deep inside the chunk
+    trace; .operand without .apply would silently fall back to closure
+    capture — the GB-scale XLA-constant compile stall the protocol exists
+    to prevent."""
+    apply = getattr(matvec_jax, "apply", None)
+    has_operand = hasattr(matvec_jax, "operand")
+    if (apply is not None) != has_operand:
+        raise TypeError(
+            "operator protocol requires BOTH .apply and .operand "
+            f"(got apply={apply is not None}, operand={has_operand})"
+        )
+    return (apply, matvec_jax.operand) if apply is not None else (None, ())
+
+
 def _device_chunk_fn(matvec_jax, m_cap: int, l_cols: int, n: int, dtype):
     """Jitted chunk: run _DEVICE_CHUNK Lanczos steps entirely on device.
 
@@ -207,14 +225,27 @@ def _device_chunk_fn(matvec_jax, m_cap: int, l_cols: int, n: int, dtype):
     dynamic_slice_in_dim on axis 0), alphas/betas (m_cap,), j, done. Rows
     past j are zero, so full reorthogonalization is a fixed-shape
     Q^T (Q w) — masked by construction, no dynamic shapes anywhere.
+
+    Operator protocol: a bare ``matvec_jax`` is traced as a closure — fine
+    for small operators, but any device array it captures becomes an XLA
+    CONSTANT of the chunk program, and at Gramian scale the compiler's
+    host-side constant handling explodes (observed on v5e at 200k x 2048:
+    the 1.6 GB captured operand drove compile past 25 min and 11 GB of
+    host RSS, where the same matvec as a top-level jit ARGUMENT runs in
+    ms). An operator exposing ``.apply(operand, v)`` + ``.operand`` gets
+    its operand threaded through the jitted chunk as a runtime argument
+    instead (dense.gramian_matvec_operator does).
     """
     import jax
     import jax.numpy as jnp
 
-    def step(carry):
+    apply, _ = _operator_protocol(matvec_jax)
+
+    def step(operand, carry):
         Q, alphas, betas, L, j, done = carry
         qj = jax.lax.dynamic_slice_in_dim(Q, j, 1, 0)[0]
-        w = matvec_jax(qj).astype(dtype)
+        w = (apply(operand, qj) if apply is not None
+             else matvec_jax(qj)).astype(dtype)
         a_j = qj @ w
         jm1 = jnp.maximum(j - 1, 0)
         qprev = jax.lax.dynamic_slice_in_dim(Q, jm1, 1, 0)[0]
@@ -236,11 +267,14 @@ def _device_chunk_fn(matvec_jax, m_cap: int, l_cols: int, n: int, dtype):
         Q = jax.lax.dynamic_update_slice_in_dim(Q, qnext[None], j + 1, 0)
         return Q, alphas, betas, L, j + 1, done | breakdown
 
-    def chunk(carry):
+    def chunk(operand, carry):
         def body(_, c):
             Q, alphas, betas, L, j, done = c
             return jax.lax.cond(
-                done | (j >= m_cap), lambda c: c, step, (Q, alphas, betas, L, j, done)
+                done | (j >= m_cap),
+                lambda c: c,
+                functools.partial(step, operand),
+                (Q, alphas, betas, L, j, done),
             )
 
         return jax.lax.fori_loop(0, _DEVICE_CHUNK, body, carry)
@@ -282,6 +316,7 @@ def _lanczos_sweep_device(
     check_from = max(2 * k, k + 2)
     from ..config import linalg_precision_scope
 
+    _, operand = _operator_protocol(matvec_jax)
     m, exact = 0, False
     while True:
         # The scope governs the chunk's trace (first call) and caches by
@@ -290,7 +325,7 @@ def _lanczos_sweep_device(
         # precision is relaxed — orthogonality loss in the Krylov basis
         # produces spurious Ritz values.
         with linalg_precision_scope():
-            carry = chunk(carry)
+            carry = chunk(operand, carry)
         # Small fetches only: the (m,) recurrence scalars + flags.
         j_dev = int(carry[4])
         done = bool(carry[5])

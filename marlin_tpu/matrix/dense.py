@@ -329,6 +329,13 @@ class DenseVecMatrix(DistributedMatrix):
             def op(v):
                 return f(data, v.astype(data.dtype))
 
+            # Operator protocol (lanczos._device_chunk_fn): thread the data
+            # through enclosing jits as an ARGUMENT — a closure capture
+            # becomes an XLA constant there, and constant handling at
+            # Gramian scale (GBs) stalls compilation for tens of minutes.
+            op.apply = lambda a, v: f(a, v.astype(a.dtype))
+            op.operand = data
+
             self._gramian_op = op
         return op
 
