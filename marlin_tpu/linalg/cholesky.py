@@ -3,13 +3,15 @@
 Counterpart of ``DenseVecMatrix.choleskyDecompose`` (DenseVecMatrix.scala:
 475-561): returns the lower-triangular L (A = L L^T) as a BlockMatrix. The
 reference's dist path mirrors its LU driver loop (driver-local ``brzCholesky``
-of the diagonal block + broadcast + distributed Schur update); here the whole
-panel loop is ONE jitted XLA program (``lax.fori_loop`` over panels, like
-``lu._lu_blocked_core``): diagonal-block Cholesky at a dynamic offset, a
+of the diagonal block + broadcast + distributed Schur update); here the dist
+path is a RECURSIVE-HALVING factorization (``_cholesky_recurse``) whose
+solve and Schur GEMM run at the exact trailing size, bottoming out in a
+flat panel sweep compiled as one ``lax.fori_loop`` program
+(``_cholesky_blocked_core``): diagonal-block Cholesky at a dynamic offset, a
 fixed-shape column-stripe triangular solve with an iota mask selecting the
-trailing rows, and the Schur complement as one masked sharded GEMM. Single
-compile, no host round-trips inside the loop. No pivoting (SPD input assumed,
-as in the reference).
+trailing rows, and the Schur complement as one masked sharded GEMM. All
+device work is dispatched asynchronously (no host round-trips). No pivoting
+(SPD input assumed, as in the reference).
 """
 
 from __future__ import annotations
@@ -45,8 +47,47 @@ def _cholesky_blocked(a: jax.Array, base: int) -> jax.Array:
     if npad != n:
         a = _pad_identity(a, npad)
     with linalg_precision_scope():
-        l = _cholesky_blocked_core(a, base=base)
+        l = _cholesky_recurse(a, base)
     return l[:n, :n] if npad != n else l
+
+
+# Below this size the flat panel sweep runs as one program; above it the
+# recursion halves. 4 * base keeps the leaf's masked-GEMM waste bounded
+# (the flat sweep computes n^2*base MACs per panel regardless of trailing
+# size — x3 the minimum over a whole matrix, but only x1.5-ish at 4 panels).
+_RECURSE_LEAF_PANELS = 4
+
+
+def _cholesky_recurse(a: jax.Array, base: int) -> jax.Array:
+    """Recursive-halving blocked Cholesky (host-level recursion, static
+    shapes).
+
+    chol(A) = [[L11, 0], [A21 L11^-T, chol(A22 - L21 L21^T)]] — the solve
+    and the Schur GEMM run at the EXACT trailing size (n/2), so total GEMM
+    work approaches the minimal n^3/3 instead of the flat sweep's n^3 of
+    masked full-shape updates (measured 0.45 s -> target <0.31 s at 16k f32
+    on v5e, where the full-precision flat sweep missed the 3x-of-raw-XLA
+    bar). Only O(log(n/base)) distinct shapes compile — each half reuses
+    the cache — and the host recursion dispatches asynchronously (no
+    device_get anywhere)."""
+    n = a.shape[0]
+    if n <= _RECURSE_LEAF_PANELS * base:
+        return _cholesky_blocked_core(a, base=base)
+    # Split on a panel boundary (round the midpoint down to a base
+    # multiple): n is always a base multiple here, so both halves stay
+    # base-aligned and every size recurses — an odd panel count must not
+    # silently fall back to the O(n^3) flat sweep.
+    h = max(base, (n // (2 * base)) * base)
+    l11 = _cholesky_recurse(a[:h, :h], base)
+    l21 = jax.lax.linalg.triangular_solve(
+        l11, a[h:, :h], left_side=False, lower=True, transpose_a=True
+    )
+    # Ambient precision (called under linalg_precision_scope).
+    a22 = a[h:, h:] - jnp.dot(l21, l21.T)
+    l22 = _cholesky_recurse(a22, base)
+    top = jnp.concatenate([l11, jnp.zeros((h, n - h), a.dtype)], axis=1)
+    bot = jnp.concatenate([l21, l22], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("base",))
