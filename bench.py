@@ -399,6 +399,21 @@ def config_attention():
                    window_speedup_vs_causal=round(dt_c / dt_w, 2),
                    causal_ms=round(dt_c * 1e3, 2),
                    window_ms=round(dt_w * 1e3, 2))
+
+    # Training path: fwd + Pallas flash backward (dQ + dK/dV kernels — no
+    # (S, S) buffer in either direction). 3.5x the fwd MAC count (2 fwd
+    # matmuls + 5 bwd: recomputed logits, dP, dV, dQ, dK).
+    def fwdbwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return dq + dk + dv
+
+    dt_b = _scan_timed(fwdbwd, q, k, v)
+    out.update(fwd_bwd_ms=round(dt_b * 1e3, 2),
+               fwd_bwd_tflops=round(3.5 * 4.0 * s * s * h * d / dt_b / 1e12,
+                                    2))
     return out
 
 

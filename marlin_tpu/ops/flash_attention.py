@@ -38,8 +38,39 @@ _LANES = 128  # TPU lane count: last-dim tiles are always x128
 _LOG2E = float(np.log2(np.e))
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
-            block_q, block_k, kv_len, window):
+def _block_live(i, j, *, causal, block_q, block_k, window):
+    """Block-liveness predicate shared by the forward and both backward
+    kernels: causal skips blocks strictly above the diagonal; a sliding
+    window (implies causal) also skips blocks strictly below the band."""
+    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    if window:  # static; run is a traced bool — combine with logical_and
+        run = jnp.logical_and(
+            run, j * block_k + block_k - 1 > i * block_q - window
+        )
+    return run
+
+
+def _mask_logits(s, i, j, *, causal, block_q, block_k, kv_len, window):
+    """The liveness mask, applied to a logits tile (forward and backward
+    recompute MUST stay in lockstep): padded-tail keys always; causal /
+    window band when configured. Built only when a mask can bite (kv_len
+    and causal are static) — on unpadded non-causal shapes the iota+where
+    would be pure VPU overhead."""
+    has_pad = kv_len % block_k != 0  # static: padded tail block exists
+    if not (causal or has_pad):
+        return s
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = k_pos < kv_len  # padded tail keys contribute nothing
+    if causal:
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = jnp.logical_and(mask, k_pos <= q_pos)
+        if window:
+            mask = jnp.logical_and(mask, k_pos > q_pos - window)
+    return jnp.where(mask, s, _NEG_INF)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
+            causal, block_q, block_k, kv_len, window):
     """One (head, q_block, k_block) grid step of the online-softmax sweep.
 
     VPU economy (measured ~5% on v5e at S=8k): the softmax runs in base 2
@@ -62,19 +93,14 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: skip blocks strictly above the diagonal. Sliding window
-    # (window > 0, implies causal): also skip blocks strictly BELOW the
-    # band — their MXU/VPU work never issues (pl.when gates compute only;
-    # the pipeline still DMAs every k-block's tiles). Rows
-    # whose real keys haven't arrived yet accumulate p=1 garbage against
-    # the -1e30 running max; the online-softmax discards it the moment a
-    # real key lands (corr = exp2(-1e30 - m_real) = 0), and causal
-    # guarantees every row eventually sees its diagonal key.
-    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
-    if window:  # static; run is a traced bool — combine with logical_and
-        run = jnp.logical_and(
-            run, j * block_k + block_k - 1 > i * block_q - window
-        )
+    # Skipped blocks' MXU/VPU work never issues (pl.when gates compute
+    # only; the pipeline still DMAs every k-block's tiles). Rows whose real
+    # keys haven't arrived yet accumulate p=1 garbage against the -1e30
+    # running max; the online-softmax discards it the moment a real key
+    # lands (corr = exp2(-1e30 - m_real) = 0), and causal guarantees every
+    # row eventually sees its diagonal key.
+    run = _block_live(i, j, causal=causal, block_q=block_q,
+                      block_k=block_k, window=window)
 
     @pl.when(run)
     def _step():
@@ -83,17 +109,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        has_pad = kv_len % block_k != 0  # static: padded tail block exists
-        if causal or has_pad:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = k_pos < kv_len  # padded tail keys contribute nothing
-            if causal:
-                q_pos = i * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, s.shape, 0)
-                mask = jnp.logical_and(mask, k_pos <= q_pos)
-                if window:
-                    mask = jnp.logical_and(mask, k_pos > q_pos - window)
-            s = jnp.where(mask, s, _NEG_INF)
+        s = _mask_logits(s, i, j, causal=causal, block_q=block_q,
+                         block_k=block_k, kv_len=kv_len, window=window)
 
         m_prev = m_ref[:, :1]  # (block_q, 1), log2 units
         l_prev = l_ref[:, :1]
@@ -113,16 +130,21 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # Per-row log2-sum-exp in the SAME log2-scaled domain as m: the
+        # backward kernels recompute p = exp2(s2 - lse) tile by tile from
+        # this instead of materializing the (Sq, Skv) matrix.
+        lse_ref[0] = (m_ref[:, 0] + jnp.log2(l[:, 0])).astype(jnp.float32)
 
 
-def _out_struct(x: jax.Array, shape) -> jax.ShapeDtypeStruct:
-    """Output aval of ``shape`` with x's dtype, carrying x's varying-mesh-axes
-    set so the kernel composes with shard_map's vma checking (the output
-    varies over exactly the axes the inputs do)."""
+def _out_struct(x: jax.Array, shape, dtype=None) -> jax.ShapeDtypeStruct:
+    """Output aval of ``shape`` with x's dtype (or ``dtype``), carrying x's
+    varying-mesh-axes set so the kernel composes with shard_map's vma
+    checking (the output varies over exactly the axes the inputs do)."""
+    dtype = dtype or x.dtype
     vma = getattr(jax.typeof(x), "vma", None)
     if vma:
-        return jax.ShapeDtypeStruct(shape, x.dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, x.dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 @functools.partial(
@@ -150,7 +172,8 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     kp = pad_to_multiple(k, 1, block_k)
     vp = pad_to_multiple(v, 1, block_k)
     grid = (h, qp.shape[1] // block_q, kp.shape[1] // block_k)
-    out = pl.pallas_call(
+    lse_struct = _out_struct(qp, (h, qp.shape[1]), jnp.float32)
+    out, lse = pl.pallas_call(
         functools.partial(
             _kernel, causal=causal,
             block_q=block_q, block_k=block_k, kv_len=kv_len, window=window,
@@ -161,8 +184,11 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // group, j, 0)),
             pl.BlockSpec((1, block_k, dv), lambda h, i, j: (h // group, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dv), lambda h, i, j: (h, i, 0)),
-        out_shape=_out_struct(qp, (h, qp.shape[1], dv)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dv), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_shape=[_out_struct(qp, (h, qp.shape[1], dv)), lse_struct],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denominator
@@ -175,34 +201,223 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
         ),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :sq]
+    return out[:, :sq], lse[:, :sq]
+
+
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, i, j, *, causal, scale, block_q,
+              block_k, kv_len, window):
+    """Recompute the probability tile p and the natural-domain dS tile for
+    one (q_block, k_block) pair — the shared core of both backward kernels.
+
+    p = exp2(s2 - lse) with s2 = (q k^T) * scale * log2(e) reproduces the
+    forward's softmax exactly (lse is saved in the same log2 domain);
+    dS = p * (dP - D) with dP = dO V^T and D = rowsum(dO * O)."""
+    s2 = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (scale * _LOG2E)
+    s2 = _mask_logits(s2, i, j, causal=causal, block_q=block_q,
+                      block_k=block_k, kv_len=kv_len, window=window)
+    p = jnp.exp2(s2 - lse[:, None])
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, causal, scale, block_q, block_k, kv_len,
+                   window):
+    """dQ = scale * sum_j dS_ij K_j; grid (heads, q_blocks, k_blocks), the
+    k sweep innermost carrying the f32 accumulator."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = _block_live(i, j, causal=causal, block_q=block_q,
+                      block_k=block_k, window=window)
+
+    @pl.when(run)
+    def _step():
+        _, ds = _bwd_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(jnp.float32),
+            lse_ref[0], delta_ref[0], i, j, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, window=window,
+        )
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, causal, scale, block_q,
+                    block_k, kv_len, window):
+    """dK = scale * sum_i dS_ij^T Q_i and dV = sum_i P_ij^T dO_i; grid
+    (heads, k_blocks, q_blocks), the q sweep innermost carrying both f32
+    accumulators."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    n_i = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _block_live(i, j, causal=causal, block_q=block_q,
+                      block_k=block_k, window=window)
+
+    @pl.when(run)
+    def _step():
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do, lse_ref[0], delta_ref[0],
+            i, j, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, kv_len=kv_len, window=window,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == n_i - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "window"),
+)
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                      interpret, window):
+    """Flash backward (MHA): dQ/dK/dV via tile recomputation from the saved
+    logsumexp — no (Sq, Skv) buffer at any point, so training memory scales
+    with S * D instead of S^2 (the GQA path still takes the XLA fallback).
+    """
+    h, sq, d = q.shape
+    dv_dim = v.shape[2]
+    kv_len = k.shape[1]
+    # D_i = rowsum(dO * O): one cheap fused elementwise+reduce in XLA.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qp = pad_to_multiple(q, 1, block_q)
+    gp = pad_to_multiple(g, 1, block_q)
+    # Pad lse with a large POSITIVE value: recomputed pad-row tiles then get
+    # p = exp2(s2 - big) = 0 (a -inf pad would make them explode).
+    pad_rows = qp.shape[1] - sq
+    if pad_rows:
+        lse = jnp.concatenate(
+            [lse, jnp.full((h, pad_rows), 1e30, jnp.float32)], axis=1)
+        delta = jnp.concatenate(
+            [delta, jnp.zeros((h, pad_rows), jnp.float32)], axis=1)
+    kp = pad_to_multiple(k, 1, block_k)
+    vp = pad_to_multiple(v, 1, block_k)
+    n_q, n_k = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    common = dict(causal=causal, scale=scale, block_q=block_q,
+                  block_k=block_k, kv_len=kv_len, window=window)
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=_out_struct(qp, (h, qp.shape[1], d)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, j, i: (h, i)),
+            pl.BlockSpec((1, block_q), lambda h, j, i: (h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            _out_struct(kp, (h, kp.shape[1], d)),
+            _out_struct(vp, (h, kp.shape[1], dv_dim)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, dv_dim), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, delta)
+
+    return (dq[:, :sq].astype(q.dtype), dk[:, :kv_len].astype(k.dtype),
+            dv[:, :kv_len].astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret, window):
-    """Differentiable wrapper: forward is the Pallas kernel; backward
-    recomputes the attention in f32 with XLA and applies the closed-form
-    softmax-attention gradients (the standard flash training trade — no
-    (Sq, Skv) matrix in the forward, one per head in the backward)."""
+    """Differentiable wrapper: forward is the Pallas kernel (which also
+    saves the per-row logsumexp); backward is the Pallas flash backward —
+    dQ and dK/dV kernels recompute probability TILES from the saved
+    logsumexp, so no (Sq, Skv) matrix exists in either direction and
+    training memory scales with S*D, not S^2. The GQA/MQA case falls back
+    to an XLA recompute with the closed-form softmax-attention gradients
+    (one transient (Sq, Skv) per head)."""
     return _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k,
-                           interpret, window)
+                           interpret, window)[0]
 
 
 def _flash_hsd_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                    window):
-    out = _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k,
-                          interpret, window)
-    return out, (q, k, v)
+    out, lse = _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k,
+                               interpret, window)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, window,
                    res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
     group = q.shape[0] // k.shape[0]
+    if group == 1:
+        return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale,
+                                 block_q, block_k, interpret, window)
+    # GQA path (group > 1 — MHA returned above): XLA recompute with the
+    # closed-form softmax-attention gradients — one (Sq, Skv) matrix per
+    # head lives transiently here. Broadcast K/V heads for the recompute...
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    if group > 1:  # GQA: broadcast K/V heads for the recompute...
-        kf = jnp.repeat(kf, group, axis=0)
-        vf = jnp.repeat(vf, group, axis=0)
+    kf = jnp.repeat(kf, group, axis=0)
+    vf = jnp.repeat(vf, group, axis=0)
     gf = g.astype(jnp.float32)
     logits = jnp.einsum("hsd,htd->hst", qf, kf) * scale
     if causal:
@@ -219,10 +434,10 @@ def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, window,
     ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
     dq = jnp.einsum("hst,htd->hsd", ds, kf) * scale
     dk = jnp.einsum("hst,hsd->htd", ds, qf) * scale
-    if group > 1:  # ...and sum each group's gradients back to its K/V head
-        hk, skv, d = k.shape[0], k.shape[1], dk.shape[2]
-        dk = dk.reshape(hk, group, skv, d).sum(axis=1)
-        dv = dv.reshape(hk, group, skv, dv.shape[2]).sum(axis=1)
+    # ...and sum each group's gradients back to its K/V head.
+    hk, skv, d = k.shape[0], k.shape[1], dk.shape[2]
+    dk = dk.reshape(hk, group, skv, d).sum(axis=1)
+    dv = dv.reshape(hk, group, skv, dv.shape[2]).sum(axis=1)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -1,0 +1,98 @@
+"""Unit tests for bench.py's harness pieces (timing + artifact contract).
+
+The bench script is the round's perf-artifact producer (BENCH_r{N}.json);
+its failure modes — a traceback instead of a parsable line, RTT-polluted
+kernel timings — each cost a capture session before being fixed, so the
+harness functions get the same regression coverage as library code.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+
+
+class TestScanTimed:
+    def test_positive_and_finite(self):
+        x = jnp.ones((64, 64), jnp.float32)
+        dt = bench._scan_timed(lambda x: x @ x, x, loop=3, reps=3)
+        assert np.isfinite(dt) and dt > 0
+
+    def test_reps_one_falls_back_to_single_shot(self):
+        # reps < 2 must not divide by zero (review finding): one fenced
+        # scan, RTT included.
+        x = jnp.ones((32, 32), jnp.float32)
+        dt = bench._scan_timed(lambda x: x @ x, x, loop=2, reps=1)
+        assert np.isfinite(dt) and dt > 0
+
+    def test_extra_operands_pass_through(self):
+        x = jnp.ones((32, 32), jnp.float32)
+        y = jnp.full((32, 32), 2.0, jnp.float32)
+        dt = bench._scan_timed(lambda a, b: a @ b, x, y, loop=2, reps=2)
+        assert dt > 0
+
+
+class TestTimed:
+    def test_returns_result_and_caps_burst(self):
+        x = jnp.ones((128, 128), jnp.float32)
+        dt, r = bench._timed_r(lambda: x @ x, iters=3)
+        assert dt > 0 and r.shape == (128, 128)
+
+
+class TestErrorContract:
+    def test_emit_error_is_parsable_json(self, capsys):
+        bench._emit_error("some_config", "boom")
+        line = capsys.readouterr().out.strip()
+        d = json.loads(line)
+        assert d["metric"] == "some_config" and d["unit"] == "error"
+        assert d["error"] == "boom" and d["vs_baseline"] == 0.0
+
+    def test_trim_err_bounds_length(self):
+        e = ValueError("x" * 10_000)
+        s = bench._trim_err(e, limit=100)
+        assert len(s) == 100
+
+    def test_xla_ref_survives_baseline_failure(self):
+        def broken():
+            raise RuntimeError("scoped vmem exceeded")
+
+        out = bench._xla_ref({"metric": "m", "value": 1.0}, "lu", broken, 1.0)
+        assert out["vs_baseline"] == 0
+        assert "scoped vmem" in out["xla_lu_error"]
+        assert out["value"] == 1.0  # our measurement survives
+
+    def test_xla_ref_scopes_baseline_precision(self, monkeypatch):
+        # The baseline must run under linalg_precision_scope (an ambient-
+        # default bf16-pass baseline fails the same oracle bar our op is
+        # held to).
+        import jax
+
+        import marlin_tpu.config as cfg_mod
+
+        seen = []
+        real = jax.default_matmul_precision
+
+        def spy(p):
+            seen.append(p)
+            return real(p)
+
+        monkeypatch.setattr(jax, "default_matmul_precision", spy)
+        x = jnp.ones((16, 16), jnp.float32)
+        out = bench._xla_ref({"metric": "m", "value": 1.0}, "c",
+                             lambda: x @ x, 1e-9)
+        assert "highest" in seen
+        assert "xla_c_seconds" in out and "xla_c_error" not in out
+
+
+class TestConfigsRegistry:
+    def test_all_excludes_sweeps(self):
+        assert "sweep" in bench.CONFIGS and "attnsweep" in bench.CONFIGS
+        sweep_fns = set(bench.CONFIGS["sweep"] + bench.CONFIGS["attnsweep"])
+        assert not sweep_fns & set(bench.CONFIGS["all"])
+
+    def test_every_config_has_callable(self):
+        for name, fns in bench.CONFIGS.items():
+            assert fns and all(callable(f) for f in fns), name

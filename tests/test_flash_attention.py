@@ -292,3 +292,104 @@ class TestSlidingWindow:
         q = jnp.zeros((16, 2, 8), jnp.float32)
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, q, q, window=4)
+
+
+class TestFlashBackwardKernels:
+    """The Pallas flash backward (dQ + dK/dV kernels, probability tiles
+    recomputed from the saved logsumexp) must match the XLA closed-form
+    softmax-attention gradients on every mask configuration. No (Sq, Skv)
+    buffer exists in the Pallas path — training memory is S*D."""
+
+    @staticmethod
+    def _xla_grads(q, k, v, g, causal, window):
+        def f(q, k, v):
+            qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+            sc = jnp.float32(1.0 / np.sqrt(q.shape[-1]))  # keep f32 under x64
+            logits = jnp.einsum("shd,thd->hst", qf, kf) * sc
+            if causal:
+                kp = jnp.arange(k.shape[0])[None, :]
+                qp = jnp.arange(q.shape[0])[:, None]
+                m = kp <= qp
+                if window:
+                    m = jnp.logical_and(m, kp > qp - window)
+                logits = jnp.where(m[None], logits, -1e30)
+            return jnp.einsum(
+                "hst,thd->shd", jax.nn.softmax(logits, -1), vf)
+
+        return jax.vjp(f, q, k, v)[1](g.astype(jnp.float32))
+
+    @pytest.mark.parametrize(
+        "sq,skv,h,d,dv,causal,window",
+        [
+            (96, 96, 2, 32, 32, False, 0),
+            (96, 96, 2, 32, 32, True, 0),
+            (96, 96, 2, 32, 32, True, 24),   # sliding window band
+            (80, 112, 2, 32, 48, False, 0),  # cross lengths + dv != d
+            (90, 100, 2, 32, 32, True, 0),   # pad in both seq dims
+            (50, 50, 1, 16, 16, True, 16),   # window + pad + single head
+        ],
+    )
+    def test_grads_match_xla_closed_form(self, rng, sq, skv, h, d, dv,
+                                         causal, window):
+        q = jnp.asarray(rng.standard_normal((sq, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((skv, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((skv, h, dv)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((sq, h, dv)), jnp.float32)
+        _, vjp = jax.vjp(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, window=window,
+                block_q=32, block_k=32, interpret=True),
+            q, k, v,
+        )
+        got = vjp(g)
+        ref = self._xla_grads(q, k, v, g, causal, window)
+        for name, a, b in zip(("dq", "dk", "dv"), got, ref):
+            err = float(jnp.max(jnp.abs(a - b))
+                        / (jnp.max(jnp.abs(b)) + 1e-30))
+            assert err < 2e-5, (name, err)
+
+    def test_gqa_falls_back_and_runs(self, rng):
+        q = jnp.asarray(rng.standard_normal((64, 4, 32)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((64, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((64, 2, 32)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((64, 4, 32)), jnp.float32)
+        _, vjp = jax.vjp(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, interpret=True,
+                block_q=32, block_k=32),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+        assert dq.shape == q.shape and dk.shape == k.shape
+        assert dv.shape == v.shape
+
+    def test_no_s_squared_buffer_in_jaxpr(self, rng):
+        # The MHA backward must not materialize an (Sq, Skv) array: check
+        # no intermediate in the vjp jaxpr has both seq dims.
+        sq = skv = 256
+        q = jnp.asarray(rng.standard_normal((sq, 2, 32)), jnp.float32)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64,
+                interpret=True))
+
+        jx = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+        bad = []
+
+        def scan(jaxpr):  # recurse into jit/scan/cond sub-jaxprs
+            for eqn in jaxpr.eqns:
+                for v in eqn.outvars:
+                    shape = getattr(v.aval, "shape", None)
+                    if shape and sum(dim == sq for dim in shape) >= 2:
+                        bad.append(shape)
+                for p in eqn.params.values():
+                    if hasattr(p, "jaxpr"):
+                        scan(p.jaxpr)
+                    elif isinstance(p, (list, tuple)):
+                        for pp in p:
+                            if hasattr(pp, "jaxpr"):
+                                scan(pp.jaxpr)
+
+        scan(jx.jaxpr)
+        assert not bad, f"S^2 intermediates present: {bad}"
