@@ -263,14 +263,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                     dv_ref, dk_acc, dv_acc, *, causal, scale, block_q,
                     block_k, kv_len, window):
-    """dK = scale * sum_i dS_ij^T Q_i and dV = sum_i P_ij^T dO_i; grid
-    (heads, k_blocks, q_blocks), the q sweep innermost carrying both f32
-    accumulators."""
+    """dK = scale * sum_i dS_ij^T Q_i and dV = sum_i P_ij^T dO_i, summed
+    over every q-head in the kv-head's group; grid (kv_heads, k_blocks,
+    group, q_blocks) — the (group, q) double sweep is innermost and
+    contiguous per (kv_head, k_block), carrying both f32 accumulators, so
+    one kernel covers MHA (group=1) and GQA/MQA alike."""
     j = pl.program_id(1)
-    i = pl.program_id(2)
-    n_i = pl.num_programs(2)
+    g = pl.program_id(2)
+    i = pl.program_id(3)
+    n_g = pl.num_programs(2)
+    n_i = pl.num_programs(3)
 
-    @pl.when(i == 0)
+    @pl.when((i == 0) & (g == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -295,7 +299,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(i == n_i - 1)
+    @pl.when((i == n_i - 1) & (g == n_g - 1))
     def _finalize():
         dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -308,11 +312,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 )
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                       interpret, window):
-    """Flash backward (MHA): dQ/dK/dV via tile recomputation from the saved
+    """Flash backward: dQ/dK/dV via tile recomputation from the saved
     logsumexp — no (Sq, Skv) buffer at any point, so training memory scales
-    with S * D instead of S^2 (the GQA path still takes the XLA fallback).
+    with S * D instead of S^2. Covers MHA and GQA/MQA (grouped K/V heads
+    read via index maps in the dQ kernel; the dK/dV kernel's group sweep
+    accumulates each kv-head's gradients over its q-heads).
     """
     h, sq, d = q.shape
+    hk = k.shape[0]
+    group = h // hk
     dv_dim = v.shape[2]
     kv_len = k.shape[1]
     # D_i = rowsum(dO * O): one cheap fused elementwise+reduce in XLA.
@@ -341,8 +349,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         grid=(h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim),
+                         lambda h, i, j: (h // group, j, 0)),
             pl.BlockSpec((1, block_q, dv_dim), lambda h, i, j: (h, i, 0)),
             pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
             pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
@@ -354,35 +364,54 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qp, kp, vp, gp, lse, delta)
 
+    # Grid (kv_head, k_block, group_member, q_block): for each (kv_head,
+    # k_block) the (group, q) sweep is contiguous, so the accumulators
+    # collect the whole group's contribution before the block is emitted.
+    dkv_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary",
+                             "arbitrary"),
+    )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
-        grid=(h, n_k, n_q),
+        grid=(hk, n_k, group, n_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, block_q, dv_dim), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, j, i: (h, i)),
-            pl.BlockSpec((1, block_q), lambda h, j, i: (h, i)),
+            pl.BlockSpec((1, block_q, d), _qmap(group)),
+            pl.BlockSpec((1, block_k, d), lambda hk, j, g, i: (hk, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim),
+                         lambda hk, j, g, i: (hk, j, 0)),
+            pl.BlockSpec((1, block_q, dv_dim), _qmap(group)),
+            pl.BlockSpec((1, block_q), _qmap2(group)),
+            pl.BlockSpec((1, block_q), _qmap2(group)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, block_k, dv_dim), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hk, j, g, i: (hk, j, 0)),
+            pl.BlockSpec((1, block_k, dv_dim),
+                         lambda hk, j, g, i: (hk, j, 0)),
         ],
         out_shape=[
-            _out_struct(kp, (h, kp.shape[1], d)),
-            _out_struct(vp, (h, kp.shape[1], dv_dim)),
+            _out_struct(kp, (hk, kp.shape[1], d)),
+            _out_struct(vp, (hk, kp.shape[1], dv_dim)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, dv_dim), jnp.float32),
         ],
-        compiler_params=params,
+        compiler_params=dkv_params,
         interpret=interpret,
     )(qp, kp, vp, gp, lse, delta)
 
     return (dq[:, :sq].astype(q.dtype), dk[:, :kv_len].astype(k.dtype),
             dv[:, :kv_len].astype(v.dtype))
+
+
+def _qmap(group):
+    """(kv_head, k_blk, group_member, q_blk) -> q-head-indexed 3-D block."""
+    return lambda hk, j, g, i: (hk * group + g, i, 0)
+
+
+def _qmap2(group):
+    """Same, for the 2-D lse/delta operands."""
+    return lambda hk, j, g, i: (hk * group + g, i)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -391,9 +420,9 @@ def _flash_hsd(q, k, v, causal, scale, block_q, block_k, interpret, window):
     saves the per-row logsumexp); backward is the Pallas flash backward —
     dQ and dK/dV kernels recompute probability TILES from the saved
     logsumexp, so no (Sq, Skv) matrix exists in either direction and
-    training memory scales with S*D, not S^2. The GQA/MQA case falls back
-    to an XLA recompute with the closed-form softmax-attention gradients
-    (one transient (Sq, Skv) per head)."""
+    training memory scales with S*D, not S^2 — for MHA and GQA/MQA alike
+    (the dK/dV kernel's group sweep accumulates each kv-head's gradients
+    over its q-heads)."""
     return _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k,
                            interpret, window)[0]
 
@@ -408,37 +437,8 @@ def _flash_hsd_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 def _flash_hsd_bwd(causal, scale, block_q, block_k, interpret, window,
                    res, g):
     q, k, v, out, lse = res
-    group = q.shape[0] // k.shape[0]
-    if group == 1:
-        return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale,
-                                 block_q, block_k, interpret, window)
-    # GQA path (group > 1 — MHA returned above): XLA recompute with the
-    # closed-form softmax-attention gradients — one (Sq, Skv) matrix per
-    # head lives transiently here. Broadcast K/V heads for the recompute...
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    kf = jnp.repeat(kf, group, axis=0)
-    vf = jnp.repeat(vf, group, axis=0)
-    gf = g.astype(jnp.float32)
-    logits = jnp.einsum("hsd,htd->hst", qf, kf) * scale
-    if causal:
-        sq, skv = q.shape[1], k.shape[1]
-        k_pos = jnp.arange(skv)[None, :]
-        q_pos = jnp.arange(sq)[:, None]
-        mask = k_pos <= q_pos
-        if window:
-            mask = jnp.logical_and(mask, k_pos > q_pos - window)
-        logits = jnp.where(mask[None], logits, _NEG_INF)
-    p = jax.nn.softmax(logits, axis=-1)  # (H, Sq, Skv)
-    dv = jnp.einsum("hst,hsd->htd", p, gf)
-    dp = jnp.einsum("hsd,htd->hst", gf, vf)
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    dq = jnp.einsum("hst,htd->hsd", ds, kf) * scale
-    dk = jnp.einsum("hst,hsd->htd", ds, qf) * scale
-    # ...and sum each group's gradients back to its K/V head.
-    hk, skv, d = k.shape[0], k.shape[1], dk.shape[2]
-    dk = dk.reshape(hk, group, skv, d).sum(axis=1)
-    dv = dv.reshape(hk, group, skv, dv.shape[2]).sum(axis=1)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale,
+                             block_q, block_k, interpret, window)
 
 
 _flash_hsd.defvjp(_flash_hsd_fwd, _flash_hsd_bwd)

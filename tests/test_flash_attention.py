@@ -301,22 +301,26 @@ class TestFlashBackwardKernels:
     buffer exists in the Pallas path — training memory is S*D."""
 
     @staticmethod
-    def _xla_grads(q, k, v, g, causal, window):
-        def f(q, k, v):
-            qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-            sc = jnp.float32(1.0 / np.sqrt(q.shape[-1]))  # keep f32 under x64
-            logits = jnp.einsum("shd,thd->hst", qf, kf) * sc
-            if causal:
-                kp = jnp.arange(k.shape[0])[None, :]
-                qp = jnp.arange(q.shape[0])[:, None]
-                m = kp <= qp
-                if window:
-                    m = jnp.logical_and(m, kp > qp - window)
-                logits = jnp.where(m[None], logits, -1e30)
-            return jnp.einsum(
-                "hst,thd->shd", jax.nn.softmax(logits, -1), vf)
+    def _dense_attn(q, k, v, causal, window):
+        """The one shared dense closed-form oracle (already head-matched)."""
+        qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+        sc = jnp.float32(1.0 / np.sqrt(q.shape[-1]))  # keep f32 under x64
+        logits = jnp.einsum("shd,thd->hst", qf, kf) * sc
+        if causal:
+            kp = jnp.arange(k.shape[0])[None, :]
+            qp = jnp.arange(q.shape[0])[:, None]
+            m = kp <= qp
+            if window:
+                m = jnp.logical_and(m, kp > qp - window)
+            logits = jnp.where(m[None], logits, -1e30)
+        return jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, -1), vf)
 
-        return jax.vjp(f, q, k, v)[1](g.astype(jnp.float32))
+    @classmethod
+    def _xla_grads(cls, q, k, v, g, causal, window):
+        return jax.vjp(
+            lambda q, k, v: cls._dense_attn(q, k, v, causal, window),
+            q, k, v,
+        )[1](g.astype(jnp.float32))
 
     @pytest.mark.parametrize(
         "sq,skv,h,d,dv,causal,window",
@@ -348,33 +352,57 @@ class TestFlashBackwardKernels:
                         / (jnp.max(jnp.abs(b)) + 1e-30))
             assert err < 2e-5, (name, err)
 
-    def test_gqa_falls_back_and_runs(self, rng):
-        q = jnp.asarray(rng.standard_normal((64, 4, 32)), jnp.float32)
-        k = jnp.asarray(rng.standard_normal((64, 2, 32)), jnp.float32)
-        v = jnp.asarray(rng.standard_normal((64, 2, 32)), jnp.float32)
-        g = jnp.asarray(rng.standard_normal((64, 4, 32)), jnp.float32)
+    @classmethod
+    def _xla_grads_gqa(cls, q, k, v, g, causal, window):
+        group = q.shape[1] // k.shape[1]
+
+        def f(q, k, v):  # broadcast K/V heads; vjp sums grads per kv-head
+            return cls._dense_attn(q, jnp.repeat(k, group, axis=1),
+                                   jnp.repeat(v, group, axis=1),
+                                   causal, window)
+
+        return jax.vjp(f, q, k, v)[1](g.astype(jnp.float32))
+
+    @pytest.mark.parametrize(
+        "heads,kv_heads,causal,window",
+        [(4, 2, True, 0), (4, 1, False, 0), (4, 2, True, 24)],
+    )
+    def test_gqa_grads_match_dense_oracle(self, rng, heads, kv_heads,
+                                          causal, window):
+        sq = 96
+        q = jnp.asarray(rng.standard_normal((sq, heads, 32)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((sq, kv_heads, 32)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((sq, kv_heads, 32)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((sq, heads, 32)), jnp.float32)
         _, vjp = jax.vjp(
             lambda q, k, v: flash_attention(
-                q, k, v, causal=True, interpret=True,
+                q, k, v, causal=causal, window=window, interpret=True,
                 block_q=32, block_k=32),
             q, k, v,
         )
-        dq, dk, dv = vjp(g)
-        assert dq.shape == q.shape and dk.shape == k.shape
-        assert dv.shape == v.shape
+        got = vjp(g)
+        ref = self._xla_grads_gqa(q, k, v, g, causal, window)
+        for name, a, b in zip(("dq", "dk", "dv"), got, ref):
+            err = float(jnp.max(jnp.abs(a - b))
+                        / (jnp.max(jnp.abs(b)) + 1e-30))
+            assert err < 2e-5, (name, err)
 
-    def test_no_s_squared_buffer_in_jaxpr(self, rng):
-        # The MHA backward must not materialize an (Sq, Skv) array: check
-        # no intermediate in the vjp jaxpr has both seq dims.
+    @pytest.mark.parametrize("kv_heads", [2, 1])  # MHA and MQA/GQA
+    def test_no_s_squared_buffer_in_jaxpr(self, rng, kv_heads):
+        # Neither backward path may materialize an (Sq, Skv) array: check
+        # no intermediate in the vjp jaxpr has both seq dims (recursing
+        # into nested jaxprs).
         sq = skv = 256
         q = jnp.asarray(rng.standard_normal((sq, 2, 32)), jnp.float32)
+        kv = jnp.asarray(
+            rng.standard_normal((skv, kv_heads, 32)), jnp.float32)
 
         def loss(q, k, v):
             return jnp.sum(flash_attention(
                 q, k, v, causal=True, block_q=64, block_k=64,
                 interpret=True))
 
-        jx = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+        jx = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, kv, kv)
         bad = []
 
         def scan(jaxpr):  # recurse into jit/scan/cond sub-jaxprs
