@@ -66,10 +66,12 @@ def _sp_conflict(cfg: TransformerConfig) -> Optional[str]:
     Checked both at param init AND at attention dispatch: sequence_parallel
     is a runtime flag (cfg._replace) while params are shape-identical
     across it, so a late flip must hit the contract error, not a cryptic
-    engine shape error."""
-    if cfg.kv_heads != cfg.n_heads:
-        return ("GQA + sequence_parallel is unsupported: the SP engines "
-                "shard the full head axis")
+    engine shape error.
+
+    GQA composes with both engines now (ring streams the reduced K/V
+    stripes; all_to_all shards kv heads when divisible, else the
+    dispatcher falls back to ring), so nothing conflicts today; the hook
+    stays as the single place future engine contracts land."""
     return None
 
 

@@ -131,7 +131,8 @@ def ring_matmul(
 @functools.cache
 def _ring_attention_fn(
     mesh: Mesh, n_dev: int, causal: bool, scale: float,
-    multihead: bool = False, window: int = 0, skv_stripe: int = 0
+    multihead: bool = False, window: int = 0, skv_stripe: int = 0,
+    group: int = 1,
 ):
     axes = _ring_axes(mesh)
     # Sliding window (causal): only the current stripe plus the previous
@@ -202,8 +203,20 @@ def _ring_attention_fn(
 
     if multihead:
         # (S/P, H, D) blocks: one dispatch, head axis vmapped through the
-        # same streaming pipeline (K/V permutes batch over heads).
-        body = jax.vmap(kernel, in_axes=1, out_axes=1)
+        # same streaming pipeline (K/V permutes batch over heads). GQA
+        # (group > 1): fold Q's head axis to (kv_heads, group); the outer
+        # vmap pairs each kv head with its q-head group, the inner vmap
+        # shares that kv stripe across the group — K/V stripes are never
+        # replicated, so ring ICI traffic keeps the full GQA shrink.
+        per_head = jax.vmap(kernel, in_axes=(1, None, None), out_axes=1)
+        per_kv = jax.vmap(per_head, in_axes=(1, 1, 1), out_axes=1)
+
+        def body(q_blk, k_blk, v_blk):
+            s_local, h, d = q_blk.shape
+            hk = h // group
+            out = per_kv(q_blk.reshape(s_local, hk, group, d), k_blk, v_blk)
+            return out.reshape(s_local, h, out.shape[-1])
+
         specs = P(axes, None, None)
     else:
         body = kernel
@@ -223,8 +236,10 @@ def ring_self_attention(
 ) -> jax.Array:
     """softmax(Q K^T * scale) V with the sequence dimension sharded on the
     ring; K/V blocks stream. Shapes: q (sq, d) or (sq, h, d) multi-head (the
-    head axis is vmapped through one pipeline); k/v match q's rank with
-    lengths (skv, ...). sq and skv must each be divisible-padded to the
+    head axis is vmapped through one pipeline); k/v match q's rank and may
+    carry FEWER heads (GQA/MQA: q-head i streams kv-head i // group, and
+    the rotating K/V stripes keep the full group-factor traffic shrink)
+    with lengths (skv, ...). sq and skv must each be divisible-padded to the
     device count (zero-pad keys get masked out by the softmax max-shift only
     if padded — callers should pass divisible lengths; this wrapper pads q
     only).
@@ -252,6 +267,13 @@ def ring_self_attention(
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     multihead = q.ndim == 3
+    group = 1
+    if multihead:
+        if q.shape[1] % k.shape[1]:
+            raise ValueError(
+                f"GQA needs kv_heads ({k.shape[1]}) to divide heads "
+                f"({q.shape[1]})")
+        group = q.shape[1] // k.shape[1]
     sq = q.shape[0]
     qp = _pad_dim(q, 0, n_dev)
     axes = _ring_axes(mesh)
@@ -264,5 +286,6 @@ def ring_self_attention(
         # stripe only matters for the windowed hop bound; keep it out of
         # the cache key otherwise so one fn serves every kv length.
         k.shape[0] // n_dev if window else 0,
+        group,
     )(qp, kp, vp)
     return out[:sq] if out.shape[0] != sq else out

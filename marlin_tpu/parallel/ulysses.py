@@ -86,18 +86,27 @@ def _ulysses_fn(mesh: Mesh, n_dev: int, causal: bool, scale: float,
 
         # Full-sequence attention over this device's heads: the Pallas flash
         # kernel (VMEM-tiled, no S x S logits in HBM) on TPU, or the XLA
-        # oracle vmapped over heads.
+        # oracle vmapped over heads. GQA arrives aligned: per-device q-head
+        # j pairs with per-device kv-head j // group (contiguous head
+        # chunks preserve the grouping), and the flash kernel groups via
+        # index maps natively.
         if flash:
             from ..ops.flash_attention import flash_attention
 
             out_h = flash_attention(q_h, k_h, v_h, causal=causal, scale=scale,
                                     window=window)
         else:
-            out_h = jax.vmap(
+            group = q_h.shape[1] // k_h.shape[1]
+            per_head = jax.vmap(
                 lambda q, k, v: _attend(q, k, v, scale, causal, window),
-                in_axes=1,
+                in_axes=(1, None, None),
                 out_axes=1,
-            )(q_h, k_h, v_h)
+            )
+            per_kv = jax.vmap(per_head, in_axes=(1, 1, 1), out_axes=1)
+            sfull, hloc, d = q_h.shape
+            out_h = per_kv(
+                q_h.reshape(sfull, hloc // group, group, d), k_h, v_h
+            ).reshape(sfull, hloc, -1)
         return head_to_seq(out_h)
 
     # check_vma=False with the flash kernel: interpret-mode pallas_call
@@ -135,9 +144,12 @@ def ulysses_self_attention(
     attention (each device holds the whole sequence for its heads, so the
     band is just the local kernel's window).
 
-    Shapes: q/k/v are (seq, n_heads, head_dim); seq and n_heads must both be
-    divisible by the device count (all_to_all re-shards each of them once).
-    Returns (seq, n_heads, head_dim_v) with the same sequence sharding.
+    Shapes: q is (seq, n_heads, head_dim); k/v may carry FEWER heads
+    (GQA/MQA — kv_heads must divide n_heads). seq, n_heads, and kv_heads
+    must each be divisible by the device count (all_to_all re-shards each
+    tensor once; contiguous head chunks keep the q-to-kv grouping aligned
+    per device). Returns (seq, n_heads, head_dim_v) with the same sequence
+    sharding.
 
     ``local_kernel``: per-device attention after the re-shard — "flash"
     (Pallas VMEM-tiled), "xla", or "auto" (flash on TPU).
@@ -145,14 +157,19 @@ def ulysses_self_attention(
     mesh = mesh or default_mesh()
     n_dev = len(mesh.devices.flat)
     s, h, d = q.shape
+    hk = k.shape[1] if k.ndim == 3 else h
     if s % n_dev != 0:
         raise ValueError(f"sequence length {s} must divide by {n_dev} devices")
     if h % n_dev != 0:
         raise ValueError(f"head count {h} must divide by {n_dev} devices")
-    if k.shape != (s, h, d) or v.shape[:2] != (s, h):
+    if h % hk or hk % n_dev:
+        raise ValueError(
+            f"GQA needs kv_heads ({hk}) dividing heads ({h}) and divisible "
+            f"by {n_dev} devices (otherwise use the ring engine)")
+    if k.shape != (s, hk, d) or v.shape[:2] != (s, hk):
         raise ValueError(
             f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape} "
-            "(all-to-all attention needs equal seq/head counts and "
+            "(all-to-all attention needs equal seq lengths and "
             "matching q/k head_dim)"
         )
     if scale is None:
@@ -208,17 +225,23 @@ def sequence_parallel_attention(
     n_dev = len(mesh.devices.flat)
     if strategy == "auto":
         # all_to_all needs what ulysses_self_attention enforces: (s, h, d)
-        # inputs with s and h divisible by the mesh and self-attention
-        # lengths (kv length == q length). Cross-attention or non-divisible
-        # shapes fall back to ring, which streams unequal K/V fine.
+        # inputs with s, h, AND kv heads divisible by the mesh (kv heads
+        # may be fewer — GQA), self-attention lengths (kv length == q
+        # length), matching head_dim. Cross-attention, non-divisible
+        # shapes, or too-few kv heads fall back to ring, which streams
+        # unequal K/V and grouped heads fine.
         strategy = (
             "all_to_all"
             if (
                 q.ndim == 3
+                and k.ndim == 3
                 and q.shape[1] % n_dev == 0
                 and q.shape[0] % n_dev == 0
-                and k.shape == q.shape
-                and v.shape[:2] == q.shape[:2]
+                and q.shape[1] % k.shape[1] == 0
+                and k.shape[1] % n_dev == 0  # GQA: kv heads must shard too
+                and k.shape[0] == q.shape[0]
+                and k.shape[2] == q.shape[2]
+                and v.shape[:2] == k.shape[:2]
             )
             else "ring"
         )

@@ -7,7 +7,7 @@ set -u
 OUT=${1:-docs/bench_captures/r02_session3c_$(date +%Y%m%d_%H%M).jsonl}
 
 echo "=== phase 1: flash bwd Mosaic compile smoke ===" >&2
-timeout 900 python -u - <<'PY' 2>&1 | grep -v WARNING >&2
+timeout 900 python -u - >&2 2>&1 <<'PY'
 import time
 import jax, jax.numpy as jnp
 from marlin_tpu.ops import flash_attention
@@ -24,7 +24,7 @@ PY
 echo "rc=$? (bwd smoke)" >&2
 
 echo "=== phase 2: panel-LU compile + 16k timing ===" >&2
-timeout 1200 python -u - <<'PY' 2>&1 | grep -v WARNING >&2
+timeout 1200 python -u - >&2 2>&1 <<'PY'
 import time
 import jax, jax.numpy as jnp, numpy as np
 import marlin_tpu as mt
