@@ -660,14 +660,41 @@ def config_svd():
             "unit": "s", "vs_baseline": 0, "oracle_ok": ok}
 
 
+def _train_throughput(metric, cfg, batch):
+    """Shared train-step timing recipe: init, jit, warmup+fence, burst-timed
+    step, tokens/sec + 6*N*T model-FLOPs estimate."""
+    import numpy as np
+
+    from marlin_tpu.models import init_params, train_step
+
+    s = cfg.max_len
+    params = init_params(cfg, seed=0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, s), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    step = jax.jit(train_step, static_argnames="cfg")
+    loss0, params = step(params, tokens, targets, cfg=cfg)
+    fence(loss0)
+    # Time against fixed params (throughput, not a training run); fetch
+    # only the scalar loss.
+    dt, loss = _timed_r(
+        lambda: step(params, tokens, targets, cfg=cfg)[0],
+        iters=5 if batch > 1 else 3,
+    )
+    n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    return {"metric": metric, "value": round(batch * s / dt, 1),
+            "unit": "tok/s", "vs_baseline": 0,
+            "model_tflops_est": round(6.0 * n_par * batch * s / dt / 1e12, 2),
+            "params_m": round(n_par / 1e6, 1),
+            "loss_finite": bool(np.isfinite(float(loss)))}
+
+
 def config_transformer():
     """Flagship transformer LM train step (models/): tokens/sec on the chip
     through the differentiable flash-attention path. Model-scale knobs via
     BENCH_TF_* (default ~125M params, S=2048, B=8, bf16 activations via the
     global default dtype)."""
-    import numpy as np
-
-    from marlin_tpu.models import TransformerConfig, init_params, train_step
+    from marlin_tpu.models import TransformerConfig
 
     d = _sized("BENCH_TF_D", 1024)
     cfg = TransformerConfig(
@@ -679,26 +706,31 @@ def config_transformer():
         rope=bool(_sized("BENCH_TF_ROPE", 0)),
         window=_sized("BENCH_TF_WINDOW", 0),
     )
-    b, s = _sized("BENCH_TF_B", 8), cfg.max_len
-    params = init_params(cfg, seed=0)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
-    targets = jnp.roll(tokens, -1, axis=1)
-    step = jax.jit(train_step, static_argnames="cfg")
-    loss0, params = step(params, tokens, targets, cfg=cfg)
-    fence(loss0)
-    # Time the step against fixed params (throughput, not a training run);
-    # fetch only the scalar loss.
-    dt, loss = _timed_r(
-        lambda: step(params, tokens, targets, cfg=cfg)[0], iters=5
+    return _train_throughput(
+        "transformer_train_tokens_per_s", cfg, _sized("BENCH_TF_B", 8))
+
+
+def config_longseq():
+    """Long-context train step: B=1 at S=8k (default; BENCH_LS_* to push
+    further) through the Pallas flash backward + per-block remat. Before
+    those landed this config was impossible on a 16 GB chip: the XLA
+    attention backward alone materialized H * S^2 f32 logits (8 GB per
+    layer at S=16k)."""
+    from marlin_tpu.models import TransformerConfig
+
+    d = _sized("BENCH_LS_D", 1024)
+    s = _sized("BENCH_LS_S", 8192)
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_LS_VOCAB", 16384), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_LS_L", 8),
+        d_ff=4 * d, max_len=s, rope=True, remat=True,
+        n_kv_heads=_sized("BENCH_LS_KV", 0),
+        window=_sized("BENCH_LS_WINDOW", 0),
     )
-    # ~6 * params * tokens FLOPs per step (fwd + bwd).
-    n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
-    tflops = 6.0 * n_par * b * s / dt / 1e12
-    return {"metric": "transformer_train_tokens_per_s",
-            "value": round(b * s / dt, 1), "unit": "tok/s",
-            "vs_baseline": 0, "model_tflops_est": round(tflops, 2),
-            "params_m": round(n_par / 1e6, 1),
-            "loss_finite": bool(np.isfinite(float(loss)))}
+    out = _train_throughput(
+        f"longseq_train_s{s // 1024}k_tokens_per_s", cfg, batch=1)
+    out["seq_len"] = s
+    return out
 
 
 def config_decode():
@@ -839,6 +871,7 @@ CONFIGS = {
     "inverse": [config_inverse],
     "svd": [config_svd],
     "transformer": [config_transformer],
+    "longseq": [config_longseq],
     "decode": [config_decode],
     "sweep": [config_dispatch_sweep],
     "attnsweep": [config_attention_sweep],

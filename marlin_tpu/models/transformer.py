@@ -55,6 +55,10 @@ class TransformerConfig(NamedTuple):
     n_kv_heads: int = 0  # 0 = n_heads; fewer = GQA/MQA (must divide n_heads)
     rope: bool = False  # rotary position embeddings instead of learned ones
     window: int = 0  # >0: sliding-window (causal) attention span
+    remat: bool = False  # jax.checkpoint each block: activation memory
+    # drops from O(layers * S * D) to O(S * D) + one block's recompute per
+    # layer in the backward — with the flash backward's S*D scaling this
+    # is what makes long-context training fit (SURVEY §5 long-context)
 
     @property
     def kv_heads(self) -> int:
@@ -276,9 +280,16 @@ def forward(params, tokens, cfg: TransformerConfig):
     """tokens (B, S) int32 -> logits (B, S, vocab)."""
     x = _embed_prefix(params, tokens, cfg)
 
+    block = functools.partial(_block, cfg=cfg)
+    if cfg.remat:
+        # Policy: save nothing per block; the backward re-runs each block's
+        # forward (the flash kernels' own recompute is tile-local either
+        # way, so remat adds one extra block forward, not an S^2 anything).
+        block = jax.checkpoint(block)
+
     def per_seq(xi):
         for bp in params["blocks"]:
-            xi = _block(bp, xi, cfg)
+            xi = block(bp, xi)
         return _layer_norm(params["ln_f"], xi)
 
     x = _map_seqs(per_seq, x, cfg)

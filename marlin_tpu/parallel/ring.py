@@ -269,6 +269,9 @@ def ring_self_attention(
     multihead = q.ndim == 3
     group = 1
     if multihead:
+        if k.shape[1] != v.shape[1]:
+            raise ValueError(
+                f"k/v head-count mismatch: {k.shape} vs {v.shape}")
         if q.shape[1] % k.shape[1]:
             raise ValueError(
                 f"GQA needs kv_heads ({k.shape[1]}) to divide heads "

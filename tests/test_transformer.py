@@ -638,3 +638,34 @@ class TestSamplingTruncation:
         negk = np.asarray(generate(params, prompt, 5, CFG, temperature=0.9,
                                    seed=2, top_k=-1))
         np.testing.assert_array_equal(plain, negk)
+
+
+class TestRemat:
+    """cfg.remat wraps each block in jax.checkpoint: loss and one-step
+    parameter updates must be bit-compatible with the non-remat path (the
+    flag trades backward recompute for activation memory, nothing else)."""
+
+    def test_train_step_matches_non_remat(self, rng):
+        cfg = TransformerConfig(vocab=31, d_model=32, n_heads=4, n_layers=2,
+                                d_ff=64, max_len=32)
+        p = init_params(cfg, seed=0)
+        tok = jnp.asarray(rng.integers(0, 31, (2, 32)), jnp.int32)
+        tgt = jnp.roll(tok, -1, 1)
+        step = jax.jit(train_step, static_argnames="cfg")
+        l0, p0 = step(p, tok, tgt, cfg=cfg)
+        l1, p1 = step(p, tok, tgt, cfg=cfg._replace(remat=True))
+        assert abs(float(l0) - float(l1)) < 1e-6
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-6, atol=2e-6)
+
+    def test_composes_with_gqa_window_rope(self, rng):
+        cfg = TransformerConfig(vocab=31, d_model=32, n_heads=4,
+                                n_kv_heads=2, n_layers=2, d_ff=64,
+                                max_len=32, rope=True, window=16,
+                                remat=True)
+        p = init_params(cfg, seed=1)
+        tok = jnp.asarray(rng.integers(0, 31, (2, 32)), jnp.int32)
+        loss, p2 = jax.jit(train_step, static_argnames="cfg")(
+            p, tok, jnp.roll(tok, -1, 1), cfg=cfg)
+        assert np.isfinite(float(loss))
