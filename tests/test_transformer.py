@@ -669,3 +669,58 @@ class TestRemat:
         loss, p2 = jax.jit(train_step, static_argnames="cfg")(
             p, tok, jnp.roll(tok, -1, 1), cfg=cfg)
         assert np.isfinite(float(loss))
+
+
+class TestChunkedCrossEntropy:
+    """loss_fn's readout + CE run chunked over the sequence past _CE_CHUNK
+    positions — full (B, S, vocab) logits must never materialize, and the
+    chunked value/grads must equal the direct computation exactly."""
+
+    def test_matches_direct_incl_pad_tail(self, rng, monkeypatch):
+        import marlin_tpu.models.transformer as tr
+
+        cfg = TransformerConfig(vocab=31, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_len=20)
+        p = init_params(cfg, seed=0)
+        tok = jnp.asarray(rng.integers(0, 31, (2, 20)), jnp.int32)
+        tgt = jnp.roll(tok, -1, 1)
+        monkeypatch.setattr(tr, "_CE_CHUNK", 8)  # 20 % 8 != 0: pad path
+        l_c = float(loss_fn(p, tok, tgt, cfg))
+        g_c = jax.grad(loss_fn)(p, tok, tgt, cfg)
+        monkeypatch.setattr(tr, "_CE_CHUNK", 4096)
+        l_d = float(loss_fn(p, tok, tgt, cfg))
+        g_d = jax.grad(loss_fn)(p, tok, tgt, cfg)
+        assert abs(l_c - l_d) < 1e-6
+        for a, b in zip(jax.tree.leaves(g_c), jax.tree.leaves(g_d)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_no_full_logits_buffer(self, rng, monkeypatch):
+        import marlin_tpu.models.transformer as tr
+
+        monkeypatch.setattr(tr, "_CE_CHUNK", 8)
+        cfg = TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_len=32)
+        p = init_params(cfg, seed=1)
+        tok = jnp.asarray(rng.integers(0, 64, (1, 32)), jnp.int32)
+        jx = jax.make_jaxpr(
+            jax.grad(loss_fn), static_argnums=(3,)
+        )(p, tok, tok, cfg)
+        bad = []
+
+        def scan(jaxpr):
+            for eqn in jaxpr.eqns:
+                for v in eqn.outvars:
+                    shape = getattr(v.aval, "shape", None)
+                    if shape and 32 in shape and 64 in shape:
+                        bad.append(shape)
+                for pr in eqn.params.values():
+                    if hasattr(pr, "jaxpr"):
+                        scan(pr.jaxpr)
+                    elif isinstance(pr, (list, tuple)):
+                        for pp in pr:
+                            if hasattr(pp, "jaxpr"):
+                                scan(pp.jaxpr)
+
+        scan(jx.jaxpr)
+        assert not bad, f"full (S, vocab) logits materialized: {bad}"
