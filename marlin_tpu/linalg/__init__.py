@@ -2,5 +2,6 @@ from .cholesky import cholesky_decompose, cholesky_factor_array
 from .inverse import inverse
 from .lanczos import symmetric_eigs
 from .lu import lu_decompose, lu_factor_array, unpack_lu
+from .qr import lstsq, qr_decompose, qr_factor_array
 from .solve import solve
 from .svd import SVDResult, compute_svd

@@ -561,3 +561,89 @@ class TestLUPanelPivoting:
             perm_s[[i, p]] = perm_s[[p, i]]
         assert np.array_equal(perm, perm_s)
         np.testing.assert_allclose(np.asarray(packed), lu_s, atol=1e-9)
+
+
+class TestQR:
+    """CholeskyQR2 thin QR + seminormal-equations least squares (beyond the
+    reference's L4 set; the tall row-sharded regime its DenseVecMatrix
+    lives in). Oracle: numpy QR up to column-sign, machine-precision
+    orthogonality, and lstsq vs numpy."""
+
+    def _check_qr(self, a, mode):
+        from marlin_tpu.linalg import qr_factor_array
+
+        q, r = qr_factor_array(jnp.asarray(a), mode=mode)
+        q, r = np.asarray(q, np.float64), np.asarray(r, np.float64)
+        m, n = a.shape
+        assert q.shape == (m, n) and r.shape == (n, n)
+        np.testing.assert_allclose(q @ r, a, rtol=1e-8, atol=1e-8)
+        np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-9)
+        assert np.allclose(np.tril(r, -1), 0)  # R upper triangular
+        return q, r
+
+    def test_tall_tsqr_matches_numpy_up_to_sign(self, rng):
+        a = rng.standard_normal((7000, 24))  # auto -> dist -> CholeskyQR2
+        q, r = self._check_qr(a, "auto")
+        qn, rn = np.linalg.qr(a)
+        sign = np.sign(np.diag(rn)) * np.sign(np.diag(r))
+        np.testing.assert_allclose(r * sign[:, None], rn, rtol=1e-6,
+                                   atol=1e-8)
+
+    def test_tsqr_moderately_ill_conditioned(self, rng):
+        # cond ~ 1e4: one-pass CholeskyQR loses orthogonality as cond^2*eps
+        # (~1e-8 at f64 would pass, but f32-graded scales matter); the
+        # second pass must restore machine-precision orthogonality.
+        u = np.linalg.qr(rng.standard_normal((600, 12)))[0]
+        a = u * np.logspace(0, 4, 12)[None, :]
+        self._check_qr(a, "tsqr")
+
+    def test_square_routes_local(self, rng):
+        a = rng.standard_normal((32, 32))
+        self._check_qr(a, "auto")
+
+    def test_tsqr_rejects_fat(self, rng):
+        from marlin_tpu.linalg import qr_factor_array
+
+        with pytest.raises(ValueError, match="m >= n"):
+            qr_factor_array(jnp.asarray(rng.standard_normal((4, 8))),
+                            mode="tsqr")
+
+    def test_qr_decompose_type_roundtrip(self, rng):
+        from marlin_tpu.linalg.qr import qr_decompose
+
+        m = DenseVecMatrix(rng.standard_normal((40, 8)))
+        qm, r = qr_decompose(m, mode="tsqr")
+        assert isinstance(qm, DenseVecMatrix)
+        np.testing.assert_allclose(
+            qm.to_numpy() @ np.asarray(r), m.to_numpy(), rtol=1e-8,
+            atol=1e-8)
+
+    def test_lstsq_matches_numpy(self, rng):
+        from marlin_tpu.linalg import lstsq
+
+        a = rng.standard_normal((7000, 16))
+        x_true = rng.standard_normal((16, 3))
+        b = a @ x_true + 0.01 * rng.standard_normal((7000, 3))
+        x = np.asarray(lstsq(jnp.asarray(a), jnp.asarray(b)))
+        x_np = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(x, x_np, rtol=1e-6, atol=1e-8)
+
+    def test_lstsq_vector_rhs_and_local_route(self, rng):
+        from marlin_tpu.linalg import lstsq
+
+        a = rng.standard_normal((40, 8))  # small -> local route
+        b = rng.standard_normal(40)
+        x = np.asarray(lstsq(jnp.asarray(a), jnp.asarray(b)))
+        assert x.shape == (8,)
+        np.testing.assert_allclose(
+            x, np.linalg.lstsq(a, b, rcond=None)[0], rtol=1e-6, atol=1e-8)
+
+    def test_lstsq_mode_validation_and_fat_guard(self, rng):
+        from marlin_tpu.linalg import lstsq
+
+        a = jnp.asarray(rng.standard_normal((4, 8)))
+        b = jnp.asarray(rng.standard_normal(4))
+        with pytest.raises(ValueError, match="m >= n"):
+            lstsq(a, b, mode="tsqr")
+        with pytest.raises(ValueError, match="Do not support mode"):
+            lstsq(a, b, mode="dist")
