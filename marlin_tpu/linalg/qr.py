@@ -21,8 +21,11 @@ One pass loses orthogonality as cond(A)^2 * eps; repeating it on Q
 (CholeskyQR2) brings ||Q^T Q - I|| back to machine precision for any
 cond(A) <= 1/sqrt(eps) — and both passes are pure GEMM/chol/solve, i.e.
 MXU-shaped work with two scalar-free reductions, where Householder panels
-would serialize n reflector applications. Square/fat or ill-conditioned
-inputs route to XLA's QR under the same precision scope.
+would serialize n reflector applications. Square/fat inputs route to
+XLA's QR under the same precision scope, and a non-finite Cholesky
+(cond(A) beyond ~1/sqrt(eps) makes the Gramian numerically indefinite)
+triggers the same XLA fallback at runtime — one host sync, only on the
+failure path.
 
 ``lstsq`` solves min ||A x - b|| through the same factorization without
 ever forming Q explicitly: R^T R x = A^T b (the seminormal equations,
@@ -90,6 +93,12 @@ def qr_factor_array(
             return q, r
         # Pass 1: Q1 = A R1^-1.
         r1 = _chol_r(_gram(a))
+        if not bool(jnp.isfinite(r1).all()):
+            # Gramian numerically indefinite (cond(A) ~> 1/sqrt(eps) at
+            # this dtype): CholeskyQR cannot proceed — XLA's Householder
+            # QR can. One host sync, failure path only.
+            q, r = jnp.linalg.qr(a, mode="reduced")
+            return q, r
         q1 = _solve_r(a, r1)
         # Pass 2 (CholeskyQR2): re-orthogonalize; R composes.
         r2 = _chol_r(_gram(q1))
@@ -102,7 +111,7 @@ def qr_decompose(mat, mode: str = "auto"):
     """(Q as the caller's distributed type, R as a replicated array) —
     row-sharded in, row-sharded out; R is n x n and lives replicated."""
     q, r = qr_factor_array(mat.logical, mode=mode)
-    return type(mat)(q, mesh=mat.mesh), r
+    return mat._from_logical(q), r
 
 
 def lstsq(a: jax.Array, b: jax.Array, mode: str = "auto") -> jax.Array:
@@ -125,6 +134,10 @@ def lstsq(a: jax.Array, b: jax.Array, mode: str = "auto") -> jax.Array:
             return x[:, 0] if vec else x
         prec = get_config().linalg_precision
         r = _chol_r(_gram(a))
+        if not bool(jnp.isfinite(r).all()):
+            # Same runtime fallback as qr_factor_array.
+            x = jnp.linalg.lstsq(a, bm.astype(a.dtype))[0]
+            return x[:, 0] if vec else x
 
         def solve_semi(rhs):  # R^T R x = rhs (lower= describes R's storage)
             y = jax.lax.linalg.triangular_solve(

@@ -195,8 +195,17 @@ def _ring_attention_fn(
         m0 = _pvary(jnp.full((sq,), neg, acc_t), axes)
         l0 = _pvary(jnp.zeros((sq,), acc_t), axes)
         o0 = _pvary(jnp.zeros((sq, v_blk.shape[1]), acc_t), axes)
+        # checkpoint each hop: reverse-mode through the loop would
+        # otherwise save every hop's (sq/P, skv/P) logits/p tiles —
+        # O(S^2/P) per device, exactly the buffer flash attention training
+        # exists to avoid. Recomputing one hop's tiles in the backward is
+        # the same trade the flash kernels make.
+        # prevent_cse=False: under a scan-lowered loop the structure
+        # already prevents the problematic CSE, and the default barriers
+        # would block fusion across the recomputed GEMMs.
         _, _, _, l_fin, o_fin = jax.lax.fori_loop(
-            0, hops, step, (k_blk, v_blk, m0, l0, o0)
+            0, hops, jax.checkpoint(step, prevent_cse=False),
+            (k_blk, v_blk, m0, l0, o0)
         )
         out = o_fin / jnp.maximum(l_fin, 1e-30)[:, None]
         return out.astype(q_blk.dtype)

@@ -647,3 +647,18 @@ class TestQR:
             lstsq(a, b, mode="tsqr")
         with pytest.raises(ValueError, match="Do not support mode"):
             lstsq(a, b, mode="dist")
+
+    def test_f32_extreme_condition_falls_back_finite(self, rng):
+        # f32 CholeskyQR limit is cond ~ 1/sqrt(eps_f32) ~ 3e3; beyond it
+        # the Gramian Cholesky goes NaN and the runtime fallback must
+        # produce a finite, orthogonal factorization via XLA QR.
+        from marlin_tpu.linalg import lstsq, qr_factor_array
+
+        u = np.linalg.qr(rng.standard_normal((7000, 8)))[0]
+        a = jnp.asarray(u * np.logspace(0, 7, 8)[None, :], jnp.float32)
+        q, r = qr_factor_array(a, mode="tsqr")
+        qn = np.asarray(q, np.float64)
+        assert np.isfinite(qn).all()
+        np.testing.assert_allclose(qn.T @ qn, np.eye(8), atol=1e-4)
+        x = lstsq(a, jnp.asarray(rng.standard_normal(7000), jnp.float32))
+        assert np.isfinite(np.asarray(x)).all()
