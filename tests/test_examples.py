@@ -173,3 +173,15 @@ def test_gcn_example(capsys):
 
     assert gcn.main(["128", "40"]) == 0
     assert "test accuracy" in capsys.readouterr().out
+
+
+def test_least_squares(capsys):
+    import json
+
+    from marlin_tpu.examples import least_squares
+
+    assert least_squares.main(["2000", "12", "--mode", "tsqr"]) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["example"] == "LeastSquares"
+    assert line["coef_max_err"] < 0.05  # recovers the planted coefficients
+    assert line["qr_orth_err"] < 1e-6
