@@ -127,6 +127,7 @@ def lstsq(a: jax.Array, b: jax.Array, mode: str = "auto") -> jax.Array:
     bm = b[:, None] if vec else b
     if bm.shape[0] != m:
         raise ValueError(f"rhs rows {bm.shape[0]} != lhs rows {m}")
+    bm = bm.astype(a.dtype)
     use_cqr = _use_cqr(mode, m, n)
     with linalg_precision_scope():
         if not use_cqr:
@@ -136,7 +137,7 @@ def lstsq(a: jax.Array, b: jax.Array, mode: str = "auto") -> jax.Array:
         r = _chol_r(_gram(a))
         if not bool(jnp.isfinite(r).all()):
             # Same runtime fallback as qr_factor_array.
-            x = jnp.linalg.lstsq(a, bm.astype(a.dtype))[0]
+            x = jnp.linalg.lstsq(a, bm)[0]
             return x[:, 0] if vec else x
 
         def solve_semi(rhs):  # R^T R x = rhs (lower= describes R's storage)
@@ -147,9 +148,9 @@ def lstsq(a: jax.Array, b: jax.Array, mode: str = "auto") -> jax.Array:
                 r, y, left_side=True, lower=False
             )
 
-        atb = jnp.dot(a.T, bm.astype(a.dtype), precision=prec)
+        atb = jnp.dot(a.T, bm, precision=prec)
         x = solve_semi(atb)
         # One refinement step: x += (R^T R)^-1 A^T (b - A x).
-        resid = bm.astype(a.dtype) - jnp.dot(a, x, precision=prec)
+        resid = bm - jnp.dot(a, x, precision=prec)
         x = x + solve_semi(jnp.dot(a.T, resid, precision=prec))
     return x[:, 0] if vec else x
