@@ -50,6 +50,30 @@ def _block_live(i, j, *, causal, block_q, block_k, window):
     return run
 
 
+def _win_lo_k(i, *, block_q, block_k, window):
+    """First k-block intersecting q-block i's window band (traced)."""
+    return jnp.maximum(0, (i * block_q - window + 1) // block_k)
+
+
+def _win_kblocks(n_k, *, block_q, block_k, window):
+    """Static size of the shrunk k sweep: a q-block's band spans
+    ``block_q + window - 1`` contiguous key positions, which touch at most
+    ``(block_q + window - 2) // block_k + 2`` k-blocks."""
+    return min(n_k, (block_q + window - 2) // block_k + 2)
+
+
+def _win_lo_q(j, *, block_q, block_k, window):
+    """First q-block whose rows attend into k-block j (traced): causality
+    puts the first live row at j * block_k."""
+    return (j * block_k) // block_q
+
+
+def _win_qblocks(n_q, *, block_q, block_k, window):
+    """Static size of the shrunk q sweep of the dK/dV kernel: k-block j is
+    visible to rows [j * block_k, j * block_k + block_k - 1 + window)."""
+    return min(n_q, (block_k + window - 2) // block_q + 2)
+
+
 def _mask_logits(s, i, j, *, causal, block_q, block_k, kv_len, window):
     """The liveness mask, applied to a logits tile (forward and backward
     recompute MUST stay in lockstep): padded-tail keys always; causal /
@@ -84,21 +108,31 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     exists (kv_len is static); on unpadded shapes the per-step iota+where
     over the logits block is pure VPU overhead."""
     i = pl.program_id(1)  # q block
-    j = pl.program_id(2)  # k block (innermost: scratch carries over j)
+    jj = pl.program_id(2)  # k sweep position (innermost: scratch carries)
     n_j = pl.num_programs(2)
+    # Windowed kernels run a SHRUNK k sweep (only the band's blocks are in
+    # the grid, so out-of-band tiles are never DMA'd); jj is a position in
+    # the band and the real k-block index is lo(i) + jj. The liveness/mask
+    # logic below uses the UNCLAMPED index: the DMA index map clamps to the
+    # last block, and a clamped duplicate must never pass the predicate
+    # (double-counting into the accumulator).
+    if window:
+        j = _win_lo_k(i, block_q=block_q, block_k=block_k, window=window) + jj
+    else:
+        j = jj
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # Skipped blocks' MXU/VPU work never issues (pl.when gates compute
-    # only; the pipeline still DMAs every k-block's tiles). Rows whose real
-    # keys haven't arrived yet accumulate p=1 garbage against the -1e30
-    # running max; the online-softmax discards it the moment a real key
-    # lands (corr = exp2(-1e30 - m_real) = 0), and causal guarantees every
-    # row eventually sees its diagonal key.
+    # only). Rows whose real keys haven't arrived yet accumulate p=1
+    # garbage against the -1e30 running max; the online-softmax discards it
+    # the moment a real key lands (corr = exp2(-1e30 - m_real) = 0), and
+    # causal guarantees every row eventually sees its diagonal key (the
+    # windowed band always ends at the diagonal block).
     run = _block_live(i, j, causal=causal, block_q=block_q,
                       block_k=block_k, window=window)
 
@@ -126,14 +160,18 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
 
-    @pl.when(j == n_j - 1)
+    @pl.when(jj == n_j - 1)
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
         # Per-row log2-sum-exp in the SAME log2-scaled domain as m: the
         # backward kernels recompute p = exp2(s2 - lse) tile by tile from
-        # this instead of materializing the (Sq, Skv) matrix.
-        lse_ref[0] = (m_ref[:, 0] + jnp.log2(l[:, 0])).astype(jnp.float32)
+        # this instead of materializing the (Sq, Skv) matrix. Stored
+        # lane-replicated as a (block_q, LANES) tile — a (1, block_q) block
+        # violates Mosaic's (8, 128)-divisibility rule for the minor dims
+        # (caught by the r03 hardware compile smoke; interpret mode never
+        # surfaces it), and m/l are already lane-broadcast in scratch.
+        lse_ref[0] = m_ref[:] + jnp.log2(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _out_struct(x: jax.Array, shape, dtype=None) -> jax.ShapeDtypeStruct:
@@ -171,8 +209,25 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     qp = pad_to_multiple(q, 1, block_q)
     kp = pad_to_multiple(k, 1, block_k)
     vp = pad_to_multiple(v, 1, block_k)
-    grid = (h, qp.shape[1] // block_q, kp.shape[1] // block_k)
-    lse_struct = _out_struct(qp, (h, qp.shape[1]), jnp.float32)
+    n_k = kp.shape[1] // block_k
+    # window > 0: sweep only the band's k-blocks (grid shrink) so HBM reads
+    # scale with S * window, not S^2 — the index map picks the band's
+    # blocks, clamped in-bounds (the kernel masks by the unclamped index).
+    if window:
+        n_sweep = _win_kblocks(
+            n_k, block_q=block_q, block_k=block_k, window=window)
+
+        def _kv_map(h, i, jj, group=group):
+            lo = _win_lo_k(i, block_q=block_q, block_k=block_k, window=window)
+            return (h // group, jnp.minimum(lo + jj, n_k - 1), 0)
+    else:
+        n_sweep = n_k
+
+        def _kv_map(h, i, j, group=group):
+            return (h // group, j, 0)
+
+    grid = (h, qp.shape[1] // block_q, n_sweep)
+    lse_struct = _out_struct(qp, (h, qp.shape[1], _LANES), jnp.float32)
     out, lse = pl.pallas_call(
         functools.partial(
             _kernel, causal=causal,
@@ -181,12 +236,12 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // group, j, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((1, block_k, d), _kv_map),
+            pl.BlockSpec((1, block_k, dv), _kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dv), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda h, i, j: (h, i, 0)),
         ],
         out_shape=[_out_struct(qp, (h, qp.shape[1], dv)), lse_struct],
         scratch_shapes=[
@@ -206,24 +261,30 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 
-def _bwd_p_ds(q, k, v, do, lse, delta, i, j, *, causal, scale, block_q,
+def _bwd_p_ds(q_hat, k, v, do, lse, delta, i, j, *, causal, block_q,
               block_k, kv_len, window):
     """Recompute the probability tile p and the natural-domain dS tile for
     one (q_block, k_block) pair — the shared core of both backward kernels.
 
-    p = exp2(s2 - lse) with s2 = (q k^T) * scale * log2(e) reproduces the
-    forward's softmax exactly (lse is saved in the same log2 domain);
-    dS = p * (dP - D) with dP = dO V^T and D = rowsum(dO * O)."""
+    ``q_hat`` is the SAME prescaled-and-rounded Q the forward kernel saw
+    (scale * log2(e) folded in by _flash_bwd_pallas, cast back to q.dtype),
+    so s2 = q_hat k^T reproduces the forward's logits bit-for-bit in bf16 —
+    recomputing from the unscaled Q would differ by the prescale rounding
+    and leave p slightly inconsistent with the saved lse.
+    p = exp2(s2 - lse); dS = p * (dP - D) with dP = dO V^T and
+    D = rowsum(dO * O). ``lse`` and ``delta`` arrive as lane-replicated
+    (block_q, LANES) tiles (see _kernel's finalize); column 0 is used."""
     s2 = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * (scale * _LOG2E)
+        q_hat, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     s2 = _mask_logits(s2, i, j, causal=causal, block_q=block_q,
                       block_k=block_k, kv_len=kv_len, window=window)
-    p = jnp.exp2(s2 - lse[:, None])
+    p = jnp.exp2(s2 - lse[:, :1])
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta[:, :1])
     return p, ds
 
 
@@ -231,12 +292,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_ref, *, causal, scale, block_q, block_k, kv_len,
                    window):
     """dQ = scale * sum_j dS_ij K_j; grid (heads, q_blocks, k_blocks), the
-    k sweep innermost carrying the f32 accumulator."""
+    k sweep innermost carrying the f32 accumulator. Windowed: the k sweep
+    is the band only (see _kernel), masked by the unclamped index."""
     i = pl.program_id(1)
-    j = pl.program_id(2)
+    jj = pl.program_id(2)
     n_j = pl.num_programs(2)
+    if window:
+        j = _win_lo_k(i, block_q=block_q, block_k=block_k, window=window) + jj
+    else:
+        j = jj
 
-    @pl.when(j == 0)
+    @pl.when(jj == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -247,7 +313,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _step():
         _, ds = _bwd_p_ds(
             q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(jnp.float32),
-            lse_ref[0], delta_ref[0], i, j, causal=causal, scale=scale,
+            lse_ref[0], delta_ref[0], i, j, causal=causal,
             block_q=block_q, block_k=block_k, kv_len=kv_len, window=window,
         )
         acc_ref[:] += jax.lax.dot_general(
@@ -255,39 +321,52 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(j == n_j - 1)
+    @pl.when(jj == n_j - 1)
     def _finalize():
         dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                     dv_ref, dk_acc, dv_acc, *, causal, scale, block_q,
-                    block_k, kv_len, window):
-    """dK = scale * sum_i dS_ij^T Q_i and dV = sum_i P_ij^T dO_i, summed
+                    block_k, kv_len, window, q_blocks):
+    """dK = ln2 * sum_i dS_ij^T Q_hat_i and dV = sum_i P_ij^T dO_i, summed
     over every q-head in the kv-head's group; grid (kv_heads, k_blocks,
     group, q_blocks) — the (group, q) double sweep is innermost and
     contiguous per (kv_head, k_block), carrying both f32 accumulators, so
-    one kernel covers MHA (group=1) and GQA/MQA alike."""
+    one kernel covers MHA (group=1) and GQA/MQA alike. Windowed: the q
+    sweep covers only the q-blocks that can see k-block j (grid shrink;
+    the unclamped index feeds the liveness mask). ``q_blocks`` bounds the
+    sweep from above: unlike the forward/dQ k-sweep — where an overrun
+    index is past the diagonal and hence causal-dead — an overrun q index
+    here is MORE causal-valid, so without the explicit ``i < q_blocks``
+    kill the clamped duplicate of the last q-block would re-accumulate
+    into dK/dV (caught by review: ~7% dK/dV error in trailing k-blocks)."""
     j = pl.program_id(1)
     g = pl.program_id(2)
-    i = pl.program_id(3)
+    ii = pl.program_id(3)
     n_g = pl.num_programs(2)
     n_i = pl.num_programs(3)
+    if window:
+        i = _win_lo_q(j, block_q=block_q, block_k=block_k, window=window) + ii
+    else:
+        i = ii
 
-    @pl.when((i == 0) & (g == 0))
+    @pl.when((ii == 0) & (g == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     run = _block_live(i, j, causal=causal, block_q=block_q,
                       block_k=block_k, window=window)
+    if window:
+        run = jnp.logical_and(run, i < q_blocks)
 
     @pl.when(run)
     def _step():
         do = do_ref[0].astype(jnp.float32)
         p, ds = _bwd_p_ds(
             q_ref[0], k_ref[0], v_ref[0], do, lse_ref[0], delta_ref[0],
-            i, j, causal=causal, scale=scale, block_q=block_q,
+            i, j, causal=causal, block_q=block_q,
             block_k=block_k, kv_len=kv_len, window=window,
         )
         dv_acc[:] += jax.lax.dot_general(
@@ -299,9 +378,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when((i == n_i - 1) & (g == n_g - 1))
+    @pl.when((ii == n_i - 1) & (g == n_g - 1))
     def _finalize():
-        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        # q_ref holds the prescaled q_hat = q * scale * log2(e), so the
+        # exact gradient of the computed forward is dK = ln2 * dS^T q_hat
+        # (d s2/d k = q_hat, base-2 softmax jacobian carries ln2) — the
+        # natural-domain scale factor is already inside q_hat.
+        dk_ref[0] = (dk_acc[:] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -323,39 +406,81 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     group = h // hk
     dv_dim = v.shape[2]
     kv_len = k.shape[1]
+    # The backward holds three (block_q, block_k) f32 intermediates per
+    # step (p, dP, dS) where the forward holds two, so 1024-wide blocks
+    # that fit the forward overflow scoped VMEM here — clamp to 512.
+    block_q = min(block_q, 512)
+    block_k = min(block_k, 512)
     # D_i = rowsum(dO * O): one cheap fused elementwise+reduce in XLA.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # Reproduce the forward's prescale EXACTLY (multiply in >= f32, round
+    # back to q.dtype) so the recomputed logit tiles match the ones the
+    # saved lse was computed from — see _bwd_p_ds.
+    prescale_dtype = jnp.promote_types(q.dtype, jnp.float32)
+    q = (q.astype(prescale_dtype) * (scale * _LOG2E)).astype(q.dtype)
     qp = pad_to_multiple(q, 1, block_q)
     gp = pad_to_multiple(g, 1, block_q)
-    # Pad lse with a large POSITIVE value: recomputed pad-row tiles then get
-    # p = exp2(s2 - big) = 0 (a -inf pad would make them explode).
+    # Pad lse rows with a large POSITIVE value: recomputed pad-row tiles
+    # then get p = exp2(s2 - big) = 0 (a -inf pad would make them explode).
+    # Both lse (already lane-replicated from the forward) and delta are fed
+    # as (h, sq, LANES) so their block specs satisfy Mosaic's minor-dim
+    # divisibility rule — a (1, block_q) block does not.
     pad_rows = qp.shape[1] - sq
     if pad_rows:
         lse = jnp.concatenate(
-            [lse, jnp.full((h, pad_rows), 1e30, jnp.float32)], axis=1)
+            [lse, jnp.full((h, pad_rows, _LANES), 1e30, jnp.float32)],
+            axis=1)
         delta = jnp.concatenate(
             [delta, jnp.zeros((h, pad_rows), jnp.float32)], axis=1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
     kp = pad_to_multiple(k, 1, block_k)
     vp = pad_to_multiple(v, 1, block_k)
     n_q, n_k = qp.shape[1] // block_q, kp.shape[1] // block_k
 
     common = dict(causal=causal, scale=scale, block_q=block_q,
                   block_k=block_k, kv_len=kv_len, window=window)
+    # Windowed grid shrink, mirroring the forward: the dQ kernel sweeps
+    # only the band's k-blocks per q-block; the dK/dV kernel sweeps only
+    # the q-blocks that can see each k-block.
+    if window:
+        n_ksweep = _win_kblocks(
+            n_k, block_q=block_q, block_k=block_k, window=window)
+
+        def _kv_map(h, i, jj, group=group):
+            lo = _win_lo_k(i, block_q=block_q, block_k=block_k, window=window)
+            return (h // group, jnp.minimum(lo + jj, n_k - 1), 0)
+
+        n_qsweep = _win_qblocks(
+            n_q, block_q=block_q, block_k=block_k, window=window)
+
+        def _qblk(j, ii):
+            lo = _win_lo_q(j, block_q=block_q, block_k=block_k, window=window)
+            return jnp.minimum(lo + ii, n_q - 1)
+
+        def _qmap_w(group=group):
+            return lambda hk, j, g, i: (hk * group + g, _qblk(j, i), 0)
+
+        qmap = _qmap_w()
+    else:
+        n_ksweep, n_qsweep = n_k, n_q
+
+        def _kv_map(h, i, j, group=group):
+            return (h // group, j, 0)
+
+        qmap = _qmap(group)
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
-        grid=(h, n_q, n_k),
+        grid=(h, n_q, n_ksweep),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda h, i, j: (h // group, j, 0)),
-            pl.BlockSpec((1, block_k, dv_dim),
-                         lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((1, block_k, d), _kv_map),
+            pl.BlockSpec((1, block_k, dv_dim), _kv_map),
             pl.BlockSpec((1, block_q, dv_dim), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda h, i, j: (h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
         out_shape=_out_struct(qp, (h, qp.shape[1], d)),
@@ -372,16 +497,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                              "arbitrary"),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(hk, n_k, group, n_q),
+        functools.partial(_bwd_dkv_kernel, **common, q_blocks=n_q),
+        grid=(hk, n_k, group, n_qsweep),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), _qmap(group)),
+            pl.BlockSpec((1, block_q, d), qmap),
             pl.BlockSpec((1, block_k, d), lambda hk, j, g, i: (hk, j, 0)),
             pl.BlockSpec((1, block_k, dv_dim),
                          lambda hk, j, g, i: (hk, j, 0)),
-            pl.BlockSpec((1, block_q, dv_dim), _qmap(group)),
-            pl.BlockSpec((1, block_q), _qmap2(group)),
-            pl.BlockSpec((1, block_q), _qmap2(group)),
+            pl.BlockSpec((1, block_q, dv_dim), qmap),
+            pl.BlockSpec((1, block_q, _LANES), qmap),
+            pl.BlockSpec((1, block_q, _LANES), qmap),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda hk, j, g, i: (hk, j, 0)),
@@ -405,13 +530,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
 
 def _qmap(group):
-    """(kv_head, k_blk, group_member, q_blk) -> q-head-indexed 3-D block."""
+    """(kv_head, k_blk, group_member, q_blk) -> q-head-indexed 3-D block
+    (q/dO tiles and the lane-replicated lse/delta tiles alike)."""
     return lambda hk, j, g, i: (hk * group + g, i, 0)
-
-
-def _qmap2(group):
-    """Same, for the 2-D lse/delta operands."""
-    return lambda hk, j, g, i: (hk * group + g, i)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -458,11 +579,11 @@ def flash_attention(
     """softmax(Q K^T * scale) V, flash-tiled, single device.
 
     ``window`` > 0 (requires ``causal``) restricts each query to the last
-    ``window`` key positions (sliding-window attention). K-blocks outside
-    the band skip their compute entirely (``pl.when``), so MXU/VPU work
-    scales with S * window instead of S^2; their tiles are still DMA'd by
-    the pipeline, so HBM reads are NOT reduced — shrink the grid via a
-    prefetch scheme if bandwidth ever becomes the windowed bottleneck.
+    ``window`` key positions (sliding-window attention). The k sweep is
+    grid-shrunk to the band (forward, dQ, and dK/dV kernels alike), so
+    out-of-band K/V tiles are never DMA'd: MXU work AND HBM reads both
+    scale with S * window instead of S^2. block_k is capped near window/2
+    for windowed runs so the swept band tracks the window tightly.
 
     Shapes: (S, D) single-head or (S, H, D) multi-head; K/V lengths may
     differ from Q's (cross attention), and K/V may carry FEWER heads than Q
@@ -493,6 +614,11 @@ def flash_attention(
     single = q.ndim == 2
     if single:
         q, k, v = q[:, None, :], k[:, None, :], v[:, None, :]
+    if window:
+        # The shrunk sweep reads ~(block_q + window + 2*block_k) key rows
+        # per q-block, so a block_k much wider than the window defeats the
+        # grid shrink; cap it near window/2 (128-row floor).
+        block_k = max(128, min(block_k, (window // 2 + 127) // 128 * 128))
     # Clamp blocks to the (sublane-padded) sequence lengths.
     block_q = min(block_q, -(-q.shape[0] // 16) * 16)
     block_k = min(block_k, -(-k.shape[0] // 16) * 16)

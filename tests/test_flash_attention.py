@@ -293,6 +293,66 @@ class TestSlidingWindow:
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, q, q, window=4)
 
+    def test_window_grads_multiblock_no_double_count(self, rng):
+        # Regression (r03 review): the dK/dV kernel's shrunk q sweep can
+        # overrun n_q; the clamped duplicate of the LAST q-block is MORE
+        # causal-valid (unlike the forward's k overrun, which is dead past
+        # the diagonal) and was re-accumulated into dk/dv — ~7% error
+        # concentrated in the trailing k-blocks. Needs multiple blocks AND
+        # an overrunning sweep, which the small single-block shapes above
+        # never hit: S=512 with 128-blocks and window=128 sweeps
+        # lo_q(n_k-1) + ii past n_q.
+        s_len, h, d, w = 512, 2, 64, 128
+
+        def banded(q, k, v):
+            qf, kf, vf = (jnp.swapaxes(x, 0, 1).astype(jnp.float32)
+                          for x in (q, k, v))
+            logits = jnp.einsum("hsd,htd->hst", qf, kf) / np.sqrt(d)
+            kp = jnp.arange(s_len)[None, :]
+            qp = jnp.arange(s_len)[:, None]
+            mask = (kp <= qp) & (kp > qp - w)
+            logits = jnp.where(mask[None], logits, -1e30)
+            return jnp.einsum("hst,htd->shd", jax.nn.softmax(logits, -1), vf)
+
+        q, k, v = (jnp.asarray(rng.standard_normal((s_len, h, d)),
+                               jnp.float32) for _ in range(3))
+        args = dict(causal=True, window=w, block_q=128, block_k=128)
+        g = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, **args) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(banded(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a_, b_ in zip("q k v".split(), g, gr):
+            np.testing.assert_allclose(
+                np.asarray(a_), np.asarray(b_), rtol=2e-3, atol=2e-4,
+                err_msg=f"d{name}")
+
+    @pytest.mark.parametrize("bq,bk,w,n", [
+        (128, 128, 128, 16), (128, 64, 96, 9), (64, 128, 200, 7),
+        (256, 128, 512, 32), (96, 96, 100, 5), (128, 128, 1, 4),
+    ])
+    def test_shrunk_sweep_covers_every_live_block(self, bq, bk, w, n):
+        # The windowed grid shrink (HBM reads ~ S*window) must never drop
+        # a live (i, j) pair: for every q-block i, all k-blocks passing
+        # _block_live lie inside [lo_k(i), lo_k(i) + nb_w); dually for the
+        # dK/dV kernel's q sweep.
+        from marlin_tpu.ops.flash_attention import (
+            _block_live, _win_kblocks, _win_lo_k, _win_lo_q, _win_qblocks)
+
+        nb_w = _win_kblocks(n, block_q=bq, block_k=bk, window=w)
+        nb_q = _win_qblocks(n, block_q=bq, block_k=bk, window=w)
+        for i in range(n):
+            lo = int(_win_lo_k(i, block_q=bq, block_k=bk, window=w))
+            for j in range(n):
+                if bool(_block_live(i, j, causal=True, block_q=bq,
+                                    block_k=bk, window=w)):
+                    assert lo <= j < lo + nb_w, (i, j, lo, nb_w)
+        for j in range(n):
+            lo = int(_win_lo_q(j, block_q=bq, block_k=bk, window=w))
+            for i in range(n):
+                if bool(_block_live(i, j, causal=True, block_q=bq,
+                                    block_k=bk, window=w)):
+                    assert lo <= i < lo + nb_q, (j, i, lo, nb_q)
+
 
 class TestFlashBackwardKernels:
     """The Pallas flash backward (dQ + dK/dV kernels, probability tiles
