@@ -96,3 +96,75 @@ class TestConfigsRegistry:
     def test_every_config_has_callable(self):
         for name, fns in bench.CONFIGS.items():
             assert fns and all(callable(f) for f in fns), name
+
+    def test_every_artifact_config_has_cache_prefix(self):
+        # Every "all" config must be replayable from captures on a dead
+        # tunnel — a new config without a _CACHE_PREFIX entry would silently
+        # drop out of the fallback artifact.
+        for fn in bench.CONFIGS["all"]:
+            assert fn.__name__ in bench._CACHE_PREFIX, fn.__name__
+
+
+class TestCachedFallback:
+    """Dead-tunnel artifact fallback (VERDICT r02 item 2): BENCH_r0{1,2}
+    both went rc=1 because the backend was unreachable at capture time even
+    though valid on-hardware lines existed in docs/bench_captures/."""
+
+    def _write(self, path, lines):
+        with open(path, "w") as f:
+            for line in lines:
+                f.write(json.dumps(line) + "\n")
+
+    def test_latest_valid_line_wins(self, tmp_path):
+        old = {"metric": "dense_gemm_tflops_per_chip_32k", "value": 100.0,
+               "unit": "TFLOPS/chip", "vs_baseline": 1.0}
+        new = dict(old, value=186.58)
+        self._write(tmp_path / "a.jsonl", [old])
+        self._write(tmp_path / "b.jsonl", [new])
+        import os
+        import time
+
+        now = time.time()
+        os.utime(tmp_path / "a.jsonl", (now - 7200, now - 7200))
+        os.utime(tmp_path / "b.jsonl", (now - 60, now - 60))
+        best = bench._load_cached_lines(str(tmp_path))
+        assert best["headline"][1]["value"] == 186.58
+        assert best["headline"][2] == "b.jsonl"
+
+    def test_error_and_failed_oracle_lines_skipped(self, tmp_path):
+        self._write(tmp_path / "c.jsonl", [
+            {"metric": "lu_dist_16k_seconds", "value": 0.0, "unit": "error",
+             "vs_baseline": 0, "error": "boom"},
+            {"metric": "lu_dist_16k_seconds", "value": 1.2, "unit": "s",
+             "vs_baseline": 0.4, "oracle_ok": False},
+            {"metric": "cholesky_dist_16k_seconds", "value": 0.3, "unit": "s",
+             "vs_baseline": 0.4, "oracle_ok": True},
+        ])
+        best = bench._load_cached_lines(str(tmp_path))
+        assert "config_lu" not in best  # error + failed oracle don't count
+        assert best["config_cholesky"][1]["value"] == 0.3
+
+    def test_emit_tags_lines_and_counts(self, tmp_path, capsys):
+        self._write(tmp_path / "d.jsonl", [
+            {"metric": "dense_gemm_tflops_per_chip_32k", "value": 186.58,
+             "unit": "TFLOPS/chip", "vs_baseline": 1.894},
+        ])
+        n = bench._emit_cached_results("headline", "tunnel dead",
+                                       str(tmp_path))
+        assert n == 1
+        d = json.loads(capsys.readouterr().out.strip())
+        assert d["cached"] is True and d["value"] == 186.58
+        assert d["backend_error"] == "tunnel dead"
+        assert d["cached_from"].endswith("d.jsonl")
+        assert d["cached_age_hours"] >= 0
+
+    def test_emit_empty_dir_returns_zero(self, tmp_path):
+        assert bench._emit_cached_results("headline", "e", str(tmp_path)) == 0
+
+    def test_real_capture_dir_covers_headline(self):
+        # The shipped capture files must already satisfy the fallback for
+        # the default --config, or BENCH_r03 would still go rc=1 on a dead
+        # tunnel at end-of-round.
+        best = bench._load_cached_lines()
+        assert "headline" in best
+        assert best["headline"][1]["value"] > 0
