@@ -20,6 +20,13 @@ The reference writes one part-file per RDD partition into a directory; we keep
 the directory layout (``part-00000`` ...) so files interoperate, and also accept
 single plain files on load. "Directory of files" loaders (loadMatrixFiles,
 MTUtils.scala:350) are the same code path here.
+
+Every loader/saver accepts remote-filesystem URIs (``gs://bucket/path``,
+``memory://...``, anything fsspec speaks) as well as plain local paths —
+the TPU-native analogue of the reference reading/writing any Hadoop
+filesystem URI (HDFS/Tachyon/local; MTUtils.scala:286, 324;
+DenseVecMatrix.scala:1042 via Hadoop TextOutputFormat). Plain paths never
+touch fsspec (fast local path).
 """
 
 from __future__ import annotations
@@ -31,6 +38,43 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 _SEP = re.compile(r",\s?|\s+")
+
+
+# ---------------------------------------------------------------------------
+# Filesystem shim: plain paths -> os/open; URIs with a scheme -> fsspec
+# ---------------------------------------------------------------------------
+
+
+def _is_uri(path) -> bool:
+    return "://" in str(path)
+
+
+def _fs_for(path: str):
+    """(fsspec filesystem, fs-native path) behind a URI."""
+    import fsspec
+
+    return fsspec.core.url_to_fs(str(path))
+
+
+def _open(path: str, mode: str = "r"):
+    if _is_uri(path):
+        fs, p = _fs_for(path)
+        return fs.open(p, mode)
+    return open(path, mode)
+
+
+def _join(path: str, name: str) -> str:
+    if _is_uri(path):
+        return str(path).rstrip("/") + "/" + name
+    return os.path.join(path, name)
+
+
+def _makedirs(path: str) -> None:
+    if _is_uri(path):
+        fs, p = _fs_for(path)
+        fs.makedirs(p, exist_ok=True)
+        return
+    os.makedirs(path, exist_ok=True)
 
 
 def _data_lines(path: str) -> List[str]:
@@ -60,6 +104,17 @@ STREAM_CHUNK_BYTES = 8 << 20
 
 def _input_files(path: str) -> List[str]:
     """The data files behind ``path`` (itself, or a dir's non-hidden files)."""
+    if _is_uri(path):
+        fs, root = _fs_for(path)
+        if not fs.isdir(root):
+            return [str(path)]
+        out = []
+        for info in sorted(fs.ls(root, detail=True), key=lambda d: d["name"]):
+            name = os.path.basename(str(info["name"]).rstrip("/"))
+            if name.startswith(("_", ".")) or info.get("type") == "directory":
+                continue
+            out.append(fs.unstrip_protocol(info["name"]))
+        return out
     if not os.path.isdir(path):
         return [path]
     return [
@@ -74,7 +129,7 @@ def _iter_lines(path: str):
     """Yield non-empty stripped lines of a file / directory of part-files
     WITHOUT materializing them (the streaming loaders' input)."""
     for p in _input_files(path):
-        with open(p) as f:
+        with _open(p) as f:
             for ln in f:
                 ln = ln.strip()
                 if ln:
@@ -85,7 +140,7 @@ def _iter_text_chunks(path: str):
     """Yield ~STREAM_CHUNK_BYTES byte chunks of COMPLETE lines."""
     for p in _input_files(path):
         rem = b""
-        with open(p, "rb") as f:
+        with _open(p, "rb") as f:
             while True:
                 buf = f.read(STREAM_CHUNK_BYTES)
                 if not buf:
@@ -102,6 +157,12 @@ def _iter_text_chunks(path: str):
 
 
 def _input_size_mb(path: str) -> float:
+    if _is_uri(path):
+        total = 0
+        for p in _input_files(path):
+            fs, fp = _fs_for(p)
+            total += fs.size(fp) or 0
+        return total / 1e6
     return sum(os.path.getsize(p) for p in _input_files(path)) / 1e6
 
 
@@ -238,10 +299,10 @@ def save_dense_matrix(
         if native.available():
             text = native.format_dense_text(arr)
             if text is not None:
-                os.makedirs(path, exist_ok=True)
-                with open(os.path.join(path, "part-00000"), "wb") as f:
+                _makedirs(path)
+                with _open(_join(path, "part-00000"), "wb") as f:
                     f.write(text)
-                open(os.path.join(path, "_SUCCESS"), "w").close()
+                _open(_join(path, "_SUCCESS"), "w").close()
                 return
     _write_parts(
         path,
@@ -252,13 +313,13 @@ def save_dense_matrix(
 
 def save_dense_matrix_with_description(mat, path: str, name: str = "N/A") -> None:
     save_dense_matrix(mat, path)
-    with open(os.path.join(path, "_description"), "w") as f:
+    with _open(_join(path, "_description"), "w") as f:
         f.write(f"MatrixName\t{name}\nMatrixSize\t{mat.num_rows} {mat.num_cols}")
 
 
 def load_description(path: str) -> Tuple[str, int, int]:
     """Read a ``_description`` file -> (name, rows, cols)."""
-    with open(os.path.join(path, "_description")) as f:
+    with _open(_join(path, "_description")) as f:
         text = f.read()
     name = "N/A"
     rows = cols = 0
@@ -385,16 +446,16 @@ def load_svm_den_vec_matrix(path: str, vector_len: int, mesh=None, dtype=None):
 
 def _write_parts(path: str, lines: List[str], parts: Optional[int] = None) -> None:
     """Write lines into Hadoop-style part-files + _SUCCESS marker."""
-    os.makedirs(path, exist_ok=True)
+    _makedirs(path)
     parts = max(1, parts or 1)
     per = -(-len(lines) // parts)
     for p in range(parts):
         chunk = lines[p * per : (p + 1) * per]
-        with open(os.path.join(path, f"part-{p:05d}"), "w") as f:
+        with _open(_join(path, f"part-{p:05d}"), "w") as f:
             f.write("\n".join(chunk))
             if chunk:
                 f.write("\n")
-    open(os.path.join(path, "_SUCCESS"), "w").close()
+    _open(_join(path, "_SUCCESS"), "w").close()
 
 
 def array_to_matrix(arr, mesh=None):
