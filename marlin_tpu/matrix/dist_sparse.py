@@ -62,6 +62,17 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 _ENTRY_CHUNK = 128  # storage-cap quantum for the padded (n_dev, cap) triples
+# Auto-dispatch budget for the DENSE fast path: when the densified
+# operands + result fit this many bytes per device, the sparse products
+# scatter their COO stripes into dense stripes and run an MXU ring instead
+# of the gather/segment-sum ring. On TPU the MXU wins at any practical
+# density (measured 16k/1e-3: the gather ring does ~2-3 GFLOP/s of real
+# work, the dense ring >10 TFLOPS of padded work — a >50x wall-clock win);
+# what the gather ring buys is MEMORY, never materializing a dense operand,
+# so it remains the big-shape arm. The reference's analogous escape hatch
+# is its densify-then-multiply SparseMultiply modes (SparseMultiply.scala
+# :44-82); design.md §4 documents the policy.
+_DENSIFY_BUDGET_BYTES = 4 << 30
 # The ring kernels expand A entries into a (chunk, n) buffer per loop step.
 # Each fori_loop step costs a full accumulator-stripe pass (the functional
 # scatter-add rewrites the (m_stripe, n) carry), so FEWER, LARGER chunks win
@@ -244,21 +255,54 @@ class DistSparseVecMatrix:
         return self.vals.dtype
 
     # -- products -----------------------------------------------------------
-    def multiply_sparse(self, other: "DistSparseVecMatrix"):
+    def _use_dense_route(self, k: int, n: int, mode: str) -> bool:
+        """Auto-dispatch: dense MXU ring when the densified operands fit
+        the per-device budget (see _DENSIFY_BUDGET_BYTES), gather ring
+        otherwise. ``mode``: "auto" | "dense" | "ring"."""
+        if mode == "dense":
+            return True
+        if mode == "ring":
+            return False
+        if mode != "auto":
+            raise ValueError(f"unknown sparse multiply mode {mode!r}")
+        m = self.num_rows
+        nd = _n_dev(self.mesh)
+        # The f32 accumulator stripe is the floor even for narrower values.
+        itemsize = max(jnp.dtype(self.vals.dtype).itemsize, 4)
+        per_dev = itemsize * (m * k + k * n + m * n) // nd
+        return per_dev <= _DENSIFY_BUDGET_BYTES
+
+    def densify_stripes(self) -> jax.Array:
+        """Row-sharded dense stripes of the full matrix: each device
+        scatters its resident COO triple into its (stripe, n_cols) block.
+        The densify half of the dense fast path (the reference's
+        sparse-to-dense modes, SparseMultiply.scala:44-82)."""
+        fn = _densify_fn(self.mesh, _n_dev(self.mesh), self.stripe,
+                         self.num_cols, jnp.dtype(self.vals.dtype))
+        return fn(self.rows, self.cols, self.vals)
+
+    def multiply_sparse(self, other: "DistSparseVecMatrix",
+                        mode: str = "auto"):
         """Sparse x sparse -> CoordinateMatrix with mesh-sharded triples
-        (``multiplySparse``, SparseVecMatrix.scala:22-50)."""
+        (``multiplySparse``, SparseVecMatrix.scala:22-50). ``mode`` picks
+        the engine: "dense" (densified MXU ring), "ring" (gather ring), or
+        "auto" (dense when it fits the per-device memory budget)."""
         from .sparse import CoordinateMatrix
 
         if self.num_cols != other.num_rows:
             raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
-        dense = self._product_stripes(other)
+        if self._use_dense_route(self.num_cols, other.num_cols, mode):
+            a_dense = self.densify_stripes()
+            dense = _dense_ring_matmul(self, a_dense, other.densify_stripes())
+        else:
+            dense = self._product_stripes(other)
         r, c, v = _extract_coo_stripes(dense, self.mesh)
         return CoordinateMatrix(
             r.reshape(-1), c.reshape(-1), v.reshape(-1),
             shape=(self.num_rows, other.num_cols), mesh=self.mesh, padded=True,
         )
 
-    def multiply_dense(self, other):
+    def multiply_dense(self, other, mode: str = "auto"):
         """Sparse x row-distributed dense -> row-distributed dense: the same
         ring with B's resident dense stripes rotating (the reference's
         sparse-times-densified-rows mode, SparseMultiply.scala:44-56)."""
@@ -266,7 +310,8 @@ class DistSparseVecMatrix:
 
         if self.num_cols != other.num_rows:
             raise ValueError(f"dimension mismatch: {self.shape} x {other.shape}")
-        return DenseVecMatrix(_spmm_array(self, other.logical), mesh=self.mesh)
+        return DenseVecMatrix(_spmm_array(self, other.logical, mode=mode),
+                              mesh=self.mesh)
 
     def transpose(self) -> "DistSparseVecMatrix":
         """A^T as a new row-partitioned instance, cached both ways
@@ -335,10 +380,12 @@ class DistSparseVecMatrix:
                 f"devices={_n_dev(self.mesh)})")
 
 
-def _spmm_array(a: "DistSparseVecMatrix", b: jax.Array) -> jax.Array:
-    """Core sparse x dense ring on a plain (k, n) array -> (m, n) array
-    (row-sharded). Jit-safe: the device_put becomes a sharding constraint
-    under an outer jit, like the other engines."""
+def _spmm_array(a: "DistSparseVecMatrix", b: jax.Array,
+                mode: str = "auto") -> jax.Array:
+    """Core sparse x dense product on a plain (k, n) array -> (m, n) array
+    (row-sharded): dense MXU ring on the densified stripes when the budget
+    allows, gather ring otherwise. Jit-safe: the device_put becomes a
+    sharding constraint under an outer jit, like the other engines."""
     from ..mesh import row_sharding
 
     nd = _n_dev(a.mesh)
@@ -347,10 +394,29 @@ def _spmm_array(a: "DistSparseVecMatrix", b: jax.Array) -> jax.Array:
     if pad:
         b = jnp.pad(b, ((0, pad), (0, 0)))
     b = jax.device_put(b, row_sharding(a.mesh))
-    out = _spmm_ring_dense(a.mesh, nd, a.stripe, k_stripe, int(b.shape[1]))(
-        a.rows, a.cols, a.vals, b
-    )
+    if a._use_dense_route(a.num_cols, int(b.shape[1]), mode):
+        out = _dense_ring_matmul(a, a.densify_stripes(), b)
+    else:
+        out = _spmm_ring_dense(a.mesh, nd, a.stripe, k_stripe,
+                               int(b.shape[1]))(a.rows, a.cols, a.vals, b)
     return out[: a.num_rows]
+
+
+def _dense_ring_matmul(a_sp: "DistSparseVecMatrix", a_dense: jax.Array,
+                       b_dense: jax.Array) -> jax.Array:
+    """Dense-route product core: row-sharded dense A stripes stay resident,
+    B's row-sharded stripes rotate the ICI ring, each hop contributing one
+    (m_stripe, k_stripe) x (k_stripe, n) MXU matmul — dense SUMMA in ring
+    form, reusing the sparse types' row partitioning as-is."""
+    mesh = a_sp.mesh
+    nd = _n_dev(mesh)
+    k_stripe = b_dense.shape[0] // nd
+    col_pad = nd * k_stripe - a_dense.shape[1]
+    if col_pad:  # tail hop's k-slice must stay in-bounds; pad cols w/ zeros
+        a_dense = jnp.pad(a_dense, ((0, 0), (0, col_pad)))
+    fn = _dense_ring(mesh, nd, k_stripe, int(b_dense.shape[1]),
+                     get_config().linalg_precision)
+    return fn(a_dense, b_dense)
 
 
 def spmm(a: "DistSparseVecMatrix", b: jax.Array) -> jax.Array:
@@ -420,6 +486,55 @@ def _chunked_accumulate(acc, a_r, a_c, a_v, stripe_src, k0, row0, chunk):
         return acc.at[rr - row0].add(contrib, mode="drop")
 
     return jax.lax.fori_loop(first, last, chunk_step, acc)
+
+
+@functools.cache
+def _densify_fn(mesh: Mesh, nd: int, stripe: int, n_cols: int, dtype):
+    """Each device scatters its resident COO triple into its dense
+    (stripe, n_cols) block; duplicates add (same contract as to_numpy) and
+    the value-0 pads contribute nothing."""
+    axes = _ring_axes(mesh)
+
+    def kernel(r, c, v):
+        row0 = jax.lax.axis_index(axes) * stripe
+        out = jnp.zeros((stripe, n_cols), dtype)
+        return out.at[r[0] - row0, c[0]].add(v[0], mode="drop")
+
+    spec = P(axes, None)
+    f = _shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+    return jax.jit(f)
+
+
+@functools.cache
+def _dense_ring(mesh: Mesh, nd: int, k_stripe: int, n_cols: int, precision):
+    """Dense MXU ring (see _dense_ring_matmul). Accumulates f32 on the MXU
+    and casts back once at the boundary, like the gather ring."""
+    axes = _ring_axes(mesh)
+
+    def kernel(a, b):
+        i = jax.lax.axis_index(axes)
+        perm = [(s, (s - 1) % nd) for s in range(nd)]
+        out_t = jnp.result_type(a.dtype, b.dtype)
+        acc_t = jnp.promote_types(out_t, jnp.float32)
+
+        def step(t, carry):
+            b_cur, acc = carry
+            src = (i + t) % nd
+            panel = jax.lax.dynamic_slice_in_dim(a, src * k_stripe,
+                                                 k_stripe, 1)
+            acc = acc + jax.lax.dot_general(
+                panel, b_cur, (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_t, precision=precision,
+            )
+            return jax.lax.ppermute(b_cur, axes, perm), acc
+
+        acc0 = _pvary(jnp.zeros((a.shape[0], n_cols), acc_t), axes)
+        _, acc = jax.lax.fori_loop(0, nd, step, (b, acc0))
+        return acc.astype(out_t)
+
+    spec = P(axes, None)
+    f = _shard_map(kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+    return jax.jit(f)
 
 
 @functools.cache

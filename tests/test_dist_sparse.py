@@ -45,17 +45,19 @@ class TestDistSparseVecMatrix:
         back = dist.to_sparse_vec_matrix()
         np.testing.assert_allclose(back.to_numpy(), svm.to_numpy())
 
+    @pytest.mark.parametrize("mode", ["ring", "dense"])
     @pytest.mark.parametrize("shape_a,shape_b,density", [
         ((48, 40), (40, 56), 0.15),
         ((17, 23), (23, 9), 0.3),    # uneven stripes
         ((64, 64), (64, 64), 0.02),  # sparse enough for empty stripes
     ])
-    def test_multiply_sparse_vs_oracle(self, rng, shape_a, shape_b, density):
+    def test_multiply_sparse_vs_oracle(self, rng, shape_a, shape_b, density,
+                                       mode):
         ra, ca, va = _random_coo(rng, *shape_a, density)
         rb, cb, vb = _random_coo(rng, *shape_b, density)
         a = DistSparseVecMatrix.from_coo(ra, ca, va, shape_a)
         b = DistSparseVecMatrix.from_coo(rb, cb, vb, shape_b)
-        out = a.multiply_sparse(b)
+        out = a.multiply_sparse(b, mode=mode)
         assert isinstance(out, CoordinateMatrix)
         oracle = _dense(ra, ca, va, shape_a) @ _dense(rb, cb, vb, shape_b)
         np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
@@ -74,11 +76,12 @@ class TestDistSparseVecMatrix:
         oracle = _dense(ra, ca, va, (48, 40)) @ _dense(rb, cb, vb, (40, 32))
         assert out.nnz == int(np.count_nonzero(oracle))
 
-    def test_multiply_dense_vs_oracle(self, rng):
+    @pytest.mark.parametrize("mode", ["ring", "dense"])
+    def test_multiply_dense_vs_oracle(self, rng, mode):
         ra, ca, va = _random_coo(rng, 40, 48, 0.2)
         bd = rng.standard_normal((48, 24))
         a = DistSparseVecMatrix.from_coo(ra, ca, va, (40, 48))
-        out = a.multiply_dense(DenseVecMatrix(bd))
+        out = a.multiply_dense(DenseVecMatrix(bd), mode=mode)
         assert isinstance(out, DenseVecMatrix)
         oracle = _dense(ra, ca, va, (40, 48)) @ bd
         np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
@@ -104,7 +107,7 @@ class TestDistSparseVecMatrix:
             a = DistSparseVecMatrix(r, c, v, (n, n))
             eye_r, eye_c = np.arange(n), np.arange(n)
             b = DistSparseVecMatrix.from_coo(eye_r, eye_c, np.ones(n), (n, n))
-            out = a.multiply_sparse(b)
+            out = a.multiply_sparse(b, mode="ring")
             np.testing.assert_allclose(out.to_numpy(), a.to_numpy())
 
     def test_padded_to_bcoo_filters_pads(self, rng, mesh):
@@ -152,7 +155,8 @@ class TestDistSparseVecMatrix:
         b = DistSparseVecMatrix.from_coo(rb, cb, vb, (k, n))
         oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
         np.testing.assert_allclose(
-            a.multiply_sparse(b).to_numpy(), oracle, rtol=1e-10, atol=1e-10
+            a.multiply_sparse(b, mode="ring").to_numpy(), oracle,
+            rtol=1e-10, atol=1e-10
         )
 
 
@@ -207,6 +211,62 @@ class TestPaddedCoordinateConsumers:
         assert len(coo2.compact_triples()[2]) == 3
 
 
+class TestDenseRoute:
+    """Auto-dispatch between the dense MXU ring and the gather ring (the
+    TPU-native counterpart of the reference's densify-then-multiply
+    SparseMultiply modes)."""
+
+    def test_auto_picks_dense_when_it_fits(self, rng):
+        r, c, v = _random_coo(rng, 32, 32, 0.2)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (32, 32))
+        assert a._use_dense_route(32, 32, "auto")
+
+    def test_auto_falls_back_to_ring_over_budget(self, rng, monkeypatch):
+        import marlin_tpu.matrix.dist_sparse as ds
+
+        monkeypatch.setattr(ds, "_DENSIFY_BUDGET_BYTES", 0)
+        r, c, v = _random_coo(rng, 32, 32, 0.2)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (32, 32))
+        assert not a._use_dense_route(32, 32, "auto")
+        # And the product through auto still matches the oracle.
+        b = DistSparseVecMatrix.from_coo(r, c, v, (32, 32))
+        oracle = _dense(r, c, v, (32, 32)) @ _dense(r, c, v, (32, 32))
+        np.testing.assert_allclose(
+            a.multiply_sparse(b).to_numpy(), oracle, rtol=1e-10, atol=1e-10)
+
+    def test_unknown_mode_raises(self, rng):
+        r, c, v = _random_coo(rng, 8, 8, 0.3)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (8, 8))
+        with pytest.raises(ValueError, match="mode"):
+            a.multiply_sparse(a, mode="bogus")
+
+    def test_densify_stripes_matches_to_numpy(self, rng, mesh):
+        r, c, v = _random_coo(rng, 20, 12, 0.3)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (20, 12))
+        stripes = np.asarray(a.densify_stripes())
+        # Row-sharded over the mesh; rows past num_rows are stripe padding.
+        assert len(a.vals.sharding.device_set) == len(mesh.devices.flat)
+        np.testing.assert_allclose(stripes[:20], a.to_numpy())
+        assert not stripes[20:].any()
+
+    def test_dense_route_duplicate_entries_add(self, rng):
+        # densify uses scatter-add: duplicate COO entries must sum, same
+        # as the gather ring and to_numpy.
+        r = np.array([0, 0, 1]); c = np.array([1, 1, 0])
+        v = np.array([2.0, 3.0, 1.0])
+        a = DistSparseVecMatrix.from_coo(r, c, v, (4, 4))
+        eye = DistSparseVecMatrix.from_coo(
+            np.arange(4), np.arange(4), np.ones(4), (4, 4))
+        out = a.multiply_sparse(eye, mode="dense")
+        np.testing.assert_allclose(out.to_numpy(), a.to_numpy())
+
+    def test_dense_route_empty_operand(self):
+        a = DistSparseVecMatrix.from_coo([], [], np.zeros(0), (16, 16))
+        b = DistSparseVecMatrix.from_coo([0], [0], [1.0], (16, 16))
+        out = a.multiply_sparse(b, mode="dense")
+        assert out.nnz == 0
+
+
 class TestHopBounding:
     def test_entries_sorted_by_column_per_stripe(self, rng):
         r, c, v = _random_coo(rng, 40, 64, 0.3)
@@ -222,7 +282,7 @@ class TestHopBounding:
         rb, cb, vb = _random_coo(rng, k, n, 0.5)
         a = DistSparseVecMatrix.from_coo(ra, ca, va, (m, k))
         b = DistSparseVecMatrix.from_coo(rb, cb, vb, (k, n))
-        out = a.multiply_sparse(b)
+        out = a.multiply_sparse(b, mode="ring")
         oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
         np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10, atol=1e-10)
 
@@ -268,14 +328,14 @@ class TestOutputDtypeContract:
             assert ds._kernel_chunk(a.rows.shape[1], n) < a.rows.shape[1]
             oracle = _dense(ra, ca, va, (m, k)) @ _dense(rb, cb, vb, (k, n))
             np.testing.assert_allclose(
-                a.multiply_sparse(b).to_numpy(), oracle,
+                a.multiply_sparse(b, mode="ring").to_numpy(), oracle,
                 rtol=1e-10, atol=1e-10)
             # sparse x dense through the same chunk loop
             import jax.numpy as jnp
 
             dm = DenseVecMatrix(
                 jnp.asarray(rng.standard_normal((k, 24)), jnp.float64))
-            got = a.multiply_dense(dm).to_numpy()
+            got = a.multiply_dense(dm, mode="ring").to_numpy()
             ref = _dense(ra, ca, va, (m, k)) @ np.asarray(dm.to_numpy())
             np.testing.assert_allclose(got, ref, rtol=1e-8, atol=1e-8)
         finally:
