@@ -755,7 +755,21 @@ def config_lu():
     out = {"metric": f"lu_dist_{n//1024}k_seconds", "value": round(dt, 4),
            "unit": "s", "oracle_max_err": round(err, 9),
            "oracle_ok": err < 1e-3}
-    return _xla_ref(out, "lu", lambda: jax.lax.linalg.lu(a)[0], dt)
+    out = _xla_ref(out, "lu", lambda: jax.lax.linalg.lu(a)[0], dt)
+    if not out.get("vs_baseline"):
+        # XLA's LuDecompositionBlock hits its own scoped-vmem bug at 16k on
+        # v5e (r02/r03 captures) — the BASELINE is broken, not our op. For
+        # a usable ratio, compare both at half size and report that.
+        n2 = n // 2
+        a2 = jax.random.normal(key, (n2, n2), jnp.float32)
+        with mt.config_override(lu_base_size=1024):
+            dt2 = _timed(lambda: lu_factor_array(a2, mode="dist")[0], iters=2)
+        half = _xla_ref({}, "lu_half", lambda: jax.lax.linalg.lu(a2)[0], dt2)
+        out.update(vs_baseline=half.get("vs_baseline", 0),
+                   vs_baseline_note=f"ratio measured at {n2} (XLA lu "
+                                    f"fails at {n}); ours_half={dt2:.3f}s",
+                   **{k: v for k, v in half.items() if k.startswith("xla_")})
+    return out
 
 
 def config_cholesky():

@@ -67,6 +67,18 @@ def _resolve_mode(mode: str, n: int, dist_threshold: int = 6000) -> str:
     raise ValueError(f"Do not support mode {mode}.")
 
 
+def _host_fetch(x: jax.Array) -> np.ndarray:
+    """Host copy of a possibly process-spanning array: plain device_get
+    when every shard is addressable (or the array is replicated), allgather
+    across processes otherwise (a spanning-mesh LU's pivot vector in the
+    multihost harness)."""
+    if getattr(x, "is_fully_addressable", True) or x.is_fully_replicated:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def lu_factor_array(a: jax.Array, mode: str = "auto", base_size: int = None):
     """LU-factor a square array. Returns (packed LU, perm) with A[perm] = L U."""
     cfg = get_config()
@@ -79,7 +91,7 @@ def lu_factor_array(a: jax.Array, mode: str = "auto", base_size: int = None):
     if _resolve_mode(mode, n) == "local" or base >= n:
         with linalg_precision_scope():
             packed, _, perm = jax.lax.linalg.lu(a)
-        return packed, np.asarray(jax.device_get(perm))
+        return packed, _host_fetch(perm)
     return _lu_blocked(a, base)
 
 
@@ -114,7 +126,7 @@ def _lu_blocked(a: jax.Array, base: int) -> Tuple[jax.Array, np.ndarray]:
     packed = ap
     if npad != n:
         packed, perm = packed[:n, :n], perm[:n]
-    return packed, np.asarray(jax.device_get(perm))
+    return packed, _host_fetch(perm)
 
 
 @functools.partial(jax.jit, static_argnames=("base",), donate_argnums=(0, 1))

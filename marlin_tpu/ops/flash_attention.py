@@ -617,8 +617,13 @@ def flash_attention(
     if window:
         # The shrunk sweep reads ~(block_q + window + 2*block_k) key rows
         # per q-block, so a block_k much wider than the window defeats the
-        # grid shrink; cap it near window/2 (128-row floor).
+        # grid shrink; cap it near window/2 (128-row floor). block_q is
+        # capped the same way: each q-block's rows process ~window +
+        # block_q/2 keys (the diagonal partial), so block_q ~ window/2
+        # keeps the compute ratio near S/window instead of plateauing at
+        # ~2.7x (measured at S=8k, window=1024, 1024-blocks).
         block_k = max(128, min(block_k, (window // 2 + 127) // 128 * 128))
+        block_q = max(256, min(block_q, (window // 2 + 127) // 128 * 128))
     # Clamp blocks to the (sublane-padded) sequence lengths.
     block_q = min(block_q, -(-q.shape[0] // 16) * 16)
     block_k = min(block_k, -(-k.shape[0] // 16) * 16)

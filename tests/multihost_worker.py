@@ -28,10 +28,12 @@ def main() -> None:
     port = int(sys.argv[3])
     ckpt_dir = sys.argv[4]
 
-    # 4 virtual CPU devices per process -> 8 global. Must be set before the
-    # backend initializes; overrides any value inherited from the parent
-    # (the pytest conftest forces 8 in-process).
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # 8 // nproc virtual CPU devices per process -> 8 global (2 or 4
+    # processes). Must be set before the backend initializes; overrides any
+    # value inherited from the parent (the pytest conftest forces 8
+    # in-process).
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={8 // nproc}")
 
     import jax
 
@@ -106,6 +108,59 @@ def main() -> None:
     assert restored.shape == cm.shape
     r_h = multihost_utils.process_allgather(restored.data, tiled=True)
     np.testing.assert_allclose(r_h, c_h)
+
+    def fetch(x):
+        if x.is_fully_replicated:
+            return np.asarray(x)
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    # --- dist LU factor across the process boundary -----------------------
+    # The panel-pivoted single-jit sweep on a row-sharded spanning array:
+    # the Schur GEMM and pivot gathers run SPMD over the DCN-analogue mesh
+    # (VERDICT r02 item 7; match DenseVecMatrix.scala:283-461).
+    from marlin_tpu.linalg.lu import lu_factor_array, unpack_lu
+
+    a_lu = rng.standard_normal((64, 64))
+    a_dev = jax.device_put(jnp.asarray(a_lu), mesh_mod.row_sharding(mesh))
+    with mt.config_override(lu_base_size=16):
+        packed, perm = lu_factor_array(a_dev, mode="dist")
+    l, u = unpack_lu(np.asarray(fetch(packed), np.float64))
+    np.testing.assert_allclose(a_lu[perm], l @ u, rtol=1e-8, atol=1e-8)
+
+    # --- ALS half-step across the spanning mesh ---------------------------
+    # One updateFeatures call (users from products, ALSHelp.scala:263) with
+    # the product factors row-sharded over the spanning mesh; the result
+    # must match the same update computed process-locally.
+    from marlin_tpu.ml.als import _update_side
+
+    m_u, n_p, rank = 32, 24, 4
+    nr = 200
+    r_u = jnp.asarray(rng.integers(0, m_u, nr))
+    r_p = jnp.asarray(rng.integers(0, n_p, nr))
+    r_v = jnp.asarray(rng.random(nr))
+    prod_h = jnp.asarray(rng.standard_normal((n_p, rank)))
+    prod_d = jax.device_put(prod_h, mesh_mod.row_sharding(mesh))
+    users_span = _update_side(prod_d, r_p, r_u, r_v, m_u, 0.1, 1.0, False,
+                              rank)
+    users_local = _update_side(prod_h, r_p, r_u, r_v, m_u, 0.1, 1.0, False,
+                               rank)
+    np.testing.assert_allclose(fetch(users_span), fetch(users_local),
+                               rtol=1e-8, atol=1e-10)
+
+    # --- transformer dp train step across the process boundary ------------
+    from marlin_tpu.models import TransformerConfig, init_params, train_step
+
+    cfg_t = TransformerConfig(vocab=128, d_model=32, n_heads=2, n_layers=1,
+                              d_ff=64, max_len=16)
+    params = init_params(cfg_t, seed=0)
+    tok_h = rng.integers(0, 128, (8, 16))
+    dp = NamedSharding(mesh, P(tuple(mesh.axis_names), None))
+    tokens = jax.device_put(jnp.asarray(tok_h), dp)
+    targets = jax.device_put(jnp.asarray(np.roll(tok_h, -1, axis=1)), dp)
+    step = jax.jit(train_step, static_argnames="cfg")
+    loss, new_params = step(params, tokens, targets, cfg=cfg_t)
+    loss_v = float(fetch(loss))
+    assert np.isfinite(loss_v), loss_v
 
     print(f"MULTIHOST_OK pid={pid} local={n_local} global={n_global}", flush=True)
 
