@@ -1,56 +1,26 @@
 #!/bin/sh
-# One-shot TPU work queue for the next healthy-tunnel window: Mosaic
-# compile smoke of the new Pallas backward kernels, panel-LU timing, then
-# the bench re-captures. Each phase its own process; generous timeouts,
-# no mid-dispatch kills (a killed dispatch wedges the tunnel lease).
+# One-shot TPU work queue for the next healthy-tunnel window. r03 state:
+# headline/lu/cholesky/attention/sparse/sparsedist/spmm/transformer/decode
+# all captured green (r03_session1/2). Remaining hardware items:
+#   1. windowed attention with the block_q~window/2 clamp (target >=3x)
+#   2. svd / inverse / longseq if the earlier sessions didn't land them
+# Each phase its own process; generous timeouts, no mid-dispatch kills (a
+# killed dispatch wedges the tunnel lease).
 set -u
-OUT=${1:-docs/bench_captures/r02_session3c_$(date +%Y%m%d_%H%M).jsonl}
+OUT=${1:-docs/bench_captures/r03_queue_$(date +%Y%m%d_%H%M).jsonl}
 
-echo "=== phase 1: flash bwd Mosaic compile smoke ===" >&2
-timeout 900 python -u - >&2 2>&1 <<'PY'
-import time
-import jax, jax.numpy as jnp
-from marlin_tpu.ops import flash_attention
-q = jax.random.normal(jax.random.PRNGKey(0), (1024, 4, 128), jnp.bfloat16)
-kv = jax.random.normal(jax.random.PRNGKey(1), (1024, 2, 128), jnp.bfloat16)
-for name, args in [("mha", (q, q, q)), ("gqa", (q, kv, kv))]:
-    t0 = time.perf_counter()
-    def loss(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
-    g = jax.grad(loss, argnums=(0, 1, 2))(*args)
-    print(name, "bwd compile+run", f"{time.perf_counter()-t0:.1f}s",
-          float(jnp.sum(jnp.abs(g[0]).astype(jnp.float32))) > 0, flush=True)
-PY
-echo "rc=$? (bwd smoke)" >&2
+echo "=== phase 1: windowed attention re-capture (block clamps) ===" >&2
+BENCH_WATCHDOG=900 timeout 1200 python bench.py --config attention \
+  >>"$OUT" 2>/tmp/bench_attn_requeue.err
+echo "rc=$? (attention)" >&2
 
-echo "=== phase 2: panel-LU compile + 16k timing ===" >&2
-timeout 1200 python -u - >&2 2>&1 <<'PY'
-import time
-import jax, jax.numpy as jnp, numpy as np
-import marlin_tpu as mt
-from marlin_tpu.linalg.lu import lu_factor_array, unpack_lu
-a_small = jnp.asarray(np.random.default_rng(0).standard_normal((2048, 2048)), jnp.float32)
-with mt.config_override(lu_base_size=512):
-    t0 = time.perf_counter()
-    packed, perm = lu_factor_array(a_small, mode="dist")
-    print(f"2048 compile+first {time.perf_counter()-t0:.1f}s", flush=True)
-l, u = unpack_lu(np.asarray(packed, np.float64))
-an = np.asarray(a_small, np.float64)
-print("oracle err", float(np.max(np.abs(an[perm]-l@u))/np.max(np.abs(an))), flush=True)
-a = jax.random.normal(jax.random.PRNGKey(3), (16384, 16384), jnp.float32)
-for base in (1024, 512):
-    with mt.config_override(lu_base_size=base):
-        t0 = time.perf_counter()
-        p1, _ = lu_factor_array(a, mode="dist")
-        float(jnp.sum(p1[:2, :2].astype(jnp.float32)))
-        tc = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        p1, _ = lu_factor_array(a, mode="dist")
-        float(jnp.sum(p1[:2, :2].astype(jnp.float32)))
-        dt = time.perf_counter() - t0
-    print(f"16k base={base}: first {tc:.1f}s warm {dt:.3f}s", flush=True)
-PY
-echo "rc=$? (lu timing)" >&2
-
-echo "=== phase 3: re-captures ===" >&2
-sh tools/capture_bench.sh "$OUT" lu cholesky attention transformer decode
+echo "=== phase 2: any configs missing from r03 captures ===" >&2
+for cfg in svd inverse longseq; do
+  if ! grep -hq "\"metric\": \"$cfg" docs/bench_captures/r03_*.jsonl 2>/dev/null; then
+    echo "--- $cfg ---" >&2
+    BENCH_WATCHDOG=1500 timeout 1800 python bench.py --config "$cfg" \
+      >>"$OUT" 2>"/tmp/bench_$cfg.err"
+    echo "rc=$? ($cfg)" >&2
+  fi
+done
+echo "queue -> $OUT" >&2
