@@ -969,7 +969,9 @@ def config_decode():
     kv_heads = cfg.n_kv_heads or cfg.n_heads
     kv_bytes = (2 * cfg.n_layers * cfg.max_len * kv_heads
                 * (cfg.d_model // cfg.n_heads) * 2)  # bf16 K+V per seq
-    roofline = bw / (p_bytes / b + kv_bytes)
+    # One step streams params once (batch-shared) + every sequence's cache:
+    # per-seq roofline tok/s = BW / (p_bytes + B * kv_bytes).
+    roofline = bw / (p_bytes + b * kv_bytes)
     return {"metric": "decode_tokens_per_s_per_seq", "value": round(1.0 / dt, 1),
             "unit": "tok/s", "vs_baseline": round((1.0 / dt) / roofline, 3),
             "batch": b, "total_tok_s": round(b / dt, 1),

@@ -256,7 +256,10 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
         ),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :sq], lse[:, :sq]
+    # The kernel writes lse lane-replicated (Mosaic block-spec rule); keep
+    # only lane 0 in the residuals — at S=32k, H=8 the full (h, sq, 128)
+    # f32 would hold 134 MB per layer between forward and backward.
+    return out[:, :sq], lse[:, :sq, 0]
 
 
 
@@ -422,16 +425,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     gp = pad_to_multiple(g, 1, block_q)
     # Pad lse rows with a large POSITIVE value: recomputed pad-row tiles
     # then get p = exp2(s2 - big) = 0 (a -inf pad would make them explode).
-    # Both lse (already lane-replicated from the forward) and delta are fed
-    # as (h, sq, LANES) so their block specs satisfy Mosaic's minor-dim
-    # divisibility rule — a (1, block_q) block does not.
+    # Both lse and delta are then lane-broadcast to (h, sq, LANES) so their
+    # block specs satisfy Mosaic's minor-dim divisibility rule — a
+    # (1, block_q) block does not.
     pad_rows = qp.shape[1] - sq
     if pad_rows:
         lse = jnp.concatenate(
-            [lse, jnp.full((h, pad_rows, _LANES), 1e30, jnp.float32)],
-            axis=1)
+            [lse, jnp.full((h, pad_rows), 1e30, jnp.float32)], axis=1)
         delta = jnp.concatenate(
             [delta, jnp.zeros((h, pad_rows), jnp.float32)], axis=1)
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
     kp = pad_to_multiple(k, 1, block_k)
     vp = pad_to_multiple(v, 1, block_k)
