@@ -46,6 +46,20 @@ class TestLU:
         l, u = unpack_lu(lu_mat.to_numpy())
         np.testing.assert_allclose(l @ u, a[perm], rtol=1e-10, atol=1e-10)
 
+    def test_host_fetch_spanning_shard(self, rng, mesh):
+        # The pivot fetch must survive a mesh-sharded perm (the multihost
+        # worker found a spanning-sharded device_get crashing; in-process
+        # every shard is addressable, so this pins the plain path and the
+        # allgather branch is exercised by tests/test_multihost.py).
+        import jax
+        import jax.numpy as jnp
+
+        from marlin_tpu.linalg.lu import _host_fetch
+        from marlin_tpu.mesh import vector_sharding
+
+        x = jax.device_put(jnp.arange(16), vector_sharding(mesh))
+        np.testing.assert_array_equal(_host_fetch(x), np.arange(16))
+
     def test_non_square_raises(self, rng):
         with pytest.raises(ValueError):
             DenseVecMatrix(rng.standard_normal((4, 5))).lu_decompose()

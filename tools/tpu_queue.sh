@@ -25,4 +25,11 @@ for cfg in svd inverse longseq; do
     echo "rc=$? ($cfg)" >&2
   fi
 done
+echo "=== phase 3: long-context hero (S=32k single chip) ===" >&2
+if ! grep -hq '"metric": "longseq_train_s32k' docs/bench_captures/r03_*.jsonl \
+    2>/dev/null; then
+  BENCH_LS_S=32768 BENCH_WATCHDOG=1500 timeout 1800 \
+    python bench.py --config longseq >>"$OUT" 2>/tmp/bench_longseq32k.err
+  echo "rc=$? (longseq 32k)" >&2
+fi
 echo "queue -> $OUT" >&2
