@@ -296,11 +296,13 @@ class DistSparseVecMatrix:
             dense = _dense_ring_matmul(self, a_dense, other.densify_stripes())
         else:
             dense = self._product_stripes(other)
-        r, c, v = _extract_coo_stripes(dense, self.mesh)
-        return CoordinateMatrix(
+        r, c, v, total = _extract_coo_stripes(dense, self.mesh)
+        out = CoordinateMatrix(
             r.reshape(-1), c.reshape(-1), v.reshape(-1),
             shape=(self.num_rows, other.num_cols), mesh=self.mesh, padded=True,
         )
+        out._nnz = total  # the extraction's count pass already knows it
+        return out
 
     def multiply_dense(self, other, mode: str = "auto"):
         """Sparse x row-distributed dense -> row-distributed dense: the same
@@ -642,8 +644,11 @@ def _extract_fn(mesh: Mesh, cap: int, m_stripe: int):
 def _extract_coo_stripes(dense_stripes: jax.Array, mesh: Mesh):
     """Eager two-pass re-sparsification of row-sharded dense stripes: count
     per stripe (host sync for the static extraction size), then fixed-size
-    nonzero per stripe. The triples stay sharded where their stripe lives."""
+    nonzero per stripe. The triples stay sharded where their stripe lives.
+    Returns (rows, cols, vals, total_nnz) — the count is a byproduct, so
+    callers don't pay a second device round-trip to learn it."""
     counts = np.asarray(_count_stripes_fn(mesh)(dense_stripes))
     cap = max(-(-int(counts.max(initial=0)) // _ENTRY_CHUNK), 1) * _ENTRY_CHUNK
     m_stripe = dense_stripes.shape[0] // _n_dev(mesh)
-    return _extract_fn(mesh, cap, m_stripe)(dense_stripes)
+    r, c, v = _extract_fn(mesh, cap, m_stripe)(dense_stripes)
+    return r, c, v, int(counts.sum())

@@ -62,6 +62,9 @@ class CoordinateMatrix:
         if self.row_idx.shape != self.col_idx.shape or self.row_idx.shape != self.values.shape:
             raise ValueError("rows/cols/values must have equal lengths")
         self._shape = shape
+        self._nnz: Optional[int] = None  # producers that already counted
+        # (the sparse product's extraction pass) cache it here, saving the
+        # device round-trip the padded nnz reduction costs per call
 
     # -- metadata -----------------------------------------------------------
     def _compute_size(self) -> Tuple[int, int]:
@@ -87,9 +90,10 @@ class CoordinateMatrix:
 
     @property
     def nnz(self) -> int:
-        if self.padded:
-            return int(jnp.sum(self.values != 0))
-        return int(self.values.shape[0])
+        if self._nnz is None:
+            self._nnz = (int(jnp.sum(self.values != 0)) if self.padded
+                         else int(self.values.shape[0]))
+        return self._nnz
 
     def compact_triples(self):
         """Host ``(rows, cols, values)`` with pad slots removed.
