@@ -293,6 +293,45 @@ class TestSlidingWindow:
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, q, q, window=4)
 
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_window_fuzz_random_band_configs(self, seed):
+        # Randomized (S, window, block) fuzz vs the dense banded oracle —
+        # band-boundary bugs (clamped-duplicate double counts, off-by-one
+        # band edges, pad-tail interactions) live exactly in the corners a
+        # fixed-shape test can miss. Forward + all three gradients.
+        r = np.random.default_rng(100 + seed)
+        s_len = int(r.integers(65, 400))
+        w = int(r.integers(1, s_len + 32))
+        bq = int(r.choice([32, 64, 128]))
+        bk = int(r.choice([32, 64, 128]))
+        h, d = 2, 32
+
+        def banded(q, k, v):
+            qf, kf, vf = (jnp.swapaxes(x, 0, 1).astype(jnp.float32)
+                          for x in (q, k, v))
+            logits = jnp.einsum("hsd,htd->hst", qf, kf) / np.sqrt(d)
+            kp = jnp.arange(s_len)[None, :]
+            qp = jnp.arange(s_len)[:, None]
+            mask = (kp <= qp) & (kp > qp - w)
+            logits = jnp.where(mask[None], logits, -1e30)
+            return jnp.einsum("hst,htd->shd", jax.nn.softmax(logits, -1), vf)
+
+        q, k, v = (jnp.asarray(r.standard_normal((s_len, h, d)),
+                               jnp.float32) for _ in range(3))
+        args = dict(causal=True, window=w, block_q=bq, block_k=bk)
+        got = flash_attention(q, k, v, **args)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(banded(q, k, v)),
+            rtol=3e-5, atol=3e-5, err_msg=f"fwd s={s_len} w={w} bq={bq} bk={bk}")
+        g = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, **args) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(banded(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a_, b_ in zip("q k v".split(), g, gr):
+            np.testing.assert_allclose(
+                np.asarray(a_), np.asarray(b_), rtol=2e-3, atol=3e-4,
+                err_msg=f"d{name} s={s_len} w={w} bq={bq} bk={bk}")
+
     def test_window_grads_multiblock_no_double_count(self, rng):
         # Regression (r03 review): the dK/dV kernel's shrunk q sweep can
         # overrun n_q; the clamped duplicate of the LAST q-block is MORE
