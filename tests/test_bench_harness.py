@@ -168,3 +168,14 @@ class TestCachedFallback:
         best = bench._load_cached_lines()
         assert "headline" in best
         assert best["headline"][1]["value"] > 0
+
+    def test_real_capture_dir_covers_most_of_all(self, capsys):
+        # A dead-tunnel `--config all` run should still produce a nearly
+        # complete artifact from the shipped captures (longseq is the one
+        # config that has never captured on hardware).
+        n = bench._emit_cached_results("all", "test")
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert n == len(lines) >= len(bench.CONFIGS["all"]) - 1
+        for line in lines:
+            d = json.loads(line)
+            assert d["cached"] is True and d["value"] > 0
