@@ -119,14 +119,22 @@ _CACHE_PREFIX = {
 
 def _load_cached_lines(capture_dir: str = None) -> dict:
     """Newest valid capture line per config function name. Files are visited
-    in mtime order and lines in file order, so the latest write wins; error
-    lines and failed-oracle lines never qualify as evidence."""
+    in session order and lines in file order, so the latest write wins;
+    error lines and failed-oracle lines never qualify as evidence.
+
+    Session order = (capture-file basename, mtime): the files follow the
+    ``rNN_<session>_YYYYMMDD[_HHMM].jsonl`` convention, which sorts
+    chronologically by name — mtimes alone are unreliable because a git
+    checkout stamps every historic file with the same time (observed: the
+    replay picking an old under-filled summa line over the same round's
+    corrected one)."""
     import glob
 
     capture_dir = capture_dir or _CAPTURE_DIR
     best = {}
     paths = sorted(
-        glob.glob(os.path.join(capture_dir, "*.jsonl")), key=os.path.getmtime)
+        glob.glob(os.path.join(capture_dir, "*.jsonl")),
+        key=lambda p: (os.path.basename(p), os.path.getmtime(p)))
     for path in paths:
         try:
             mtime = os.path.getmtime(path)

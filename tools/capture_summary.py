@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Audit the on-hardware evidence state: newest valid capture line per
+bench config across docs/bench_captures/*.jsonl.
+
+Prints one row per artifact config — metric, value, vs_baseline, which
+file it came from, and whether the line is a live hardware measurement or
+a `cached: true` replay (bench.py's dead-tunnel fallback) — plus configs
+with no valid line at all. The audit the capture-provenance README makes
+by hand, as a command.
+
+Usage: python tools/capture_summary.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("BENCH_FORCE_CPU", "1")
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    best = bench._load_cached_lines()
+    rows = []
+    missing = []
+    for fn in bench.CONFIGS["all"]:
+        name = fn.__name__.removeprefix("config_") or fn.__name__
+        hit = best.get(fn.__name__)
+        if hit is None:
+            missing.append(name)
+            continue
+        _, line, fname = hit
+        rows.append((
+            name, str(line["metric"]), line["value"],
+            line.get("vs_baseline", ""),
+            "REPLAY" if line.get("cached") else "live",
+            fname,
+        ))
+    w = max(len(r[1]) for r in rows) if rows else 10
+    for name, metric, value, vsb, kind, fname in rows:
+        print(f"{name:12} {metric:{w}} {value:>12} vs={vsb!s:>6} "
+              f"{kind:6} {fname}")
+    for name in missing:
+        print(f"{name:12} -- NO VALID CAPTURE --")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
