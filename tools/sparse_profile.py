@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Stage-level timing of the distributed sparse product's dense route on
+hardware: densify A, densify B, MXU ring matmul, COO extraction, result
+construction + nnz. Answers where the ~3.4 s fixed cost the r03_session2
+capture showed actually goes (candidates: TPU scatter, nonzero extraction,
+tunnel round-trips). Run on a healthy tunnel:
+
+  PYTHONPATH=/root/repo:$PYTHONPATH python -u tools/sparse_profile.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marlin_tpu.matrix.dist_sparse import (
+    DistSparseVecMatrix, _dense_ring_matmul, _extract_coo_stripes)
+from marlin_tpu.matrix.sparse import CoordinateMatrix
+
+
+def fence(x):
+    return float(jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))(x))
+
+
+def main():
+    n, density = 16384, 1e-3
+    r = np.random.default_rng(3)
+    nnz = int(n * n * density)
+    ra, ca, va = (r.integers(0, n, nnz), r.integers(0, n, nnz),
+                  r.standard_normal(nnz).astype(np.float32))
+    rb, cb, vb = (r.integers(0, n, nnz), r.integers(0, n, nnz),
+                  r.standard_normal(nnz).astype(np.float32))
+    t0 = time.perf_counter()
+    a = DistSparseVecMatrix.from_coo(ra, ca, va, (n, n))
+    b = DistSparseVecMatrix.from_coo(rb, cb, vb, (n, n))
+    print(f"construct {time.perf_counter() - t0:.3f}s", flush=True)
+
+    for it in range(2):
+        t0 = time.perf_counter(); ad = a.densify_stripes(); fence(ad)
+        t1 = time.perf_counter(); bd = b.densify_stripes(); fence(bd)
+        t2 = time.perf_counter()
+        prod = _dense_ring_matmul(a, ad, bd); fence(prod)
+        t3 = time.perf_counter()
+        rr, cc, vv, tot = _extract_coo_stripes(prod, a.mesh); fence(vv)
+        t4 = time.perf_counter()
+        out = CoordinateMatrix(rr.reshape(-1), cc.reshape(-1),
+                               vv.reshape(-1), shape=(n, n), mesh=a.mesh,
+                               padded=True)
+        out._nnz = tot
+        nz = out.nnz
+        t5 = time.perf_counter()
+        print(f"iter{it}: densifyA {t1-t0:.3f} densifyB {t2-t1:.3f} "
+              f"matmul {t3-t2:.3f} extract {t4-t3:.3f} "
+              f"ctor+nnz {t5-t4:.3f} total {t5-t0:.3f} nnz={nz}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
