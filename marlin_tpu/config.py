@@ -69,6 +69,27 @@ class MarlinConfig:
     # SUMMA loop in marlin_tpu.parallel.summa.
     gemm_engine: str = "summa"
 
+    # Precision for the sparse dense-route MXU products (dist_sparse's
+    # densified ring). SEPARATE from linalg_precision: this is a single GEMM
+    # with no iterative error feedback, so "high" (3 bf16 passes, ~1.5e-7
+    # relative error on f32 operands) is numerically indistinguishable from
+    # "highest" (6 passes) for every oracle bar while running ~2x faster.
+    # "default" (1 bf16 pass) is NOT safe here: mostly-single-product sparse
+    # outputs see the full ~4e-3 bf16 input-rounding error.
+    sparse_matmul_precision: str = "high"
+
+    # Per-device byte budget for the sparse dense fast path (densified
+    # operands + f32 result stripes). None -> the module default in
+    # matrix/dist_sparse.py (_DENSIFY_BUDGET_BYTES, 4 GiB).
+    sparse_densify_budget_bytes: Optional[int] = None
+
+    # Density ceiling for the ELL gather engine in "auto" sparse dispatch:
+    # below it, gather traffic (nnz * n_cols words) undercuts the dense
+    # ring's padded MXU work; above it the MXU wins. ~0.5% is the computed
+    # v5e crossover (819 GB/s HBM vs ~60 TFLOPS 3-pass f32 GEMM); bench
+    # `sparsedist` measures it per chip.
+    sparse_ell_density_max: float = 5e-3
+
     # Mesh axis names (rows, cols) used throughout.
     mesh_axis_rows: str = "mr"
     mesh_axis_cols: str = "mc"

@@ -50,7 +50,11 @@ class CoordinateMatrix:
     entries); all metadata ops are reductions that run sharded. With
     ``padded=True`` the arrays carry fixed-size per-stripe padding — pad
     entries have value 0 at index (0, 0) — and logical views (``nnz``,
-    ``entries``) exclude them."""
+    ``entries``) exclude them.
+
+    Instances are immutable: do not rebind ``row_idx``/``col_idx``/
+    ``values`` after construction — derived metadata (the ``_nnz`` cache,
+    ``_shape`` from ``_compute_size``) is computed once and would go stale."""
 
     def __init__(self, rows, cols, values, shape: Optional[Tuple[int, int]] = None, mesh=None,
                  padded: bool = False):

@@ -59,10 +59,22 @@ class TransformerConfig(NamedTuple):
     # drops from O(layers * S * D) to O(S * D) + one block's recompute per
     # layer in the backward — with the flash backward's S*D scaling this
     # is what makes long-context training fit (SURVEY §5 long-context)
+    dtype: str = "float32"  # COMPUTE dtype for params/activations/KV cache.
+    # Master params stay f32 (init_params); entry points cast once, so with
+    # "bfloat16" every matmul/flash-attention input, the embedding table
+    # read, and the decode cache run at half the HBM traffic and full MXU
+    # rate, while gradients accumulate back into f32 (the cast's vjp) and
+    # the optimizer update stays exact — standard mixed precision. Numerics
+    # that need it (layernorm stats, softmax, RoPE, CE) compute >= f32
+    # internally regardless.
 
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
 
 
 def _sp_conflict(cfg: TransformerConfig) -> Optional[str]:
@@ -102,12 +114,19 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
     kv_d = cfg.kv_heads * (d // h)
 
     def norm(key, *shape, scale=None):
-        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        # float(scale): an np.float64 scale would silently promote the f32
+        # normals to f64 under jax_enable_x64 (np scalars are strongly
+        # typed; Python floats are weak).
+        scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(shape[0]))
         return jax.random.normal(key, shape, jnp.float32) * scale
 
+    # Master params are uniformly float32 (the normals already were; the
+    # ones/zeros must not drift to f64 under jax_enable_x64) — the compute
+    # dtype is cfg.dtype's job, not the initializer's.
+    f32 = jnp.float32
     params = {
         "embed": norm(ks[0], cfg.vocab, d, scale=0.02),
-        "ln_f": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+        "ln_f": {"g": jnp.ones((d,), f32), "b": jnp.zeros((d,), f32)},
         "blocks": [],
     }
     if not cfg.rope:  # rope rotates Q/K per block; no learned table
@@ -115,8 +134,8 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
     for i in range(cfg.n_layers):
         b = 4 + 6 * i
         blk = {
-            "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-            "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "ln1": {"g": jnp.ones((d,), f32), "b": jnp.zeros((d,), f32)},
+            "ln2": {"g": jnp.ones((d,), f32), "b": jnp.zeros((d,), f32)},
             "wqkv": norm(ks[b], d, d + 2 * kv_d),
             "wo": norm(ks[b + 1], d, d),
         }
@@ -127,26 +146,44 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
                 "router": norm(kr, d, e, scale=0.02),
                 "w1": jax.vmap(lambda k: norm(k, d, f))(
                     jax.random.split(kw1, e)),
-                "b1": jnp.zeros((e, f)),
+                "b1": jnp.zeros((e, f), f32),
                 "w2": jax.vmap(lambda k: norm(k, f, d))(
                     jax.random.split(kw2, e)),
-                "b2": jnp.zeros((e, d)),
+                "b2": jnp.zeros((e, d), f32),
             })
         else:
             blk.update({
                 "w1": norm(ks[b + 2], d, f),
-                "b1": jnp.zeros((f,)),
+                "b1": jnp.zeros((f,), f32),
                 "w2": norm(ks[b + 3], f, d),
-                "b2": jnp.zeros((d,)),
+                "b2": jnp.zeros((d,), f32),
             })
         params["blocks"].append(blk)
     return params
 
 
+def _cast_params(params, cfg: TransformerConfig):
+    """Cast float leaves to the compute dtype (no-op at f32 default).
+    Called once per entry point; master params stay what init_params made
+    them, and the cast's vjp accumulates gradients back in the master
+    dtype."""
+    dt = cfg.compute_dtype
+    if params["embed"].dtype == dt:
+        return params
+    return jax.tree.map(
+        lambda p: p.astype(dt) if jnp.issubdtype(p.dtype, jnp.floating)
+        else p, params)
+
+
 def _layer_norm(p, x, eps=1e-5):
-    mu = jnp.mean(x, -1, keepdims=True)
-    var = jnp.var(x, -1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+    # Stats in >= f32: bf16 mean/variance over d_model-sized rows loses
+    # mantissa exactly where normalization is supposed to help.
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["g"].astype(xf.dtype) + p["b"].astype(xf.dtype)).astype(
+        x.dtype)
 
 
 def _attend_local(q, k, v, cfg: TransformerConfig):
@@ -262,7 +299,7 @@ def _embed_prefix(params, tokens, cfg: TransformerConfig):
     x = params["embed"][tokens]
     if not cfg.rope:
         x = x + params["pos"][None, : tokens.shape[1], :]
-    return x
+    return x.astype(cfg.compute_dtype)
 
 
 def _map_seqs(fn, x, cfg: TransformerConfig):
@@ -280,6 +317,7 @@ def hidden_states(params, tokens, cfg: TransformerConfig):
     """tokens (B, S) int32 -> final-LN hidden states (B, S, D) — forward
     without the vocab readout, for consumers (chunked CE, probing) that
     must not materialize (B, S, vocab)."""
+    params = _cast_params(params, cfg)
     x = _embed_prefix(params, tokens, cfg)
 
     block = functools.partial(_block, cfg=cfg)
@@ -299,6 +337,7 @@ def hidden_states(params, tokens, cfg: TransformerConfig):
 
 def forward(params, tokens, cfg: TransformerConfig):
     """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    params = _cast_params(params, cfg)
     return hidden_states(params, tokens, cfg) @ params["embed"].T
 
 
@@ -314,25 +353,32 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig):
     way, which would undo what remat + the flash backward save for
     long-context training. jax.checkpoint on the chunk keeps the backward
     from stashing per-chunk logits either."""
+    params = _cast_params(params, cfg)
     h = hidden_states(params, tokens, cfg)  # (B, S, D)
     b, s, d = h.shape
-    if s <= _CE_CHUNK:
+    if b * s <= _CE_CHUNK:  # whole-BATCH position count: a (B*S, vocab)
+        # buffer is what hurts, whether the positions come from one long
+        # sequence or many short ones
         logits = h @ params["embed"].T
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
-    pad = (-s) % _CE_CHUNK
-    hp = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-    tp = jnp.pad(targets, ((0, 0), (0, pad)))
-    # (b, n_chunks * C, ...) -> (b * n_chunks, C, ...) is layout-preserving
-    # (no transpose copy of the multi-GB hidden tensor); pad positions are
-    # masked inside the chunk, so no correction pass exists.
-    n_chunks = hp.shape[1] // _CE_CHUNK
-    hc = hp.reshape(b * n_chunks, _CE_CHUNK, d)
-    tc = tp.reshape(b * n_chunks, _CE_CHUNK)
-    vc = jnp.broadcast_to(
-        jnp.arange(hp.shape[1]) < s, (b, hp.shape[1])
-    ).reshape(b * n_chunks, _CE_CHUNK)
+    # Chunk the FLAT (b*s) position axis: (B, S, D) -> (B*S, D) is
+    # layout-preserving (no transpose copy of the multi-GB hidden tensor),
+    # chunks may span sequence boundaries (CE is per-position), and the
+    # whole batch pays ONE sub-chunk of padding — per-sequence padding
+    # would blow up many-short-sequence batches by _CE_CHUNK/s.
+    total = b * s
+    pad = (-total) % _CE_CHUNK
+    hf = h.reshape(total, d)
+    tf = targets.reshape(total)
+    if pad:
+        hf = jnp.pad(hf, ((0, pad), (0, 0)))
+        tf = jnp.pad(tf, (0, pad))
+    n_chunks = (total + pad) // _CE_CHUNK
+    hc = hf.reshape(n_chunks, _CE_CHUNK, d)
+    tc = tf.reshape(n_chunks, _CE_CHUNK)
+    vc = (jnp.arange(total + pad) < total).reshape(n_chunks, _CE_CHUNK)
 
     @jax.checkpoint
     def chunk_nll(args):
@@ -436,9 +482,11 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     (B, vocab), updated cache). Without a window, writes each layer's K/V
     at ``pos`` and attends the cache prefix; with a window the cache is a
     ring (see init_kv_cache) and the write lands at pos mod cache_len."""
+    params = _cast_params(params, cfg)
     x = params["embed"][tokens]  # (B, D)
     if not cfg.rope:
         x = x + params["pos"][pos]
+    x = x.astype(cfg.compute_dtype)
     positions = (
         jnp.full((x.shape[0],), pos, jnp.int32) if cfg.rope else None
     )
@@ -481,6 +529,7 @@ def prefill(params, tokens, cfg: TransformerConfig):
     b, s = tokens.shape
     if s > cfg.max_len:
         raise ValueError(f"prompt length {s} > max_len {cfg.max_len}")
+    params = _cast_params(params, cfg)
     x = _embed_prefix(params, tokens, cfg)
     cache = init_kv_cache(cfg, b, dtype=x.dtype)
 

@@ -582,7 +582,11 @@ def flash_attention(
     """softmax(Q K^T * scale) V, flash-tiled, single device.
 
     ``window`` > 0 (requires ``causal``) restricts each query to the last
-    ``window`` key positions (sliding-window attention). The k sweep is
+    ``window`` key positions (sliding-window attention). NOTE: windowed
+    runs OVERRIDE caller-supplied ``block_q``/``block_k``, clamping both
+    to ~window/2 (128/256-row floors) — wider blocks defeat the banded
+    grid shrink (see the inline rationale below); tune blocks via the
+    window, not past it. The k sweep is
     grid-shrunk to the band (forward, dQ, and dK/dV kernels alike), so
     out-of-band K/V tiles are never DMA'd: MXU work AND HBM reads both
     scale with S * window instead of S^2. block_k is capped near window/2

@@ -31,7 +31,10 @@ def main() -> None:
     # 8 // nproc virtual CPU devices per process -> 8 global (2 or 4
     # processes). Must be set before the backend initializes; overrides any
     # value inherited from the parent (the pytest conftest forces 8
-    # in-process).
+    # in-process). A nproc that doesn't divide 8 would silently yield
+    # fewer than 8 global devices and break the fixed-8 mesh assumption
+    # downstream — fail loudly instead.
+    assert 8 % nproc == 0, f"nproc {nproc} must divide the 8-device mesh"
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={8 // nproc}")
 

@@ -152,11 +152,17 @@ class TestCachedFallback:
         n = bench._emit_cached_results("headline", "tunnel dead",
                                        str(tmp_path))
         assert n == 1
-        d = json.loads(capsys.readouterr().out.strip())
+        lines = [json.loads(l)
+                 for l in capsys.readouterr().out.strip().splitlines()]
+        d = lines[0]
         assert d["cached"] is True and d["value"] == 186.58
         assert d["backend_error"] == "tunnel dead"
         assert d["cached_from"].endswith("d.jsonl")
         assert d["cached_age_hours"] >= 0
+        # A replay run must be machine-distinguishable from a live one.
+        status = lines[-1]
+        assert status["metric"] == "bench_run_status"
+        assert status["live"] is False and status["value"] == 1.0
 
     def test_emit_empty_dir_returns_zero(self, tmp_path):
         assert bench._emit_cached_results("headline", "e", str(tmp_path)) == 0
@@ -174,8 +180,11 @@ class TestCachedFallback:
         # complete artifact from the shipped captures (longseq is the one
         # config that has never captured on hardware).
         n = bench._emit_cached_results("all", "test")
-        lines = capsys.readouterr().out.strip().splitlines()
-        assert n == len(lines) >= len(bench.CONFIGS["all"]) - 1
-        for line in lines:
-            d = json.loads(line)
+        lines = [json.loads(l)
+                 for l in capsys.readouterr().out.strip().splitlines()]
+        status = [d for d in lines if d["metric"] == "bench_run_status"]
+        cached = [d for d in lines if d["metric"] != "bench_run_status"]
+        assert n == len(cached) >= len(bench.CONFIGS["all"]) - 1
+        for d in cached:
             assert d["cached"] is True and d["value"] > 0
+        assert len(status) == 1 and status[0]["live"] is False

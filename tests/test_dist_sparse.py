@@ -45,7 +45,7 @@ class TestDistSparseVecMatrix:
         back = dist.to_sparse_vec_matrix()
         np.testing.assert_allclose(back.to_numpy(), svm.to_numpy())
 
-    @pytest.mark.parametrize("mode", ["ring", "dense"])
+    @pytest.mark.parametrize("mode", ["ring", "dense", "ell"])
     @pytest.mark.parametrize("shape_a,shape_b,density", [
         ((48, 40), (40, 56), 0.15),
         ((17, 23), (23, 9), 0.3),    # uneven stripes
@@ -76,7 +76,7 @@ class TestDistSparseVecMatrix:
         oracle = _dense(ra, ca, va, (48, 40)) @ _dense(rb, cb, vb, (40, 32))
         assert out.nnz == int(np.count_nonzero(oracle))
 
-    @pytest.mark.parametrize("mode", ["ring", "dense"])
+    @pytest.mark.parametrize("mode", ["ring", "dense", "ell"])
     def test_multiply_dense_vs_oracle(self, rng, mode):
         ra, ca, va = _random_coo(rng, 40, 48, 0.2)
         bd = rng.standard_normal((48, 24))
@@ -265,6 +265,78 @@ class TestDenseRoute:
         b = DistSparseVecMatrix.from_coo([0], [0], [1.0], (16, 16))
         out = a.multiply_sparse(b, mode="dense")
         assert out.nnz == 0
+
+
+class TestEllRoute:
+    """ELL row-gather engine (the low-density arm) + the lazy result."""
+
+    def test_auto_picks_ell_at_low_density(self, rng):
+        n = 64
+        r, c, v = _random_coo(rng, n, n, 0.003)  # under the 5e-3 ceiling
+        a = DistSparseVecMatrix.from_coo(r, c, v, (n, n))
+        assert a._ell_wins(n, n)
+        b = DistSparseVecMatrix.from_coo(r, c, v, (n, n))
+        oracle = _dense(r, c, v, (n, n)) @ _dense(r, c, v, (n, n))
+        np.testing.assert_allclose(a.multiply_sparse(b).to_numpy(), oracle,
+                                   rtol=1e-10, atol=1e-10)
+
+    def test_density_gate(self, rng):
+        n = 64
+        r, c, v = _random_coo(rng, n, n, 0.2)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (n, n))
+        assert not a._ell_wins(n, n)  # 20% density: dense ring territory
+
+    def test_skew_guard(self):
+        # One dense-ish row among empties: r_slots blows past 8*mean + 32.
+        n = 512
+        cols = np.arange(n)
+        rows = np.zeros(n, np.int64)
+        a = DistSparseVecMatrix.from_coo(rows, cols, np.ones(n), (n, n))
+        assert not a._ell_wins(n, n)
+        # Forced ELL still computes the right answer.
+        b = DistSparseVecMatrix.from_coo(
+            np.arange(n), np.arange(n), np.ones(n), (n, n))
+        out = a.multiply_sparse(b, mode="ell")
+        np.testing.assert_allclose(out.to_numpy(), a.to_numpy())
+
+    def test_lazy_result_defers_extraction(self, rng, mesh):
+        ra, ca, va = _random_coo(rng, 48, 40, 0.1)
+        rb, cb, vb = _random_coo(rng, 40, 32, 0.1)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (48, 40))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, (40, 32))
+        out = a.multiply_sparse(b, mode="ell")
+        oracle = _dense(ra, ca, va, (48, 40)) @ _dense(rb, cb, vb, (40, 32))
+        # nnz comes from the fused count — no triple extraction yet.
+        assert out.nnz == int(np.count_nonzero(oracle))
+        assert out._triples is None
+        # Densify straight from the product stripes, still no extraction.
+        np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10,
+                                   atol=1e-10)
+        assert out._triples is None
+        # First triple read materializes sharded padded triples.
+        vals = out.values
+        assert out._triples is not None
+        assert len(vals.sharding.device_set) == len(mesh.devices.flat)
+        r2, c2, v2 = out.compact_triples()
+        got = np.zeros(out.shape)
+        np.add.at(got, (r2, c2), v2)
+        np.testing.assert_allclose(got, oracle, rtol=1e-10, atol=1e-10)
+
+    def test_ell_duplicate_entries_add(self):
+        r = np.array([0, 0, 1]); c = np.array([1, 1, 0])
+        v = np.array([2.0, 3.0, 1.0])
+        a = DistSparseVecMatrix.from_coo(r, c, v, (4, 4))
+        eye = DistSparseVecMatrix.from_coo(
+            np.arange(4), np.arange(4), np.ones(4), (4, 4))
+        out = a.multiply_sparse(eye, mode="ell")
+        np.testing.assert_allclose(out.to_numpy(), a.to_numpy())
+
+    def test_ell_empty_operand(self):
+        a = DistSparseVecMatrix.from_coo([], [], np.zeros(0), (16, 16))
+        b = DistSparseVecMatrix.from_coo([0], [0], [1.0], (16, 16))
+        out = a.multiply_sparse(b, mode="ell")
+        assert out.nnz == 0
+        np.testing.assert_allclose(out.to_numpy(), np.zeros((16, 16)))
 
 
 class TestHopBounding:

@@ -724,3 +724,45 @@ class TestChunkedCrossEntropy:
 
         scan(jx.jaxpr)
         assert not bad, f"full (S, vocab) logits materialized: {bad}"
+
+
+class TestMixedPrecision:
+    """cfg.dtype: f32 master params, low-precision compute (the bench's
+    bf16 mode). Master params and gradients stay f32; activations, the KV
+    cache, and the streamed weights run at the compute dtype."""
+
+    BF = TransformerConfig(vocab=31, d_model=32, n_heads=2, n_layers=2,
+                           d_ff=64, max_len=64, dtype="bfloat16")
+
+    def test_train_step_keeps_f32_master(self, rng):
+        params = init_params(self.BF, seed=0)
+        tok = jnp.asarray(rng.integers(0, 31, (2, 16)), jnp.int32)
+        step = jax.jit(train_step, static_argnames="cfg")
+        loss, new_params = step(params, tok, tok, cfg=self.BF)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree.leaves(new_params):
+            assert leaf.dtype == jnp.float32
+        # And the step moved the params (gradients flowed through casts).
+        assert any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(params),
+                            jax.tree.leaves(new_params)))
+
+    def test_bf16_loss_tracks_f32(self, rng):
+        cfg32 = self.BF._replace(dtype="float32")
+        params = init_params(self.BF, seed=0)
+        tok = jnp.asarray(rng.integers(0, 31, (2, 16)), jnp.int32)
+        l16 = float(loss_fn(params, tok, tok, self.BF))
+        l32 = float(loss_fn(params, tok, tok, cfg32))
+        assert abs(l16 - l32) / max(abs(l32), 1e-6) < 0.05
+
+    def test_decode_cache_at_compute_dtype(self, rng):
+        from marlin_tpu.models import generate, prefill
+
+        params = init_params(self.BF, seed=0)
+        prompt = jnp.asarray(rng.integers(0, 31, (2, 8)), jnp.int32)
+        _, cache = prefill(params, prompt, self.BF)
+        assert cache[0]["k"].dtype == jnp.bfloat16
+        out = generate(params, prompt, 4, self.BF)
+        assert out.shape == (2, 4)
+        assert bool(jnp.all((out >= 0) & (out < 31)))

@@ -1,16 +1,22 @@
 #!/usr/bin/env python
 """Audit the on-hardware evidence state: newest valid capture line per
-bench config across docs/bench_captures/*.jsonl.
+bench config across docs/bench_captures/*.jsonl, plus per-metric HISTORY
+with regression flags.
 
 Prints one row per artifact config — metric, value, vs_baseline, which
 file it came from, and whether the line is a live hardware measurement or
 a `cached: true` replay (bench.py's dead-tunnel fallback) — plus configs
-with no valid line at all. The audit the capture-provenance README makes
-by hand, as a command.
+with no valid line at all. With --history (or by default when any metric
+moved), also prints every capture of each metric in session order and
+flags deltas >1.5x between consecutive sessions (VERDICT r03 item 8: the
+LU 0.69 s -> 1.54 s regression went unremarked; now the ledger surfaces
+it mechanically).
 
-Usage: python tools/capture_summary.py
+Usage: python tools/capture_summary.py [--history]
 """
 
+import glob
+import json
 import os
 import sys
 
@@ -18,6 +24,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("BENCH_FORCE_CPU", "1")
 
 import bench  # noqa: E402
+
+DELTA_FLAG = 1.5  # consecutive-session ratio that earns a flag
+
+
+def _history():
+    """metric -> [(file, value, vs_baseline, cached)] in session order."""
+    hist = {}
+    paths = sorted(
+        glob.glob(os.path.join(bench._CAPTURE_DIR, "*.jsonl")),
+        key=lambda p: (os.path.basename(p), os.path.getmtime(p)))
+    for path in paths:
+        fname = os.path.basename(path)
+        try:
+            with open(path) as f:
+                raw = f.readlines()
+        except OSError:
+            continue
+        for rawline in raw:
+            try:
+                line = json.loads(rawline)
+            except ValueError:
+                continue
+            if not isinstance(line, dict) or "metric" not in line:
+                continue
+            if line.get("unit") == "error" or not line.get("value"):
+                continue
+            if line.get("metric") == "bench_run_status":
+                continue
+            if line.get("cached"):  # replays are not new evidence
+                continue
+            hist.setdefault(str(line["metric"]), []).append(
+                (fname, float(line["value"]),
+                 line.get("vs_baseline", ""), bool(line.get("oracle_ok", True))))
+    return hist
 
 
 def main() -> int:
@@ -43,6 +83,33 @@ def main() -> int:
               f"{kind:6} {fname}")
     for name in missing:
         print(f"{name:12} -- NO VALID CAPTURE --")
+
+    hist = _history()
+    flags = []
+    for metric, entries in sorted(hist.items()):
+        for (f0, v0, _, _), (f1, v1, _, _) in zip(entries, entries[1:]):
+            if f0 == f1 or not v0 or not v1:
+                continue
+            ratio = v1 / v0
+            if ratio > DELTA_FLAG or ratio < 1.0 / DELTA_FLAG:
+                flags.append((metric, f0, v0, f1, v1, ratio))
+    show_history = "--history" in sys.argv or flags
+    if show_history:
+        print("\n-- per-metric capture history (live lines only) --")
+        for metric, entries in sorted(hist.items()):
+            if len(entries) < 2 and "--history" not in sys.argv:
+                continue
+            trail = " -> ".join(
+                f"{v:g} ({f.replace('.jsonl', '')}"
+                f"{'' if ok else ', ORACLE-FAIL'})"
+                for f, v, _, ok in entries)
+            print(f"{metric}: {trail}")
+    if flags:
+        print("\n-- DELTA FLAGS (>1.5x between consecutive sessions; "
+              "explain or investigate) --")
+        for metric, f0, v0, f1, v1, ratio in flags:
+            print(f"  {metric}: {v0:g} ({f0}) -> {v1:g} ({f1})  "
+                  f"x{ratio:.2f}")
     return 0
 
 

@@ -1,9 +1,14 @@
 #!/usr/bin/env python
-"""Stage-level timing of the distributed sparse product's dense route on
-hardware: densify A, densify B, MXU ring matmul, COO extraction, result
-construction + nnz. Answers where the ~3.4 s fixed cost the r03_session2
-capture showed actually goes (candidates: TPU scatter, nonzero extraction,
-tunnel round-trips). Run on a healthy tunnel:
+"""Stage-level timing of the distributed sparse product on hardware, r04
+edition: where does the time go in each engine at the bench regime
+(16k^2, 1e-3)?
+
+Stages: construction, format caches (densify scatter, ELL build+upload),
+fused ELL gather product (+count), fused dense MXU ring (+count) at each
+precision, gather-ring arm, and the COO extraction. Answers r03's open
+question (the ~3.4 s unexplained fixed cost) with per-stage numbers, and
+tells us whether the ELL gather achieves HBM-roofline rates (~20 ms at
+819 GB/s for nnz * n * 4 bytes of traffic). Run on a healthy tunnel:
 
   PYTHONPATH=/root/repo:$PYTHONPATH python -u tools/sparse_profile.py
 """
@@ -13,13 +18,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import marlin_tpu as mt
 from marlin_tpu.matrix.dist_sparse import (
-    DistSparseVecMatrix, _dense_ring_matmul, _extract_coo_stripes)
-from marlin_tpu.matrix.sparse import CoordinateMatrix
+    DistSparseVecMatrix, _dense_ring_matmul, _ell_product, _extract_coo_stripes,
+    _n_dev)
 
 
 def fence(x):
     return float(jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))(x))
+
+
+def stage(label, fn, reps=2):
+    out = None
+    for it in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        print(f"  {label}[{it}]: {dt*1e3:8.1f} ms", flush=True)
+    return out
 
 
 def main():
@@ -30,28 +46,67 @@ def main():
                   r.standard_normal(nnz).astype(np.float32))
     rb, cb, vb = (r.integers(0, n, nnz), r.integers(0, n, nnz),
                   r.standard_normal(nnz).astype(np.float32))
+    print(f"regime: {n}^2, density {density}, nnz {nnz}", flush=True)
+
     t0 = time.perf_counter()
     a = DistSparseVecMatrix.from_coo(ra, ca, va, (n, n))
     b = DistSparseVecMatrix.from_coo(rb, cb, vb, (n, n))
     print(f"construct {time.perf_counter() - t0:.3f}s", flush=True)
 
-    for it in range(2):
-        t0 = time.perf_counter(); ad = a.densify_stripes(); fence(ad)
-        t1 = time.perf_counter(); bd = b.densify_stripes(); fence(bd)
-        t2 = time.perf_counter()
-        prod = _dense_ring_matmul(a, ad, bd); fence(prod)
-        t3 = time.perf_counter()
-        rr, cc, vv, tot = _extract_coo_stripes(prod, a.mesh); fence(vv)
-        t4 = time.perf_counter()
-        out = CoordinateMatrix(rr.reshape(-1), cc.reshape(-1),
-                               vv.reshape(-1), shape=(n, n), mesh=a.mesh,
-                               padded=True)
-        out._nnz = tot
-        nz = out.nnz
-        t5 = time.perf_counter()
-        print(f"iter{it}: densifyA {t1-t0:.3f} densifyB {t2-t1:.3f} "
-              f"matmul {t3-t2:.3f} extract {t4-t3:.3f} "
-              f"ctor+nnz {t5-t4:.3f} total {t5-t0:.3f} nnz={nz}", flush=True)
+    # Format caches (first call builds, second shows the cache hit).
+    stage("densify_a", lambda: fence(a.densify_stripes()))
+    stage("densify_b", lambda: fence(b.densify_stripes()))
+    bd = b.densify_stripes()
+    stage("ell_build_a",
+          lambda: (a.ell_stripes()[2], fence(a.ell_stripes()[1])))
+    ec, ev, r_slots = a.ell_stripes()
+    print(f"  ell r_slots={r_slots}", flush=True)
+
+    nd = _n_dev(a.mesh)
+
+    # Fused ELL gather product + count (the auto route at this regime).
+    fn_ell = _ell_product(a.mesh, nd, a.stripe, r_slots, n,
+                          jnp.dtype(jnp.float32), with_count=True)
+
+    def run_ell():
+        _, c = fn_ell(ec, ev, bd)
+        return int(np.asarray(c).sum())
+
+    print(f"  ell nnz_out={stage('ell_fused', run_ell, reps=3)}", flush=True)
+
+    # Fused dense MXU ring at each precision (precision = where the f32
+    # matmul cost lives: 1/3/6 bf16 passes).
+    ad = a.densify_stripes()
+    for prec in ("default", "high", "highest"):
+        with mt.config_override(sparse_matmul_precision=prec):
+            def run_dense():
+                _, c = _dense_ring_matmul(a, ad, bd, with_count=True)
+                return int(np.asarray(c).sum())
+
+            stage(f"dense_fused[{prec}]", run_dense, reps=3)
+
+    # Gather-ring arm (the memory-scalable engine).
+    stage("gather_ring", lambda: fence(a._product_stripes(b)), reps=2)
+
+    # Extraction (the lazy tail): fixed-size nonzero per stripe.
+    prod, counts = fn_ell(ec, ev, bd)
+    ch = np.asarray(counts)
+
+    def run_extract():
+        _, _, vv, _ = _extract_coo_stripes(prod, a.mesh, counts=ch)
+        return fence(vv)
+
+    stage("extract", run_extract, reps=2)
+
+    # scipy reference on this host, for the vs_baseline frame.
+    try:
+        import scipy.sparse as sp
+
+        sa = sp.csr_matrix((va, (ra, ca)), shape=(n, n))
+        sb = sp.csr_matrix((vb, (rb, cb)), shape=(n, n))
+        stage("scipy_csr", lambda: (sa @ sb).nnz, reps=2)
+    except Exception as e:  # noqa: BLE001
+        print(f"  scipy failed: {e}", flush=True)
 
 
 if __name__ == "__main__":

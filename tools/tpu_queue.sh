@@ -1,35 +1,51 @@
 #!/bin/sh
-# One-shot TPU work queue for the next healthy-tunnel window. r03 state:
-# headline/lu/cholesky/attention/sparse/sparsedist/spmm/transformer/decode
-# all captured green (r03_session1/2). Remaining hardware items:
-#   1. windowed attention with the block_q~window/2 clamp (target >=3x)
-#   2. svd / inverse / longseq if the earlier sessions didn't land them
-# Each phase its own process; generous timeouts, no mid-dispatch kills (a
-# killed dispatch wedges the tunnel lease).
+# One-shot TPU work queue for the next healthy-tunnel window — r04 edition.
+# VERDICT r03 item 1: land captures where no line carries vs_baseline 0.
+# Order = value density if the tunnel dies partway:
+#   1. headline        (fast sanity + the round's LIVE bench line, item 6)
+#   2. attention       (windowed >=3x re-capture after the block clamp)
+#   3. longseq         (NEVER captured on HW; the Pallas backward's config)
+#   4. transformer     (MFU ratio populated, item 3 evidence base)
+#   5. svd             (XLA Gramian-eigh baseline populated)
+#   6. decode          (HBM roofline ratio populated)
+#   7. inverse         (fresh, with XLA inv baseline)
+#   8. lu              (8k fallback ratio -> defensible vs_baseline, item 4)
+#   9. sparsedist      (fused dense route vs scipy, item 2)
+#  10. sparse_profile  (stage timings -> where the old 3.4s went)
+#  11. longseq 32k     (hero run)
+#  12. cholesky        (fresh repeat of the r03 green line)
+# Each phase its own process; generous timeouts; no mid-dispatch kills (a
+# killed dispatch wedges the tunnel lease for hours — r03 lost 9h to one).
 set -u
-OUT=${1:-docs/bench_captures/r03_queue_$(date +%Y%m%d_%H%M).jsonl}
+cd "$(dirname "$0")/.." || exit 1
+OUT=${1:-docs/bench_captures/r04_session_$(date -u +%Y%m%d_%H%M).jsonl}
+export PYTHONPATH=/root/repo:${PYTHONPATH:-}
 
-echo "=== phase 1: windowed attention re-capture (block clamps) ===" >&2
-BENCH_WATCHDOG=900 timeout 1200 python bench.py --config attention \
-  >>"$OUT" 2>/tmp/bench_attn_requeue.err
-echo "rc=$? (attention)" >&2
+SEQ=0
+run() { # run <config> <watchdog_s> [ENV=VAL ...]
+  cfg=$1; wd=$2; shift 2
+  SEQ=$((SEQ + 1))  # distinct stderr per invocation: repeated configs
+  # (longseq base + 32k hero) must not overwrite each other's diagnostics
+  echo "=== $cfg $(date -u +%H:%M:%S) ===" >&2
+  env "$@" BENCH_WATCHDOG="$wd" timeout $((wd + 300)) \
+    python bench.py --config "$cfg" >>"$OUT" \
+    2>"/tmp/bench_r04_${SEQ}_$cfg.err"
+  echo "rc=$? ($cfg $(date -u +%H:%M:%S))" >&2
+}
 
-echo "=== phase 2: any configs missing from r03 captures ===" >&2
-# A cached:true line is a REPLAY of an older round, not a capture.
-for cfg in svd inverse longseq; do
-  if ! grep -h "\"metric\": \"$cfg" docs/bench_captures/r03_*.jsonl 2>/dev/null \
-      | grep -vq '"cached": true'; then
-    echo "--- $cfg ---" >&2
-    BENCH_WATCHDOG=1500 timeout 1800 python bench.py --config "$cfg" \
-      >>"$OUT" 2>"/tmp/bench_$cfg.err"
-    echo "rc=$? ($cfg)" >&2
-  fi
-done
-echo "=== phase 3: long-context hero (S=32k single chip) ===" >&2
-if ! grep -hq '"metric": "longseq_train_s32k' docs/bench_captures/r03_*.jsonl \
-    2>/dev/null; then
-  BENCH_LS_S=32768 BENCH_WATCHDOG=1500 timeout 1800 \
-    python bench.py --config longseq >>"$OUT" 2>/tmp/bench_longseq32k.err
-  echo "rc=$? (longseq 32k)" >&2
-fi
-echo "queue -> $OUT" >&2
+run headline 600
+run attention 900
+run longseq 1200
+run transformer 1200
+run svd 900
+run decode 900
+run inverse 900
+run lu 1800
+run sparsedist 900
+echo "=== sparse_profile $(date -u +%H:%M:%S) ===" >&2
+timeout 900 python -u tools/sparse_profile.py \
+  >/tmp/sparse_profile_r04.log 2>&1
+echo "rc=$? (sparse_profile)" >&2
+run longseq 1500 BENCH_LS_S=32768
+run cholesky 900
+echo "queue done -> $OUT $(date -u +%H:%M:%S)" >&2

@@ -1,0 +1,28 @@
+#!/bin/sh
+# Tunnel-recovery watcher: probe the axon TPU tunnel in a SUBPROCESS (a dead
+# tunnel makes jax.devices() hang, not raise) every POLL seconds; on the
+# first healthy probe, run tools/tpu_queue.sh once and exit. nohup this at
+# session start — r01-r03 all lost capture windows to a tunnel that came
+# back while nobody was watching.
+#
+#   nohup tools/tunnel_watch.sh >/tmp/r04_watcher.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.." || exit 1
+export PYTHONPATH=/root/repo:${PYTHONPATH:-}
+POLL=${POLL:-180}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-300}
+
+while :; do
+  echo "probe $(date -u +%H:%M:%S)" >&2
+  if timeout "$PROBE_TIMEOUT" python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+print(float(jnp.sum(x @ x)), jax.devices()[0].device_kind)
+" >&2 2>/dev/null; then
+    echo "tunnel healthy $(date -u +%H:%M:%S) -> running queue" >&2
+    sh tools/tpu_queue.sh
+    echo "watcher done $(date -u +%H:%M:%S)" >&2
+    exit 0
+  fi
+  sleep "$POLL"
+done
