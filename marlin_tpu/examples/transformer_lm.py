@@ -7,6 +7,11 @@ step throughput.
 
 Usage:
   python -m marlin_tpu.examples.transformer_lm [steps] [batch] [seq] [d_model]
+                                               [dtype]
+
+``dtype`` (default float32) is the compute dtype — pass bfloat16 for the
+mixed-precision mode the TPU benches run (f32 master params, bf16
+activations/attention/KV cache).
 
 After training, generates a short continuation with the KV-cache decode path
 (models.generate) — train and serve from the same checkpointable params.
@@ -28,6 +33,7 @@ def main(argv=None) -> int:
     batch = int(argv[1]) if len(argv) > 1 else 8
     seq = int(argv[2]) if len(argv) > 2 else 64
     d_model = int(argv[3]) if len(argv) > 3 else 64
+    dtype = argv[4] if len(argv) > 4 else "float32"
 
     import marlin_tpu as mt
     from marlin_tpu.models import TransformerConfig, init_params, train_step
@@ -38,7 +44,7 @@ def main(argv=None) -> int:
     mesh = mt.default_mesh()
     cfg = TransformerConfig(
         vocab=128, d_model=d_model, n_heads=max(2, d_model // 32),
-        n_layers=2, d_ff=4 * d_model, max_len=seq,
+        n_layers=2, d_ff=4 * d_model, max_len=seq, dtype=dtype,
     )
     params = init_params(cfg, seed=0)
     key = jax.random.PRNGKey(1)

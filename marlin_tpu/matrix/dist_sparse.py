@@ -380,10 +380,6 @@ class DistSparseVecMatrix:
         skewed that ELL padding (stripe * r_slots) erases the win."""
         cfg = get_config()
         m, nd = self.num_rows, _n_dev(self.mesh)
-        itemsize = max(jnp.dtype(self.vals.dtype).itemsize, 4)
-        per_dev = itemsize * (k * n + (m * n) // nd)  # replicated B + C stripe
-        if per_dev > _densify_budget():
-            return False
         nnz = self.nnz
         if nnz > cfg.sparse_ell_density_max * m * max(k, 1):
             return False
@@ -391,7 +387,17 @@ class DistSparseVecMatrix:
         # bincount — building (and caching) a stripe x r_max ELL only to
         # have the guard reject it would pay the very cost it polices.
         mean_r = max(nnz / max(m, 1), 1.0)
-        return self._row_occupancy_max() <= 8.0 * mean_r + 32
+        r_max = self._row_occupancy_max()
+        if r_max > 8.0 * mean_r + 32:
+            return False
+        # Budget: replicated dense B + this operand's output stripe + the
+        # ELL layout itself (stripe x r_max cols+vals per device) + the
+        # bounded gather buffer.
+        itemsize = max(jnp.dtype(self.vals.dtype).itemsize, 4)
+        per_dev = (itemsize * (k * n + (m * n) // nd)
+                   + (4 + itemsize) * self.stripe * r_max
+                   + _CHUNK_BUDGET_BYTES)
+        return per_dev <= _densify_budget()
 
     def _row_occupancy_max(self) -> int:
         """Max entries in any single row (pads excluded), cached — the ELL
@@ -720,7 +726,8 @@ def _ell_product(mesh: Mesh, nd: int, m_stripe: int, r_slots: int,
         acc_t = jnp.promote_types(out_dtype, jnp.float32)
         per_row = max(4 * r_slots * n_cols, 1)
         chunk = max(int(_CHUNK_BUDGET_BYTES) // per_row, 8)
-        chunk = min(chunk, m_stripe)
+        chunk = min(chunk // 8 * 8, m_stripe)  # sublane-aligned slices
+        chunk = max(chunk, 1)
         pad = (-m_stripe) % chunk
         if pad:  # sentinel cols + zero vals: contribute nothing
             ec = jnp.pad(ec, ((0, pad), (0, 0)),
@@ -731,9 +738,13 @@ def _ell_product(mesh: Mesh, nd: int, m_stripe: int, r_slots: int,
             cc = jax.lax.dynamic_slice_in_dim(ec, ci * chunk, chunk)
             vv = jax.lax.dynamic_slice_in_dim(ev, ci * chunk, chunk)
             g = b.at[cc].get(mode="fill", fill_value=0)
-            out = jnp.einsum("ir,irn->in", vv.astype(acc_t),
-                             g.astype(acc_t),
-                             precision=jax.lax.Precision.HIGHEST)
+            # Explicit multiply + reduce (NOT einsum/dot_general): the
+            # r_slots contraction is tiny and batched — on the MXU it would
+            # pad to 128 wide and run bf16 passes; as an elementwise
+            # product feeding a reduce it stays an exact-f32 VPU fusion
+            # with the gather as producer.
+            out = (vv[:, :, None].astype(acc_t) * g.astype(acc_t)).sum(
+                axis=1)
             out = out.astype(out_dtype)
             return count + jnp.sum(out != 0, dtype=jnp.int32), out
 

@@ -690,10 +690,13 @@ class TestChunkedCrossEntropy:
         monkeypatch.setattr(tr, "_CE_CHUNK", 4096)
         l_d = float(loss_fn(p, tok, tgt, cfg))
         g_d = jax.grad(loss_fn)(p, tok, tgt, cfg)
-        assert abs(l_c - l_d) < 1e-6
+        # Relative bound: the flat-axis chunking reassociates the f32 sum
+        # (chunks span sequence boundaries), so bit-exactness is not the
+        # contract — agreement to f32 roundoff is.
+        assert abs(l_c - l_d) <= 3e-6 * max(1.0, abs(l_d))
         for a, b in zip(jax.tree.leaves(g_c), jax.tree.leaves(g_d)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=1e-6, atol=1e-6)
+                                       rtol=1e-5, atol=1e-6)
 
     def test_no_full_logits_buffer(self, rng, monkeypatch):
         import marlin_tpu.models.transformer as tr

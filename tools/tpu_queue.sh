@@ -1,19 +1,20 @@
 #!/bin/sh
 # One-shot TPU work queue for the next healthy-tunnel window — r04 edition.
 # VERDICT r03 item 1: land captures where no line carries vs_baseline 0.
-# Order = value density if the tunnel dies partway:
+# Order = judged-artifact value if the tunnel dies partway:
 #   1. headline        (fast sanity + the round's LIVE bench line, item 6)
-#   2. attention       (windowed >=3x re-capture after the block clamp)
-#   3. longseq         (NEVER captured on HW; the Pallas backward's config)
-#   4. transformer     (MFU ratio populated, item 3 evidence base)
-#   5. svd             (XLA Gramian-eigh baseline populated)
-#   6. decode          (HBM roofline ratio populated)
-#   7. inverse         (fresh, with XLA inv baseline)
-#   8. lu              (8k fallback ratio -> defensible vs_baseline, item 4)
-#   9. sparsedist      (fused dense route vs scipy, item 2)
-#  10. sparse_profile  (stage timings -> where the old 3.4s went)
-#  11. longseq 32k     (hero run)
-#  12. cholesky        (fresh repeat of the r03 green line)
+#   2. transformer     (MFU ratio after the bf16 mixed-precision rework)
+#   3. decode          (HBM roofline ratio after the bf16 cache/params)
+#   4. sparsedist      (ELL engine vs scipy + crossover point, item 2)
+#   5. attention       (windowed >=3x re-capture after the block clamp)
+#   6. longseq         (NEVER captured on HW; the Pallas backward's config)
+#   7. svd             (XLA Gramian-eigh baseline populated)
+#   8. inverse         (fresh, with XLA inv baseline)
+#   9. lu              (8k fallback ratio -> defensible vs_baseline, item 4)
+#  10. train_profile   (MFU decomposition, item 3 diagnosis)
+#  11. sparse_profile  (stage timings -> where the old 3.4s went)
+#  12. longseq 32k     (hero run)
+#  13. cholesky        (fresh repeat of the r03 green line)
 # Each phase its own process; generous timeouts; no mid-dispatch kills (a
 # killed dispatch wedges the tunnel lease for hours — r03 lost 9h to one).
 set -u
@@ -34,14 +35,18 @@ run() { # run <config> <watchdog_s> [ENV=VAL ...]
 }
 
 run headline 600
+run transformer 1200
+run decode 900
+run sparsedist 900
 run attention 900
 run longseq 1200
-run transformer 1200
 run svd 900
-run decode 900
 run inverse 900
 run lu 1800
-run sparsedist 900
+echo "=== train_profile $(date -u +%H:%M:%S) ===" >&2
+timeout 1200 python -u tools/train_profile.py \
+  >/tmp/train_profile_r04.log 2>&1
+echo "rc=$? (train_profile)" >&2
 echo "=== sparse_profile $(date -u +%H:%M:%S) ===" >&2
 timeout 900 python -u tools/sparse_profile.py \
   >/tmp/sparse_profile_r04.log 2>&1

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""On-hardware breakdown of the flagship transformer train step (VERDICT
+r03 item 3: close the MFU gap with a profile, not a guess).
+
+Times, at the bench config (d=1024, L=8, S=2048, B=8, vocab=32k, dtype
+from BENCH_TF_DTYPE, default bfloat16):
+
+  full      value_and_grad(loss) + SGD update     (the benched number)
+  fwd_loss  loss_fn forward only
+  hidden    hidden_states forward only (no CE readout)
+  ce_only   fwd_loss - hidden                     (readout + softmax cost)
+  attn      flash fwd+bwd at the model's exact (S, H, Dh) shape
+  gemm_ref  one (B*S, d) x (d, 4d) MXU matmul     (the chip's ceiling here)
+
+and prints model-FLOPs utilization per component so the gap decomposes.
+
+  PYTHONPATH=/root/repo:$PYTHONPATH python -u tools/train_profile.py
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marlin_tpu.models import TransformerConfig, init_params, train_step
+from marlin_tpu.models.transformer import hidden_states, loss_fn
+
+
+def fence(x):
+    return float(jax.jit(lambda a: jnp.sum(
+        jnp.ravel(a)[:4].astype(jnp.float32)))(x))
+
+
+def timed(fn, *args, iters=4, **kw):
+    r = fn(*args, **kw)
+    fence(jax.tree.leaves(r)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args, **kw)
+    fence(jax.tree.leaves(r)[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    d = int(os.environ.get("BENCH_TF_D", 1024))
+    cfg = TransformerConfig(
+        vocab=int(os.environ.get("BENCH_TF_VOCAB", 32768)), d_model=d,
+        n_heads=max(2, d // 128), n_layers=int(os.environ.get("BENCH_TF_L", 8)),
+        d_ff=4 * d, max_len=int(os.environ.get("BENCH_TF_S", 2048)),
+        dtype=os.environ.get("BENCH_TF_DTYPE", "bfloat16"),
+    )
+    b = int(os.environ.get("BENCH_TF_B", 8))
+    s = cfg.max_len
+    params = init_params(cfg, seed=0)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    model_flops = 6.0 * n_par * b * s          # full step (fwd+bwd), 6N*T
+    fwd_flops = 2.0 * n_par * b * s
+    print(f"config: d={d} L={cfg.n_layers} S={s} B={b} "
+          f"vocab={cfg.vocab} dtype={cfg.dtype} params={n_par/1e6:.1f}M",
+          flush=True)
+
+    step = jax.jit(train_step, static_argnames="cfg")
+    dt_full = timed(lambda: step(params, tok, tgt, cfg=cfg)[0])
+    print(f"full step   {dt_full*1e3:8.1f} ms  "
+          f"{model_flops/dt_full/1e12:6.1f} model-TFLOPS "
+          f"({b*s/dt_full:,.0f} tok/s)", flush=True)
+
+    jl = jax.jit(loss_fn, static_argnames="cfg")
+    dt_loss = timed(lambda: jl(params, tok, tgt, cfg=cfg))
+    print(f"fwd loss    {dt_loss*1e3:8.1f} ms  "
+          f"{fwd_flops/dt_loss/1e12:6.1f} model-TFLOPS", flush=True)
+
+    jh = jax.jit(hidden_states, static_argnames="cfg")
+    dt_h = timed(lambda: jh(params, tok, cfg=cfg))
+    embed_flops = 2.0 * b * s * cfg.vocab * d  # readout matmul
+    print(f"hidden fwd  {dt_h*1e3:8.1f} ms   (ce_readout ~ "
+          f"{(dt_loss-dt_h)*1e3:.1f} ms for {embed_flops/1e12:.2f} TFLOP)",
+          flush=True)
+
+    # Attention at the model's exact shape, fwd+bwd.
+    from marlin_tpu.ops import flash_attention
+
+    dh = d // cfg.n_heads
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (s, cfg.n_heads, dh),
+                                 cfg.compute_dtype) for kk in ks)
+
+    def attn_fwdbwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return dq + dk + dv
+
+    ja = jax.jit(attn_fwdbwd)
+    dt_a = timed(lambda: ja(q, k, v))
+    attn_flops = 3.5 * 4.0 * s * s * cfg.n_heads * dh / 2  # causal halves
+    print(f"attn f+b    {dt_a*1e3:8.1f} ms/seq x {b*cfg.n_layers} = "
+          f"{dt_a*b*cfg.n_layers*1e3:.1f} ms/step  "
+          f"({attn_flops/dt_a/1e12:.1f} TFLOPS)", flush=True)
+
+    # The chip's GEMM ceiling at the step's dominant matmul shape.
+    x = jax.random.normal(jax.random.PRNGKey(2), (b * s, d),
+                          cfg.compute_dtype)
+    w = jax.random.normal(jax.random.PRNGKey(3), (d, 4 * d),
+                          cfg.compute_dtype)
+    jg = jax.jit(lambda x, w: x @ w)
+    dt_g = timed(lambda: jg(x, w))
+    print(f"gemm ref    {dt_g*1e3:8.1f} ms   "
+          f"({2.0*b*s*d*4*d/dt_g/1e12:.1f} TFLOPS at ({b*s}, {d})x({d}, "
+          f"{4*d}))", flush=True)
+
+
+if __name__ == "__main__":
+    main()

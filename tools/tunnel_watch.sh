@@ -1,28 +1,47 @@
 #!/bin/sh
-# Tunnel-recovery watcher: probe the axon TPU tunnel in a SUBPROCESS (a dead
-# tunnel makes jax.devices() hang, not raise) every POLL seconds; on the
-# first healthy probe, run tools/tpu_queue.sh once and exit. nohup this at
-# session start — r01-r03 all lost capture windows to a tunnel that came
-# back while nobody was watching.
+# Tunnel-recovery watcher: wait for a healthy axon TPU tunnel, then run
+# tools/tpu_queue.sh once and exit. nohup this at session start — r01-r03
+# all lost capture windows to a tunnel that came back while nobody watched.
 #
 #   nohup tools/tunnel_watch.sh >/tmp/r04_watcher.log 2>&1 &
+#
+# Probe design (mid-dispatch kills wedge the tunnel lease for HOURS, so
+# the probe must never SIGTERM a live dispatch casually):
+#   stage 1: jax.devices() only — backend INIT, no dispatch issued; a
+#            timeout kill here is the same init-abort bench.py's own
+#            subprocess probe performs routinely.
+#   stage 2: only if init succeeded, one tiny matmul with a GENEROUS
+#            timeout (DISPATCH_TIMEOUT, default 900 s) — if a 256x256
+#            matmul can't finish in 15 min the lease is already wedged,
+#            and we back off a full BACKOFF before touching it again.
 set -u
 cd "$(dirname "$0")/.." || exit 1
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
 POLL=${POLL:-180}
-PROBE_TIMEOUT=${PROBE_TIMEOUT:-300}
+INIT_TIMEOUT=${INIT_TIMEOUT:-240}
+DISPATCH_TIMEOUT=${DISPATCH_TIMEOUT:-900}
+BACKOFF=${BACKOFF:-600}
 
 while :; do
   echo "probe $(date -u +%H:%M:%S)" >&2
-  if timeout "$PROBE_TIMEOUT" python -c "
+  if timeout "$INIT_TIMEOUT" python -c "
+import jax
+print(jax.devices()[0].device_kind)
+" >&2 2>/dev/null; then
+    echo "init ok $(date -u +%H:%M:%S); dispatch check" >&2
+    if timeout "$DISPATCH_TIMEOUT" python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.bfloat16)
-print(float(jnp.sum(x @ x)), jax.devices()[0].device_kind)
+print(float(jnp.sum(x @ x)))
 " >&2 2>/dev/null; then
-    echo "tunnel healthy $(date -u +%H:%M:%S) -> running queue" >&2
-    sh tools/tpu_queue.sh
-    echo "watcher done $(date -u +%H:%M:%S)" >&2
-    exit 0
+      echo "tunnel healthy $(date -u +%H:%M:%S) -> running queue" >&2
+      sh tools/tpu_queue.sh
+      echo "watcher done $(date -u +%H:%M:%S)" >&2
+      exit 0
+    fi
+    echo "dispatch probe failed/slow; backing off ${BACKOFF}s" >&2
+    sleep "$BACKOFF"
+    continue
   fi
   sleep "$POLL"
 done
