@@ -520,10 +520,43 @@ def config_attention():
             q, k, v)
         dt_c = _scan_timed(
             lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        # Analytic block-MAC ceiling — derivation in docs/ROUND4.md §7:
+        # causal (1024-blocks) ~ S*(S+1024)/2, banded ~ S*(bq + w + bk).
+        # bq/bk must mirror flash_attention's windowed clamp EXACTLY
+        # (ops/flash_attention.py: block_k floor 128, block_q floor 256,
+        # both capped ~w/2) or ceiling_frac misattributes the gap.
+        wclamp = (w // 2 + 127) // 128 * 128
+        bq_eff = max(256, min(1024, wclamp))
+        bk_eff = max(128, min(1024, wclamp))
+        ideal = (s * (s + 1024) / 2.0) / (s * (bq_eff + w + bk_eff))
         out.update(window=w,
                    window_speedup_vs_causal=round(dt_c / dt_w, 2),
                    causal_ms=round(dt_c * 1e3, 2),
-                   window_ms=round(dt_w * 1e3, 2))
+                   window_ms=round(dt_w * 1e3, 2),
+                   window_block_ceiling=round(ideal, 2),
+                   window_ceiling_frac=round((dt_c / dt_w) / ideal, 3))
+        # Block sweep inside the band: the best (bq, bk) is a
+        # measurement, not a formula — smaller blocks shrink the diagonal
+        # overhang but raise grid overhead. The clamped-default point is
+        # dt_w, already measured; time only the new shapes.
+        sweep = [[bq_eff, bk_eff, round(dt_c / dt_w, 2)]]
+        for bq, bk in ((256, 256), (256, 128), (512, 128)):
+            if (bq, bk) == (bq_eff, bk_eff):
+                continue
+            try:
+                dt_s = _scan_timed(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, causal=True, window=w,
+                        block_q=bq, block_k=bk),
+                    q, k, v)
+                sweep.append([bq, bk, round(dt_c / dt_s, 2)])
+            except Exception as e:  # noqa: BLE001
+                print(f"wsweep ({bq},{bk}) failed: {_trim_err(e, 100)}",
+                      file=sys.stderr, flush=True)
+        best = max(sweep, key=lambda t: t[2])
+        out.update(window_sweep=sweep,
+                   window_best_speedup=best[2],
+                   window_best_block=best[:2])
 
     # Training path: fwd + Pallas flash backward (dQ + dK/dV kernels — no
     # (S, S) buffer in either direction). 3.5x the fwd MAC count (2 fwd

@@ -40,7 +40,13 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 _BREAKDOWN = 1e-14
-_DEVICE_CHUNK = 16  # Lanczos steps per device dispatch in the device sweep
+# Lanczos steps per device dispatch in the device sweep. 32 (was 16):
+# each inter-chunk boundary costs one tunnel round-trip for the
+# convergence fetch, which on the axon link is comparable to the chunk's
+# own compute — fewer, larger chunks win until the over-run past the
+# convergence point (~chunk/2 wasted steps) costs more than the saved
+# round-trips.
+_DEVICE_CHUNK = 32
 
 
 def symmetric_eigs(
@@ -326,11 +332,15 @@ def _lanczos_sweep_device(
         # produces spurious Ritz values.
         with linalg_precision_scope():
             carry = chunk(operand, carry)
-        # Small fetches only: the (m,) recurrence scalars + flags.
-        j_dev = int(carry[4])
-        done = bool(carry[5])
-        alphas = np.asarray(carry[1][:j_dev], np.float64)
-        betas = np.asarray(carry[2][:j_dev], np.float64)
+        # Small fetches only — and in ONE device_get: each separate fetch
+        # costs a tunnel round-trip comparable to the chunk's compute
+        # (observed on the axon link), and this loop runs per chunk.
+        alphas_f, betas_f, j_dev, done = jax.device_get(
+            (carry[1], carry[2], carry[4], carry[5]))
+        j_dev = int(j_dev)
+        done = bool(done)
+        alphas = np.asarray(alphas_f[:j_dev], np.float64)
+        betas = np.asarray(betas_f[:j_dev], np.float64)
         m = j_dev
         if done:
             exact = True
