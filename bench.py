@@ -153,6 +153,11 @@ def _load_cached_lines(capture_dir: str = None) -> dict:
                 continue
             if line.get("oracle_ok") is False:
                 continue
+            if line.get("cached"):
+                # A replay that a dead-tunnel queue run appended into a
+                # capture file is NOT evidence — replaying it again would
+                # launder its provenance (age/file) as fresh.
+                continue
             for key, prefix in _CACHE_PREFIX.items():
                 if str(line["metric"]).startswith(prefix):
                     best[key] = (mtime, line, os.path.basename(path))

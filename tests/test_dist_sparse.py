@@ -375,6 +375,8 @@ class TestOutputDtypeContract:
         assert a.vals.dtype == jnp.bfloat16
         out = a.multiply_sparse(b)
         assert out.values.dtype == jnp.bfloat16
+        out_ell = a.multiply_sparse(b, mode="ell")
+        assert out_ell.values.dtype == jnp.bfloat16
         dm = DenseVecMatrix(
             jnp.asarray(rng.standard_normal((16, 6)), jnp.bfloat16)
         )
@@ -413,3 +415,33 @@ class TestOutputDtypeContract:
         finally:
             ds._spsp_ring.cache_clear()
             ds._spmm_ring_dense.cache_clear()
+
+
+class TestEllUnderJit:
+    def test_spmm_ell_route_inside_jit_with_grad(self):
+        # GCN-shaped usage at ELL-eligible density: spmm inside a jitted
+        # loss, gradient through the custom vjp (cached-transpose engine),
+        # with the route pick + ELL build happening under the trace.
+        import jax
+        import jax.numpy as jnp
+
+        n, f = 1024, 8
+        rng = np.random.default_rng(11)
+        nnz = 2000  # density ~0.002 < the 5e-3 ELL ceiling
+        r = rng.integers(0, n, nnz)
+        c = rng.integers(0, n, nnz)
+        v = rng.standard_normal(nnz)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (n, n))
+        assert a._ell_wins(n, f)
+        from marlin_tpu.matrix.dist_sparse import spmm
+
+        b = jnp.asarray(rng.standard_normal((n, f)))
+
+        @jax.jit
+        def loss(b):
+            return jnp.sum(spmm(a, b) ** 2)
+
+        g = jax.jit(jax.grad(loss))(b)
+        da = _dense(r, c, v, (n, n))
+        ref = 2.0 * da.T @ (da @ np.asarray(b))
+        np.testing.assert_allclose(np.asarray(g), ref, rtol=1e-8, atol=1e-8)
