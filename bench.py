@@ -999,6 +999,14 @@ def _train_throughput(metric, cfg, batch):
             "vs_baseline": round(model_tflops / (0.5 * guess_peak()), 3),
             "model_tflops_est": round(model_tflops, 2),
             "params_m": round(n_par / 1e6, 1),
+            # Config provenance: which variant this line measured (the
+            # capture ledger compares lines across sessions; dtype/arch
+            # knobs are exactly what moves them).
+            "dtype": cfg.dtype, "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers, "batch": batch,
+            "seq_len": cfg.max_len,
+            "kv_heads": cfg.kv_heads, "rope": cfg.rope,
+            "window": cfg.window, "remat": cfg.remat,
             "loss_finite": bool(np.isfinite(float(loss)))}
 
 
@@ -1044,10 +1052,8 @@ def config_longseq():
         window=_sized("BENCH_LS_WINDOW", 0),
         dtype=os.environ.get("BENCH_LS_DTYPE", "bfloat16"),
     )
-    out = _train_throughput(
+    return _train_throughput(
         f"longseq_train_s{s // 1024}k_tokens_per_s", cfg, batch=1)
-    out["seq_len"] = s
-    return out
 
 
 def config_decode():
