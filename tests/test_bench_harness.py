@@ -188,3 +188,39 @@ class TestCachedFallback:
         for d in cached:
             assert d["cached"] is True and d["value"] > 0
         assert len(status) == 1 and status[0]["live"] is False
+
+
+class TestCaptureSummaryHistory:
+    def test_history_skips_replays_and_flags_deltas(self, tmp_path, monkeypatch):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "capture_summary", "tools/capture_summary.py")
+        cs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cs)
+        monkeypatch.setattr(bench, "_CAPTURE_DIR", str(tmp_path))
+
+        def write(name, lines):
+            with open(tmp_path / name, "w") as f:
+                for line in lines:
+                    f.write(json.dumps(line) + "\n")
+
+        write("r01_a.jsonl", [
+            {"metric": "m_x_seconds", "value": 1.0, "unit": "s",
+             "vs_baseline": 0},
+            {"metric": "bench_run_status", "value": 1.0, "unit": "lines",
+             "vs_baseline": 0, "live": True},
+        ])
+        write("r02_b.jsonl", [
+            {"metric": "m_x_seconds", "value": 2.0, "unit": "s",
+             "vs_baseline": 0},
+            # replay: not evidence, must not appear in history
+            {"metric": "m_x_seconds", "value": 9.0, "unit": "s",
+             "vs_baseline": 0, "cached": True},
+        ])
+        hist = cs._history()
+        assert list(hist) == ["m_x_seconds"]  # run_status + replay excluded
+        assert [v for _, v, _, _ in hist["m_x_seconds"]] == [1.0, 2.0]
+        # 1.0 -> 2.0 crosses the 1.5x flag threshold.
+        (f0, v0, _, _), (f1, v1, _, _) = hist["m_x_seconds"]
+        assert v1 / v0 > cs.DELTA_FLAG
