@@ -1108,6 +1108,9 @@ def config_decode():
             "unit": "tok/s", "vs_baseline": round((1.0 / dt) / roofline, 3),
             "batch": b, "total_tok_s": round(b / dt, 1),
             "hbm_roofline_tok_s_per_seq": round(roofline, 1),
+            # Config provenance (cross-session ledger comparability).
+            "dtype": cfg.dtype, "kv_heads": kv_heads, "rope": cfg.rope,
+            "cache_len": cfg.max_len, "d_model": cfg.d_model,
             "out_ok": n_out == b * steps}
 
 
