@@ -241,3 +241,22 @@ class TestSequenceParallelGQA:
         for a, b in zip(gs, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-8, atol=1e-8)
+
+
+class TestBF16Inputs:
+    """Mixed-precision rollout contract: the SP engines accumulate >= f32
+    internally (softmax, PV sums), so bf16 q/k/v must track the f64 oracle
+    to bf16 IO tolerance — not bf16-accumulation error."""
+
+    @pytest.mark.parametrize("strategy", ["ring", "all_to_all"])
+    def test_bf16_tracks_oracle(self, strategy):
+        s, h, d = 32, 8, 16
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q, k, v = (jax.random.normal(kk, (s, h, d), jnp.bfloat16)
+                   for kk in ks)
+        got = sequence_parallel_attention(q, k, v, causal=True,
+                                          strategy=strategy)
+        assert got.dtype == jnp.bfloat16
+        ref = oracle_mha(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), ref, rtol=0.05, atol=0.05)
