@@ -769,3 +769,19 @@ class TestMixedPrecision:
         out = generate(params, prompt, 4, self.BF)
         assert out.shape == (2, 4)
         assert bool(jnp.all((out >= 0) & (out < 31)))
+
+    def test_moe_composes_with_bf16_compute(self, rng, mesh):
+        # MoE x mixed precision: bf16 activations route through the expert
+        # engine (gates softmax promotes >= f32 internally); masters stay
+        # f32 and the step remains finite under jit.
+        n_dev = len(mesh.devices.flat)
+        cfg = TransformerConfig(vocab=17, d_model=16, n_heads=2, n_layers=1,
+                                d_ff=32, max_len=2 * n_dev, n_experts=n_dev,
+                                dtype="bfloat16")
+        params = init_params(cfg, seed=0)
+        tok = jnp.asarray(rng.integers(0, 17, (2, 2 * n_dev)), jnp.int32)
+        step = jax.jit(train_step, static_argnames="cfg")
+        loss, new_params = step(params, tok, jnp.roll(tok, -1, 1), cfg=cfg)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree.leaves(new_params):
+            assert leaf.dtype == jnp.float32
