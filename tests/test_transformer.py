@@ -785,3 +785,25 @@ class TestMixedPrecision:
         assert np.isfinite(float(loss))
         for leaf in jax.tree.leaves(new_params):
             assert leaf.dtype == jnp.float32
+
+    def test_tp_composes_with_bf16_compute(self, rng, mesh):
+        # TP x mixed precision: the entry-point cast of SHARDED f32
+        # masters must preserve the Megatron layout under jit (GSPMD
+        # propagates the sharding through the cast) and reproduce the
+        # unsharded bf16 forward.
+        from marlin_tpu.models import shard_params
+
+        bf_cfg = CFG._replace(dtype="bfloat16")
+        params = init_params(bf_cfg, seed=0)
+        tp = shard_params(params, bf_cfg, mesh=mesh)
+        tok = jnp.asarray(rng.integers(0, bf_cfg.vocab, (2, 16)), jnp.int32)
+        ref = forward(params, tok, bf_cfg)
+        got = jax.jit(forward, static_argnames="cfg")(tp, tok, cfg=bf_cfg)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            rtol=0.05, atol=0.05)
+        loss, new_params = jax.jit(train_step, static_argnames="cfg")(
+            tp, tok, jnp.roll(tok, -1, 1), cfg=bf_cfg)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree.leaves(new_params):
+            assert leaf.dtype == jnp.float32
