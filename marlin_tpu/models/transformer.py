@@ -35,6 +35,7 @@ run a static-shape KV cache with the whole decode loop in one jitted
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -341,7 +342,16 @@ def forward(params, tokens, cfg: TransformerConfig):
     return hidden_states(params, tokens, cfg) @ params["embed"].T
 
 
-_CE_CHUNK = 2048  # sequence positions per readout chunk in loss_fn
+# Positions per readout chunk in loss_fn. Env-overridable (MARLIN_CE_CHUNK)
+# so the on-hardware profile session can sweep the chunked-CE cost without
+# code edits; tests monkeypatch the module attribute directly. Guarded: a
+# malformed value must not break module import for inference-only users.
+try:
+    _CE_CHUNK = max(1, int(os.environ.get("MARLIN_CE_CHUNK", 2048)))
+except ValueError:
+    raise ValueError(
+        f"MARLIN_CE_CHUNK must be an integer, got "
+        f"{os.environ['MARLIN_CE_CHUNK']!r}") from None
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig):
