@@ -807,3 +807,17 @@ class TestMixedPrecision:
         assert np.isfinite(float(loss))
         for leaf in jax.tree.leaves(new_params):
             assert leaf.dtype == jnp.float32
+
+    def test_gqa_rope_bf16_generate(self, rng):
+        # GQA + RoPE + bf16 cache: the full decode stack at the bench's
+        # architecture-knob settings stays in-vocab and shape-correct.
+        from marlin_tpu.models import generate
+
+        cfg = TransformerConfig(vocab=31, d_model=32, n_heads=4,
+                                n_kv_heads=2, n_layers=2, d_ff=64,
+                                max_len=32, rope=True, dtype="bfloat16")
+        params = init_params(cfg, seed=2)
+        prompt = jnp.asarray(rng.integers(0, 31, (2, 6)), jnp.int32)
+        out = np.asarray(generate(params, prompt, 5, cfg))
+        assert out.shape == (2, 5)
+        assert out.min() >= 0 and out.max() < 31
