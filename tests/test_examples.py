@@ -74,6 +74,16 @@ def test_sparse_multiply(capsys):
     assert len(out["seconds"]) == 6
 
 
+def test_sparse_multiply_ell_regime(capsys):
+    # Low enough density that mode 1's auto dispatch takes the ELL
+    # row-gather arm (and the lazy result's .values path in the CLI fence).
+    sparse_multiply.main(["256", "256", "256", "--sparsity", "0.003",
+                          "--modes", "1", "3"])
+    out = json.loads(capsys.readouterr().out)
+    assert "1_sparse_x_sparse" in out["seconds"]
+    assert "3_sparse_x_dense" in out["seconds"]
+
+
 def test_lu_example(tmp_path, rng, capsys):
     from marlin_tpu.matrix.dense import DenseVecMatrix
     from marlin_tpu.linalg import unpack_lu
