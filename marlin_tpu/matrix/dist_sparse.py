@@ -889,7 +889,14 @@ class _LazyCoordinateMatrix(CoordinateMatrix):
     dense ops (or only need the count) never pay the fixed-size-nonzero
     extraction at all. Everything else inherits: ``row_idx/col_idx/values``
     materialize lazily as the same padded mesh-sharded triples the eager
-    path produced, and ``padded`` filtering semantics are unchanged."""
+    path produced, and ``padded`` filtering semantics are unchanged.
+
+    HBM note (ADVICE r04): until the triples are first read, this object
+    PINS the full (m x n) dense product stripes on device — consumers that
+    only ever touch ``nnz``/``to_numpy`` keep that buffer alive for the
+    object's lifetime (the eager path released it at extraction time).
+    Long-lived results on a memory-tight mesh should call
+    :meth:`materialize` once to convert to triples and drop the stripes."""
 
     def __init__(self, dense_stripes: jax.Array,
                  counts: Optional[jax.Array], shape: Tuple[int, int], mesh):
@@ -921,6 +928,14 @@ class _LazyCoordinateMatrix(CoordinateMatrix):
             self._nnz = total
             self._dense = None  # triples carry the data from here on
         return self._triples
+
+    def materialize(self) -> "_LazyCoordinateMatrix":
+        """Extract the COO triples now and RELEASE the dense product
+        stripes (the lazy path otherwise pins that (m x n) HBM buffer until
+        the triples are first read — see the class docstring). Idempotent;
+        returns self for chaining."""
+        self._materialize()
+        return self
 
     @property
     def row_idx(self):

@@ -344,14 +344,20 @@ def forward(params, tokens, cfg: TransformerConfig):
 
 # Positions per readout chunk in loss_fn. Env-overridable (MARLIN_CE_CHUNK)
 # so the on-hardware profile session can sweep the chunked-CE cost without
-# code edits; tests monkeypatch the module attribute directly. Guarded: a
-# malformed value must not break module import for inference-only users.
+# code edits; tests monkeypatch the module attribute directly. A malformed
+# value falls back to the default with a warning instead of poisoning module
+# import — inference-only users never reach loss_fn, so a typo'd profiling
+# knob must not take forward() down with it (ADVICE r04).
 try:
     _CE_CHUNK = max(1, int(os.environ.get("MARLIN_CE_CHUNK", 2048)))
 except ValueError:
-    raise ValueError(
+    import warnings
+
+    warnings.warn(
         f"MARLIN_CE_CHUNK must be an integer, got "
-        f"{os.environ['MARLIN_CE_CHUNK']!r}") from None
+        f"{os.environ['MARLIN_CE_CHUNK']!r}; using the default 2048",
+        RuntimeWarning, stacklevel=2)
+    _CE_CHUNK = 2048
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig):

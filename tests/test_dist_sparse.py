@@ -322,6 +322,25 @@ class TestEllRoute:
         np.add.at(got, (r2, c2), v2)
         np.testing.assert_allclose(got, oracle, rtol=1e-10, atol=1e-10)
 
+    def test_materialize_releases_dense_stripes(self, rng):
+        # ADVICE r04: the lazy result pins the (m x n) dense stripes until
+        # the triples are first read; materialize() is the explicit release
+        # for memory-sensitive callers — idempotent, chains, and the data
+        # survives the handoff.
+        ra, ca, va = _random_coo(rng, 48, 40, 0.1)
+        rb, cb, vb = _random_coo(rng, 40, 32, 0.1)
+        a = DistSparseVecMatrix.from_coo(ra, ca, va, (48, 40))
+        b = DistSparseVecMatrix.from_coo(rb, cb, vb, (40, 32))
+        out = a.multiply_sparse(b, mode="ell")
+        assert out._dense is not None
+        assert out.materialize() is out
+        assert out._dense is None and out._triples is not None
+        assert out.materialize() is out  # idempotent
+        oracle = _dense(ra, ca, va, (48, 40)) @ _dense(rb, cb, vb, (40, 32))
+        np.testing.assert_allclose(out.to_numpy(), oracle, rtol=1e-10,
+                                   atol=1e-10)
+        assert out.nnz == int(np.count_nonzero(oracle))
+
     def test_ell_duplicate_entries_add(self):
         r = np.array([0, 0, 1]); c = np.array([1, 1, 0])
         v = np.array([2.0, 3.0, 1.0])

@@ -63,11 +63,36 @@ def test_spanning_mesh_processes(tmp_path, nproc):
 
     # The N-process coordination-service rendezvous is timing-sensitive
     # under host load (observed: a one-off worker failure in a full-suite
-    # run that passes in isolation) — retry the whole launch once before
-    # declaring failure; a real boundary bug fails both attempts.
+    # run that passes in isolation) — retry the whole launch once, but ONLY
+    # for timeout/rendezvous-shaped failures (ADVICE r04: a blanket retry
+    # masks real intermittent cross-process bugs), and print the first
+    # attempt's output first so a passing retry still leaves a flake trace.
+    _RENDEZVOUS_MARKS = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "barrier",
+                        "coordination", "failed to connect",
+                        "connection refused", "heartbeat")
+
+    def _transient(outs) -> bool:
+        if outs is None:
+            return True  # whole-launch timeout
+        return any(rc != 0 and any(m.lower() in (out + err).lower()
+                                   for m in _RENDEZVOUS_MARKS)
+                   for rc, out, err in outs)
+
     outs = launch()
-    if outs is None or any(rc != 0 for rc, _, _ in outs):
+    if outs is not None and all(rc == 0 for rc, _, _ in outs):
+        pass  # first attempt clean
+    elif _transient(outs):
+        if outs is None:
+            print("multihost attempt 1 timed out; retrying", flush=True)
+        else:
+            for i, (rc, out, err) in enumerate(outs):
+                if rc != 0:
+                    print(f"multihost attempt 1 worker {i} rc={rc} "
+                          f"(rendezvous-shaped, retrying)\nstdout:\n{out}\n"
+                          f"stderr:\n{err[-3000:]}", flush=True)
         outs = launch()
+    # Non-transient first-attempt failures fall through to the assertions
+    # below and fail loudly with their own output.
     if outs is None:
         pytest.fail("multihost workers timed out (both attempts)")
     for i, (rc, out, err) in enumerate(outs):

@@ -698,6 +698,28 @@ class TestChunkedCrossEntropy:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
 
+    def test_malformed_chunk_env_warns_and_imports(self):
+        # ADVICE r04: a typo'd MARLIN_CE_CHUNK is a profiling-knob mistake,
+        # not grounds to fail module import for inference-only users — the
+        # module must come up on the 2048 default with a warning.
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "import warnings\n"
+            "with warnings.catch_warnings(record=True) as w:\n"
+            "    warnings.simplefilter('always')\n"
+            "    import marlin_tpu.models.transformer as tr\n"
+            "print(tr._CE_CHUNK,\n"
+            "      any('MARLIN_CE_CHUNK' in str(x.message) for x in w))\n")
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "MARLIN_CE_CHUNK": "banana"},
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert r.stdout.split() == ["2048", "True"], r.stdout
+
     def test_no_full_logits_buffer(self, rng, monkeypatch):
         import marlin_tpu.models.transformer as tr
 
