@@ -62,6 +62,19 @@ def _win_kblocks(n_k, *, block_q, block_k, window):
     return min(n_k, (block_q + window - 2) // block_k + 2)
 
 
+def window_block_clamp(block_q: int, block_k: int,
+                       window: int) -> tuple:
+    """The windowed entry clamp, as ONE shared function: bench.py's ceiling
+    accounting must evaluate the model at exactly the blocks the kernel
+    will run (a hand-copied mirror silently misattributes the gap when the
+    clamp changes — review finding r05). The shrunk sweep reads
+    ~(block_q + window + 2*block_k) key rows per q-block, so blocks much
+    wider than the window defeat the grid shrink; cap both near window/2
+    (128/256-row floors, 128-lane rounding)."""
+    cap = (window // 2 + 127) // 128 * 128
+    return (max(256, min(block_q, cap)), max(128, min(block_k, cap)))
+
+
 def _win_lo_q(j, *, block_q, block_k, window):
     """First q-block whose rows attend into k-block j (traced): causality
     puts the first live row at j * block_k."""
@@ -622,15 +635,11 @@ def flash_attention(
     if single:
         q, k, v = q[:, None, :], k[:, None, :], v[:, None, :]
     if window:
-        # The shrunk sweep reads ~(block_q + window + 2*block_k) key rows
-        # per q-block, so a block_k much wider than the window defeats the
-        # grid shrink; cap it near window/2 (128-row floor). block_q is
-        # capped the same way: each q-block's rows process ~window +
-        # block_q/2 keys (the diagonal partial), so block_q ~ window/2
-        # keeps the compute ratio near S/window instead of plateauing at
-        # ~2.7x (measured at S=8k, window=1024, 1024-blocks).
-        block_k = max(128, min(block_k, (window // 2 + 127) // 128 * 128))
-        block_q = max(256, min(block_q, (window // 2 + 127) // 128 * 128))
+        # Rationale in window_block_clamp: each q-block's rows process
+        # ~window + block_q/2 keys (the diagonal partial), so ~window/2
+        # blocks keep the compute ratio near S/window instead of
+        # plateauing at ~2.7x (measured at S=8k, window=1024, 1024-blocks).
+        block_q, block_k = window_block_clamp(block_q, block_k, window)
     # Clamp blocks to the (sublane-padded) sequence lengths.
     block_q = min(block_q, -(-q.shape[0] // 16) * 16)
     block_k = min(block_k, -(-k.shape[0] // 16) * 16)

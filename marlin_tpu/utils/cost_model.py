@@ -1,0 +1,273 @@
+"""Static perf floor: analytic FLOP/HBM-byte models for the hot paths,
+checked against XLA's compiled cost analysis in CI (tests/test_cost_model.py).
+
+Three dead-tunnel rounds (r02 lease wedge, r03 mid-session death, r04
+full-round outage) showed that when every perf claim needs the one TPU chip,
+a tunnel outage zeroes a round's perf evidence. This module is the hedge the
+r04 verdict asked for (item 4): each hot path gets a roofline model —
+predicted FLOPs and bytes moved — and a CI test asserts the COMPILED
+program's cost analysis stays inside the model's band on the CPU mesh. A
+perf regression (an op starting to materialize a buffer it shouldn't, a
+gather turning dense, a cache re-read) then fails a TEST, tunnel or no
+tunnel; the chip's role shrinks to confirming the achieved fraction of the
+modeled roofline. This upgrades the reference's wall-clock-only timing idiom
+(MTUtils.scala:218-220) into a subsystem per SURVEY §5.
+
+Conventions:
+
+* Under SPMD (``shard_map``/jit over an N-device mesh) XLA's
+  ``cost_analysis`` reports PER-DEVICE figures — the models here do the
+  same (``n_devices`` args divide the sharded axes).
+* ``flops`` counts multiply+add as 2 (XLA's convention for dot).
+* ``bytes`` are logical words moved to/from HBM assuming perfect reuse of
+  operands inside one fused kernel — a lower bound the compiled program can
+  exceed (fusion boundaries, padding) but should stay within a small factor
+  of.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+__all__ = [
+    "CostReport", "compiled_cost",
+    "gemm_cost", "summa_cost", "ell_product_cost", "decode_step_cost",
+    "ce_logits_bytes", "attention_block_counts", "flash_attention_cost",
+]
+
+
+# ---------------------------------------------------------------------------
+# Compiled-program side: what XLA says the executable does
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CostReport:
+    """Per-device cost of a compiled executable, as XLA accounts it."""
+
+    flops: float
+    bytes_accessed: float
+    arg_bytes: int
+    out_bytes: int
+    temp_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return self.arg_bytes + self.out_bytes + self.temp_bytes
+
+
+def compiled_cost(fn, *args, **kwargs) -> CostReport:
+    """Lower + compile ``fn(*args, **kwargs)`` and read XLA's cost tables.
+
+    ``fn`` may be a plain callable (it is jitted here) or an
+    already-``jax.jit``-wrapped function (used as-is, preserving its
+    static_argnames/shardings). Nothing is executed — this is the static
+    path that works with a dead backend, on any platform.
+    """
+    import jax
+
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    compiled = fn.lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returned [dict]
+        ca = ca[0] if ca else {}
+    ma = compiled.memory_analysis()
+    return CostReport(
+        flops=float(ca.get("flops", 0.0)),
+        bytes_accessed=float(ca.get("bytes accessed", 0.0)),
+        arg_bytes=int(getattr(ma, "argument_size_in_bytes", 0)),
+        out_bytes=int(getattr(ma, "output_size_in_bytes", 0)),
+        temp_bytes=int(getattr(ma, "temp_size_in_bytes", 0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Analytic models: the rooflines the compiled programs are held to
+# ---------------------------------------------------------------------------
+
+
+def gemm_cost(m: int, k: int, n: int, itemsize: int = 4) -> Tuple[float, float]:
+    """(flops, bytes) of a local C = A @ B: the MXU headline path.
+
+    Bytes assume each operand crosses HBM once — A (m, k) and B (k, n) read,
+    C (m, n) written. Reference call-site shape: DenseVecMatrix.scala:196.
+    """
+    return 2.0 * m * k * n, float(itemsize) * (m * k + k * n + m * n)
+
+
+def summa_cost(m: int, k: int, n: int, pr: int, pc: int,
+               itemsize: int = 4) -> Tuple[float, float]:
+    """Per-device (flops, bytes) of the all-gather SUMMA engine on a
+    (pr x pc) mesh (parallel/summa.py:_summa_fn).
+
+    Each device holds (m/pr, k/pc) of A and (k/pr, n/pc) of B, gathers the
+    full A row-panel (m/pr, k) and B col-panel (k, n/pc) over ICI, then runs
+    one local MXU matmul into its (m/pr, n/pc) block. Bytes count the
+    gathered panels (what actually crosses the device boundary into the
+    matmul) plus the output block.
+    """
+    flops = 2.0 * (m / pr) * k * (n / pc)
+    byts = itemsize * ((m / pr) * k + k * (n / pc) + (m / pr) * (n / pc))
+    return flops, float(byts)
+
+
+def ell_product_cost(m: int, k: int, n: int, r_slots: int, n_devices: int,
+                     itemsize: int = 4) -> Tuple[float, float]:
+    """Per-device (flops, bytes) of the ELL row-gather sparse product
+    (matrix/dist_sparse.py:_ell_product).
+
+    Each of the m/nd local output rows gathers its ``r_slots`` B rows
+    (r_slots * n words), multiplies by the slot values and reduces — traffic
+    ~ nnz(A) * n words (the class docstring's bound), NOT m*k*n: the whole
+    point of the low-density arm. Bytes: the B all-gather (k * n, read once
+    per device), the gathered rows (m/nd * r_slots * n), the output stripe
+    (m/nd * n), plus the ELL tables (m/nd * r_slots * (4 + itemsize)).
+    FLOPs: one multiply + one add per gathered element (VPU, not MXU — the
+    model counts 2 * m/nd * r_slots * n).
+    """
+    ms = m / n_devices
+    flops = 2.0 * ms * r_slots * n
+    byts = itemsize * (k * n + ms * r_slots * n + ms * n) \
+        + ms * r_slots * (4 + itemsize)
+    return flops, float(byts)
+
+
+def transformer_param_count(cfg) -> int:
+    """Parameter count of models/transformer.py's pytree (embed shared with
+    the readout; per-block fused qkv / wo / mlp+biases / two LNs; final LN;
+    learned positions unless rope). Checked exactly against init_params in
+    the cost tests."""
+    d, v, ff = cfg.d_model, cfg.vocab, cfg.d_ff
+    dh = d // cfg.n_heads
+    kvd = cfg.kv_heads * dh
+    if cfg.n_experts:
+        e = cfg.n_experts
+        mlp = d * e + e * (d * ff + ff + ff * d + d)  # router + expert banks
+    else:
+        mlp = d * ff + ff + ff * d + d  # w1 + b1 + w2 + b2
+    per_block = d * (d + 2 * kvd) + d * d + mlp + 4 * d
+    total = v * d + cfg.n_layers * per_block + 2 * d
+    if not cfg.rope:
+        total += cfg.max_len * d
+    return int(total)
+
+
+def decode_step_cost(cfg, batch: int, param_itemsize: int = 4,
+                     cache_itemsize: int = 4) -> Tuple[float, float]:
+    """(flops, bytes) of one decode step at batch B (single device).
+
+    Decode is HBM-bound: the step must stream the PARAMETERS once
+    (B independent of it) and the KV cache once (read all slots, write one),
+    and nothing else of that magnitude — the honest roofline bench.py prices
+    at the streamed dtype. FLOPs: 2 * params * B for the matmuls plus the
+    cache attention (4 * B * L * cache_len * Hk * Dh MACs * 2).
+    """
+    params = transformer_param_count(cfg)
+    dh = cfg.d_model // cfg.n_heads
+    cache_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
+    cache_elems = 2 * cfg.n_layers * batch * cache_len * cfg.kv_heads * dh
+    flops = 2.0 * params * batch + 2.0 * 2.0 * cfg.n_layers * batch \
+        * cache_len * cfg.kv_heads * dh * (cfg.n_heads // cfg.kv_heads)
+    byts = params * param_itemsize + cache_elems * cache_itemsize \
+        + cache_elems * cache_itemsize / cache_len  # one-slot write-back
+    return flops, float(byts)
+
+
+def ce_logits_bytes(batch: int, seq: int, vocab: int,
+                    itemsize: int = 4) -> int:
+    """Bytes of the FULL (B*S, vocab) logits buffer that chunked CE must
+    never materialize (models/transformer.py loss_fn). The cost test holds
+    the compiled grad's temp arena under this figure."""
+    return batch * seq * vocab * itemsize
+
+
+# -- flash attention block accounting ---------------------------------------
+#
+# The Pallas kernel is opaque to XLA's cost analysis (a custom call), so its
+# model comes from the kernel's own grid plan: enumerate exactly the (i, j)
+# block pairs the grid visits and the subset the liveness predicate runs
+# compute for. _py_block_live mirrors ops/flash_attention._block_live and
+# tests/test_cost_model.py locks the two together over a parameter sweep —
+# change the kernel's clamp and the model (and the bench ceiling derived
+# from it) moves with it or the test fails.
+
+
+def _py_block_live(i: int, j: int, *, causal: bool, block_q: int,
+                   block_k: int, window: int) -> bool:
+    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    if window:
+        run = run and (j * block_k + block_k - 1 > i * block_q - window)
+    return bool(run)
+
+
+def attention_block_counts(s: int, block_q: int, block_k: int,
+                           window: int = 0, causal: bool = True,
+                           kv_len: Optional[int] = None) -> dict:
+    """Grid accounting for ops/flash_attention at (S queries, kv_len keys):
+    ``visited`` = block pairs the grid iterates (bytes move for these),
+    ``live`` = pairs the predicate runs MACs for. Windowed grids shrink the
+    k sweep to the band (``_win_lo_k``/``_win_kblocks``); causal-only grids
+    sweep all k-blocks and skip dead ones via ``pl.when`` (no HBM read is
+    saved for a skipped block's K/V tile under the current index maps — they
+    are mapped per-j regardless — so ``visited`` is the byte-side count and
+    ``live`` the FLOP-side count)."""
+    kv_len = kv_len if kv_len is not None else s
+    n_q = -(-s // block_q)
+    n_k = -(-kv_len // block_k)
+    visited = 0
+    live = 0
+    for i in range(n_q):
+        if window:
+            lo = max(0, (i * block_q - window + 1) // block_k)
+            span = min(n_k, (block_q + window - 2) // block_k + 2)
+            js = range(lo, min(lo + span, n_k))
+        else:
+            js = range(n_k)
+        for j in js:
+            visited += 1
+            if _py_block_live(i, j, causal=causal, block_q=block_q,
+                              block_k=block_k, window=window):
+                live += 1
+    return {"n_q": n_q, "n_k": n_k, "visited": visited, "live": live}
+
+
+def flash_attention_cost(s: int, h: int, d: int, block_q: int, block_k: int,
+                         window: int = 0, causal: bool = True,
+                         itemsize: int = 2) -> Tuple[float, float]:
+    """(flops, bytes) of the flash forward at (S, H, D): 4*bq*bk*D FLOPs
+    (QK^T + PV) per live block pair per head; bytes stream one K and one V
+    tile per visited pair plus one Q read and one output write per q-block
+    sweep."""
+    c = attention_block_counts(s, block_q, block_k, window=window,
+                               causal=causal)
+    flops = 4.0 * h * c["live"] * block_q * block_k * d
+    byts = itemsize * h * (
+        2 * c["visited"] * block_k * d      # K + V tiles per visited pair
+        + c["n_q"] * block_q * d            # Q read once per q-block row
+        + c["n_q"] * block_q * d            # output write
+    )
+    return flops, float(byts)
+
+
+def speedup_ceiling(s: int, window: int,
+                    banded_blocks: Tuple[int, int],
+                    causal_blocks: Tuple[int, int] = (1024, 1024)) -> float:
+    """Windowed-vs-causal block ceiling — the bar the bench's
+    ``window_speedup_vs_causal`` is measured against (docs/ROUND4.md §7:
+    the r03 2.27x measurement sat AT this ceiling for the w/2 clamp, not
+    35% under a mistaken 8x bar).
+
+    Basis mirrors how each kernel actually spends time: the causal sweep's
+    dead blocks are pl.when-skipped (near-free), so its cost is LIVE tiles
+    at its own (usually larger) default blocks; the windowed grid is
+    hard-shrunk to the band, so its cost is VISITED tiles — including the
+    dead diagonal overhang that small blocks shrink, which is exactly why
+    the (256, 128) sweep point has a higher ceiling than the (512, 512)
+    clamp."""
+    cq, ck = causal_blocks
+    bq, bk = banded_blocks
+    causal = attention_block_counts(s, cq, ck, causal=True)
+    banded = attention_block_counts(s, bq, bk, window=window, causal=True)
+    return (causal["live"] * cq * ck) / (banded["visited"] * bq * bk)

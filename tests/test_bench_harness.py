@@ -227,6 +227,29 @@ class TestCachedFallback:
         assert lines[1]["metric"] == "fake_metric_seconds"
         assert lines[-1]["unit"] == "error"  # boom's parsable error line
 
+    def test_all_error_live_run_has_no_status_line(self, capsys,
+                                                   monkeypatch):
+        # Review finding r05: a run where nothing measures must not carry
+        # a live=True status — consumers map "status present" to "evidence
+        # exists". All-error live runs stay status-free (rc=1).
+        import sys as _sys
+
+        monkeypatch.setattr(bench, "init_backend", lambda: None)
+        monkeypatch.setattr(bench.mt, "set_config", lambda **kw: None)
+
+        def config_boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setitem(bench.CONFIGS, "errtest", [config_boom])
+        monkeypatch.setattr(_sys, "argv", ["bench.py", "--config", "errtest"])
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == 1
+        lines = [json.loads(l)
+                 for l in capsys.readouterr().out.strip().splitlines()]
+        assert len(lines) == 1 and lines[0]["unit"] == "error"
+        assert all(d["metric"] != "bench_run_status" for d in lines)
+
 
 class TestCaptureSummaryHistory:
     def test_history_skips_replays_and_flags_deltas(self, tmp_path, monkeypatch):

@@ -1,0 +1,244 @@
+"""Static cost harness (VERDICT r04 item 4): the tunnel-independent perf
+floor.
+
+Each hot path's compiled program is held to its analytic roofline model via
+XLA's cost/memory analysis — on the CPU mesh, with no hardware in the loop.
+A perf regression (a gather turning dense, chunked CE materializing logits,
+decode re-reading the cache, an attention clamp change silently moving the
+ceiling) fails HERE, tunnel or no tunnel; the chip's job shrinks to
+confirming achieved fractions of these modeled rooflines."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.utils import cost_model as cm
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return mt.create_mesh()
+
+
+class TestCompiledCost:
+    def test_local_gemm_flops_exact(self):
+        m, k, n = 256, 128, 512
+        a = jnp.ones((m, k), jnp.float32)
+        b = jnp.ones((k, n), jnp.float32)
+        rep = cm.compiled_cost(lambda a, b: a @ b, a, b)
+        flops, byts = cm.gemm_cost(m, k, n)
+        assert rep.flops == flops  # XLA counts dot MACs as 2 flops, exactly
+        # Operands + output each cross memory once; fusion may add a small
+        # factor but a 2x blowout means an extra materialization.
+        assert byts <= rep.bytes_accessed <= 2 * byts
+
+    def test_summa_per_device_flops(self, mesh):
+        from marlin_tpu.config import get_config
+        from marlin_tpu.parallel import summa
+
+        cfg = get_config()
+        pr, pc = mt.mesh.axis_sizes(mesh)
+        m = k = n = 64 * pr * pc
+        a = jnp.ones((m, k), jnp.float32)
+        b = jnp.ones((k, n), jnp.float32)
+        fn = summa._summa_fn(mesh, "default", cfg.mesh_axis_rows,
+                             cfg.mesh_axis_cols)
+        rep = cm.compiled_cost(fn, a, b)
+        flops, byts = cm.summa_cost(m, k, n, pr, pc)
+        # SPMD cost analysis is per-device; the local matmul dominates.
+        assert rep.flops == pytest.approx(flops, rel=0.01)
+        # Bytes include the all-gathered panels; the gather's own
+        # source+destination accounting lands within a small factor.
+        assert byts <= rep.bytes_accessed <= 4 * byts
+
+
+class TestEllProductCost:
+    """The low-density arm's reason to exist: traffic ~ nnz * n, not m*k*n."""
+
+    def _ell_compiled(self, m, k, n, density, mesh):
+        from marlin_tpu.matrix.dist_sparse import (DistSparseVecMatrix,
+                                                   _ell_product, _n_dev)
+        from marlin_tpu.mesh import row_sharding
+
+        rng = np.random.default_rng(3)
+        nnz = int(m * k * density)
+        r = rng.integers(0, m, nnz)
+        c = rng.integers(0, k, nnz)
+        v = rng.standard_normal(nnz)
+        a = DistSparseVecMatrix.from_coo(r, c, v, (m, k))
+        ec, ev, r_slots = a.ell_stripes()
+        nd = _n_dev(mesh)
+        b = jax.device_put(jnp.ones((a.stripe * nd, n), ev.dtype),
+                           row_sharding(a.mesh))
+        fn = _ell_product(a.mesh, nd, a.stripe, r_slots, n,
+                          jnp.dtype(ev.dtype))
+        return cm.compiled_cost(fn, ec, ev, b), a.stripe, r_slots, nd
+
+    def test_flops_track_slots_not_density_squared(self, mesh):
+        m = k = 512
+        n = 256
+        rep, stripe, r_slots, nd = self._ell_compiled(m, k, n, 2e-3, mesh)
+        flops, byts = cm.ell_product_cost(stripe * nd, k, n, r_slots, nd)
+        dense_flops = 2.0 * (stripe * nd / nd) * k * n  # per-device ring arm
+        # The model counts the multiply+reduce; XLA adds the gather/select
+        # overhead around it — band, not equality.
+        assert rep.flops <= 4 * flops + 1e5
+        # The point of the arm: far under the dense ring's MXU cost.
+        assert rep.flops < 0.25 * dense_flops
+        assert rep.bytes_accessed < 6 * byts
+
+    def test_cost_scales_with_slots(self, mesh):
+        # Double the density -> slots (and modeled cost) roughly double;
+        # the compiled program must follow, not stay dense-sized.
+        m = k = 512
+        n = 256
+        lo, *_ = self._ell_compiled(m, k, n, 1e-3, mesh)
+        hi, *_ = self._ell_compiled(m, k, n, 8e-3, mesh)
+        assert hi.flops > 2 * lo.flops
+
+
+class TestDecodeCost:
+    def _cfg(self, **kw):
+        from marlin_tpu.models.transformer import TransformerConfig
+
+        base = dict(vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                    max_len=64)
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    def test_param_count_matches_init_exactly(self):
+        from marlin_tpu.models.transformer import init_params
+
+        for kw in ({}, {"rope": True}, {"n_kv_heads": 2},
+                   {"n_experts": 4}):
+            cfg = self._cfg(**kw)
+            p = init_params(cfg, seed=0)
+            got = sum(x.size for x in jax.tree.leaves(p))
+            assert got == cm.transformer_param_count(cfg), kw
+
+    def test_decode_step_streams_params_and_cache_once(self):
+        from marlin_tpu.models import transformer as tr
+
+        cfg = self._cfg()
+        p = tr.init_params(cfg, seed=0)
+        batch = 4
+        cache = tr.init_kv_cache(cfg, batch)
+        tok = jnp.zeros((batch,), jnp.int32)
+        fn = jax.jit(tr.decode_step, static_argnames="cfg")
+        rep = cm.compiled_cost(fn, p, cache, tok, 3, cfg=cfg)
+        flops, byts = cm.decode_step_cost(cfg, batch)
+        # Decode is HBM-bound: everything the step touches is params +
+        # cache (read once, one-slot write) + activations of order B*D.
+        # XLA's per-instruction accounting on the unfused CPU pipeline
+        # lands at ~3.7x the perfect-reuse model (calibrated here); one
+        # EXTRA cache or params pass (+0.9x model) breaks the band.
+        assert byts <= rep.bytes_accessed <= 4.5 * byts
+        assert flops <= rep.flops <= 3 * flops
+        # The temp arena must hold activations, not a second cache copy.
+        cache_bytes = sum(x.nbytes for lay in cache for x in lay.values())
+        assert rep.temp_bytes <= 2.5 * cache_bytes
+
+
+class TestChunkedCECost:
+    def test_grad_temp_arena_does_not_scale_with_vocab(self, monkeypatch):
+        """The chunked-CE contract, stated as memory accounting: the grad's
+        temp arena must be VOCAB-INDEPENDENT (per-chunk logits live only
+        inside the lax.map body under jax.checkpoint), while the unchunked
+        control grows by full (B*S, vocab) buffers — so a regression that
+        starts materializing logits moves the measured arena by megabytes."""
+        from marlin_tpu.models import transformer as tr
+
+        def temp(vocab, chunk):
+            cfg = tr.TransformerConfig(vocab=vocab, d_model=32, n_heads=2,
+                                       n_layers=1, d_ff=64, max_len=128)
+            p = tr.init_params(cfg, seed=0)
+            tok = jnp.zeros((2, 128), jnp.int32)
+            monkeypatch.setattr(tr, "_CE_CHUNK", chunk)
+            grad = jax.jit(jax.grad(tr.loss_fn), static_argnames="cfg")
+            return cm.compiled_cost(grad, p, tok, tok, cfg=cfg).temp_bytes
+
+        b, s = 2, 128
+        delta_logits = cm.ce_logits_bytes(b, s, 2048) \
+            - cm.ce_logits_bytes(b, s, 512)
+        chunked_512, chunked_2048 = temp(512, 32), temp(2048, 32)
+        # Vocab x4 moves the chunked arena by at most one chunk's buffers.
+        assert abs(chunked_2048 - chunked_512) <= \
+            4 * cm.ce_logits_bytes(1, 32, 2048)
+        # Control (the test's teeth): the unchunked path pays >= two full
+        # logits-sized buffers (forward value + backward cotangent).
+        direct_512, direct_2048 = temp(512, b * s), temp(2048, b * s)
+        assert direct_2048 - direct_512 >= 2 * delta_logits
+        assert chunked_2048 < direct_2048
+
+
+class TestAttentionBlockModel:
+    """The Pallas kernel is a custom call XLA's tables can't see into, so
+    its model is grid accounting locked to the kernel's OWN predicates."""
+
+    def test_python_predicate_matches_kernel_predicate(self):
+        import importlib
+
+        fa = importlib.import_module("marlin_tpu.ops.flash_attention")
+
+        for bq, bk in ((256, 128), (512, 512), (1024, 1024)):
+            for w in (0, 256, 1024):
+                for i in range(0, 9):
+                    for j in range(0, 9):
+                        want = bool(fa._block_live(
+                            i, j, causal=True, block_q=bq, block_k=bk,
+                            window=w))
+                        got = cm._py_block_live(
+                            i, j, causal=True, block_q=bq, block_k=bk,
+                            window=w)
+                        assert got == want, (bq, bk, w, i, j)
+
+    def test_windowed_sweep_matches_kernel_bounds(self):
+        import importlib
+
+        fa = importlib.import_module("marlin_tpu.ops.flash_attention")
+
+        s, bq, bk, w = 8192, 512, 512, 1024
+        n_k = s // bk
+        counts = cm.attention_block_counts(s, bq, bk, window=w)
+        # The model's per-i sweep must be exactly the kernel's shrunk grid.
+        span = fa._win_kblocks(n_k, block_q=bq, block_k=bk, window=w)
+        visited = 0
+        for i in range(s // bq):
+            lo = int(fa._win_lo_k(i, block_q=bq, block_k=bk, window=w))
+            visited += min(lo + span, n_k) - lo
+        assert counts["visited"] == visited
+
+    def test_ceilings_reproduce_r04_derivation(self):
+        # docs/ROUND4.md §7: at the w/2 clamp (512, 512) the ceiling is
+        # ~2.25x (the r03 2.27x measurement sat AT it, not 35% under a
+        # mistaken 8x bar); the small-block sweep points reach 3.0-3.27x.
+        assert cm.speedup_ceiling(8192, 1024, (512, 512)) == pytest.approx(
+            2.25, abs=0.2)
+        assert cm.speedup_ceiling(8192, 1024, (256, 128)) >= 3.1
+        assert cm.speedup_ceiling(8192, 1024, (256, 256)) >= 2.9
+
+    def test_bench_ceiling_evaluates_at_kernel_clamp(self):
+        # The bench's windowed ceiling must be computed at the blocks the
+        # kernel will actually run — shared helper, not a hand mirror
+        # (review finding r05).
+        from marlin_tpu.ops.flash_attention import window_block_clamp
+
+        assert window_block_clamp(1024, 1024, 1024) == (512, 512)
+        assert window_block_clamp(256, 128, 1024) == (256, 128)  # under cap
+        assert window_block_clamp(1024, 1024, 256) == (256, 128)  # floors
+
+    def test_flash_cost_flops_formula(self):
+        # Causal full-band: live pairs = lower-triangle blocks; the FLOP
+        # model must agree with the closed form 4*H*D * S*(S+bq)/2 within
+        # the block-rounding margin.
+        s, h, d, bq, bk = 4096, 8, 128, 512, 512
+        flops, byts = cm.flash_attention_cost(s, h, d, bq, bk, causal=True)
+        closed = 4.0 * h * d * s * (s + bq) / 2
+        assert flops == pytest.approx(closed, rel=1e-6)
+        # Bytes scale with visited blocks: the windowed grid at w=1024 must
+        # move far fewer bytes than the causal sweep.
+        _, byts_w = cm.flash_attention_cost(s, h, d, bq, bk, window=1024)
+        assert byts_w < 0.6 * byts
