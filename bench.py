@@ -108,6 +108,7 @@ _CACHE_PREFIX = {
     "config_transformer": "transformer_train_tokens",
     "config_longseq": "longseq_train_",
     "config_decode": "decode_tokens_per_s",
+    "config_decode_int8": "decode_int8_tokens_per_s",
 }
 
 
@@ -1117,6 +1118,12 @@ def config_decode():
     prompt_len = min(64, max(1, cfg.max_len // 2))
     steps = cfg.max_len - prompt_len
     params = init_params(cfg, seed=0)
+    quant = bool(_sized("BENCH_DEC_QUANT", 0))
+    if quant:  # weight-only int8 streaming (models/quant.py): the roofline
+        # denominator below shrinks to the int8 bytes actually streamed.
+        from marlin_tpu.models import quantize_params_int8
+
+        params = quantize_params_int8(params)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (b, prompt_len), 0, cfg.vocab)
     out = generate(params, prompt, steps, cfg)  # warmup: prefill+scan compile
@@ -1133,11 +1140,15 @@ def config_decode():
     kind = jax.devices()[0].device_kind
     bw = next((v for kk, v in HBM_GBPS.items() if kk.lower() in kind.lower()),
               819.0) * 1e9
-    # Streamed bytes per step are at the COMPUTE dtype: the scan-invariant
-    # cast of the f32 master params is hoisted and materialized once, and
-    # the KV cache is built at the compute dtype too.
+    # Streamed bytes per step are at the STREAMED dtype: int8 weights (with
+    # their small float scales) stream as-is; float leaves stream at the
+    # compute dtype (the scan-invariant cast of the f32 masters is hoisted
+    # and materialized once), and the KV cache is built at the compute
+    # dtype too.
     it = jnp.dtype(cfg.dtype).itemsize
-    p_bytes = sum(l.size for l in jax.tree.leaves(params)) * it
+    p_bytes = sum(
+        l.nbytes if jnp.issubdtype(l.dtype, jnp.integer) else l.size * it
+        for l in jax.tree.leaves(params))
     kv_heads = cfg.n_kv_heads or cfg.n_heads
     kv_bytes = (2 * cfg.n_layers * cfg.max_len * kv_heads
                 * (cfg.d_model // cfg.n_heads) * it)  # K+V per sequence
@@ -1149,8 +1160,13 @@ def config_decode():
     from marlin_tpu.utils import cost_model as cm
 
     _, predicted_step_bytes = cm.decode_step_cost(
-        cfg, b, param_itemsize=it, cache_itemsize=it)
-    return {"metric": "decode_tokens_per_s_per_seq", "value": round(1.0 / dt, 1),
+        cfg, b, param_itemsize=(1 if quant else it), cache_itemsize=it)
+    # The int8 arm gets its own metric name: same-prefix lines share one
+    # replay slot per config, and the quant line must not shadow the base
+    # capture (or vice versa) in the dead-tunnel fallback.
+    metric = ("decode_int8_tokens_per_s_per_seq" if quant
+              else "decode_tokens_per_s_per_seq")
+    return {"metric": metric, "value": round(1.0 / dt, 1),
             "unit": "tok/s", "vs_baseline": round((1.0 / dt) / roofline, 3),
             "batch": b, "total_tok_s": round(b / dt, 1),
             "hbm_roofline_tok_s_per_seq": round(roofline, 1),
@@ -1158,7 +1174,23 @@ def config_decode():
             # Config provenance (cross-session ledger comparability).
             "dtype": cfg.dtype, "kv_heads": kv_heads, "rope": cfg.rope,
             "cache_len": cfg.max_len, "d_model": cfg.d_model,
-            "out_ok": n_out == b * steps}
+            "quant": quant, "out_ok": n_out == b * steps}
+
+
+def config_decode_int8():
+    """config_decode with weight-only int8 streaming (models/quant.py) —
+    its own config so the int8 line gets its own dead-tunnel replay slot
+    (the per-config cache keys on the config FUNCTION; an env-var arm of
+    config_decode would silently replay the base decode line instead)."""
+    prev = os.environ.get("BENCH_DEC_QUANT")
+    os.environ["BENCH_DEC_QUANT"] = "1"
+    try:
+        return config_decode()
+    finally:
+        if prev is None:
+            os.environ.pop("BENCH_DEC_QUANT", None)
+        else:
+            os.environ["BENCH_DEC_QUANT"] = prev
 
 
 def config_dispatch_sweep():
@@ -1267,6 +1299,7 @@ CONFIGS = {
     "transformer": [config_transformer],
     "longseq": [config_longseq],
     "decode": [config_decode],
+    "decodeint8": [config_decode_int8],
     "sweep": [config_dispatch_sweep],
     "attnsweep": [config_attention_sweep],
 }

@@ -2,6 +2,7 @@
 
 from . import gcn
 
+from .quant import dequantize_params, quantize_params_int8
 from .transformer import (
     TransformerConfig,
     decode_step,
@@ -20,6 +21,8 @@ from .transformer import (
 __all__ = [
     "TransformerConfig",
     "decode_step",
+    "dequantize_params",
+    "quantize_params_int8",
     "forward",
     "generate",
     "hidden_states",

@@ -1,0 +1,95 @@
+"""Weight-only int8 quantization for decode.
+
+Decode is HBM-bound: each step streams the parameter set once, batch-shared
+(see bench.py config_decode's roofline and utils/cost_model.decode_step_cost),
+so the streamed WIDTH of the weights is the roofline denominator. Symmetric
+per-channel int8 cuts it ~4x vs f32 / ~2x vs bf16 while the matmuls still run
+at the compute dtype: the int8 tiles are converted (and scaled) on the way
+into the dot — an elementwise producer XLA fuses into the operand load, so no
+dequantized copy of a weight ever lands in HBM. The transformer's use sites
+resolve quantized leaves via ``transformer._deq`` / ``_embed_rows`` /
+``_readout``; the readout applies the per-row embed scale AFTER the
+(B, d) @ int8.T matmul so the (vocab, d) table is never materialized in
+float.
+
+No reference counterpart (Marlin is exact-arithmetic linalg; quantization
+would change its answers). This serves the KV-cache decode axis the parity
+doc claims beyond the reference (docs/parity.md §2.8); training always uses
+the float masters — ``loss_fn`` rejects quantized params explicitly.
+
+Granularity: one scale per OUTPUT channel of each matmul (per embed ROW for
+the shared embed/readout table — the same scale serves the gather and, as a
+post-matmul column scale, the readout). Symmetric, zero-point-free:
+``w ~ q8 * s8`` with ``q8`` in [-127, 127], ``s8 = amax / 127``.
+
+Unsupported combinations (documented, guarded where cheap): MoE expert banks
+(3-D leaves stay float — routing already dominates their decode cost),
+``shard_params`` TP placement (per-channel scale shapes don't match the 2-D
+weight specs), and any gradient path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_params_int8", "dequantize_params", "is_quantized"]
+
+# Per-block 2-D weights that stream every decode step. Biases, layer norms
+# and the router stay float (tiny), the learned ``pos`` table too (decode
+# reads one row per step).
+_BLOCK_WEIGHTS = ("wqkv", "wo", "w1", "w2")
+
+
+def _quant(w: jax.Array, axis: int) -> dict:
+    """Symmetric per-channel int8: reduce |w| over ``axis`` (the matmul's
+    contraction axis), keepdims so ``q8 * s8`` broadcasts back exactly."""
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    s = jnp.where(amax > 0, amax, 127.0) / 127.0
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q8": q, "s8": s.astype(jnp.float32)}
+
+
+def is_quantized(params) -> bool:
+    return isinstance(params.get("embed"), dict)
+
+
+def quantize_params_int8(params) -> dict:
+    """Float master pytree (init_params) -> decode pytree where the embed
+    table and each block's dense 2-D weights are {"q8", "s8"} pairs.
+    Idempotent on already-quantized input."""
+    if is_quantized(params):
+        return params
+    out = dict(params)
+    # Embed: per-ROW scale — the row scalar serves the token gather, and
+    # s8[:, 0] is the readout's per-vocab-column post-matmul scale.
+    out["embed"] = _quant(params["embed"], axis=1)
+    blocks = []
+    for bp in params["blocks"]:
+        nb = dict(bp)
+        for name in _BLOCK_WEIGHTS:
+            w = bp.get(name)
+            if w is not None and w.ndim == 2:  # MoE banks (3-D) stay float
+                nb[name] = _quant(w, axis=0)
+        blocks.append(nb)
+    out["blocks"] = blocks
+    return out
+
+
+def dequantize_params(qparams) -> dict:
+    """Inverse mapping (to f32) for tests/oracles: the returned pytree runs
+    the float paths and is the exact function the int8 decode computes (up
+    to the compute-dtype rounding both share)."""
+
+    def deq(leaf):
+        return (leaf["q8"].astype(jnp.float32) * leaf["s8"]
+                if isinstance(leaf, dict) and "q8" in leaf else leaf)
+
+    out = dict(qparams)
+    out["embed"] = deq(qparams["embed"])
+    out["blocks"] = [
+        {k: deq(v) if k in _BLOCK_WEIGHTS else v for k, v in bp.items()}
+        for bp in qparams["blocks"]
+    ]
+    return out

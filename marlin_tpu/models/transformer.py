@@ -167,13 +167,47 @@ def _cast_params(params, cfg: TransformerConfig):
     """Cast float leaves to the compute dtype (no-op at f32 default).
     Called once per entry point; master params stay what init_params made
     them, and the cast's vjp accumulates gradients back in the master
-    dtype."""
+    dtype. Int8-quantized weights (models/quant.py {"q8","s8"} leaves) pass
+    through: q8 is integer (untouched), s8 is a float scale whose cast to
+    the compute dtype is harmless next to the int8 rounding itself."""
     dt = cfg.compute_dtype
-    if params["embed"].dtype == dt:
+    emb = params["embed"]
+    ref = emb["s8"] if isinstance(emb, dict) else emb
+    if ref.dtype == dt:
         return params
     return jax.tree.map(
         lambda p: p.astype(dt) if jnp.issubdtype(p.dtype, jnp.floating)
         else p, params)
+
+
+def _deq(w, dt):
+    """Resolve a possibly int8-quantized weight (models/quant.py) for a
+    matmul at ``dt``: the convert + per-output-channel scale are
+    elementwise producers XLA fuses into the dot's operand load, so only
+    the int8 tile streams from HBM."""
+    if isinstance(w, dict) and "q8" in w:
+        return w["q8"].astype(dt) * w["s8"].astype(dt)
+    return w
+
+
+def _embed_rows(params, tokens, dt):
+    """Token gather off the (possibly int8) embed table, at ``dt``: the
+    int8 path gathers int8 rows and scales by the per-row s8 scalar."""
+    emb = params["embed"]
+    if isinstance(emb, dict) and "q8" in emb:
+        return emb["q8"][tokens].astype(dt) * emb["s8"][tokens].astype(dt)
+    return emb[tokens].astype(dt)
+
+
+def _readout(params, x):
+    """Vocab logits x @ embed.T; the int8 path applies the per-row embed
+    scale AFTER the matmul (it is a per-output-column scale there), so the
+    float (vocab, d) table never materializes."""
+    emb = params["embed"]
+    if isinstance(emb, dict) and "q8" in emb:
+        return (x @ emb["q8"].T.astype(x.dtype)) * emb["s8"][:, 0].astype(
+            x.dtype)
+    return x @ emb.T
 
 
 def _layer_norm(p, x, eps=1e-5):
@@ -240,7 +274,8 @@ def _mlp_residual(bp, x, cfg: TransformerConfig):
     if cfg.n_experts:
         y = _moe_apply(bp, y, cfg)
     else:
-        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"]) @ bp["w2"] + bp["b2"]
+        y = jax.nn.gelu(y @ _deq(bp["w1"], y.dtype) + bp["b1"]) \
+            @ _deq(bp["w2"], y.dtype) + bp["b2"]
     return x + y
 
 
@@ -269,7 +304,8 @@ def _split_qkv(bp, x, cfg: TransformerConfig, positions=None):
     t, d = x.shape
     h, hk = cfg.n_heads, cfg.kv_heads
     dh = d // h
-    qkv = _layer_norm(bp["ln1"], x) @ bp["wqkv"]  # (T, D + 2 Hk Dh)
+    qkv = _layer_norm(bp["ln1"], x) @ _deq(bp["wqkv"], x.dtype)
+    # qkv: (T, D + 2 Hk Dh)
     q, k, v = jnp.split(qkv, [d, d + hk * dh], axis=1)
     q = q.reshape(t, h, dh)
     k = k.reshape(t, hk, dh)
@@ -290,16 +326,16 @@ def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
     q, k, v = _split_qkv(bp, x, cfg, positions=positions)
     attend = _attend_sp if cfg.sequence_parallel else _attend_local
     att = attend(q, k, v, cfg).reshape(s, d)
-    x = _mlp_residual(bp, x + att @ bp["wo"], cfg)
+    x = _mlp_residual(bp, x + att @ _deq(bp["wo"], att.dtype), cfg)
     return (x, k, v) if return_kv else x
 
 
 def _embed_prefix(params, tokens, cfg: TransformerConfig):
     """(B, S) tokens -> (B, S, D) embeddings, plus the learned position
     table for positions [0, S) unless rope rotates Q/K per block instead."""
-    x = params["embed"][tokens]
+    x = _embed_rows(params, tokens, cfg.compute_dtype)
     if not cfg.rope:
-        x = x + params["pos"][None, : tokens.shape[1], :]
+        x = x + params["pos"][None, : tokens.shape[1], :].astype(x.dtype)
     return x.astype(cfg.compute_dtype)
 
 
@@ -339,7 +375,7 @@ def hidden_states(params, tokens, cfg: TransformerConfig):
 def forward(params, tokens, cfg: TransformerConfig):
     """tokens (B, S) int32 -> logits (B, S, vocab)."""
     params = _cast_params(params, cfg)
-    return hidden_states(params, tokens, cfg) @ params["embed"].T
+    return _readout(params, hidden_states(params, tokens, cfg))
 
 
 # Positions per readout chunk in loss_fn. Env-overridable (MARLIN_CE_CHUNK)
@@ -369,6 +405,12 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig):
     way, which would undo what remat + the flash backward save for
     long-context training. jax.checkpoint on the chunk keeps the backward
     from stashing per-chunk logits either."""
+    from .quant import is_quantized
+
+    if is_quantized(params):
+        raise ValueError(
+            "int8-quantized params are inference-only (decode/prefill/"
+            "forward); train with the float masters (models/quant.py)")
     params = _cast_params(params, cfg)
     h = hidden_states(params, tokens, cfg)  # (B, S, D)
     b, s, d = h.shape
@@ -499,9 +541,9 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     at ``pos`` and attends the cache prefix; with a window the cache is a
     ring (see init_kv_cache) and the write lands at pos mod cache_len."""
     params = _cast_params(params, cfg)
-    x = params["embed"][tokens]  # (B, D)
+    x = _embed_rows(params, tokens, cfg.compute_dtype)  # (B, D)
     if not cfg.rope:
-        x = x + params["pos"][pos]
+        x = x + params["pos"][pos].astype(x.dtype)
     x = x.astype(cfg.compute_dtype)
     positions = (
         jnp.full((x.shape[0],), pos, jnp.int32) if cfg.rope else None
@@ -527,10 +569,11 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
             functools.partial(_attend_cached, window=cfg.window),
             in_axes=(0, 0, 0, None),
         )(q, ck, cv, pos)
-        x = _mlp_residual(bp, x + att.reshape(x.shape) @ bp["wo"], cfg)
+        x = _mlp_residual(
+            bp, x + att.reshape(x.shape) @ _deq(bp["wo"], x.dtype), cfg)
         new_cache.append({"k": ck, "v": cv})
     x = _layer_norm(params["ln_f"], x)
-    return x @ params["embed"].T, new_cache
+    return _readout(params, x), new_cache
 
 
 def prefill(params, tokens, cfg: TransformerConfig):
@@ -568,7 +611,7 @@ def prefill(params, tokens, cfg: TransformerConfig):
             cache[i]["k"] = cache[i]["k"].at[:, :s].set(kd)
             cache[i]["v"] = cache[i]["v"].at[:, :s].set(vd)
     x = _layer_norm(params["ln_f"], x)
-    return x[:, -1] @ params["embed"].T, cache
+    return _readout(params, x[:, -1]), cache
 
 
 # Jitted prefill for generate(): eager per-op dispatch through a remote

@@ -54,6 +54,7 @@ timeout 900 python -u tools/sparse_profile.py \
 echo "rc=$? (sparse_profile)" >&2
 run spmm 900
 run decode 900
+run decodeint8 900
 run svd 900
 run lu 1800
 run inverse 900
