@@ -1105,6 +1105,7 @@ def config_decode():
     from marlin_tpu.models import TransformerConfig, generate, init_params
 
     d = _sized("BENCH_DEC_D", 1024)
+    quant = bool(_sized("BENCH_DEC_QUANT", 0))
     cfg = TransformerConfig(
         vocab=_sized("BENCH_DEC_VOCAB", 32768), d_model=d,
         n_heads=max(2, d // 128), n_layers=_sized("BENCH_DEC_L", 8),
@@ -1113,14 +1114,15 @@ def config_decode():
         n_kv_heads=_sized("BENCH_DEC_KV", 0),
         rope=bool(_sized("BENCH_DEC_ROPE", 0)),
         dtype=os.environ.get("BENCH_DEC_DTYPE", "bfloat16"),
+        # The int8 arm streams int8 on BOTH sides of the roofline
+        # denominator: weights (models/quant.py) AND the KV cache.
+        kv_quant="int8" if quant else "",
     )
     b = _sized("BENCH_DEC_B", 8)
     prompt_len = min(64, max(1, cfg.max_len // 2))
     steps = cfg.max_len - prompt_len
     params = init_params(cfg, seed=0)
-    quant = bool(_sized("BENCH_DEC_QUANT", 0))
-    if quant:  # weight-only int8 streaming (models/quant.py): the roofline
-        # denominator below shrinks to the int8 bytes actually streamed.
+    if quant:
         from marlin_tpu.models import quantize_params_int8
 
         params = quantize_params_int8(params)
@@ -1150,8 +1152,11 @@ def config_decode():
         l.nbytes if jnp.issubdtype(l.dtype, jnp.integer) else l.size * it
         for l in jax.tree.leaves(params))
     kv_heads = cfg.n_kv_heads or cfg.n_heads
-    kv_bytes = (2 * cfg.n_layers * cfg.max_len * kv_heads
-                * (cfg.d_model // cfg.n_heads) * it)  # K+V per sequence
+    dh = cfg.d_model // cfg.n_heads
+    # K+V per sequence: int8 cache streams 1 byte/elem + one f32 scale per
+    # stored vector; float cache streams at the compute dtype.
+    per_vec = (dh + 4) if quant else dh * it
+    kv_bytes = 2 * cfg.n_layers * cfg.max_len * kv_heads * per_vec
     # One step streams params once (batch-shared) + every sequence's cache:
     # per-seq roofline tok/s = BW / (p_bytes + B * kv_bytes).
     roofline = bw / (p_bytes + b * kv_bytes)
@@ -1160,7 +1165,8 @@ def config_decode():
     from marlin_tpu.utils import cost_model as cm
 
     _, predicted_step_bytes = cm.decode_step_cost(
-        cfg, b, param_itemsize=(1 if quant else it), cache_itemsize=it)
+        cfg, b, param_itemsize=(1 if quant else it),
+        cache_itemsize=(1 if quant else it))
     # The int8 arm gets its own metric name: same-prefix lines share one
     # replay slot per config, and the quant line must not shadow the base
     # capture (or vice versa) in the dead-tunnel fallback.

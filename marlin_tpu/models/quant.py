@@ -33,7 +33,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["quantize_params_int8", "dequantize_params", "is_quantized"]
+__all__ = ["quantize_params_int8", "dequantize_params", "is_quantized",
+           "kv_quantize"]
 
 # Per-block 2-D weights that stream every decode step. Biases, layer norms
 # and the router stay float (tiny), the learned ``pos`` table too (decode
@@ -43,16 +44,29 @@ _BLOCK_WEIGHTS = ("wqkv", "wo", "w1", "w2")
 
 def _quant(w: jax.Array, axis: int) -> dict:
     """Symmetric per-channel int8: reduce |w| over ``axis`` (the matmul's
-    contraction axis), keepdims so ``q8 * s8`` broadcasts back exactly."""
-    w = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
-    s = jnp.where(amax > 0, amax, 127.0) / 127.0
-    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
-    return {"q8": q, "s8": s.astype(jnp.float32)}
+    contraction axis), keepdims so ``q8 * s8`` broadcasts back exactly.
+    One formula for weights and KV vectors — kv_quantize IS the kernel."""
+    q, s = kv_quantize(w, axis=axis)
+    return {"q8": q, "s8": s}
 
 
 def is_quantized(params) -> bool:
     return isinstance(params.get("embed"), dict)
+
+
+def kv_quantize(x: jax.Array, axis: int = -1):
+    """Per-vector symmetric int8 for KV-cache writes
+    (``TransformerConfig.kv_quant``): one scale per written K/V vector
+    (reduced over the head dim), so each cache slot dequantizes
+    independently — ring-buffer overwrites and prefill bulk-writes need no
+    global calibration. Returns ``(q8, s)`` with ``s`` keepdims-shaped for
+    broadcast; runs at f32 regardless of the compute dtype (the quant
+    rounding dominates either way)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    s = jnp.where(amax > 0, amax, 127.0) / 127.0
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
 
 
 def quantize_params_int8(params) -> dict:

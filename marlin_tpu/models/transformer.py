@@ -68,6 +68,12 @@ class TransformerConfig(NamedTuple):
     # the optimizer update stays exact — standard mixed precision. Numerics
     # that need it (layernorm stats, softmax, RoPE, CE) compute >= f32
     # internally regardless.
+    kv_quant: str = ""  # "int8": store the decode KV cache as per-vector
+    # symmetric int8 (models/quant.py kv_quantize) + f32 scales — ~4x (vs
+    # f32) / ~2x (vs bf16) less cache traffic per step, which is the other
+    # half of decode's HBM roofline denominator next to the weights.
+    # Approximate (~0.4% per-vector rounding), decode-only: training and
+    # the flash-attention prompt pass never see quantized K/V.
 
     @property
     def kv_heads(self) -> int:
@@ -499,13 +505,28 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
     dh = cfg.d_model // cfg.n_heads
     cache_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
     shape = (batch, cache_len, cfg.kv_heads, dh)
+    if cfg.kv_quant:
+        if cfg.kv_quant != "int8":
+            raise ValueError(f"unknown kv_quant {cfg.kv_quant!r}; "
+                             "supported: 'int8'")
+        # Per-vector int8 slots + f32 scales (models/quant.py kv_quantize);
+        # ``dtype`` only sets what _attend_cached dequantizes into via the
+        # query, the stored cache is int8 regardless.
+        sshape = shape[:-1] + (1,)
+        return [
+            {"k": jnp.zeros(shape, jnp.int8),
+             "v": jnp.zeros(shape, jnp.int8),
+             "ks": jnp.ones(sshape, jnp.float32),
+             "vs": jnp.ones(sshape, jnp.float32)}
+            for _ in range(cfg.n_layers)
+        ]
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.n_layers)
     ]
 
 
-def _attend_cached(q, ck, cv, pos, window=0):
+def _attend_cached(q, ck, cv, pos, ks=None, vs=None, window=0):
     """One query position against the cache: q (H, Dh), ck/cv (T, Hk, Dh)
     with Hk dividing H (GQA: q-head group g reads K/V head g). Without a
     window, T = max_len and slot index == absolute position (slots > pos
@@ -514,9 +535,14 @@ def _attend_cached(q, ck, cv, pos, window=0):
     base - T + s (else), where base = pos - pos mod T; unfilled slots
     (negative positions) are masked, and the band bound is implied by
     T <= window. f32 softmax (the framework's accumulate->=f32
-    convention)."""
+    convention). With an int8 cache (``cfg.kv_quant``) ``ks``/``vs`` are
+    the per-vector (T, Hk, 1) scales and the dequant fuses into the
+    einsum operand loads."""
     h, dh = q.shape
     hk = ck.shape[1]
+    if ks is not None:  # int8 cache: dequant fuses into the einsum loads
+        ck = ck.astype(jnp.float32) * ks
+        cv = cv.astype(jnp.float32) * vs
     qg = q.reshape(hk, h // hk, dh).astype(jnp.float32)  # (Hk, G, Dh)
     logits = jnp.einsum(
         "kgd,tkd->kgt", qg, ck.astype(jnp.float32)) / np.sqrt(dh)
@@ -557,21 +583,47 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
             f"cache length {cache[0]['k'].shape[1]} != {expect_len} expected "
             f"for window={cfg.window}, max_len={cfg.max_len}; build the "
             "cache with init_kv_cache(cfg, ...)")
+    if ("ks" in cache[0]) != bool(cfg.kv_quant):
+        # Same class of mismatch as the length check: a float cache under a
+        # kv_quant cfg dies on a KeyError, but the REVERSE — an int8 cache
+        # attended by a cfg without kv_quant — would astype-truncate K/V
+        # into the int8 buffers and return finite garbage silently.
+        raise ValueError(
+            f"cache {'is' if 'ks' in cache[0] else 'is not'} int8-quantized "
+            f"but cfg.kv_quant={cfg.kv_quant!r}; build the cache with "
+            "init_kv_cache(cfg, ...) from the SAME config")
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
         q, k, v = _split_qkv(bp, x, cfg, positions=positions)
         slot = pos % layer["k"].shape[1] if cfg.window else pos
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            layer["k"], k[:, None].astype(layer["k"].dtype), slot, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            layer["v"], v[:, None].astype(layer["v"].dtype), slot, axis=1)
-        att = jax.vmap(
-            functools.partial(_attend_cached, window=cfg.window),
-            in_axes=(0, 0, 0, None),
-        )(q, ck, cv, pos)
+
+        def put(buf, val, slot=slot):
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, val[:, None].astype(buf.dtype), slot, axis=1)
+
+        if cfg.kv_quant:
+            from .quant import kv_quantize
+
+            kq, ksc = kv_quantize(k)
+            vq, vsc = kv_quantize(v)
+            layer = {"k": put(layer["k"], kq), "v": put(layer["v"], vq),
+                     "ks": put(layer["ks"], ksc),
+                     "vs": put(layer["vs"], vsc)}
+            att = jax.vmap(
+                functools.partial(_attend_cached, window=cfg.window),
+                in_axes=(0, 0, 0, None, 0, 0),
+            )(q, layer["k"], layer["v"], pos, layer["ks"], layer["vs"])
+            new_cache.append(layer)
+        else:
+            ck = put(layer["k"], k)
+            cv = put(layer["v"], v)
+            att = jax.vmap(
+                functools.partial(_attend_cached, window=cfg.window),
+                in_axes=(0, 0, 0, None),
+            )(q, ck, cv, pos)
+            new_cache.append({"k": ck, "v": cv})
         x = _mlp_residual(
             bp, x + att.reshape(x.shape) @ _deq(bp["wo"], x.dtype), cfg)
-        new_cache.append({"k": ck, "v": cv})
     x = _layer_norm(params["ln_f"], x)
     return _readout(params, x), new_cache
 
@@ -602,14 +654,23 @@ def prefill(params, tokens, cfg: TransformerConfig):
     for i, bp in enumerate(params["blocks"]):
         x, k, v = _map_seqs(
             lambda xi: _block(bp, xi, cfg, return_kv=True), x, cfg)
-        kd = k.astype(cache[i]["k"].dtype)
-        vd = v.astype(cache[i]["v"].dtype)
-        if cfg.window:
-            cache[i]["k"] = cache[i]["k"].at[:, slots].set(kd[:, idx])
-            cache[i]["v"] = cache[i]["v"].at[:, slots].set(vd[:, idx])
+        if cfg.kv_quant:
+            from .quant import kv_quantize
+
+            writes = []
+            for name, sname, arr in (("k", "ks", k), ("v", "vs", v)):
+                qx, sx = kv_quantize(arr)
+                writes += [(name, qx), (sname, sx)]
         else:
-            cache[i]["k"] = cache[i]["k"].at[:, :s].set(kd)
-            cache[i]["v"] = cache[i]["v"].at[:, :s].set(vd)
+            writes = [("k", k.astype(cache[i]["k"].dtype)),
+                      ("v", v.astype(cache[i]["v"].dtype))]
+        for name, arr in writes:
+            if cfg.window:
+                cache[i][name] = cache[i][name].at[:, slots].set(
+                    arr[:, idx].astype(cache[i][name].dtype))
+            else:
+                cache[i][name] = cache[i][name].at[:, :s].set(
+                    arr.astype(cache[i][name].dtype))
     x = _layer_norm(params["ln_f"], x)
     return _readout(params, x[:, -1]), cache
 
@@ -685,6 +746,14 @@ def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):
 
     Compose dp x tp by also sharding the token batch over the other mesh
     axis. Returns a new params pytree placed with ``jax.device_put``."""
+    from .quant import is_quantized
+
+    if is_quantized(params):
+        raise ValueError(
+            "int8-quantized params can't be TP-placed (per-channel scale "
+            "shapes don't match the 2-D weight specs); shard the float "
+            "masters, or quantize per-host after placement "
+            "(models/quant.py)")
     from ..mesh import default_mesh
     from jax.sharding import NamedSharding, PartitionSpec as P
 

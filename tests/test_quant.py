@@ -103,6 +103,84 @@ class TestDecodeParity:
         assert cache[0]["k"].shape[1] == cfg.max_len
 
 
+class TestKvCacheQuant:
+    """Int8 KV cache (cfg.kv_quant): approximate by design (~0.4%
+    per-vector rounding), so the oracle is tolerance-based against the
+    float-cache decode of the SAME params — not exactness."""
+
+    def test_cache_layout_and_bytes(self):
+        cfg = _cfg(kv_quant="int8")
+        cache = init_kv_cache(cfg, 2)
+        lay = cache[0]
+        assert lay["k"].dtype == jnp.int8 and lay["v"].dtype == jnp.int8
+        assert lay["ks"].shape == lay["k"].shape[:-1] + (1,)
+        qbytes = sum(x.nbytes for x in lay.values())
+        fbytes = sum(x.nbytes
+                     for x in init_kv_cache(_cfg(), 2)[0].values())
+        # ~4x smaller than f32 + the per-vector scale overhead.
+        assert qbytes < 0.3 * fbytes + 8 * lay["ks"].size
+
+    def test_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="kv_quant"):
+            init_kv_cache(_cfg(kv_quant="fp4"), 1)
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"rope": True, "n_kv_heads": 1, "window": 16},
+        {"dtype": "bfloat16"},
+    ])
+    def test_decode_close_to_float_cache(self, kw):
+        cfg_f = _cfg(**kw)
+        cfg_q = _cfg(kv_quant="int8", **kw)
+        p = init_params(cfg_f, seed=6)
+        b = 2
+        tok0 = jnp.asarray([3, 11], jnp.int32)
+        tok1 = jnp.asarray([9, 2], jnp.int32)
+        cf = init_kv_cache(cfg_f, b, dtype=jnp.dtype(cfg_f.dtype))
+        cq = init_kv_cache(cfg_q, b)
+        lf, cf = tr.decode_step(p, cf, tok0, 0, cfg_f)
+        lq, cq = tr.decode_step(p, cq, tok0, 0, cfg_q)
+        # Step 2 attends cached (quantized) K/V from step 1.
+        lf, _ = tr.decode_step(p, cf, tok1, 1, cfg_f)
+        lq, _ = tr.decode_step(p, cq, tok1, 1, cfg_q)
+        lff = np.asarray(lf, np.float32)
+        lqf = np.asarray(lq, np.float32)
+        scale = np.abs(lff).max()
+        assert np.max(np.abs(lqf - lff)) <= 0.05 * scale
+
+    def test_generate_with_full_int8_stack(self):
+        # Weights AND cache int8 — the bench's decodeint8 configuration.
+        cfg = _cfg(kv_quant="int8", dtype="bfloat16")
+        q = quantize_params_int8(init_params(cfg, seed=7))
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab, (2, 8)),
+            jnp.int32)
+        out = generate(q, prompt, 6, cfg)
+        assert out.shape == (2, 6)
+        assert int(jnp.min(out)) >= 0 and int(jnp.max(out)) < cfg.vocab
+
+    def test_prefill_primes_quantized_ring(self):
+        # The prompt pass itself never sees quantized K/V (flash kernel on
+        # float) — what matters is the FIRST DECODE STEP attending the
+        # int8-primed ring matching the float-primed one.
+        cfg_q = _cfg(kv_quant="int8", rope=True, window=8, max_len=32)
+        cfg_f = _cfg(rope=True, window=8, max_len=32)
+        p = init_params(cfg_q, seed=8)
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(0, cfg_q.vocab, (1, 12)),
+            jnp.int32)
+        _, cache_q = prefill(p, prompt, cfg_q)
+        _, cache_f = prefill(p, prompt, cfg_f)
+        assert cache_q[0]["k"].dtype == jnp.int8
+        assert cache_q[0]["k"].shape[1] == 8  # ring length = window
+        tok = jnp.asarray([5], jnp.int32)
+        lq, _ = tr.decode_step(p, cache_q, tok, 12, cfg_q)
+        lf, _ = tr.decode_step(p, cache_f, tok, 12, cfg_f)
+        lff = np.asarray(lf, np.float32)
+        lqf = np.asarray(lq, np.float32)
+        assert np.max(np.abs(lqf - lff)) <= 0.05 * np.abs(lff).max()
+
+
 class TestGuards:
     def test_loss_fn_rejects_quantized_params(self):
         cfg = _cfg()
@@ -110,6 +188,25 @@ class TestGuards:
         tok = jnp.zeros((1, 8), jnp.int32)
         with pytest.raises(ValueError, match="inference-only"):
             loss_fn(q, tok, tok, cfg)
+
+    def test_shard_params_rejects_quantized_params(self):
+        cfg = _cfg()
+        q = quantize_params_int8(init_params(cfg, seed=0))
+        with pytest.raises(ValueError, match="TP-placed"):
+            tr.shard_params(q, cfg)
+
+    def test_decode_rejects_cache_config_mismatch(self):
+        # An int8 cache attended by a kv_quant-less cfg would astype-
+        # truncate K/V into the int8 buffers and return finite garbage;
+        # both mismatch directions must error instead.
+        cfg_q = _cfg(kv_quant="int8")
+        cfg_f = _cfg()
+        p = init_params(cfg_f, seed=0)
+        tok = jnp.zeros((1,), jnp.int32)
+        with pytest.raises(ValueError, match="int8-quantized"):
+            tr.decode_step(p, init_kv_cache(cfg_q, 1), tok, 0, cfg_f)
+        with pytest.raises(ValueError, match="int8-quantized"):
+            tr.decode_step(p, init_kv_cache(cfg_f, 1), tok, 0, cfg_q)
 
 
 class TestStreamingWin:
