@@ -209,6 +209,28 @@ class TestGuards:
             tr.decode_step(p, init_kv_cache(cfg_f, 1), tok, 0, cfg_q)
 
 
+class TestServeStackIntegration:
+    def test_quantized_params_checkpoint_roundtrip(self, tmp_path):
+        # The deploy story: train float masters -> quantize once ->
+        # checkpoint the int8 artifact -> restore -> serve. The int8
+        # pytree ({"q8" int8, "s8" f32} leaves) must survive the orbax
+        # round-trip bit-exactly and decode identically.
+        from marlin_tpu.utils.checkpoint import load_pytree, save_pytree
+
+        cfg = _cfg(kv_quant="int8")
+        q = quantize_params_int8(init_params(cfg, seed=9))
+        path = str(tmp_path / "int8_ckpt")
+        save_pytree(q, path)
+        q2 = load_pytree(path)
+        for a, b in zip(jax.tree.leaves(q), jax.tree.leaves(q2)):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out1 = generate(q, prompt, 4, cfg)
+        out2 = generate(q2, prompt, 4, cfg)
+        assert np.array_equal(np.asarray(out1), np.asarray(out2))
+
+
 class TestStreamingWin:
     def test_int8_decode_streams_a_quarter_of_the_bytes(self):
         cfg = _cfg(vocab=256, d_model=64, d_ff=256, n_layers=2, max_len=64)
