@@ -128,6 +128,19 @@ def ring_matmul(
 # ---------------------------------------------------------------------------
 
 
+def ring_hops(n_dev: int, skv_stripe: int, window: int) -> int:
+    """Hops the ring attention engine runs — THE function the kernel uses
+    (utils/cost_model's ICI-traffic model imports it, so the model can't
+    drift from the engine). Sliding window (causal): only the current
+    stripe plus the previous ceil((window - 1) / stripe) stripes can
+    intersect any local query's band, so the windowed ring stops after
+    that many hops — communication and compute scale with the window, not
+    the device count. Without a window every stripe visits every device."""
+    if window:
+        return min(n_dev, (window + skv_stripe - 2) // max(skv_stripe, 1) + 1)
+    return n_dev
+
+
 @functools.cache
 def _ring_attention_fn(
     mesh: Mesh, n_dev: int, causal: bool, scale: float,
@@ -135,14 +148,11 @@ def _ring_attention_fn(
     group: int = 1,
 ):
     axes = _ring_axes(mesh)
-    # Sliding window (causal): only the current stripe plus the previous
-    # ceil((window - 1) / stripe) stripes can intersect any local query's
-    # band, so the ring ROTATES FORWARD (device i sees stripes i, i-1, ...)
-    # and stops after that many hops — communication and compute scale with
-    # the window, not the device count. skv_stripe is static (wrapper
-    # passes skv // n_dev) so the bound is compile-time.
+    # skv_stripe is static (wrapper passes skv // n_dev) so the hop bound
+    # is compile-time; the windowed ring rotates FORWARD (device i sees
+    # stripes i, i-1, ...).
     if window:
-        hops = min(n_dev, (window + skv_stripe - 2) // max(skv_stripe, 1) + 1)
+        hops = ring_hops(n_dev, skv_stripe, window)
         direction = +1
     else:
         hops = n_dev

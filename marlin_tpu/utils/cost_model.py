@@ -34,6 +34,7 @@ __all__ = [
     "CostReport", "compiled_cost",
     "gemm_cost", "summa_cost", "ell_product_cost", "decode_step_cost",
     "ce_logits_bytes", "attention_block_counts", "flash_attention_cost",
+    "ring_attention_cost", "speedup_ceiling",
 ]
 
 
@@ -249,6 +250,43 @@ def flash_attention_cost(s: int, h: int, d: int, block_q: int, block_k: int,
         + c["n_q"] * block_q * d            # output write
     )
     return flops, float(byts)
+
+
+def ring_attention_cost(s: int, h: int, d: int, n_dev: int,
+                        window: int = 0, causal: bool = True,
+                        itemsize: int = 2,
+                        kv_heads: Optional[int] = None) -> Tuple[float, float]:
+    """Per-device (flops, ici_bytes) of ring attention
+    (parallel/ring.py): each of the ``hops`` ring steps runs local
+    attention of the (s/P, d) query stripe against one rotated K/V
+    stripe, and ships K+V one hop over ICI. The hop count comes from the
+    ENGINE's own ``ring_hops`` (windowed rings stop once no earlier
+    stripe can intersect the band), so the model moves with the kernel.
+    FLOPs count the causal/window liveness at stripe granularity (a full
+    causal ring computes ~half its visited stripe pairs' MACs); with GQA
+    pass ``kv_heads`` — the ROTATING stripes carry only the K/V heads, so
+    ICI traffic shrinks by the group factor exactly as the engine's."""
+    if window and not causal:
+        # Mirror the engine's contract (ring.py ring_self_attention).
+        raise ValueError("window > 0 requires causal=True")
+    from ..parallel.ring import ring_hops
+
+    kv_heads = kv_heads or h
+    stripe = -(-s // n_dev)
+    hops = ring_hops(n_dev, stripe, window)
+    # Stripe pairs actually computed: causal keeps (i, j<=i) pairs —
+    # n_dev*(n_dev+1)/2 of the n_dev*hops visited; a windowed ring visits
+    # only band-adjacent stripes (hops per query stripe, edge-clipped).
+    if window:
+        live_pairs = sum(min(i + 1, hops) for i in range(n_dev))
+    elif causal:
+        live_pairs = n_dev * (n_dev + 1) // 2
+    else:
+        live_pairs = n_dev * hops
+    flops = 4.0 * h * d * stripe * stripe * live_pairs / n_dev
+    # K+V per hop: only the kv heads rotate (GQA traffic shrink).
+    ici_bytes = 2.0 * (hops - 1) * stripe * kv_heads * d * itemsize
+    return flops, ici_bytes
 
 
 def speedup_ceiling(s: int, window: int,

@@ -230,6 +230,48 @@ class TestAttentionBlockModel:
         assert window_block_clamp(256, 128, 1024) == (256, 128)  # under cap
         assert window_block_clamp(1024, 1024, 256) == (256, 128)  # floors
 
+    def test_ring_hop_bound_is_tight_against_brute_force(self):
+        # ring_hops is THE engine function (parallel/ring.py); check it
+        # against an independent derivation: the number of consecutive
+        # stripes (current + earlier) that can contain keys in any local
+        # query's (q - w, q] band.
+        from marlin_tpu.parallel.ring import ring_hops
+
+        for n_dev in (4, 8):
+            for stripe in (64, 128, 192):
+                for w in (1, 63, 64, 65, 128, 300, 10_000):
+                    need = 0
+                    for i in range(n_dev):
+                        for q in range(i * stripe, (i + 1) * stripe):
+                            lo_key = max(0, q - w + 1)
+                            need = max(need, i - lo_key // stripe + 1)
+                    got = ring_hops(n_dev, stripe, w)
+                    # The formula is exact (worst query is the stripe's
+                    # first position), so no slack: an off-by-one hop
+                    # overcount would double ICI at hops=2 configs.
+                    assert got == min(n_dev, need), \
+                        (n_dev, stripe, w, need, got)
+
+    def test_ring_attention_cost_shapes(self):
+        s, h, d, nd = 8192, 8, 128, 8
+        full_f, full_b = cm.ring_attention_cost(s, h, d, nd)
+        # Causal full ring: live stripe pairs = lower triangle.
+        stripe = s // nd
+        assert full_f == 4.0 * h * d * stripe * stripe * 36 / 8
+        assert full_b == 2.0 * 7 * stripe * h * d * 2
+        # A window covering one stripe cuts hops (and ICI bytes) hard.
+        win_f, win_b = cm.ring_attention_cost(s, h, d, nd, window=stripe)
+        # hops=2 of 8: ICI drops to 1/7 of the full ring's; live stripe
+        # pairs drop to 15/36 (the first stripe has no predecessor).
+        assert win_b == full_b / 7
+        assert win_f == full_f * 15 / 36
+        # GQA: rotating stripes carry only the kv heads.
+        _, gqa_b = cm.ring_attention_cost(s, h, d, nd, kv_heads=2)
+        assert gqa_b == full_b * 2 / h
+        # Invalid engine combination must not return fabricated numbers.
+        with pytest.raises(ValueError, match="causal"):
+            cm.ring_attention_cost(s, h, d, nd, window=64, causal=False)
+
     def test_flash_cost_flops_formula(self):
         # Causal full-band: live pairs = lower-triangle blocks; the FLOP
         # model must agree with the closed form 4*H*D * S*(S+bq)/2 within
