@@ -543,12 +543,16 @@ def config_attention():
         # both capped ~w/2) or ceiling_frac misattributes the gap.
         # Predicate-derived ceiling (utils/cost_model.py): enumerates the
         # kernel's own grid plan instead of the closed form, evaluated at
-        # the kernel's OWN entry clamp (shared helper — a clamp change
-        # moves this bar automatically).
-        from marlin_tpu.ops.flash_attention import window_block_clamp
+        # the kernel's FULL entry block selection (window + sequence
+        # clamps, shared helper — a clamp or default-block change moves
+        # this bar automatically).
+        from marlin_tpu.ops.flash_attention import (DEFAULT_BLOCK_K,
+                                                    DEFAULT_BLOCK_Q,
+                                                    effective_blocks)
         from marlin_tpu.utils import cost_model as cm
 
-        bq_eff, bk_eff = window_block_clamp(1024, 1024, w)
+        bq_eff, bk_eff = effective_blocks(s, s, DEFAULT_BLOCK_Q,
+                                          DEFAULT_BLOCK_K, w)
         ideal = cm.speedup_ceiling(s, w, (bq_eff, bk_eff))
         out.update(window=w,
                    window_speedup_vs_causal=round(dt_c / dt_w, 2),
@@ -1032,13 +1036,24 @@ def _train_throughput(metric, cfg, batch):
     )
     n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
     model_tflops = 6.0 * n_par * batch * s / dt / 1e12
+    # Full-step model incl. the attention term 6*N*T excludes
+    # (utils/cost_model.py, CI-locked to the flash kernel's grid): real
+    # MFU for the attribution the r04 verdict asked of this line.
+    from marlin_tpu.utils import cost_model as cm
+
+    full_flops = cm.transformer_step_flops(
+        n_par, batch, s, cfg.n_layers, cfg.n_heads,
+        cfg.d_model // cfg.n_heads, window=cfg.window)
     # vs_baseline: model-FLOPs utilization against the same 50%-of-peak
     # north star the headline GEMM uses (6*N*T is the standard lower-bound
-    # FLOP count — attention FLOPs excluded, so long-seq configs understate).
+    # FLOP count — attention FLOPs excluded, so long-seq configs understate;
+    # mfu_frac_peak is the honest fraction including attention).
     return {"metric": metric, "value": round(batch * s / dt, 1),
             "unit": "tok/s",
             "vs_baseline": round(model_tflops / (0.5 * guess_peak()), 3),
             "model_tflops_est": round(model_tflops, 2),
+            "full_model_tflops": round(full_flops / dt / 1e12, 2),
+            "mfu_frac_peak": round(full_flops / dt / 1e12 / guess_peak(), 3),
             "params_m": round(n_par / 1e6, 1),
             # Config provenance: which variant this line measured (the
             # capture ledger compares lines across sessions; dtype/arch

@@ -37,6 +37,12 @@ _NEG_INF = -1e30
 _LANES = 128  # TPU lane count: last-dim tiles are always x128
 _LOG2E = float(np.log2(np.e))
 
+# Default tile sizes — the autotuned sweet spot for v5e at the bench shape
+# (bench.py attnsweep). ONE constant shared with the cost model so a retune
+# moves every grid-accounting consumer with it.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
 
 def _block_live(i, j, *, causal, block_q, block_k, window):
     """Block-liveness predicate shared by the forward and both backward
@@ -73,6 +79,20 @@ def window_block_clamp(block_q: int, block_k: int,
     (128/256-row floors, 128-lane rounding)."""
     cap = (window // 2 + 127) // 128 * 128
     return (max(256, min(block_q, cap)), max(128, min(block_k, cap)))
+
+
+def effective_blocks(s_q: int, s_kv: int, block_q: int, block_k: int,
+                     window: int = 0) -> tuple:
+    """The (block_q, block_k) the kernel actually runs for these sequence
+    lengths: the window clamp (above) followed by the sublane-padded
+    sequence clamp — the full entry-point block selection, shared so cost
+    models (utils/cost_model.transformer_step_flops) grid-count exactly
+    what the kernel grids."""
+    if window:
+        block_q, block_k = window_block_clamp(block_q, block_k, window)
+    block_q = min(block_q, -(-s_q // 16) * 16)
+    block_k = min(block_k, -(-s_kv // 16) * 16)
+    return block_q, block_k
 
 
 def _win_lo_q(j, *, block_q, block_k, window):
@@ -587,8 +607,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
     window: int = 0,
 ) -> jax.Array:
@@ -634,15 +654,13 @@ def flash_attention(
     single = q.ndim == 2
     if single:
         q, k, v = q[:, None, :], k[:, None, :], v[:, None, :]
-    if window:
-        # Rationale in window_block_clamp: each q-block's rows process
-        # ~window + block_q/2 keys (the diagonal partial), so ~window/2
-        # blocks keep the compute ratio near S/window instead of
-        # plateauing at ~2.7x (measured at S=8k, window=1024, 1024-blocks).
-        block_q, block_k = window_block_clamp(block_q, block_k, window)
-    # Clamp blocks to the (sublane-padded) sequence lengths.
-    block_q = min(block_q, -(-q.shape[0] // 16) * 16)
-    block_k = min(block_k, -(-k.shape[0] // 16) * 16)
+    # Window clamp (rationale in window_block_clamp: each q-block's rows
+    # process ~window + block_q/2 keys, so ~window/2 blocks keep the
+    # compute ratio near S/window instead of plateauing at ~2.7x) followed
+    # by the sublane-padded sequence clamp — one shared function so cost
+    # models grid-count exactly what runs.
+    block_q, block_k = effective_blocks(
+        q.shape[0], k.shape[0], block_q, block_k, window)
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if k.shape[-1] != q.shape[-1]:

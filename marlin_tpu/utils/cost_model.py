@@ -252,6 +252,32 @@ def flash_attention_cost(s: int, h: int, d: int, block_q: int, block_k: int,
     return flops, float(byts)
 
 
+def transformer_step_flops(n_params: int, batch: int, s: int,
+                           n_layers: int, n_heads: int, d_head: int,
+                           window: int = 0, block_q: Optional[int] = None,
+                           block_k: Optional[int] = None) -> float:
+    """Model FLOPs of one training step: the standard ``6 * N * T`` matmul
+    bound PLUS the attention term it excludes — per layer and sequence,
+    the causal flash forward's live-block MACs (the same grid accounting
+    the flash model uses, at the kernel's default/windowed blocks) times
+    3.5 for fwd+bwd (2 fwd matmuls + 5 bwd: recomputed logits, dP, dV,
+    dQ, dK). 6*N*T alone understates long-sequence configs — at S=8k the
+    attention term is ~25% of the total for the bench shape — which is
+    exactly the gap between 'model FLOPs utilization' and real MFU that
+    the r04 verdict asked the transformer line to attribute."""
+    from ..ops.flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+                                       effective_blocks)
+
+    # Defaults resolve from the kernel's own constants — a retune moves
+    # this model with it (review finding r05: no hand-copied mirrors).
+    block_q, block_k = effective_blocks(
+        s, s, block_q or DEFAULT_BLOCK_Q, block_k or DEFAULT_BLOCK_K,
+        window)
+    attn_fwd, _ = flash_attention_cost(s, n_heads, d_head, block_q,
+                                       block_k, window=window, causal=True)
+    return 6.0 * n_params * batch * s + 3.5 * batch * n_layers * attn_fwd
+
+
 def ring_attention_cost(s: int, h: int, d: int, n_dev: int,
                         window: int = 0, causal: bool = True,
                         itemsize: int = 2,
@@ -291,7 +317,7 @@ def ring_attention_cost(s: int, h: int, d: int, n_dev: int,
 
 def speedup_ceiling(s: int, window: int,
                     banded_blocks: Tuple[int, int],
-                    causal_blocks: Tuple[int, int] = (1024, 1024)) -> float:
+                    causal_blocks: Optional[Tuple[int, int]] = None) -> float:
     """Windowed-vs-causal block ceiling — the bar the bench's
     ``window_speedup_vs_causal`` is measured against (docs/ROUND4.md §7:
     the r03 2.27x measurement sat AT this ceiling for the w/2 clamp, not
@@ -303,7 +329,11 @@ def speedup_ceiling(s: int, window: int,
     hard-shrunk to the band, so its cost is VISITED tiles — including the
     dead diagonal overhang that small blocks shrink, which is exactly why
     the (256, 128) sweep point has a higher ceiling than the (512, 512)
-    clamp."""
+    clamp. ``causal_blocks`` defaults to the kernel's own default tiles."""
+    if causal_blocks is None:
+        from ..ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+
+        causal_blocks = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     cq, ck = causal_blocks
     bq, bk = banded_blocks
     causal = attention_block_counts(s, cq, ck, causal=True)
