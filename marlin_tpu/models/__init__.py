@@ -5,9 +5,11 @@ from . import gcn
 from .quant import dequantize_params, quantize_params_int8
 from .transformer import (
     TransformerConfig,
+    decode_chunk,
     decode_step,
     forward,
     generate,
+    generate_speculative,
     hidden_states,
     init_kv_cache,
     init_params,
@@ -20,8 +22,10 @@ from .transformer import (
 
 __all__ = [
     "TransformerConfig",
+    "decode_chunk",
     "decode_step",
     "dequantize_params",
+    "generate_speculative",
     "quantize_params_int8",
     "forward",
     "generate",

@@ -561,6 +561,26 @@ def _attend_cached(q, ck, cv, pos, ks=None, vs=None, window=0):
     return out.reshape(h, dh).astype(q.dtype)
 
 
+def _check_cache(cache, cfg: TransformerConfig, expect_len: int):
+    """Shared cache/config validation for decode_step and decode_chunk.
+    Length: the window bound is implied by the ring length, so a cache
+    built with a different window would silently un-band the attention.
+    Quantization: a float cache under a kv_quant cfg dies on a KeyError,
+    but the REVERSE — an int8 cache attended by a cfg without kv_quant —
+    would astype-truncate K/V into the int8 buffers and return finite
+    garbage silently."""
+    if cache[0]["k"].shape[1] != expect_len:
+        raise ValueError(
+            f"cache length {cache[0]['k'].shape[1]} != {expect_len} expected "
+            f"for window={cfg.window}, max_len={cfg.max_len}; build the "
+            "cache with init_kv_cache(cfg, ...)")
+    if ("ks" in cache[0]) != bool(cfg.kv_quant):
+        raise ValueError(
+            f"cache {'is' if 'ks' in cache[0] else 'is not'} int8-quantized "
+            f"but cfg.kv_quant={cfg.kv_quant!r}; build the cache with "
+            "init_kv_cache(cfg, ...) from the SAME config")
+
+
 def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     """One decode step: tokens (B,) int32 at position ``pos`` -> (logits
     (B, vocab), updated cache). Without a window, writes each layer's K/V
@@ -575,23 +595,7 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         jnp.full((x.shape[0],), pos, jnp.int32) if cfg.rope else None
     )
     expect_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
-    if cache[0]["k"].shape[1] != expect_len:
-        # The window bound is implied by the ring length: a mismatched cache
-        # (e.g. built with a different window) would silently un-band the
-        # attention instead of erroring.
-        raise ValueError(
-            f"cache length {cache[0]['k'].shape[1]} != {expect_len} expected "
-            f"for window={cfg.window}, max_len={cfg.max_len}; build the "
-            "cache with init_kv_cache(cfg, ...)")
-    if ("ks" in cache[0]) != bool(cfg.kv_quant):
-        # Same class of mismatch as the length check: a float cache under a
-        # kv_quant cfg dies on a KeyError, but the REVERSE — an int8 cache
-        # attended by a cfg without kv_quant — would astype-truncate K/V
-        # into the int8 buffers and return finite garbage silently.
-        raise ValueError(
-            f"cache {'is' if 'ks' in cache[0] else 'is not'} int8-quantized "
-            f"but cfg.kv_quant={cfg.kv_quant!r}; build the cache with "
-            "init_kv_cache(cfg, ...) from the SAME config")
+    _check_cache(cache, cfg, expect_len=expect_len)
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
         q, k, v = _split_qkv(bp, x, cfg, positions=positions)
@@ -624,6 +628,81 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
             new_cache.append({"k": ck, "v": cv})
         x = _mlp_residual(
             bp, x + att.reshape(x.shape) @ _deq(bp["wo"], x.dtype), cfg)
+    x = _layer_norm(params["ln_f"], x)
+    return _readout(params, x), new_cache
+
+
+def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
+    """Multi-position decode: tokens (B, C) at positions pos..pos+C-1 ->
+    (logits (B, C, vocab), updated cache).
+
+    The speculative-verify step (``generate_speculative``): C candidate
+    tokens stream the weights ONCE — the whole point, since decode is
+    bound by parameter streaming — and each position attends the cache
+    prefix up to itself (within-chunk causality falls out of the
+    per-position slot mask; the chunk's K/V are written before attending).
+    A partially REJECTED chunk needs no rollback: slot == position in the
+    dense cache, so stale rejected-draft slots sit beyond the accepted
+    position and are overwritten before they are ever attendable. That
+    self-healing property is exactly what a ring cache lacks (overwritten
+    slots held still-live earlier positions), so ``cfg.window`` is
+    unsupported here. Caller contract: pos + C <= cache length (JAX's
+    update-slice clamp would otherwise silently shift the write)."""
+    if cfg.window:
+        raise NotImplementedError(
+            "decode_chunk needs the dense slot==position cache: a ring "
+            "cache can't absorb a partially rejected chunk (overwritten "
+            "slots held live positions)")
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "decode_chunk's (B, C, D) activations don't fit the MoE "
+            "router's (T, D) batch contract; use decode_step/generate "
+            "for MoE configs")
+    params = _cast_params(params, cfg)
+    b, c = tokens.shape
+    x = _embed_rows(params, tokens, cfg.compute_dtype)  # (B, C, D)
+    chunk_pos = pos + jnp.arange(c, dtype=jnp.int32)
+    if not cfg.rope:
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos"], pos, c, axis=0).astype(x.dtype)[None]
+    x = x.astype(cfg.compute_dtype)
+    positions = jnp.tile(chunk_pos, b) if cfg.rope else None
+    _check_cache(cache, cfg, expect_len=cfg.max_len)
+    hk, dh = cache[0]["k"].shape[2], cache[0]["k"].shape[3]
+    new_cache = []
+    for bp, layer in zip(params["blocks"], cache):
+        q, k, v = _split_qkv(bp, x.reshape(b * c, -1), cfg,
+                             positions=positions)
+        q = q.reshape(b, c, cfg.n_heads, dh)
+        k = k.reshape(b, c, hk, dh)
+        v = v.reshape(b, c, hk, dh)
+
+        def put(buf, val):
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, val.astype(buf.dtype), pos, axis=1)
+
+        if cfg.kv_quant:
+            from .quant import kv_quantize
+
+            kq, ksc = kv_quantize(k)
+            vq, vsc = kv_quantize(v)
+            layer = {"k": put(layer["k"], kq), "v": put(layer["v"], vq),
+                     "ks": put(layer["ks"], ksc),
+                     "vs": put(layer["vs"], vsc)}
+            att = jax.vmap(lambda qb, ckb, cvb, ksb, vsb: jax.vmap(
+                lambda qc, pc: _attend_cached(qc, ckb, cvb, pc, ksb, vsb)
+            )(qb, chunk_pos))(q, layer["k"], layer["v"], layer["ks"],
+                              layer["vs"])
+            new_cache.append(layer)
+        else:
+            ck = put(layer["k"], k)
+            cv = put(layer["v"], v)
+            att = jax.vmap(lambda qb, ckb, cvb: jax.vmap(
+                lambda qc, pc: _attend_cached(qc, ckb, cvb, pc)
+            )(qb, chunk_pos))(q, ck, cv)
+            new_cache.append({"k": ck, "v": cv})
+        x = _mlp_residual(
+            bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
     x = _layer_norm(params["ln_f"], x)
     return _readout(params, x), new_cache
 
@@ -730,6 +809,116 @@ def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
     _, toks = jax.lax.scan(
         step, (first, pos0, cache, key), None, length=steps)
     return toks
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "draft_len", "ngram"))
+def _speculative_loop(params, buf, filled0, cache, cfg: TransformerConfig,
+                      steps: int, draft_len: int, ngram: int):
+    """The jitted prompt-lookup speculation loop (ONE dispatch for the
+    whole generation — a host loop would pay a tunnel RTT per chunk and
+    hand back most of the win). ``buf`` holds prompt + generated tokens;
+    each iteration drafts ``draft_len - 1`` tokens from the most recent
+    prior occurrence of the last ``ngram`` tokens, verifies the chunk with
+    one decode_chunk (one weight stream for the whole chunk), accepts the
+    longest agreeing prefix plus the model's correction, and writes ALL
+    chunk predictions into buf — positions beyond the accepted count are
+    overwritten by later iterations before anything reads them (the draft
+    lookup masks candidates past ``filled``)."""
+    total = buf.shape[0]
+    n_win = total - ngram + 1
+
+    def body(carry):
+        buf, filled, cache = carry
+        gram = jax.lax.dynamic_slice(buf, (filled - ngram,), (ngram,))
+        # Freshest prior occurrence of the gram, entirely inside the
+        # filled region (static shifted slices of the live buf).
+        win = jnp.stack(
+            [buf[i:n_win + i] for i in range(ngram)], axis=1)
+        match = jnp.all(win == gram[None, :], axis=1)
+        jidx = jnp.arange(n_win, dtype=jnp.int32)
+        valid = match & (jidx < filled - ngram)
+        j_star = jnp.max(jnp.where(valid, jidx, -1))
+        src = jnp.maximum(j_star, 0) + ngram
+        draft = jax.lax.dynamic_slice(buf, (src,), (draft_len - 1,))
+        last = buf[filled - 1]
+        draft = jnp.where(j_star >= 0, draft,
+                          jnp.full((draft_len - 1,), last, buf.dtype))
+        chunk = jnp.concatenate([last[None], draft])  # (C,)
+        logits, cache = decode_chunk(params, cache, chunk[None],
+                                     filled - 1, cfg)
+        pred = jnp.argmax(
+            logits[0].astype(jnp.float32), axis=-1).astype(buf.dtype)
+        agree = pred[:-1] == chunk[1:]
+        m = jnp.where(jnp.all(agree), draft_len - 1,
+                      jnp.argmin(agree).astype(jnp.int32))
+        buf = jax.lax.dynamic_update_slice(buf, pred, (filled,))
+        return buf, filled + m + 1, cache
+
+    def cond(carry):
+        _, filled, _ = carry
+        # filled0 = prompt + 1 (the prefill's token is already in buf), so
+        # the output needs filled >= prompt + steps = filled0 + steps - 1
+        # — not + steps, which would burn one discarded verify chunk.
+        return filled < filled0 + steps - 1
+
+    buf, _, _ = jax.lax.while_loop(cond, body, (buf, filled0, cache))
+    return buf
+
+
+def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
+                         draft_len: int = 8, ngram: int = 2):
+    """Greedy generation with prompt-lookup speculative decoding: drafts
+    come from the sequence's OWN history (the freshest prior occurrence of
+    the last ``ngram`` tokens proposes the ``draft_len - 1`` tokens that
+    followed it), verified in one multi-position :func:`decode_chunk` per
+    iteration. Output is EXACTLY plain greedy ``generate``'s whenever the
+    argmax is roundoff-stable (speculation changes the schedule, never
+    the distribution — the oracle the tests hold it to; NEAR-TIED logits,
+    e.g. an untrained bf16 model, can flip between the chunked and
+    per-step reduction orders exactly as two differently-fused plain
+    decodes could); throughput improves by the mean accepted-prefix length,
+    since decode is parameter-streaming-bound and a chunk streams the
+    weights once for up to ``draft_len`` emitted tokens. Repetitive text
+    (code, retrieval, chat templates) accepts long prefixes; adversarially
+    random tokens accept ~0 and degrade gracefully toward plain decode
+    minus the (draft_len-fold smaller) chunk overhead.
+
+    Contract: batch 1 (speculation is a latency optimization — per-seq
+    acceptance counts would desynchronize a batch), greedy only, dense
+    cache (``cfg.window == 0``; see decode_chunk on why a ring can't
+    absorb rejected drafts), ``prompt + steps + draft_len <= max_len``,
+    ``prompt >= ngram``. No reference counterpart (Marlin has no
+    inference); beyond-parity axis next to the int8 streaming stack."""
+    b, s = prompt.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is single-sequence (got batch {b}): "
+            "per-sequence acceptance would desynchronize a batch — use "
+            "generate() for batched throughput")
+    if cfg.window:
+        raise NotImplementedError(
+            "speculative decoding needs the dense cache (cfg.window == 0)")
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "speculative decoding uses decode_chunk, which doesn't fit "
+            "the MoE router's (T, D) batch contract; use generate()")
+    if s < ngram:
+        raise ValueError(f"prompt length {s} < ngram {ngram}")
+    if draft_len < 2:
+        raise ValueError(f"draft_len must be >= 2, got {draft_len}")
+    if s + steps + draft_len > cfg.max_len:
+        raise ValueError(
+            f"prompt {s} + steps {steps} + draft_len {draft_len} exceeds "
+            f"max_len {cfg.max_len} (the last chunk writes draft_len "
+            "cache slots past the final emitted position)")
+    logits, cache = _prefill_jit(params, prompt, cfg=cfg)
+    first = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    buf = jnp.zeros((s + steps + draft_len,), jnp.int32)
+    buf = buf.at[:s].set(prompt[0]).at[s].set(first[0])
+    buf = _speculative_loop(params, buf, s + 1, cache, cfg, steps,
+                            draft_len, ngram)
+    return buf[None, s:s + steps]
 
 
 def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):

@@ -180,15 +180,15 @@ class TestCachedFallback:
 
     def test_real_capture_dir_covers_most_of_all(self, capsys):
         # A dead-tunnel `--config all` run should still produce a nearly
-        # complete artifact from the shipped captures. Two configs have
+        # complete artifact from the shipped captures. Three configs have
         # never captured on hardware: longseq (every session died first)
-        # and decodeint8 (new in r05).
+        # and decodeint8/decodespec (new in r05).
         n = bench._emit_cached_results("all", "test")
         lines = [json.loads(l)
                  for l in capsys.readouterr().out.strip().splitlines()]
         status = [d for d in lines if d["metric"] == "bench_run_status"]
         cached = [d for d in lines if d["metric"] != "bench_run_status"]
-        assert n == len(cached) >= len(bench.CONFIGS["all"]) - 2
+        assert n == len(cached) >= len(bench.CONFIGS["all"]) - 3
         for d in cached:
             assert d["cached"] is True and d["value"] > 0
         assert len(status) == 1 and status[0]["live"] is False

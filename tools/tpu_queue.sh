@@ -55,6 +55,7 @@ echo "rc=$? (sparse_profile)" >&2
 run spmm 900
 run decode 900
 run decodeint8 900
+run decodespec 900
 run svd 900
 run lu 1800
 run inverse 900
