@@ -811,10 +811,39 @@ def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
     return toks
 
 
+def _spec_emit(lp, drafts, key):
+    """The speculative-sampling acceptance kernel, pure for testability:
+    ``lp`` (C, V) target log-probs at the chunk's positions, ``drafts``
+    (C-1,) the deterministic prompt-lookup draft chain. Returns
+    ``(emit (C,), m)`` where positions 0..m-1 emit accepted drafts,
+    position m emits the rejection resample (or, when every draft was
+    accepted, a fresh bonus sample from the last position) — m + 1 tokens
+    total. Delta-draft speculative sampling: accept draft d w.p. p(d);
+    on rejection resample from p with d excluded (renormalized) — each
+    position's marginal, conditioned on the chain reaching it, is exactly
+    p, so the output distribution equals plain sampling's."""
+    c = lp.shape[0]
+    ku, kr, kb = jax.random.split(key, 3)
+    idx = jnp.arange(c - 1)
+    p_draft = jnp.exp(lp[idx, drafts])
+    accept = jax.random.uniform(ku, (c - 1,)) < p_draft
+    m = jnp.where(jnp.all(accept), c - 1,
+                  jnp.argmin(accept).astype(jnp.int32))
+    excl = lp[:-1].at[idx, drafts].set(-jnp.inf)
+    resamp = jax.random.categorical(kr, excl, axis=-1).astype(drafts.dtype)
+    bonus = jax.random.categorical(kb, lp[-1]).astype(drafts.dtype)
+    emit = jnp.concatenate(
+        [jnp.where(idx == m, resamp, drafts), bonus[None]])
+    return emit, m
+
+
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "draft_len", "ngram"))
-def _speculative_loop(params, buf, filled0, cache, cfg: TransformerConfig,
-                      steps: int, draft_len: int, ngram: int):
+    jax.jit,
+    static_argnames=("cfg", "steps", "draft_len", "ngram", "temperature"))
+def _speculative_loop(params, buf, filled0, cache, key,
+                      cfg: TransformerConfig,
+                      steps: int, draft_len: int, ngram: int,
+                      temperature: float):
     """The jitted prompt-lookup speculation loop (ONE dispatch for the
     whole generation — a host loop would pay a tunnel RTT per chunk and
     hand back most of the win). ``buf`` holds prompt + generated tokens;
@@ -829,7 +858,7 @@ def _speculative_loop(params, buf, filled0, cache, cfg: TransformerConfig,
     n_win = total - ngram + 1
 
     def body(carry):
-        buf, filled, cache = carry
+        buf, filled, cache, key = carry
         gram = jax.lax.dynamic_slice(buf, (filled - ngram,), (ngram,))
         # Freshest prior occurrence of the gram, entirely inside the
         # filled region (static shifted slices of the live buf).
@@ -847,28 +876,34 @@ def _speculative_loop(params, buf, filled0, cache, cfg: TransformerConfig,
         chunk = jnp.concatenate([last[None], draft])  # (C,)
         logits, cache = decode_chunk(params, cache, chunk[None],
                                      filled - 1, cfg)
-        pred = jnp.argmax(
-            logits[0].astype(jnp.float32), axis=-1).astype(buf.dtype)
-        agree = pred[:-1] == chunk[1:]
-        m = jnp.where(jnp.all(agree), draft_len - 1,
-                      jnp.argmin(agree).astype(jnp.int32))
-        buf = jax.lax.dynamic_update_slice(buf, pred, (filled,))
-        return buf, filled + m + 1, cache
+        lf = logits[0].astype(jnp.float32)
+        if temperature > 0.0:
+            key, ks = jax.random.split(key)
+            lp = jax.nn.log_softmax(lf / temperature, axis=-1)
+            emit, m = _spec_emit(lp, chunk[1:], ks)
+        else:
+            emit = jnp.argmax(lf, axis=-1).astype(buf.dtype)
+            agree = emit[:-1] == chunk[1:]
+            m = jnp.where(jnp.all(agree), draft_len - 1,
+                          jnp.argmin(agree).astype(jnp.int32))
+        buf = jax.lax.dynamic_update_slice(buf, emit, (filled,))
+        return buf, filled + m + 1, cache, key
 
     def cond(carry):
-        _, filled, _ = carry
+        _, filled, _, _ = carry
         # filled0 = prompt + 1 (the prefill's token is already in buf), so
         # the output needs filled >= prompt + steps = filled0 + steps - 1
         # — not + steps, which would burn one discarded verify chunk.
         return filled < filled0 + steps - 1
 
-    buf, _, _ = jax.lax.while_loop(cond, body, (buf, filled0, cache))
+    buf, _, _, _ = jax.lax.while_loop(cond, body, (buf, filled0, cache, key))
     return buf
 
 
 def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
-                         draft_len: int = 8, ngram: int = 2):
-    """Greedy generation with prompt-lookup speculative decoding: drafts
+                         draft_len: int = 8, ngram: int = 2,
+                         temperature: float = 0.0, seed: int = 0):
+    """Generation with prompt-lookup speculative decoding: drafts
     come from the sequence's OWN history (the freshest prior occurrence of
     the last ``ngram`` tokens proposes the ``draft_len - 1`` tokens that
     followed it), verified in one multi-position :func:`decode_chunk` per
@@ -884,10 +919,20 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
     random tokens accept ~0 and degrade gracefully toward plain decode
     minus the (draft_len-fold smaller) chunk overhead.
 
+    With ``temperature > 0`` the draft chain runs delta-draft speculative
+    SAMPLING (:func:`_spec_emit`): accept draft d w.p. p(d), on rejection
+    resample from p with d excluded — each emitted token's marginal is
+    exactly the plain sampling distribution (the kernel carries a
+    distributional unit test), so speculation again changes only the
+    schedule. Acceptance rates are lower than greedy's (a draft must win
+    the sampling draw, not just the argmax), so the speedup shrinks with
+    temperature — the honest physics of speculative sampling.
+
     Contract: batch 1 (speculation is a latency optimization — per-seq
-    acceptance counts would desynchronize a batch), greedy only, dense
-    cache (``cfg.window == 0``; see decode_chunk on why a ring can't
-    absorb rejected drafts), ``prompt + steps + draft_len <= max_len``,
+    acceptance counts would desynchronize a batch), temperature only (no
+    top-k/top-p truncation on this path — use ``generate``), dense cache
+    (``cfg.window == 0``; see decode_chunk on why a ring can't absorb
+    rejected drafts), ``prompt + steps + draft_len <= max_len``,
     ``prompt >= ngram``. No reference counterpart (Marlin has no
     inference); beyond-parity axis next to the int8 streaming stack."""
     b, s = prompt.shape
@@ -913,11 +958,15 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
             f"max_len {cfg.max_len} (the last chunk writes draft_len "
             "cache slots past the final emitted position)")
     logits, cache = _prefill_jit(params, prompt, cfg=cfg)
-    first = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    # First token through the same sampler plain generate uses, so the
+    # whole output sequence shares one distributional contract.
+    first = _sample_jit(logits, float(temperature), k0, top_k=0, top_p=0.0)
     buf = jnp.zeros((s + steps + draft_len,), jnp.int32)
     buf = buf.at[:s].set(prompt[0]).at[s].set(first[0])
-    buf = _speculative_loop(params, buf, s + 1, cache, cfg, steps,
-                            draft_len, ngram)
+    buf = _speculative_loop(params, buf, s + 1, cache, key, cfg, steps,
+                            draft_len, ngram, float(temperature))
     return buf[None, s:s + steps]
 
 
