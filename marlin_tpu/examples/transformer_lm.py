@@ -7,7 +7,7 @@ step throughput.
 
 Usage:
   python -m marlin_tpu.examples.transformer_lm [steps] [batch] [seq] [d_model]
-                                               [dtype] [--int8]
+                                               [dtype] [--int8] [--spec]
 
 ``dtype`` (default float32) is the compute dtype — pass bfloat16 for the
 mixed-precision mode the TPU benches run (f32 master params, bf16
@@ -18,6 +18,9 @@ After training, generates a short continuation with the KV-cache decode path
 With ``--int8`` the serving half runs the full int8 streaming stack
 (models/quant.py weight-only int8 + int8 KV cache): train on the float
 masters, quantize once, decode at ~a quarter of the f32 HBM traffic.
+With ``--spec`` it decodes via prompt-lookup speculation
+(generate_speculative) and reports both rates — output is identical to
+plain greedy by construction.
 """
 
 from __future__ import annotations
@@ -33,7 +36,8 @@ import numpy as np
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     int8 = "--int8" in argv
-    argv = [a for a in argv if a != "--int8"]
+    spec = "--spec" in argv
+    argv = [a for a in argv if a not in ("--int8", "--spec")]
     steps = int(argv[0]) if len(argv) > 0 else 20
     batch = int(argv[1]) if len(argv) > 1 else 8
     seq = int(argv[2]) if len(argv) > 2 else 64
@@ -99,6 +103,19 @@ def main(argv=None) -> int:
         f"greedy decode {gen_steps} tokens ({label}): "
         f"{dt_gen * 1e3:.2f} ms/token -> {out[0].tolist()}"
     )
+    if spec:
+        from marlin_tpu.models import generate_speculative
+
+        draft = min(4, cfg.max_len - prompt_len - gen_steps)
+        if draft >= 2 and prompt_len >= 2:  # spec needs prompt >= ngram
+            t0 = time.perf_counter()
+            sp = np.asarray(generate_speculative(
+                params, prompt, gen_steps, cfg, draft_len=draft))
+            dt_sp = (time.perf_counter() - t0) / gen_steps
+            print(f"speculative decode (draft_len={draft}): "
+                  f"{dt_sp * 1e3:.2f} ms/token -> {sp[0].tolist()}")
+        else:
+            print("sequence too short for a speculative demo; skipping")
     return 0 if np.isfinite(float(loss)) and out.shape == (1, gen_steps) else 1
 
 
