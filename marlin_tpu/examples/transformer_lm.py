@@ -19,8 +19,9 @@ With ``--int8`` the serving half runs the full int8 streaming stack
 (models/quant.py weight-only int8 + int8 KV cache): train on the float
 masters, quantize once, decode at ~a quarter of the f32 HBM traffic.
 With ``--spec`` it decodes via prompt-lookup speculation
-(generate_speculative) and reports both rates — output is identical to
-plain greedy by construction.
+(generate_speculative) and reports both rates — output matches plain
+greedy whenever the argmax is roundoff-stable (bfloat16 logits can
+near-tie; see generate_speculative's contract).
 """
 
 from __future__ import annotations
@@ -108,6 +109,10 @@ def main(argv=None) -> int:
 
         draft = min(4, cfg.max_len - prompt_len - gen_steps)
         if draft >= 2 and prompt_len >= 2:  # spec needs prompt >= ngram
+            # Warmup: compile the prefill + chunked while_loop untimed
+            # (same discipline as the training loop above), then time.
+            generate_speculative(params, prompt, gen_steps, cfg,
+                                 draft_len=draft)
             t0 = time.perf_counter()
             sp = np.asarray(generate_speculative(
                 params, prompt, gen_steps, cfg, draft_len=draft))

@@ -342,7 +342,7 @@ def _embed_prefix(params, tokens, cfg: TransformerConfig):
     x = _embed_rows(params, tokens, cfg.compute_dtype)
     if not cfg.rope:
         x = x + params["pos"][None, : tokens.shape[1], :].astype(x.dtype)
-    return x.astype(cfg.compute_dtype)
+    return x
 
 
 def _map_seqs(fn, x, cfg: TransformerConfig):
@@ -581,6 +581,29 @@ def _check_cache(cache, cfg: TransformerConfig, expect_len: int):
             "init_kv_cache(cfg, ...) from the SAME config")
 
 
+def _put_kv(layer, k, v, put, quant: bool):
+    """Write new K/V into a cache layer through ``put`` (the caller's
+    slice-update), quantizing per vector first when the cache is int8 —
+    the one write path decode_step and decode_chunk share."""
+    if quant:
+        from .quant import kv_quantize
+
+        kq, ks = kv_quantize(k)
+        vq, vs = kv_quantize(v)
+        return {"k": put(layer["k"], kq), "v": put(layer["v"], vq),
+                "ks": put(layer["ks"], ks), "vs": put(layer["vs"], vs)}
+    return {"k": put(layer["k"], k), "v": put(layer["v"], v)}
+
+
+def _scale_args(layer, quant: bool, axes=0):
+    """(extra vmap operands, extra in_axes) for _attend_cached's optional
+    int8-cache scales; decode_chunk maps its scales through a closure and
+    only uses the operands half."""
+    if quant:
+        return (layer["ks"], layer["vs"]), (axes, axes)
+    return (), ()
+
+
 def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     """One decode step: tokens (B,) int32 at position ``pos`` -> (logits
     (B, vocab), updated cache). Without a window, writes each layer's K/V
@@ -590,12 +613,12 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     x = _embed_rows(params, tokens, cfg.compute_dtype)  # (B, D)
     if not cfg.rope:
         x = x + params["pos"][pos].astype(x.dtype)
-    x = x.astype(cfg.compute_dtype)
     positions = (
         jnp.full((x.shape[0],), pos, jnp.int32) if cfg.rope else None
     )
     expect_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
     _check_cache(cache, cfg, expect_len=expect_len)
+    quant = bool(cfg.kv_quant)
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
         q, k, v = _split_qkv(bp, x, cfg, positions=positions)
@@ -605,27 +628,13 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
             return jax.lax.dynamic_update_slice_in_dim(
                 buf, val[:, None].astype(buf.dtype), slot, axis=1)
 
-        if cfg.kv_quant:
-            from .quant import kv_quantize
-
-            kq, ksc = kv_quantize(k)
-            vq, vsc = kv_quantize(v)
-            layer = {"k": put(layer["k"], kq), "v": put(layer["v"], vq),
-                     "ks": put(layer["ks"], ksc),
-                     "vs": put(layer["vs"], vsc)}
-            att = jax.vmap(
-                functools.partial(_attend_cached, window=cfg.window),
-                in_axes=(0, 0, 0, None, 0, 0),
-            )(q, layer["k"], layer["v"], pos, layer["ks"], layer["vs"])
-            new_cache.append(layer)
-        else:
-            ck = put(layer["k"], k)
-            cv = put(layer["v"], v)
-            att = jax.vmap(
-                functools.partial(_attend_cached, window=cfg.window),
-                in_axes=(0, 0, 0, None),
-            )(q, ck, cv, pos)
-            new_cache.append({"k": ck, "v": cv})
+        layer = _put_kv(layer, k, v, put, quant)
+        extra, extra_axes = _scale_args(layer, quant, 0)
+        att = jax.vmap(
+            functools.partial(_attend_cached, window=cfg.window),
+            in_axes=(0, 0, 0, None) + extra_axes,
+        )(q, layer["k"], layer["v"], pos, *extra)
+        new_cache.append(layer)
         x = _mlp_residual(
             bp, x + att.reshape(x.shape) @ _deq(bp["wo"], x.dtype), cfg)
     x = _layer_norm(params["ln_f"], x)
@@ -665,10 +674,10 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
     if not cfg.rope:
         x = x + jax.lax.dynamic_slice_in_dim(
             params["pos"], pos, c, axis=0).astype(x.dtype)[None]
-    x = x.astype(cfg.compute_dtype)
     positions = jnp.tile(chunk_pos, b) if cfg.rope else None
     _check_cache(cache, cfg, expect_len=cfg.max_len)
-    hk, dh = cache[0]["k"].shape[2], cache[0]["k"].shape[3]
+    hk, dh = cache[0]["k"].shape[2:]
+    quant = bool(cfg.kv_quant)
     new_cache = []
     for bp, layer in zip(params["blocks"], cache):
         q, k, v = _split_qkv(bp, x.reshape(b * c, -1), cfg,
@@ -681,26 +690,17 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
             return jax.lax.dynamic_update_slice_in_dim(
                 buf, val.astype(buf.dtype), pos, axis=1)
 
-        if cfg.kv_quant:
-            from .quant import kv_quantize
+        layer = _put_kv(layer, k, v, put, quant)
+        extra, _ = _scale_args(layer, quant)
 
-            kq, ksc = kv_quantize(k)
-            vq, vsc = kv_quantize(v)
-            layer = {"k": put(layer["k"], kq), "v": put(layer["v"], vq),
-                     "ks": put(layer["ks"], ksc),
-                     "vs": put(layer["vs"], vsc)}
-            att = jax.vmap(lambda qb, ckb, cvb, ksb, vsb: jax.vmap(
-                lambda qc, pc: _attend_cached(qc, ckb, cvb, pc, ksb, vsb)
-            )(qb, chunk_pos))(q, layer["k"], layer["v"], layer["ks"],
-                              layer["vs"])
-            new_cache.append(layer)
-        else:
-            ck = put(layer["k"], k)
-            cv = put(layer["v"], v)
-            att = jax.vmap(lambda qb, ckb, cvb: jax.vmap(
-                lambda qc, pc: _attend_cached(qc, ckb, cvb, pc)
-            )(qb, chunk_pos))(q, ck, cv)
-            new_cache.append({"k": ck, "v": cv})
+        def att_one(qb, ckb, cvb, *scales):
+            # Inner vmap: each chunk position against its own prefix mask.
+            return jax.vmap(
+                lambda qc, pc: _attend_cached(qc, ckb, cvb, pc, *scales)
+            )(qb, chunk_pos)
+
+        att = jax.vmap(att_one)(q, layer["k"], layer["v"], *extra)
+        new_cache.append(layer)
         x = _mlp_residual(
             bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
     x = _layer_norm(params["ln_f"], x)
