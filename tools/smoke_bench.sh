@@ -1,0 +1,48 @@
+#!/bin/sh
+# Pre-session queue protection: run EVERY artifact bench config at tiny
+# shapes on the forced CPU mesh, so a code change that crashes a config is
+# caught before it burns a healthy-tunnel capture slot (r05: every config
+# touched that round was smoked ad hoc like this; this commits the
+# practice). Exit nonzero if any config emits an error line or dies.
+#
+#   sh tools/smoke_bench.sh            # ~10-15 min, all configs
+#   sh tools/smoke_bench.sh decode spmm  # just these
+set -u
+cd "$(dirname "$0")/.." || exit 1
+export PYTHONPATH=$PWD:${PYTHONPATH:-}
+export BENCH_FORCE_CPU=1
+# Tiny shapes for every sized knob the configs read.
+export BENCH_N=512 BENCH_8K_N=512 BENCH_TALL_M=4096 BENCH_CHAIN_N=512
+export BENCH_SUMMA_BASE=512 BENCH_SPARSE_N=1024 BENCH_SPARSE_DIST_N=1024
+export BENCH_SPMM_N=1024
+export BENCH_SPMM_C=128 BENCH_LU_N=512 BENCH_CHOL_N=512 BENCH_INV_N=512
+export BENCH_SVD_M=2048 BENCH_SVD_N=128
+export BENCH_TF_D=64 BENCH_TF_VOCAB=256 BENCH_TF_L=2 BENCH_TF_S=128 \
+       BENCH_TF_B=2
+export BENCH_LS_D=64 BENCH_LS_S=256 BENCH_LS_VOCAB=256 BENCH_LS_L=2
+export BENCH_DEC_D=64 BENCH_DEC_VOCAB=512 BENCH_DEC_L=2 BENCH_DEC_S=128
+export BENCH_SPEC_D=64 BENCH_SPEC_VOCAB=256 BENCH_SPEC_L=2 \
+       BENCH_SPEC_STEPS=48
+# Default list derives from bench.py's registry (a hand list would
+# silently exclude future configs); the SKIP list is the hand-maintained
+# part: attention hardcodes S=8k (interpret-mode CPU = hours; its sweep
+# wiring is unit-tested + has a stubbed-kernel dry-exec), and the sweeps
+# are tuning tools, not artifact configs.
+SKIP="attention sweep attnsweep all"
+CONFIGS=${*:-$(python -c "
+import bench
+skip = set('$SKIP'.split())
+print(' '.join(k for k in bench.CONFIGS if k not in skip))")}
+
+rc=0
+for cfg in $CONFIGS; do
+  echo "=== $cfg ===" >&2
+  out=$(timeout 1200 python bench.py --config "$cfg" 2>"/tmp/smoke_$cfg.err")
+  code=$?
+  printf '%s\n' "$out"
+  if [ $code -ne 0 ] || printf '%s\n' "$out" | grep -q '"unit": "error"'; then
+    echo "SMOKE FAIL: $cfg (rc=$code; stderr in /tmp/smoke_$cfg.err)" >&2
+    rc=1
+  fi
+done
+exit $rc
