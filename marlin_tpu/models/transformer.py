@@ -655,8 +655,11 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
     position and are overwritten before they are ever attendable. That
     self-healing property is exactly what a ring cache lacks (overwritten
     slots held still-live earlier positions), so ``cfg.window`` is
-    unsupported here. Caller contract: pos + C <= cache length (JAX's
-    update-slice clamp would otherwise silently shift the write)."""
+    unsupported here. ``pos`` is a scalar or a per-sequence (B,) vector —
+    the latter is what batched speculation needs, since acceptance counts
+    desynchronize the sequences. Caller contract: pos + C <= cache length
+    per sequence (JAX's update-slice clamp would otherwise silently shift
+    the write)."""
     if cfg.window:
         raise NotImplementedError(
             "decode_chunk needs the dense slot==position cache: a ring "
@@ -670,11 +673,13 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
     params = _cast_params(params, cfg)
     b, c = tokens.shape
     x = _embed_rows(params, tokens, cfg.compute_dtype)  # (B, C, D)
-    chunk_pos = pos + jnp.arange(c, dtype=jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    scalar_pos = pos.ndim == 0  # synchronized batch: cheaper write path
+    pos_b = jnp.broadcast_to(pos, (b,))
+    chunk_pos = pos_b[:, None] + jnp.arange(c, dtype=jnp.int32)  # (B, C)
     if not cfg.rope:
-        x = x + jax.lax.dynamic_slice_in_dim(
-            params["pos"], pos, c, axis=0).astype(x.dtype)[None]
-    positions = jnp.tile(chunk_pos, b) if cfg.rope else None
+        x = x + params["pos"][chunk_pos].astype(x.dtype)
+    positions = chunk_pos.reshape(-1) if cfg.rope else None
     _check_cache(cache, cfg, expect_len=cfg.max_len)
     hk, dh = cache[0]["k"].shape[2:]
     quant = bool(cfg.kv_quant)
@@ -687,19 +692,30 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
         v = v.reshape(b, c, hk, dh)
 
         def put(buf, val):
-            return jax.lax.dynamic_update_slice_in_dim(
-                buf, val.astype(buf.dtype), pos, axis=1)
+            if scalar_pos:
+                # Synchronized batch: one contiguous slice update (the
+                # vmapped form lowers to a scatter — the same trade the
+                # prefill comment documents as markedly slower on TPU).
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, val.astype(buf.dtype), pos, axis=1)
+            # Per-sequence write offsets: each sequence's chunk lands at
+            # its own position (they desynchronize under speculation).
+            return jax.vmap(
+                lambda bb, vv, pp: jax.lax.dynamic_update_slice_in_dim(
+                    bb, vv.astype(bb.dtype), pp, axis=0)
+            )(buf, val, pos_b)
 
         layer = _put_kv(layer, k, v, put, quant)
         extra, _ = _scale_args(layer, quant)
 
-        def att_one(qb, ckb, cvb, *scales):
+        def att_one(qb, ckb, cvb, pb, *scales):
             # Inner vmap: each chunk position against its own prefix mask.
             return jax.vmap(
                 lambda qc, pc: _attend_cached(qc, ckb, cvb, pc, *scales)
-            )(qb, chunk_pos)
+            )(qb, pb)
 
-        att = jax.vmap(att_one)(q, layer["k"], layer["v"], *extra)
+        att = jax.vmap(att_one)(q, layer["k"], layer["v"], chunk_pos,
+                                *extra)
         new_cache.append(layer)
         x = _mlp_residual(
             bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
@@ -854,49 +870,62 @@ def _speculative_loop(params, buf, filled0, cache, key,
     chunk predictions into buf — positions beyond the accepted count are
     overwritten by later iterations before anything reads them (the draft
     lookup masks candidates past ``filled``)."""
-    total = buf.shape[0]
+    bsz, total = buf.shape
     n_win = total - ngram + 1
+    # filled0 = prompt + 1 (the prefill's token is already in buf), so the
+    # output needs filled >= prompt + steps = filled0 + steps - 1 — not
+    # + steps, which would burn one discarded verify chunk. Sequences are
+    # CLAMPED at the target once done: the batch keeps iterating until the
+    # slowest sequence finishes, and a finished sequence just rewrites its
+    # final cache slots / buffer padding harmlessly.
+    target = filled0 + steps - 1
 
     def body(carry):
         buf, filled, cache, key = carry
-        gram = jax.lax.dynamic_slice(buf, (filled - ngram,), (ngram,))
-        # Freshest prior occurrence of the gram, entirely inside the
-        # filled region (static shifted slices of the live buf).
+        brange = jnp.arange(bsz)
+        gram = jax.vmap(
+            lambda bb, f: jax.lax.dynamic_slice(bb, (f - ngram,), (ngram,))
+        )(buf, filled)  # (B, ngram)
+        # Freshest prior occurrence of each sequence's gram, entirely
+        # inside its filled region (static shifted slices of the live buf).
         win = jnp.stack(
-            [buf[i:n_win + i] for i in range(ngram)], axis=1)
-        match = jnp.all(win == gram[None, :], axis=1)
+            [buf[:, i:n_win + i] for i in range(ngram)], axis=2)
+        match = jnp.all(win == gram[:, None, :], axis=2)  # (B, n_win)
         jidx = jnp.arange(n_win, dtype=jnp.int32)
-        valid = match & (jidx < filled - ngram)
-        j_star = jnp.max(jnp.where(valid, jidx, -1))
+        valid = match & (jidx[None] < (filled - ngram)[:, None])
+        j_star = jnp.max(jnp.where(valid, jidx[None], -1), axis=1)  # (B,)
         src = jnp.maximum(j_star, 0) + ngram
-        draft = jax.lax.dynamic_slice(buf, (src,), (draft_len - 1,))
-        last = buf[filled - 1]
-        draft = jnp.where(j_star >= 0, draft,
-                          jnp.full((draft_len - 1,), last, buf.dtype))
-        chunk = jnp.concatenate([last[None], draft])  # (C,)
-        logits, cache = decode_chunk(params, cache, chunk[None],
-                                     filled - 1, cfg)
-        lf = logits[0].astype(jnp.float32)
+        draft = jax.vmap(
+            lambda bb, sp: jax.lax.dynamic_slice(bb, (sp,),
+                                                 (draft_len - 1,))
+        )(buf, src)  # (B, C-1)
+        last = buf[brange, filled - 1]  # (B,)
+        draft = jnp.where((j_star >= 0)[:, None], draft,
+                          jnp.broadcast_to(last[:, None], draft.shape))
+        chunk = jnp.concatenate([last[:, None], draft], axis=1)  # (B, C)
+        logits, cache = decode_chunk(params, cache, chunk, filled - 1, cfg)
+        lf = logits.astype(jnp.float32)  # (B, C, V)
         if temperature > 0.0:
             key, ks = jax.random.split(key)
             lp = jax.nn.log_softmax(lf / temperature, axis=-1)
-            emit, m = _spec_emit(lp, chunk[1:], ks)
+            emit, m = jax.vmap(_spec_emit)(
+                lp, chunk[:, 1:], jax.random.split(ks, bsz))
         else:
-            emit = jnp.argmax(lf, axis=-1).astype(buf.dtype)
-            agree = emit[:-1] == chunk[1:]
-            m = jnp.where(jnp.all(agree), draft_len - 1,
-                          jnp.argmin(agree).astype(jnp.int32))
-        buf = jax.lax.dynamic_update_slice(buf, emit, (filled,))
-        return buf, filled + m + 1, cache, key
+            emit = jnp.argmax(lf, axis=-1).astype(buf.dtype)  # (B, C)
+            agree = emit[:, :-1] == chunk[:, 1:]
+            m = jnp.where(jnp.all(agree, axis=1), draft_len - 1,
+                          jnp.argmin(agree, axis=1).astype(jnp.int32))
+        buf = jax.vmap(
+            lambda bb, ee, f: jax.lax.dynamic_update_slice(bb, ee, (f,))
+        )(buf, emit, filled)
+        return buf, jnp.minimum(filled + m + 1, target), cache, key
 
     def cond(carry):
         _, filled, _, _ = carry
-        # filled0 = prompt + 1 (the prefill's token is already in buf), so
-        # the output needs filled >= prompt + steps = filled0 + steps - 1
-        # — not + steps, which would burn one discarded verify chunk.
-        return filled < filled0 + steps - 1
+        return jnp.any(filled < target)
 
-    buf, _, _, _ = jax.lax.while_loop(cond, body, (buf, filled0, cache, key))
+    filled = jnp.full((bsz,), filled0, jnp.int32)
+    buf, _, _, _ = jax.lax.while_loop(cond, body, (buf, filled, cache, key))
     return buf
 
 
@@ -928,19 +957,20 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
     the sampling draw, not just the argmax), so the speedup shrinks with
     temperature — the honest physics of speculative sampling.
 
-    Contract: batch 1 (speculation is a latency optimization — per-seq
-    acceptance counts would desynchronize a batch), temperature only (no
-    top-k/top-p truncation on this path — use ``generate``), dense cache
-    (``cfg.window == 0``; see decode_chunk on why a ring can't absorb
-    rejected drafts), ``prompt + steps + draft_len <= max_len``,
-    ``prompt >= ngram``. No reference counterpart (Marlin has no
-    inference); beyond-parity axis next to the int8 streaming stack."""
+    Batched prompts are supported: each sequence drafts from its own
+    history and advances at its own acceptance rate (decode_chunk takes
+    per-sequence positions), the batch iterating until the slowest
+    sequence finishes — so a batch's wall-clock is set by its least
+    repetitive member, and latency-sensitive serving should still prefer
+    B=1.
+
+    Contract: temperature only (no top-k/top-p truncation on this path —
+    use ``generate``), dense cache (``cfg.window == 0``; see decode_chunk
+    on why a ring can't absorb rejected drafts),
+    ``prompt + steps + draft_len <= max_len``, ``prompt >= ngram``. No
+    reference counterpart (Marlin has no inference); beyond-parity axis
+    next to the int8 streaming stack."""
     b, s = prompt.shape
-    if b != 1:
-        raise ValueError(
-            f"speculative decoding is single-sequence (got batch {b}): "
-            "per-sequence acceptance would desynchronize a batch — use "
-            "generate() for batched throughput")
     if cfg.window:
         raise NotImplementedError(
             "speculative decoding needs the dense cache (cfg.window == 0)")
@@ -963,11 +993,11 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
     # First token through the same sampler plain generate uses, so the
     # whole output sequence shares one distributional contract.
     first = _sample_jit(logits, float(temperature), k0, top_k=0, top_p=0.0)
-    buf = jnp.zeros((s + steps + draft_len,), jnp.int32)
-    buf = buf.at[:s].set(prompt[0]).at[s].set(first[0])
+    buf = jnp.zeros((b, s + steps + draft_len), jnp.int32)
+    buf = buf.at[:, :s].set(prompt).at[:, s].set(first)
     buf = _speculative_loop(params, buf, s + 1, cache, key, cfg, steps,
                             draft_len, ngram, float(temperature))
-    return buf[None, s:s + steps]
+    return buf[:, s:s + steps]
 
 
 def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):

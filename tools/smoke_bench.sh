@@ -33,6 +33,12 @@ CONFIGS=${*:-$(python -c "
 import bench
 skip = set('$SKIP'.split())
 print(' '.join(k for k in bench.CONFIGS if k not in skip))")}
+if [ -z "$CONFIGS" ]; then
+  # An import-time error in bench.py is exactly the breakage class this
+  # script exists to catch — an empty list must FAIL, not silently pass.
+  echo "SMOKE FAIL: could not derive config list (bench.py import broken?)" >&2
+  exit 1
+fi
 
 rc=0
 for cfg in $CONFIGS; do
