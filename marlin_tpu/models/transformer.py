@@ -903,7 +903,12 @@ def _speculative_loop(params, buf, filled0, cache, key,
         draft = jnp.where((j_star >= 0)[:, None], draft,
                           jnp.broadcast_to(last[:, None], draft.shape))
         chunk = jnp.concatenate([last[:, None], draft], axis=1)  # (B, C)
-        logits, cache = decode_chunk(params, cache, chunk, filled - 1, cfg)
+        # bsz is static: a single sequence passes a scalar pos so
+        # decode_chunk keeps the contiguous KV-write fast path (the
+        # vmapped per-sequence form lowers to a scatter) — B=1 is the
+        # latency case the docstring tells serving to prefer.
+        pos_arg = (filled - 1)[0] if bsz == 1 else filled - 1
+        logits, cache = decode_chunk(params, cache, chunk, pos_arg, cfg)
         lf = logits.astype(jnp.float32)  # (B, C, V)
         if temperature > 0.0:
             key, ks = jax.random.split(key)
