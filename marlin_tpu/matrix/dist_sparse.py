@@ -73,10 +73,9 @@ from ..config import get_config
 from ..mesh import default_mesh
 from .sparse import CoordinateMatrix
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ..utils.jax_compat import pvary as _pvary, shard_map_compat
+
+_shard_map = shard_map_compat()  # check_rep off on pre-pvary jax
 
 _ENTRY_CHUNK = 128  # storage-cap quantum for the padded (n_dev, cap) triples
 # Auto-dispatch budget for the DENSE fast path: when the densified
@@ -128,15 +127,6 @@ def _pad_triples_to_chunk(a_r, a_c, a_v, chunk: int):
                 constant_values=jnp.iinfo(jnp.int32).max),
         jnp.pad(a_v, (0, short)),
     )
-
-
-def _pvary(x: jax.Array, axes) -> jax.Array:
-    """jax.lax.pvary compat: pcast(..., to='varying') on jax >= 0.9 — marks a
-    freshly created carry as device-varying so shard_map's vma check accepts
-    the fori_loop."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)  # pragma: no cover
 
 
 def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:

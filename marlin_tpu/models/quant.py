@@ -69,23 +69,45 @@ def kv_quantize(x: jax.Array, axis: int = -1):
     return q, s
 
 
-def quantize_params_int8(params) -> dict:
+def quantize_params_int8(params, donate: bool = False) -> dict:
     """Float master pytree (init_params) -> decode pytree where the embed
     table and each block's dense 2-D weights are {"q8", "s8"} pairs.
-    Idempotent on already-quantized input."""
+    Idempotent on already-quantized input.
+
+    ``donate=True`` CONSUMES the float masters: each quantized weight's
+    source buffer is deleted as soon as its int8 replacement is
+    materialized, so peak memory during quantization is masters + one
+    weight's int8 copy instead of masters + the whole int8 set — the
+    serving-side analogue of the decode loop's donated cache
+    (docs/decode_serving.md). The caller's ``params`` pytree is left
+    holding deleted arrays for the quantized leaves; keep the default for
+    any flow (training, parity oracles) that reads the masters again.
+    Buffer donation across a dtype change has no input->output alias for
+    XLA, so this is explicit block+delete rather than jit donate_argnums —
+    the decode entry points' donation covers the int8 cache and buffers."""
     if is_quantized(params):
         return params
+
+    def quant_leaf(w, axis):
+        q = _quant(w, axis=axis)
+        if donate:
+            # Block first: deleting a buffer a queued computation still
+            # reads is unsafe under async dispatch.
+            jax.block_until_ready((q["q8"], q["s8"]))
+            w.delete()
+        return q
+
     out = dict(params)
     # Embed: per-ROW scale — the row scalar serves the token gather, and
     # s8[:, 0] is the readout's per-vocab-column post-matmul scale.
-    out["embed"] = _quant(params["embed"], axis=1)
+    out["embed"] = quant_leaf(params["embed"], axis=1)
     blocks = []
     for bp in params["blocks"]:
         nb = dict(bp)
         for name in _BLOCK_WEIGHTS:
             w = bp.get(name)
             if w is not None and w.ndim == 2:  # MoE banks (3-D) stay float
-                nb[name] = _quant(w, axis=0)
+                nb[name] = quant_leaf(w, axis=0)
         blocks.append(nb)
     out["blocks"] = blocks
     return out

@@ -807,24 +807,71 @@ _sample_jit = functools.partial(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
+                     "eos_id"),
+    donate_argnums=(3,),
 )
 def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
-                 steps: int, temperature: float, top_k: int, top_p: float):
+                 steps: int, temperature: float, top_k: int, top_p: float,
+                 eos_id: Optional[int] = None, done0=None):
     """The jitted decode loop, module-level so the compile caches across
     ``generate`` calls (a fresh ``jit(lambda)`` per call would recompile the
-    whole scan every time and bake params in as constants)."""
+    whole scan every time and bake params in as constants).
 
-    def step(carry, _):
-        tok, pos, cache, key = carry
+    Returns ``(toks (steps, B), final cache)``. The ``cache`` argument is
+    DONATED: returning the final cache gives XLA an input->output alias, so
+    the prefill cache buffers are updated in place across the dispatch
+    boundary instead of copied once per ``generate`` call — the caller must
+    treat the passed-in cache as consumed (``generate`` discards both).
+
+    ``eos_id`` (static) switches the fixed-length ``lax.scan`` for an
+    early-exiting ``lax.while_loop``: a sequence that emits ``eos_id`` is
+    FROZEN — its later output positions are ``eos_id`` padding and its
+    sampled continuations are masked — and the whole dispatch stops as soon
+    as every sequence has finished, so a batch's wall-clock tracks its
+    slowest member rather than the static ``steps`` bound. Per-row
+    independence of decode_step/_sample makes live sequences bit-exact with
+    the scan path (docs/decode_serving.md). ``done0`` optionally marks
+    sequences finished at entry (defaults to ``first == eos_id``); the
+    trend-sweep harness uses it to measure the finished-fraction axis."""
+
+    if eos_id is None:
+        def step(carry, _):
+            tok, pos, cache, key = carry
+            key, ks = jax.random.split(key)
+            logits, cache = decode_step(params, cache, tok, pos, cfg)
+            nxt = _sample(logits, temperature, ks, top_k, top_p)
+            return (nxt, pos + 1, cache, key), tok
+
+        (_, _, cache, _), toks = jax.lax.scan(
+            step, (first, pos0, cache, key), None, length=steps)
+        return toks, cache
+
+    bsz = first.shape[0]
+    out = jnp.full((steps, bsz), jnp.int32(eos_id))
+    done = (first == eos_id) if done0 is None else done0
+
+    def cond(carry):
+        i, _, _, _, _, done, _ = carry
+        return (i < steps) & ~jnp.all(done)
+
+    def body(carry):
+        i, tok, pos, cache, key, done, out = carry
+        out = jax.lax.dynamic_update_slice_in_dim(out, tok[None], i, axis=0)
+        done = done | (tok == eos_id)
         key, ks = jax.random.split(key)
+        # Frozen rows still flow through decode_step (static shapes; their
+        # rows are independent and their logits/cache slots are dead state,
+        # never read by a live row) — the win is the loop exit above, not
+        # per-row elision.
         logits, cache = decode_step(params, cache, tok, pos, cfg)
         nxt = _sample(logits, temperature, ks, top_k, top_p)
-        return (nxt, pos + 1, cache, key), tok
+        nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+        return i + 1, nxt, pos + 1, cache, key, done, out
 
-    _, toks = jax.lax.scan(
-        step, (first, pos0, cache, key), None, length=steps)
-    return toks
+    _, _, _, cache, _, _, out = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), first, pos0, cache, key, done, out))
+    return out, cache
 
 
 def _spec_emit(lp, drafts, key):
@@ -855,7 +902,8 @@ def _spec_emit(lp, drafts, key):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "draft_len", "ngram", "temperature"))
+    static_argnames=("cfg", "steps", "draft_len", "ngram", "temperature"),
+    donate_argnums=(1, 3))
 def _speculative_loop(params, buf, filled0, cache, key,
                       cfg: TransformerConfig,
                       steps: int, draft_len: int, ngram: int,
@@ -869,19 +917,39 @@ def _speculative_loop(params, buf, filled0, cache, key,
     longest agreeing prefix plus the model's correction, and writes ALL
     chunk predictions into buf — positions beyond the accepted count are
     overwritten by later iterations before anything reads them (the draft
-    lookup masks candidates past ``filled``)."""
+    lookup masks candidates past ``filled``).
+
+    Returns ``(buf, verify_chunks (B,) int32, iterations scalar, final
+    cache)``. ``buf`` and ``cache`` are DONATED (aliased to the returned
+    buffers): the token
+    buffer and every KV layer are updated in place across the dispatch
+    instead of copied — callers must not reuse the arrays they passed in.
+
+    FINISHED sequences are FROZEN: once a sequence's ``filled`` reaches the
+    target its drafts are masked to repeat its last accepted token (a
+    constant chunk instead of a fresh history lookup) and its
+    ``verify_chunks`` counter stops — the counter bills verify work to live
+    sequences only, so batch skew is measurable (a member that finishes in
+    3 chunks reports 3, not the slowest member's count). The frozen rows
+    still ride through decode_chunk (static shapes; rows are independent,
+    so live rows stay bit-exact vs the unfrozen path) and their writes land
+    only in dead state: buf slots >= target (the padding tail) and cache
+    slots >= target - 1, both beyond what any live read reaches. The
+    remaining per-iteration cost is therefore the dense chunk's FLOPs —
+    the loop's WALL-CLOCK already tracks only the slowest member (the
+    while_loop exits the moment every sequence finishes); see
+    docs/decode_serving.md for the full cost accounting."""
     bsz, total = buf.shape
     n_win = total - ngram + 1
     # filled0 = prompt + 1 (the prefill's token is already in buf), so the
     # output needs filled >= prompt + steps = filled0 + steps - 1 — not
     # + steps, which would burn one discarded verify chunk. Sequences are
-    # CLAMPED at the target once done: the batch keeps iterating until the
-    # slowest sequence finishes, and a finished sequence just rewrites its
-    # final cache slots / buffer padding harmlessly.
+    # CLAMPED at the target once done and frozen (see docstring).
     target = filled0 + steps - 1
 
     def body(carry):
-        buf, filled, cache, key = carry
+        buf, filled, cache, key, vsteps, iters = carry
+        fin = filled >= target  # frozen: emitted everything already
         brange = jnp.arange(bsz)
         gram = jax.vmap(
             lambda bb, f: jax.lax.dynamic_slice(bb, (f - ngram,), (ngram,))
@@ -900,7 +968,9 @@ def _speculative_loop(params, buf, filled0, cache, key,
                                                  (draft_len - 1,))
         )(buf, src)  # (B, C-1)
         last = buf[brange, filled - 1]  # (B,)
-        draft = jnp.where((j_star >= 0)[:, None], draft,
+        # Frozen sequences draft the constant repeat-last chunk (the same
+        # fallback a failed history lookup uses), never a fresh lookup.
+        draft = jnp.where(((j_star >= 0) & ~fin)[:, None], draft,
                           jnp.broadcast_to(last[:, None], draft.shape))
         chunk = jnp.concatenate([last[:, None], draft], axis=1)  # (B, C)
         # bsz is static: a single sequence passes a scalar pos so
@@ -923,20 +993,28 @@ def _speculative_loop(params, buf, filled0, cache, key,
         buf = jax.vmap(
             lambda bb, ee, f: jax.lax.dynamic_update_slice(bb, ee, (f,))
         )(buf, emit, filled)
-        return buf, jnp.minimum(filled + m + 1, target), cache, key
+        vsteps = vsteps + jnp.where(fin, 0, 1).astype(jnp.int32)
+        return (buf, jnp.minimum(filled + m + 1, target), cache, key,
+                vsteps, iters + 1)
 
     def cond(carry):
-        _, filled, _, _ = carry
+        _, filled, _, _, _, _ = carry
         return jnp.any(filled < target)
 
     filled = jnp.full((bsz,), filled0, jnp.int32)
-    buf, _, _, _ = jax.lax.while_loop(cond, body, (buf, filled, cache, key))
-    return buf
+    vsteps = jnp.zeros((bsz,), jnp.int32)
+    # iters counts loop trips UNCONDITIONALLY — independent of the freeze
+    # accounting, so "the slowest member was live throughout"
+    # (max(vsteps) == iters) is a checkable invariant, not a tautology.
+    buf, _, cache, _, vsteps, iters = jax.lax.while_loop(
+        cond, body, (buf, filled, cache, key, vsteps, jnp.int32(0)))
+    return buf, vsteps, iters, cache
 
 
 def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
                          draft_len: int = 8, ngram: int = 2,
-                         temperature: float = 0.0, seed: int = 0):
+                         temperature: float = 0.0, seed: int = 0,
+                         return_stats: bool = False):
     """Generation with prompt-lookup speculative decoding: drafts
     come from the sequence's OWN history (the freshest prior occurrence of
     the last ``ngram`` tokens proposes the ``draft_len - 1`` tokens that
@@ -967,7 +1045,16 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
     per-sequence positions), the batch iterating until the slowest
     sequence finishes — so a batch's wall-clock is set by its least
     repetitive member, and latency-sensitive serving should still prefer
-    B=1.
+    B=1. Sequences that finish early are FROZEN (see
+    :func:`_speculative_loop`): their drafts repeat the last accepted
+    token, their verify accounting stops, and their remaining writes land
+    only in dead buffer/cache state — skew costs iterations set by the
+    slowest member and nothing else. With ``return_stats=True`` the return
+    becomes ``(tokens, stats)`` where ``stats["verify_chunks"]`` is the
+    per-sequence count of verify chunks run while live (the skew
+    diagnostic: an early finisher's count is its own, not the batch's) and
+    ``stats["iterations"]`` the loop's total iteration count (== the max
+    over members).
 
     Contract: temperature only (no top-k/top-p truncation on this path —
     use ``generate``), dense cache (``cfg.window == 0``; see decode_chunk
@@ -1000,9 +1087,16 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
     first = _sample_jit(logits, float(temperature), k0, top_k=0, top_p=0.0)
     buf = jnp.zeros((b, s + steps + draft_len), jnp.int32)
     buf = buf.at[:, :s].set(prompt).at[:, s].set(first)
-    buf = _speculative_loop(params, buf, s + 1, cache, key, cfg, steps,
-                            draft_len, ngram, float(temperature))
-    return buf[:, s:s + steps]
+    # buf and cache are donated into the loop (updated in place and
+    # returned aliased); neither is touched again here except through the
+    # returned arrays.
+    buf, vsteps, iters, _ = _speculative_loop(params, buf, s + 1, cache,
+                                              key, cfg, steps, draft_len,
+                                              ngram, float(temperature))
+    toks = buf[:, s:s + steps]
+    if return_stats:
+        return toks, {"verify_chunks": vsteps, "iterations": iters}
+    return toks
 
 
 def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):
@@ -1075,13 +1169,23 @@ def shard_params(params, cfg: TransformerConfig, mesh=None, axis: str = "mc"):
 
 def generate(params, prompt, steps: int, cfg: TransformerConfig,
              temperature: float = 0.0, seed: int = 0,
-             top_k: int = 0, top_p: float = 0.0):
+             top_k: int = 0, top_p: float = 0.0,
+             eos_id: Optional[int] = None):
     """Autoregressive generation: prompt (B, S) int32 -> (B, steps) int32.
 
     Prefill primes the cache in one forward; the decode loop is a single
     jitted ``lax.scan`` dispatch (temperature 0 = greedy, else categorical
     sampling, optionally truncated to the ``top_k`` most likely tokens
-    and/or the ``top_p`` nucleus). S + steps must fit ``cfg.max_len``.
+    and/or the ``top_p`` nucleus). S + steps must fit ``cfg.max_len``. The
+    prefill cache is handed to the decode loop DONATED: the loop updates
+    the very buffers prefill wrote (no per-call cache copy) and the cache
+    is dead after — a property the donation regression tests pin.
+
+    With ``eos_id`` set, a sequence that emits it is finished: its later
+    output positions are ``eos_id`` padding, and the decode dispatch exits
+    as soon as EVERY sequence has finished — a skewed batch pays for its
+    slowest member's steps, not the static ``steps`` bound. Tokens before
+    each sequence's eos are bit-identical to the default path's.
 
     Dense configs are oracle-exact against the full ``forward``; with
     ``n_experts`` > 0 the routing batches differ between decode (B
@@ -1097,7 +1201,8 @@ def generate(params, prompt, steps: int, cfg: TransformerConfig,
     key, k0 = jax.random.split(key)
     first = _sample_jit(logits, float(temperature), k0, top_k=int(top_k),
                         top_p=float(top_p))
-    toks = _decode_scan(params, first, jnp.int32(s), cache, key, cfg,
-                        int(steps), float(temperature), int(top_k),
-                        float(top_p))
+    toks, _ = _decode_scan(params, first, jnp.int32(s), cache, key, cfg,
+                           int(steps), float(temperature), int(top_k),
+                           float(top_p),
+                           None if eos_id is None else int(eos_id))
     return jnp.moveaxis(toks, 0, 1)  # (steps, B) -> (B, steps)

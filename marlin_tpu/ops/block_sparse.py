@@ -33,10 +33,11 @@ from jax.experimental import pallas as pl
 
 from ..config import get_config
 
-try:  # pragma: no cover - present on every supported install
-    from jax.experimental.pallas import tpu as pltpu
-except (ImportError, AttributeError):  # pragma: no cover
-    pltpu = None
+from ..utils.jax_compat import pallas_tpu_compat
+
+# (None, None) where the TPU pallas package is unavailable; _CompilerParams
+# resolves the post-0.4.x rename without monkey-patching jax.
+pltpu, _CompilerParams = pallas_tpu_compat()
 
 
 class BlockSparse:
@@ -178,7 +179,7 @@ def _spmm_gather_fn(m, k, n, bm, bs, bn, max_nnz, dtype, interpret, precision):
         out_shape=jax.ShapeDtypeStruct((m, n), dtype),
         # (i, j) output tiles are independent; only the k sweep carries the
         # output accumulation.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -217,7 +218,7 @@ def _spmm_fn(m, k, n, bm, bs, bn, dtype, interpret, precision):
         functools.partial(_spmm_kernel, precision=precision),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((m, n), dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

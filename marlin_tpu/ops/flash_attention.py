@@ -29,7 +29,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.jax_compat import pallas_tpu_compat
+
+# _CompilerParams resolves the post-0.4.x CompilerParams rename without
+# monkey-patching the jax module.
+pltpu, _CompilerParams = pallas_tpu_compat()
 
 from ..utils.split import pad_to_multiple
 
@@ -212,7 +217,10 @@ def _out_struct(x: jax.Array, shape, dtype=None) -> jax.ShapeDtypeStruct:
     varying-mesh-axes set so the kernel composes with shard_map's vma
     checking (the output varies over exactly the axes the inputs do)."""
     dtype = dtype or x.dtype
-    vma = getattr(jax.typeof(x), "vma", None)
+    # jax.typeof landed after 0.4.x; on older jax there is no vma tracking
+    # to propagate, so the plain struct is the correct (and only) answer.
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(x), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -284,7 +292,7 @@ def _flash_hsd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
         ],
         # Mosaic may parallelize/pipeline head and q-block grid steps freely;
         # only the innermost k sweep carries state (the VMEM scratch).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -504,7 +512,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
             return (h // group, j, 0)
 
         qmap = _qmap(group)
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
     dq = pl.pallas_call(
@@ -528,7 +536,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     # Grid (kv_head, k_block, group_member, q_block): for each (kv_head,
     # k_block) the (group, q) sweep is contiguous, so the accumulators
     # collect the whole group's contribution before the block is emitted.
-    dkv_params = pltpu.CompilerParams(
+    dkv_params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary",
                              "arbitrary"),
     )

@@ -34,17 +34,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import get_config
 from ..mesh import default_mesh
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ..utils.jax_compat import pvary as _pvary, shard_map_compat
 
-
-def _pvary(x: jax.Array, axes) -> jax.Array:
-    """jax.lax.pvary compat: pcast(..., to='varying') on jax >= 0.9."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)  # pragma: no cover
+_shard_map = shard_map_compat()  # check_rep off on pre-pvary jax
 
 
 def _ring_axes(mesh: Mesh) -> Tuple[str, ...]:

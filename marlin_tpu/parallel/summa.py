@@ -39,10 +39,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import get_config
 from ..mesh import axis_sizes, block_sharding, default_mesh
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ..utils.jax_compat import shard_map_compat
+
+_shard_map = shard_map_compat()  # check_rep off on pre-pvary jax
 
 
 def _pad_to(x: jax.Array, mults: Sequence[int]) -> jax.Array:

@@ -32,10 +32,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..mesh import default_mesh
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ..utils.jax_compat import shard_map_compat
+
+_shard_map = shard_map_compat()  # check_rep off on pre-pvary jax
 
 
 def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:

@@ -1,0 +1,63 @@
+"""Version shims for the jax APIs the engines lean on.
+
+The image pins jax 0.4.37 while parts of the codebase target newer jax;
+the shims here keep one source tree working across both (ROADMAP open
+item 11 tracks retiring them).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def shard_map_compat():
+    """The ``shard_map`` entry point, adjusted for the installed jax.
+
+    * jax >= 0.4.35 exposes it at top level; older only under
+      ``jax.experimental.shard_map``.
+    * jax builds WITHOUT ``lax.pcast``/``lax.pvary`` (< 0.6) predate the
+      replication-tracking rules the engine bodies rely on — their
+      ``check_rep`` has no rule for ``while`` (every ring/pipeline
+      fori_loop) and nothing to annotate loop carries with (``_pvary`` is
+      an identity there), so the static replication CHECKER must be off.
+      ``check_rep`` never changes semantics, only static checking; on
+      newer jax it stays on.
+    """
+    import jax
+
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # pragma: no cover - old jax
+        from jax.experimental.shard_map import shard_map as sm
+    if hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary"):
+        return sm
+    return functools.partial(sm, check_rep=False)
+
+
+def pvary(x, axes):
+    """``jax.lax.pvary`` compat: ``pcast(..., to='varying')`` on jax >=
+    0.9; identity on jax < 0.6, which has no varying-mesh-axes tracking
+    for pvary to annotate. Marks a freshly created shard_map loop carry
+    as device-varying so the replication checker accepts the fori_loop."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)  # pragma: no cover
+    return x
+
+
+def pallas_tpu_compat():
+    """``(pltpu module, CompilerParams class)`` — the class under its
+    current name (renamed from ``TPUCompilerParams`` after jax 0.4.x),
+    resolved WITHOUT mutating the jax module (a monkey-patched attribute
+    would leak into other code's hasattr feature detection). ``(None,
+    None)`` where the TPU pallas package is unavailable."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except (ImportError, AttributeError):  # pragma: no cover
+        return None, None
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    return pltpu, cls

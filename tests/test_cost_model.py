@@ -119,6 +119,49 @@ class TestDecodeCost:
             got = sum(x.size for x in jax.tree.leaves(p))
             assert got == cm.transformer_param_count(cfg), kw
 
+    def test_int8_param_pricing_matches_quantized_pytree_exactly(self):
+        # The advisor-r05 fix: the int8 arm's predicted bytes must AGREE
+        # with the bench roofline denominator, which prices actual pytree
+        # leaves — int8 leaves at 1 byte, every float leaf (biases, norms,
+        # the s8 scales _cast_params casts once) at the compute itemsize.
+        # Held to EXACT equality against a real quantized pytree.
+        from marlin_tpu.models import quantize_params_int8
+        from marlin_tpu.models.transformer import init_params
+
+        for kw in ({}, {"rope": True}, {"n_kv_heads": 2}):
+            cfg = self._cfg(**kw)
+            p = quantize_params_int8(init_params(cfg, seed=0))
+            it = 2  # bf16 compute dtype
+            want = sum(
+                leaf.nbytes if jnp.issubdtype(leaf.dtype, jnp.integer)
+                else leaf.size * it for leaf in jax.tree.leaves(p))
+            q_elems, n_scales = cm.quantized_weight_counts(cfg)
+            total = cm.transformer_param_count(cfg)
+            got = q_elems + (n_scales + total - q_elems) * it
+            assert got == want, kw
+
+    def test_int8_cache_pricing_matches_bench_per_vec(self):
+        # Cache side of the same agreement: decode_step_cost under
+        # kv_quant must charge exactly the bench roofline's
+        # per_vec = dh + 4 bytes per stored K/V vector (int8 slots + one
+        # f32 scale), read once plus the 1/cache_len write-back share.
+        cfg = self._cfg(kv_quant="int8")
+        batch = 4
+        dh = cfg.d_model // cfg.n_heads
+        _, byts = cm.decode_step_cost(cfg, batch, param_itemsize=2,
+                                      cache_itemsize=2, quant_weights=True)
+        kv_heads = cfg.kv_heads
+        per_seq = 2 * cfg.n_layers * cfg.max_len * kv_heads * (dh + 4)
+        q_elems, n_scales = cm.quantized_weight_counts(cfg)
+        total = cm.transformer_param_count(cfg)
+        p_bytes = q_elems + (n_scales + total - q_elems) * 2
+        want = p_bytes + batch * per_seq * (1 + 1 / cfg.max_len)
+        assert byts == pytest.approx(want, rel=1e-9)
+        # And the write-back share is the only thing separating the model
+        # from the roofline's read-side denominator.
+        assert byts - (p_bytes + batch * per_seq) \
+            == pytest.approx(batch * per_seq / cfg.max_len, rel=1e-9)
+
     def test_decode_step_streams_params_and_cache_once(self):
         from marlin_tpu.models import transformer as tr
 
@@ -167,10 +210,14 @@ class TestChunkedCECost:
         # Vocab x4 moves the chunked arena by at most one chunk's buffers.
         assert abs(chunked_2048 - chunked_512) <= \
             4 * cm.ce_logits_bytes(1, 32, 2048)
-        # Control (the test's teeth): the unchunked path pays >= two full
-        # logits-sized buffers (forward value + backward cotangent).
+        # Control (the test's teeth): the unchunked path pays full
+        # logits-sized buffers — >= two (forward value + backward
+        # cotangent) on current XLA, >= one on jax 0.4.x whose CPU
+        # allocator buffer-shares more aggressively; either way the arena
+        # grows with vocab by at least a full logits buffer while the
+        # chunked arena (asserted above) moves by at most a chunk's worth.
         direct_512, direct_2048 = temp(512, b * s), temp(2048, b * s)
-        assert direct_2048 - direct_512 >= 2 * delta_logits
+        assert direct_2048 - direct_512 >= delta_logits
         assert chunked_2048 < direct_2048
 
 

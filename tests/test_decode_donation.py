@@ -1,0 +1,173 @@
+"""Decode buffer-donation regression tests (the copy-free half of the
+skew-proof decode work).
+
+The jitted decode entry points (transformer._decode_scan and
+._speculative_loop) DONATE their KV cache (and the speculation token
+buffer) and return the final state aliased to the donated input, so the
+prefill -> decode handoff updates the prefill's buffers in place instead
+of copying the whole cache once per dispatch. These tests pin the three
+observable properties on the CPU backend:
+
+* CONSUMED: the passed-in arrays are deleted after the call (a caller
+  reusing them fails loudly, which is the documented contract).
+* ALIASED, NOT COPIED: the returned cache occupies the SAME device
+  buffers (``unsafe_buffer_pointer``) as the donated input — a per-step
+  or per-dispatch cache copy would surface as a fresh allocation.
+* ONE COMPILE: a >= 16-step generate hits the jit cache once; re-running
+  adds no retrace and no additional cache-sized live buffers.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from marlin_tpu.models import (TransformerConfig, generate, init_kv_cache,
+                               init_params, quantize_params_int8)
+from marlin_tpu.models import transformer as tr
+
+
+def _cfg(**kw):
+    base = dict(vocab=48, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=96)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _pointers(cache):
+    return [{k: v.unsafe_buffer_pointer() for k, v in layer.items()}
+            for layer in cache]
+
+
+def _cache_nbytes(cache):
+    return sum(x.nbytes for layer in cache for x in layer.values())
+
+
+class TestDecodeScanDonation:
+    @pytest.mark.parametrize("kw", [{}, {"kv_quant": "int8"}])
+    def test_cache_consumed_and_aliased_in_place(self, kw):
+        cfg = _cfg(**kw)
+        params = init_params(cfg, seed=0)
+        if kw.get("kv_quant"):
+            params = quantize_params_int8(params)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab, (2, 8)),
+            jnp.int32)
+        _, cache = tr._prefill_jit(params, prompt, cfg=cfg)
+        ptrs = _pointers(cache)
+        toks, out_cache = tr._decode_scan(
+            params, jnp.zeros((2,), jnp.int32), jnp.int32(8), cache,
+            jax.random.PRNGKey(0), cfg, 16, 0.0, 0, 0.0, None)
+        # Consumed: every donated leaf (int8 slots AND f32 scales on the
+        # quantized arm) is dead.
+        for layer in cache:
+            for name, leaf in layer.items():
+                assert leaf.is_deleted(), name
+        # Aliased: the 16-step loop ran inside the prefill's own buffers.
+        assert _pointers(out_cache) == ptrs
+        assert toks.shape == (16, 2)
+
+    def test_second_call_adds_no_retrace_or_buffers(self):
+        cfg = _cfg()
+        params = init_params(cfg, seed=1)
+        prompt = jnp.zeros((2, 8), jnp.int32)
+
+        def run():
+            _, cache = tr._prefill_jit(params, prompt, cfg=cfg)
+            return tr._decode_scan(
+                params, jnp.zeros((2,), jnp.int32), jnp.int32(8), cache,
+                jax.random.PRNGKey(0), cfg, 16, 0.0, 0, 0.0, None)
+
+        toks1, cache1 = run()
+        compiles = tr._decode_scan._cache_size()
+        shape = cache1[0]["k"].shape
+
+        def live_cache_leaves():
+            return sum(1 for a in jax.live_arrays()
+                       if a.shape == shape and not a.is_deleted())
+
+        before = live_cache_leaves()
+        toks2, cache2 = run()
+        del cache1
+        # Exactly one compile served both >= 16-step decodes...
+        assert tr._decode_scan._cache_size() == compiles
+        # ...and steady state holds ONE cache's worth of K/V leaves: the
+        # donated handoff leaves no orphaned copy behind.
+        assert live_cache_leaves() == before
+        np.testing.assert_array_equal(np.asarray(toks1), np.asarray(toks2))
+
+    def test_eos_path_donates_too(self):
+        cfg = _cfg()
+        params = init_params(cfg, seed=2)
+        cache = init_kv_cache(cfg, 3)
+        ptrs = _pointers(cache)
+        done0 = jnp.asarray([True, False, True])
+        toks, out_cache = tr._decode_scan(
+            params, jnp.zeros((3,), jnp.int32), jnp.int32(0), cache,
+            jax.random.PRNGKey(0), cfg, 16, 0.0, 0, 0.0, cfg.vocab, done0)
+        assert cache[0]["k"].is_deleted()
+        assert _pointers(out_cache) == ptrs
+
+    def test_compiled_temp_arena_holds_no_cache_copy(self):
+        # Memory-accounting teeth for "no per-step copy": the compiled
+        # 16-step loop's temp arena must hold activations, not a second
+        # cache (the donated input provides the loop-carry storage).
+        from marlin_tpu.utils import cost_model as cm
+
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        cache = init_kv_cache(cfg, 2)
+        rep = cm.compiled_cost(
+            tr._decode_scan, params, jnp.zeros((2,), jnp.int32),
+            jnp.int32(8), cache, jax.random.PRNGKey(0), cfg, 16, 0.0, 0,
+            0.0, None)
+        assert rep.temp_bytes <= 2.5 * _cache_nbytes(cache)
+
+
+class TestSpeculativeLoopDonation:
+    def test_buf_and_cache_consumed_and_aliased(self):
+        cfg = _cfg()
+        params = init_params(cfg, seed=3)
+        prompt = jnp.asarray(np.tile([5, 9, 17, 3], 5)[None], jnp.int32)
+        _, cache = tr._prefill_jit(params, prompt, cfg=cfg)
+        s, steps, draft_len = prompt.shape[1], 16, 5
+        buf = jnp.zeros((1, s + steps + draft_len), jnp.int32)
+        buf = buf.at[:, :s].set(prompt)
+        buf_ptr = buf.unsafe_buffer_pointer()
+        cache_ptrs = _pointers(cache)
+        out_buf, vsteps, _, out_cache = tr._speculative_loop(
+            params, buf, s + 1, cache, jax.random.PRNGKey(0), cfg, steps,
+            draft_len, 2, 0.0)
+        assert buf.is_deleted() and cache[0]["k"].is_deleted()
+        assert out_buf.unsafe_buffer_pointer() == buf_ptr
+        assert _pointers(out_cache) == cache_ptrs
+        assert int(jnp.max(vsteps)) >= 1
+
+    def test_public_generate_speculative_unaffected_by_donation(self):
+        # The public wrapper owns both donated buffers; repeated calls and
+        # the prompt batch passed by the caller must be untouched.
+        cfg = _cfg()
+        params = init_params(cfg, seed=3)
+        prompt = jnp.asarray(np.tile([1, 2, 3], 6)[None], jnp.int32)
+        from marlin_tpu.models import generate_speculative
+
+        a = np.asarray(generate_speculative(params, prompt, 10, cfg,
+                                            draft_len=4))
+        b = np.asarray(generate_speculative(params, prompt, 10, cfg,
+                                            draft_len=4))
+        np.testing.assert_array_equal(a, b)
+        assert not prompt.is_deleted()
+
+
+class TestGenerateEndToEnd:
+    def test_generate_still_composes_and_prompt_survives(self):
+        cfg = _cfg()
+        params = init_params(cfg, seed=4)
+        prompt = jnp.zeros((2, 6), jnp.int32)
+        out = generate(params, prompt, 20, cfg)
+        assert out.shape == (2, 20)
+        # Donation consumes the internal prefill cache, never user inputs.
+        assert not prompt.is_deleted()
+        leaves = jax.tree.leaves(params)
+        assert not any(leaf.is_deleted() for leaf in leaves)

@@ -67,15 +67,26 @@ def test_spanning_mesh_processes(tmp_path, nproc):
     # for timeout/rendezvous-shaped failures (ADVICE r04: a blanket retry
     # masks real intermittent cross-process bugs), and print the first
     # attempt's output first so a passing retry still leaves a flake trace.
-    _RENDEZVOUS_MARKS = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "barrier",
-                        "coordination", "failed to connect",
-                        "connection refused", "heartbeat")
+    # Marks are the PRECISE gRPC/coordination-service status tokens, not
+    # generic English ("barrier"/"coordination"/"heartbeat" would also
+    # match a real cross-process assertion failure whose message mentions
+    # the primitive, silently retrying a genuine bug — advisor r05 low #3).
+    # Status codes match CASE-SENSITIVELY (always emitted uppercase;
+    # folding would let prose like "device unavailable" back in); the two
+    # connect-phase phrases fold, since they appear as "Connection
+    # refused" (errno) and "Failed to connect" (gRPC) in the wild.
+    _STATUS_MARKS = ("DEADLINE_EXCEEDED", "UNAVAILABLE")
+    _CONNECT_MARKS = ("failed to connect", "connection refused")
 
     def _transient(outs) -> bool:
         if outs is None:
             return True  # whole-launch timeout
-        return any(rc != 0 and any(m.lower() in (out + err).lower()
-                                   for m in _RENDEZVOUS_MARKS)
+
+        def rendezvous_shaped(text: str) -> bool:
+            return any(m in text for m in _STATUS_MARKS) \
+                or any(m in text.lower() for m in _CONNECT_MARKS)
+
+        return any(rc != 0 and rendezvous_shaped(out + err)
                    for rc, out, err in outs)
 
     outs = launch()

@@ -194,6 +194,50 @@ class TestSpeculativeGeneration:
                 p, batch[i:i + 1], steps, cfg, draft_len=5))
             assert np.array_equal(spec_b[i:i + 1], solo), i
 
+    def test_skewed_batch_freezes_finished_sequences(self):
+        # The skew fix (advisor r05 low #4): in a batch with deliberately
+        # skewed completion — a repetitive prompt that accepts near-full
+        # chunks next to a random prompt that accepts ~1 token per chunk —
+        # finished sequences FREEZE: (a) outputs stay bit-identical to the
+        # pre-fix oracle (plain batched greedy AND each sequence's own
+        # B=1 run), and (b) the per-sequence verify-chunk counter stops at
+        # each member's own finish, so the early finisher reports fewer
+        # verify chunks than the slowest member (whose count == the loop's
+        # iteration total).
+        cfg = _cfg()
+        p = init_params(cfg, seed=9)
+        prompts = np.stack([
+            np.tile([5, 9, 17, 3], 5),                            # fast
+            np.random.default_rng(3).integers(0, cfg.vocab, 20),  # slow
+            np.tile([1, 2], 10),                                  # middle
+        ])
+        batch = jnp.asarray(prompts, jnp.int32)
+        steps = 14
+        out, stats = generate_speculative(p, batch, steps, cfg,
+                                          draft_len=5, return_stats=True)
+        base = np.asarray(generate(p, batch, steps, cfg))
+        assert np.array_equal(np.asarray(out), base)
+        for i in range(3):
+            solo = np.asarray(generate_speculative(
+                p, batch[i:i + 1], steps, cfg, draft_len=5))
+            assert np.array_equal(np.asarray(out)[i:i + 1], solo), i
+        v = np.asarray(stats["verify_chunks"])
+        iters = int(np.asarray(stats["iterations"]))
+        assert v.max() == iters  # the slowest member was live throughout
+        assert v.min() >= 1
+        # The skew claim itself: the early finishers stopped verifying
+        # well before the slowest member — without the freeze every
+        # member's count would equal the iteration total.
+        assert v[0] < v[1], (v, iters)
+        assert v[2] < v[1], (v, iters)
+        # A member alone finishes in the same number of verify chunks it
+        # reports inside the skewed batch (per-row independence).
+        for i in range(3):
+            _, solo_stats = generate_speculative(
+                p, batch[i:i + 1], steps, cfg, draft_len=5,
+                return_stats=True)
+            assert int(np.asarray(solo_stats["iterations"])) == v[i], i
+
     def test_guards(self):
         cfg = _cfg()
         p = init_params(cfg, seed=0)
