@@ -25,1434 +25,69 @@ shape and records "oracle_max_err" (relative) in its JSON line; the LU/
 Cholesky/inverse configs likewise record a reconstruction/identity error and
 report vs_baseline as raw-XLA-time / our-time (>= 0.333 means within the
 VERDICT's 3x-of-XLA target).
+
+IMPLEMENTATION lives in benchlib/ (ROADMAP item 7 split: harness /
+artifact / configs_* / registry modules, each <= 400 LoC). This file
+stays the entry point (`python bench.py --config X`) and the stable
+attribute surface: tests and tools patch/read ``bench.X``, and main()
+resolves its collaborators through THIS module's globals so those
+patches keep working.
 """
 
 import json
 import os
 import sys
-import time
 
 import jax
 
 if os.environ.get("BENCH_FORCE_CPU"):  # smoke-test path: this image's
     # sitecustomize force-registers the axon TPU platform and overrides
     # jax_platforms via jax.config, so a CPU run must override it back the
-    # same way (see tests/conftest.py).
+    # same way (see tests/conftest.py). Must precede any backend use (the
+    # benchlib imports below touch jnp dtypes only, which is safe).
     jax.config.update("jax_platforms", "cpu")
 
-import jax.numpy as jnp
+import jax.numpy as jnp  # noqa: E402,F401 - historical bench API surface
 
-import marlin_tpu as mt
-from marlin_tpu.utils import random as mrand
+import marlin_tpu as mt  # noqa: E402
 
-# TPU-fast mode: bf16 operands (f32 accumulation on the MXU); float64 stays the
-# correctness reference in the tests.
-N = int(os.environ.get("BENCH_N", 32768))
-DTYPE = jnp.bfloat16
-PEAK_TFLOPS = {
-    "TPU v5 lite": 197.0,  # bf16 peak per v5e chip
-    "TPU v5e": 197.0,
-    "TPU v4": 275.0,
-    "TPU v6 lite": 918.0,
-    "cpu": 1.0,
-}
-HBM_GBPS = {  # per-chip HBM bandwidth, the decode roofline denominator
-    "TPU v5 lite": 819.0,
-    "TPU v5e": 819.0,
-    "TPU v4": 1228.0,
-    "TPU v6 lite": 1640.0,
-    "cpu": 50.0,
-}
+from benchlib import artifact as _artifact  # noqa: E402
+from benchlib.artifact import (  # noqa: E402,F401 - re-exported surface
+    _CACHE_PREFIX, _CONFIG, _DEADLINE, _SUCCEEDED, _emit_error,
+    _emit_run_status, _error_line, _remaining, _start_watchdog, _trim_err)
+from benchlib.harness import (  # noqa: E402,F401 - re-exported surface
+    DTYPE, HBM_GBPS, N, PEAK_TFLOPS, _probe_backend_subprocess, _raw,
+    _scan_timed, _sized, _timed, _timed_r, fence, guess_peak, init_backend)
+from benchlib.configs_gemm import (  # noqa: E402,F401
+    config_chained, config_dispatch_sweep, config_square_8k,
+    config_summa_mesh, config_tall_skinny, headline)
+from benchlib.configs_kernels import (  # noqa: E402,F401
+    config_attention, config_attention_sweep, config_sparse)
+from benchlib.configs_linalg import (  # noqa: E402,F401
+    _xla_ref, config_cholesky, config_inverse, config_lu, config_svd)
+from benchlib.configs_ml import (  # noqa: E402,F401
+    _train_throughput, config_decode, config_decode_int8,
+    config_decode_spec, config_longseq, config_transformer)
+from benchlib.configs_sparse import (  # noqa: E402,F401
+    config_sparse_dist, config_spmm)
+from benchlib.configs_trend import (  # noqa: E402,F401
+    config_serving, config_trend_cpu)
+from benchlib.registry import CONFIGS  # noqa: E402
 
-
-def _trim_err(e: BaseException, limit: int = 400) -> str:
-    s = f"{type(e).__name__}: {e}"
-    return s[-limit:] if len(s) > limit else s
-
-
-def _error_line(metric: str, err: str) -> dict:
-    return {"metric": metric, "value": 0.0, "unit": "error",
-            "vs_baseline": 0.0, "error": err}
-
-
-def _emit_error(metric: str, err: str):
-    print(json.dumps(_error_line(metric, err)), flush=True)
-
-
-_succeeded = 0  # configs that printed a number; read by the watchdog
-_DEADLINE = [0.0]  # wall-clock instant the watchdog fires (set in main)
-_CONFIG = ["headline"]  # selected --config; read by the cached fallback
-
-# Dead-tunnel fallback (BENCH_r01/r02 both went rc=1 with the tunnel wedged
-# at end-of-round): when the backend never comes up, replay the most recent
-# on-hardware capture lines from docs/bench_captures/*.jsonl as structured
-# results tagged "cached": true, so the driver artifact still carries
-# machine-readable numbers. Maps each config function to the metric-name
-# prefix its lines carry (several metrics embed sizes, hence prefixes).
-_CAPTURE_DIR = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "docs", "bench_captures")
-_CACHE_PREFIX = {
-    "headline": "dense_gemm_tflops_per_chip",
-    "config_square_8k": "gemm_8k_seconds",
-    "config_tall_skinny": "tall_skinny_seconds",
-    "config_chained": "chained_abc_",
-    "config_summa_mesh": "summa_weak_scaling",
-    "config_attention": "flash_attention_tflops",
-    "config_sparse": "block_sparse_effective_tflops",
-    "config_sparse_dist": "sparse_dist_",
-    "config_spmm": "spmm_",
-    "config_lu": "lu_dist_",
-    "config_cholesky": "cholesky_dist_",
-    "config_inverse": "inverse_dist_",
-    "config_svd": "svd_dist_eigs_",
-    "config_transformer": "transformer_train_tokens",
-    "config_longseq": "longseq_train_",
-    "config_decode": "decode_tokens_per_s",
-    "config_decode_int8": "decode_int8_tokens_per_s",
-    "config_decode_spec": "decode_spec_tokens_per_s",
-}
+# Monkeypatch-friendly module global: tests/tools set bench._CAPTURE_DIR,
+# and EVERY replay path — these wrappers, init_backend's dead-tunnel
+# fallback, the watchdog — resolves it at call time through
+# benchlib.artifact._default_capture_dir (which reads this attribute).
+_CAPTURE_DIR = _artifact._CAPTURE_DIR
 
 
 def _load_cached_lines(capture_dir: str = None) -> dict:
-    """Newest valid capture line per config function name. Files are visited
-    in session order and lines in file order, so the latest write wins;
-    error lines and failed-oracle lines never qualify as evidence.
-
-    Session order = (capture-file basename, mtime): the files follow the
-    ``rNN_<session>_YYYYMMDD[_HHMM].jsonl`` convention, which sorts
-    chronologically by name — mtimes alone are unreliable because a git
-    checkout stamps every historic file with the same time (observed: the
-    replay picking an old under-filled summa line over the same round's
-    corrected one)."""
-    import glob
-
-    capture_dir = capture_dir or _CAPTURE_DIR
-    best = {}
-    paths = sorted(
-        glob.glob(os.path.join(capture_dir, "*.jsonl")),
-        key=lambda p: (os.path.basename(p), os.path.getmtime(p)))
-    for path in paths:
-        try:
-            mtime = os.path.getmtime(path)
-            with open(path) as f:
-                raw_lines = f.readlines()
-        except OSError:
-            continue
-        for raw in raw_lines:
-            try:
-                line = json.loads(raw)
-            except ValueError:
-                continue
-            if not isinstance(line, dict) or "metric" not in line:
-                continue
-            if line.get("unit") == "error" or not line.get("value"):
-                continue
-            if line.get("oracle_ok") is False:
-                continue
-            if line.get("cached"):
-                # A replay that a dead-tunnel queue run appended into a
-                # capture file is NOT evidence — replaying it again would
-                # launder its provenance (age/file) as fresh.
-                continue
-            for key, prefix in _CACHE_PREFIX.items():
-                if str(line["metric"]).startswith(prefix):
-                    best[key] = (mtime, line, os.path.basename(path))
-    return best
+    return _artifact._load_cached_lines(capture_dir)
 
 
 def _emit_cached_results(config: str, err: str,
                          capture_dir: str = None) -> int:
-    """Emit the cached line for each function of ``config``; returns the
-    count emitted. Each line keeps its original metric/value/vs_baseline and
-    gains cached/cached_from/cached_age_hours/backend_error fields."""
-    best = _load_cached_lines(capture_dir)
-    now = time.time()
-    hits = [best[fn.__name__] for fn in CONFIGS.get(config, ())
-            if fn.__name__ in best]
-    if hits:
-        # Machine-readable run status: rc alone cannot distinguish a replay
-        # from a live run (ADVICE r03), so automated consumers key on this.
-        _emit_run_status(live=False, n_lines=len(hits), backend_error=err)
-    for mtime, line, fname in hits:
-        print(json.dumps(dict(
-            line, cached=True,
-            cached_from=f"docs/bench_captures/{fname}",
-            cached_age_hours=round((now - mtime) / 3600.0, 1),
-            backend_error=err,
-        )), flush=True)
-    return len(hits)
-
-
-def _emit_run_status(live: bool, n_lines: int, backend_error: str = ""):
-    """Status precedes the measurement lines it vouches for (VERDICT r04
-    weak #1: the driver records the LAST stdout line as the round's parsed
-    metric, so the final line must be a measurement, never status) and is
-    emitted ONLY when evidence exists: a replay with cached lines, or a
-    live run once its first config succeeds. ``value`` = the run's
-    metric/error line count (exact for a replay; for a live run every
-    config emits one line — result or error — though error lines from
-    configs that failed before the first success print ahead of the
-    status, and a watchdog hard-exit can truncate below the count)."""
-    line = {"metric": "bench_run_status", "value": float(n_lines),
-            "unit": "lines", "vs_baseline": 0, "live": live}
-    if backend_error:
-        line["backend_error"] = backend_error
-    print(json.dumps(line), flush=True)
-
-
-def _remaining() -> float:
-    return _DEADLINE[0] - time.monotonic()
-
-
-def _start_watchdog():
-    """Guarantee a parsable artifact even if the backend HANGS (observed
-    failure mode: jax.devices() blocks forever on a dead tunnel — no
-    exception for the retry loop to catch). A daemon thread hard-exits
-    after BENCH_WATCHDOG seconds unless disarmed. Exit-code contract is
-    preserved: if some configs already produced numbers, their JSON lines
-    are the artifact — exit 0 and complain on stderr only; otherwise emit
-    the error line and exit 1.
-
-    The hard exit is the LAST resort: killing a TPU process mid-dispatch
-    wedges the axon tunnel lease for a long time (observed >1h — it cost
-    this round's interactive TPU access), so the config loop in main()
-    also checks the same deadline BETWEEN configs and skips cleanly when
-    the remaining budget can't fit another config."""
-    import threading
-
-    budget = float(os.environ.get("BENCH_WATCHDOG", "3000"))
-    _DEADLINE[0] = time.monotonic() + budget
-    disarm = threading.Event()
-
-    def _fire():
-        if not disarm.wait(budget):
-            if _succeeded:
-                # The run-status line already went out FIRST (main() emits it
-                # just before the first config's result line) — adding one
-                # here would make status the last line and shadow the real
-                # metric in the driver's parsed field (VERDICT r04 weak #1).
-                print(f"bench watchdog: truncated after {budget:.0f}s with "
-                      f"{_succeeded} config(s) done", file=sys.stderr, flush=True)
-                os._exit(0)
-            why = f"bench exceeded {budget:.0f}s (backend hang?)"
-            try:  # nothing measured live — replay cached captures if any
-                if _emit_cached_results(_CONFIG[0], why):
-                    print("bench watchdog: emitted cached capture lines",
-                          file=sys.stderr, flush=True)
-                    os._exit(0)
-            except Exception:  # noqa: BLE001 - fall through to the error line
-                pass
-            _emit_error("watchdog_timeout", why)
-            os._exit(1)
-
-    threading.Thread(target=_fire, daemon=True).start()
-    return disarm
-
-
-def _probe_backend_subprocess(timeout: float) -> str:
-    """Run backend init in a child so a HANG becomes a catchable timeout —
-    an in-process jax.devices() that wedges would otherwise take the whole
-    bench (and the round's artifact) with it. Returns '' on success."""
-    import subprocess
-
-    force_cpu = (
-        "jax.config.update('jax_platforms', 'cpu');"
-        if os.environ.get("BENCH_FORCE_CPU")
-        else ""
-    )
-    code = (
-        "import jax;" + force_cpu + "import jax.numpy as jnp;"
-        "x = jnp.ones((128, 128), jnp.bfloat16);"
-        "jax.block_until_ready(x @ x);"
-        "print('ok')"
-    )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return f"backend probe hung past {timeout:.0f}s"
-    if r.returncode == 0 and "ok" in r.stdout:
-        return ""
-    return (r.stderr or r.stdout).strip()[-400:] or f"probe rc={r.returncode}"
-
-
-def init_backend():
-    """Backend bring-up with retry/backoff; emits a parsable JSON error line
-    and exits 1 if the backend never comes up (round 1 lost its artifact to a
-    bare traceback here — BENCH_r01.json rc=1, parsed null). Each attempt
-    first probes in a SUBPROCESS with a timeout, so both failure modes —
-    init raising and init hanging — are retried."""
-    retries = int(os.environ.get("BENCH_RETRIES", "3"))
-    backoff = float(os.environ.get("BENCH_BACKOFF", "60"))
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    last = "unknown"
-    for attempt in range(retries):
-        err = _probe_backend_subprocess(probe_timeout)
-        if not err:
-            try:
-                devs = jax.devices()
-                x = jnp.ones((128, 128), jnp.bfloat16)
-                jax.block_until_ready(x @ x)
-                return devs
-            except Exception as e:  # noqa: BLE001
-                err = _trim_err(e)
-        last = err
-        if attempt + 1 < retries:
-            time.sleep(backoff)
-    # Lost cause for THIS process — but the round's on-hardware numbers
-    # exist as in-repo capture files: replay the newest valid line per
-    # config as "cached": true results so a transient tunnel wedge at
-    # capture time doesn't erase the round's evidence (BENCH_r01/r02 both
-    # went rc=1 this way).
-    n = _emit_cached_results(_CONFIG[0], last)
-    if n:
-        print(f"backend unreachable ({last}); emitted {n} cached capture "
-              "line(s)", file=sys.stderr, flush=True)
-        sys.exit(0)
-    _emit_error("backend_init", last)
-    sys.exit(1)
-
-
-def guess_peak() -> float:
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAK_TFLOPS.items():
-        if k.lower() in kind.lower():
-            return v
-    return 197.0
-
-
-# Sync via a scalar fetch: on the remote-tunnel (axon) platform,
-# block_until_ready can return before execution finishes, so the timing fence
-# is a device_get of a reduction over the result.
-_fence = None
-
-
-def _raw(x) -> jax.Array:
-    """Unwrap a distributed type to its device array; pass arrays through.
-    (An attribute check on .data would misfire: ndarray.data is a memoryview.)"""
-    from marlin_tpu.matrix.base import DistributedMatrix
-
-    return x.data if isinstance(x, DistributedMatrix) else x
-
-
-def fence(mat) -> float:
-    global _fence
-    if _fence is None:
-        _fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
-    return float(_fence(_raw(mat)))
-
-
-def _timed_r(fn, iters=5):
-    """(seconds/iter, last result) — returning the result lets callers that
-    need it for a residual check avoid recomputing it."""
-    r = fn()  # warmup / compile
-    out_bytes = int(_raw(r).nbytes)
-    fence(r)
-    # Fence once after the loop: device execution is in-order, so fetching a
-    # reduction of the last result implies all queued iterations finished.
-    # Fencing every iteration would add a tunnel round-trip per iter and
-    # serialize dispatch, understating throughput by ~15%. Async dispatch
-    # keeps every queued output buffer live at once, so cap the burst at
-    # ~8 GiB of outputs to stay clear of HBM exhaustion.
-    iters = max(1, min(iters, (8 << 30) // max(out_bytes, 1)))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = fn()
-    fence(r)
-    return (time.perf_counter() - t0) / iters, r
-
-
-def _timed(fn, iters=5):
-    return _timed_r(fn, iters)[0]
-
-
-def _scan_timed(fn, x, *rest, loop=10, reps=4):
-    """Device-side scan-loop timing: ONE dispatch covers ``loop`` chained
-    invocations of ``fn(x, *rest)``, so the per-call tunnel RTT (comparable
-    to the kernel itself for ~10 ms ops) drops out of the measurement. The
-    scan carry perturbs ``x`` by a tiny amount so XLA cannot hoist the call
-    out of the loop; ``float()`` of the final carry is the tunnel-safe fence
-    (block_until_ready can return early on the axon platform).
-
-    A single fenced scan still pays ONE tunnel RTT over only ``loop``
-    invocations — on a slow-tunnel day (RTT ~100 ms vs ~120 ms of device
-    time) that alone understates throughput by ~40% (observed: the same
-    attention kernel read 45 vs 31 TFLOPS across sessions). So: time one
-    fenced call, then ``reps`` back-to-back calls fenced once at the end
-    (device execution is in-order, dispatch is async); both measurements
-    contain exactly one RTT + one fence, and their DIFFERENCE is pure
-    device time for ``(reps - 1) * loop`` invocations. Returns seconds per
-    invocation."""
-
-    @jax.jit
-    def scan_loop(x, *rest):
-        def body(c, _):
-            o = fn(x + (c * 1e-8).astype(x.dtype), *rest)
-            return jnp.sum(jnp.ravel(o)[:2].astype(jnp.float32)), None
-        return jax.lax.scan(body, jnp.float32(0), None, length=loop)[0]
-
-    float(scan_loop(x, *rest))  # warmup compile + fence
-    t0 = time.perf_counter()
-    float(scan_loop(x, *rest))
-    t_one = time.perf_counter() - t0
-    if reps < 2:  # single-shot behavior: one fenced scan, RTT included
-        return t_one / loop
-    t0 = time.perf_counter()
-    for _ in range(reps - 1):
-        scan_loop(x, *rest)  # queue without fetching
-    float(scan_loop(x, *rest))
-    t_many = time.perf_counter() - t0
-    dt = (t_many - t_one) / ((reps - 1) * loop)
-    if dt <= 0:  # timing noise exceeded the spread — fall back, RTT included
-        dt = t_many / (reps * loop)
-    return dt
-
-
-def headline():
-    """Config: 32k x 32k auto-dispatch multiply (the MatrixMultiply shape)."""
-    n_dev = len(jax.devices())
-    a = mrand.random_den_vec_matrix(N, N, seed=1, dtype=DTYPE)
-    b = mrand.random_den_vec_matrix(N, N, seed=2, dtype=DTYPE)
-    dt = _timed(lambda: a.multiply(b))
-    tflops_per_chip = 2.0 * N * N * N / dt / 1e12 / n_dev
-    target = 0.5 * guess_peak()
-    # Static cost model (utils/cost_model.py): the per-chip roofline this
-    # measurement is a fraction of — asserted in CI by test_cost_model.py,
-    # confirmed here by the chip.
-    from marlin_tpu.mesh import axis_sizes, default_mesh
-    from marlin_tpu.utils import cost_model as cm
-
-    pr, pc = axis_sizes(default_mesh())
-    mflops, mbytes = cm.summa_cost(N, N, N, pr, pc,
-                                   jnp.dtype(DTYPE).itemsize)
-    return {
-        "metric": "dense_gemm_tflops_per_chip_32k",
-        "value": round(tflops_per_chip, 2),
-        "unit": "TFLOPS/chip",
-        "vs_baseline": round(tflops_per_chip / target, 3),
-        "device": jax.devices()[0].device_kind,
-        "n": N,
-        "predicted_flops_per_chip": mflops,
-        "predicted_bytes_per_chip": mbytes,
-    }
-
-
-def config_square_8k():
-    """BASELINE config #2: 8192^2 square GEMM."""
-    n = _sized("BENCH_8K_N", 8192)
-    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
-    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
-    dt = _timed(lambda: a.multiply(b))
-    return {"metric": "gemm_8k_seconds", "value": round(dt, 4), "unit": "s",
-            "vs_baseline": 0}
-
-
-def config_tall_skinny():
-    """BASELINE config #3: 1,000,000 x 512 times 512 x 512 (broadcast path)."""
-    m = _sized("BENCH_TALL_M", 1_000_000)
-    a = mrand.random_den_vec_matrix(m, 512, seed=1, dtype=DTYPE)
-    b = mrand.random_den_vec_matrix(512, 512, seed=2, dtype=DTYPE)
-    dt = _timed(lambda: a.multiply(b))
-    return {"metric": "tall_skinny_seconds", "value": round(dt, 4), "unit": "s",
-            "vs_baseline": 0}
-
-
-def config_chained():
-    """BASELINE config #4: chained A.B.C at 16384^3 (HBM residency stress)."""
-    n = _sized("BENCH_CHAIN_N", 16384)
-    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
-    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
-    c = mrand.random_den_vec_matrix(n, n, seed=3, dtype=DTYPE)
-    def chain():
-        # The dispatch's first hop returns a BlockMatrix on the SUMMA arms
-        # and a DenseVecMatrix on the broadcast arm (small smoke sizes);
-        # re-stripe only when needed.
-        ab = a.multiply(b)
-        if hasattr(ab, "to_dense_vec_matrix"):
-            ab = ab.to_dense_vec_matrix()
-        return ab.multiply(c)
-
-    dt = _timed(chain, iters=3)
-    tflops = 2 * 2.0 * n**3 / dt / 1e12
-    return {"metric": f"chained_abc_{n//1024}k_tflops", "value": round(tflops, 2),
-            "unit": "TFLOPS", "vs_baseline": 0}
-
-
-def config_summa_mesh():
-    """BASELINE config #5 (scaled to the available mesh): explicit SUMMA over
-    the full device mesh. The side scales as 8192 * sqrt(n_dev), so a v5e-64
-    runs the named 65536^2 config and per-chip MEMORY stays constant
-    (per-chip FLOPs grow as sqrt(n_dev) — memory-weak scaling, matching how
-    the baseline config was sized)."""
-    import math
-
-    n_dev = len(jax.devices())
-    # Base side 16384: 8192 under-fills the MXU pipeline (38 vs ~150
-    # TFLOPS/chip measured on v5e); per-chip memory stays ~1.6 GB at any
-    # mesh size under this weak-scaling rule.
-    n = int(_sized("BENCH_SUMMA_BASE", 16384) * math.sqrt(n_dev))
-    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
-    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
-    dt = _timed(lambda: a.multiply(b, mode="summa"), iters=3)
-    tflops_chip = 2.0 * n**3 / dt / 1e12 / n_dev
-    return {"metric": f"summa_weak_scaling_tflops_chip_n{n_dev}",
-            "value": round(tflops_chip, 2), "unit": "TFLOPS/chip",
-            "vs_baseline": round(tflops_chip / (0.5 * guess_peak()), 3)}
-
-
-def config_attention():
-    """Pallas flash attention (ops/flash_attention.py) at S=8k, H=8, D=128.
-
-    Doubles as on-hardware validation: the Pallas kernel is first checked
-    against the XLA softmax-attention oracle at S=1024 and the max relative
-    error lands in the JSON line (docs/design.md §9: interpret-mode runs
-    alone provably miss precision bugs)."""
-    from marlin_tpu.ops import flash_attention
-
-    # Oracle check at a small shape on the real hardware path.
-    so, ho, do = 1024, 2, 128
-    ks = jax.random.split(jax.random.PRNGKey(7), 3)
-    qo, ko, vo = (jax.random.normal(kk, (so, ho, do), DTYPE) for kk in ks)
-    got = flash_attention(qo, ko, vo)
-    qf, kf, vf = (x.astype(jnp.float32) for x in (qo, ko, vo))
-    logits = jnp.einsum("shd,thd->hst", qf, kf) / jnp.sqrt(float(do))
-    ref = jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, axis=-1), vf)
-    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
-                / jnp.max(jnp.abs(ref)))
-
-    s, h, d = 8192, 8, 128
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
-    dt = _scan_timed(flash_attention, q, k, v)
-    tflops = 4.0 * s * s * h * d / dt / 1e12  # QK^T + PV
-    out = {"metric": "flash_attention_tflops", "value": round(tflops, 2),
-           "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
-           "oracle_max_err": round(err, 6), "oracle_ok": err < 0.02}
-    w = _sized("BENCH_ATTN_WINDOW", 1024)
-    if w:  # sliding-window speedup: out-of-band blocks skip their compute
-        dt_w = _scan_timed(
-            lambda q, k, v: flash_attention(q, k, v, causal=True, window=w),
-            q, k, v)
-        dt_c = _scan_timed(
-            lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
-        # Analytic block-MAC ceiling — derivation in docs/ROUND4.md §7:
-        # causal (1024-blocks) ~ S*(S+1024)/2, banded ~ S*(bq + w + bk).
-        # bq/bk must mirror flash_attention's windowed clamp EXACTLY
-        # (ops/flash_attention.py: block_k floor 128, block_q floor 256,
-        # both capped ~w/2) or ceiling_frac misattributes the gap.
-        # Predicate-derived ceiling (utils/cost_model.py): enumerates the
-        # kernel's own grid plan instead of the closed form, evaluated at
-        # the kernel's FULL entry block selection (window + sequence
-        # clamps, shared helper — a clamp or default-block change moves
-        # this bar automatically).
-        from marlin_tpu.ops.flash_attention import (DEFAULT_BLOCK_K,
-                                                    DEFAULT_BLOCK_Q,
-                                                    effective_blocks)
-        from marlin_tpu.utils import cost_model as cm
-
-        bq_eff, bk_eff = effective_blocks(s, s, DEFAULT_BLOCK_Q,
-                                          DEFAULT_BLOCK_K, w)
-        ideal = cm.speedup_ceiling(s, w, (bq_eff, bk_eff))
-        out.update(window=w,
-                   window_speedup_vs_causal=round(dt_c / dt_w, 2),
-                   causal_ms=round(dt_c * 1e3, 2),
-                   window_ms=round(dt_w * 1e3, 2),
-                   window_block_ceiling=round(ideal, 2),
-                   window_ceiling_frac=round((dt_c / dt_w) / ideal, 3))
-        # Block sweep inside the band: the best (bq, bk) is a
-        # measurement, not a formula — smaller blocks shrink the diagonal
-        # overhang but raise grid overhead. The clamped-default point is
-        # dt_w, already measured; time only the new shapes.
-        sweep = [[bq_eff, bk_eff, round(dt_c / dt_w, 2),
-                  round(cm.speedup_ceiling(s, w, (bq_eff, bk_eff)), 2)]]
-        for bq, bk in ((256, 256), (256, 128), (512, 128)):
-            if (bq, bk) == (bq_eff, bk_eff):
-                continue
-            try:
-                dt_s = _scan_timed(
-                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                        q, k, v, causal=True, window=w,
-                        block_q=bq, block_k=bk),
-                    q, k, v)
-                sweep.append([bq, bk, round(dt_c / dt_s, 2),
-                              round(cm.speedup_ceiling(s, w, (bq, bk)), 2)])
-            except Exception as e:  # noqa: BLE001
-                print(f"wsweep ({bq},{bk}) failed: {_trim_err(e, 100)}",
-                      file=sys.stderr, flush=True)
-        best = max(sweep, key=lambda t: t[2])
-        out.update(window_sweep=sweep,
-                   window_best_speedup=best[2],
-                   window_best_block=best[:2])
-
-    # Training path: fwd + Pallas flash backward (dQ + dK/dV kernels — no
-    # (S, S) buffer in either direction). 3.5x the fwd MAC count (2 fwd
-    # matmuls + 5 bwd: recomputed logits, dP, dV, dQ, dK).
-    def fwdbwd(q, k, v):
-        def loss(q, k, v):
-            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
-
-        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return dq + dk + dv
-
-    dt_b = _scan_timed(fwdbwd, q, k, v)
-    out.update(fwd_bwd_ms=round(dt_b * 1e3, 2),
-               fwd_bwd_tflops=round(3.5 * 4.0 * s * s * h * d / dt_b / 1e12,
-                                    2))
-    return out
-
-
-def config_sparse():
-    """Block-sparse GEMM (gather-grid Pallas kernel) at 12% block density.
-
-    Oracle-checked on hardware first: kernel vs jnp.dot on the zero-filled
-    backing at n=2048, max relative error recorded."""
-    import numpy as np
-
-    from marlin_tpu.ops.block_sparse import BlockSparse, block_sparse_matmul
-
-    rng = np.random.default_rng(0)
-
-    # Oracle check.
-    no, bso = 1024, 256
-    mo = rng.random((no // bso, no // bso)) < 0.3
-    bo = BlockSparse(
-        jnp.asarray(rng.standard_normal((no, no)), DTYPE), jnp.asarray(mo), bso
-    )
-    ao = jnp.asarray(rng.standard_normal((no, no)), DTYPE)
-    got = block_sparse_matmul(ao, bo).astype(jnp.float32)
-    ref = jnp.dot(ao.astype(jnp.float32), bo.data.astype(jnp.float32))
-    scale = float(jnp.max(jnp.abs(ref)))
-    err = float(jnp.max(jnp.abs(got - ref))) / max(scale, 1e-30)
-
-    n, bs = _sized("BENCH_SPARSE_N", 8192), 512
-    mask = rng.random((n // bs, n // bs)) < 0.12
-    arr = rng.standard_normal((n, n)).astype(np.float32)
-    # The ctor zeroes unmasked blocks itself — no host-side mask expansion.
-    b = BlockSparse(jnp.asarray(arr, DTYPE), jnp.asarray(mask), bs)
-    a = jnp.asarray(rng.standard_normal((n, n)), DTYPE)
-    dt = _scan_timed(lambda a: block_sparse_matmul(a, b), a)
-    eff = 2.0 * n**3 * b.block_density / dt / 1e12
-    return {"metric": "block_sparse_effective_tflops", "value": round(eff, 2),
-            "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
-            "oracle_max_err": round(err, 6), "oracle_ok": err < 0.05}
-
-
-def _sized(env, default):
-    return int(os.environ.get(env, default))
-
-
-def config_sparse_dist():
-    """Distributed sparse x sparse: row-sharded COO ring engine
-    (matrix/dist_sparse.py) at the reference SparseMultiply regime
-    (SparseMultiply.scala:31-82: random sparse operands, sparse COO result).
-    Effective throughput counts the algorithm's real work, nnz(A) * n MACs.
-    Oracle: dense product at 2048 on hardware."""
-    import numpy as np
-
-    from marlin_tpu.matrix.dist_sparse import DistSparseVecMatrix
-
-    def make(m, n, density, seed):
-        r = np.random.default_rng(seed)
-        nnz = int(m * n * density)
-        rows = r.integers(0, m, nnz)
-        cols = r.integers(0, n, nnz)
-        vals = r.standard_normal(nnz).astype(np.float32)
-        return rows, cols, vals
-
-    # Oracle at 2048.
-    no = 2048
-    ra, ca, va = make(no, no, 5e-3, 1)
-    rb, cb, vb = make(no, no, 5e-3, 2)
-    a = DistSparseVecMatrix.from_coo(ra, ca, va, (no, no))
-    b = DistSparseVecMatrix.from_coo(rb, cb, vb, (no, no))
-    got = a.multiply_sparse(b).to_numpy()
-    da = np.zeros((no, no), np.float64); np.add.at(da, (ra, ca), va)
-    db = np.zeros((no, no), np.float64); np.add.at(db, (rb, cb), vb)
-    ref = da @ db
-    scale = max(float(np.max(np.abs(ref))), 1e-30)
-    err = float(np.max(np.abs(got - ref))) / scale
-
-    n = _sized("BENCH_SPARSE_DIST_N", 16384)
-    density = 1e-3
-    ra, ca, va = make(n, n, density, 3)
-    rb, cb, vb = make(n, n, density, 4)
-    a = DistSparseVecMatrix.from_coo(ra, ca, va, (n, n))
-    b = DistSparseVecMatrix.from_coo(rb, cb, vb, (n, n))
-
-    def run(mode):
-        warm = a.multiply_sparse(b, mode=mode)
-        warm.nnz  # warmup: compile + format caches
-        _ = warm.values  # warm the extraction kernel too (same cap)
-        t0 = time.perf_counter()
-        res = a.multiply_sparse(b, mode=mode)
-        nnz_out = res.nnz  # ell/dense: fused-count fetch; ring: count pass
-        return time.perf_counter() - t0, nnz_out, res
-
-    def scipy_time(rr, cc, vv, rr2, cc2, vv2, nn):
-        import scipy.sparse as sp
-
-        sa = sp.csr_matrix((vv, (rr, cc)), shape=(nn, nn))
-        sb = sp.csr_matrix((vv2, (rr2, cc2)), shape=(nn, nn))
-        _ = sa @ sb  # warm allocator
-        t0 = time.perf_counter()
-        _ = sa @ sb
-        return time.perf_counter() - t0
-
-    dt, nnz_out, res = run("auto")  # ELL gather route at this regime
-    out = {"metric": f"sparse_dist_{n//1024}k_gflops",
-           "value": round(2.0 * len(va) * n / dt / 1e9, 2),
-           "unit": "GFLOP/s", "vs_baseline": 0, "nnz_out": int(nnz_out),
-           "seconds": round(dt, 4),
-           "route": ("ell" if a._ell_wins(n, n)
-                     else "dense" if a._use_dense_route(n, n, "auto")
-                     else "ring"),
-           "oracle_max_err": round(err, 9), "oracle_ok": err < 1e-3}
-    if out["route"] == "ell":
-        # Static model (utils/cost_model.py, CI-asserted): the HBM bytes
-        # the ELL engine should move — the chip confirms the fraction.
-        from marlin_tpu.utils import cost_model as cm
-
-        _, _, r_slots = a.ell_stripes()
-        n_dev = len(jax.devices())
-        mflops, mbytes = cm.ell_product_cost(
-            n, n, n, r_slots, n_dev, jnp.dtype(va.dtype).itemsize)
-        out.update(predicted_bytes_per_chip=mbytes, ell_r_slots=int(r_slots))
-    # COO extraction cost, reported separately: the product is returned
-    # lazily (nnz from the fused count), so extraction is paid only by
-    # consumers that read the triples. The kernel was warmed on the warmup
-    # product (same cap), and the timing fences on the values reduction —
-    # otherwise this would read compile time + an async dispatch.
-    t0 = time.perf_counter()
-    fence(res.values)
-    out["extract_seconds"] = round(time.perf_counter() - t0, 4)
-    for arm in ("dense", "ring"):  # the other arms, for the record
-        try:
-            dt_arm, _, _ = run(arm)
-            out[f"{arm}_seconds"] = round(dt_arm, 4)
-        except Exception as e:  # noqa: BLE001
-            out[f"{arm}_error"] = _trim_err(e, 120)
-    # Baseline (VERDICT r02 item 4): scipy CSR spgemm on the host CPU — the
-    # closest thing to the reference's per-executor CSC kernels
-    # (SparseVecMatrix.scala:22-50); vs_baseline = scipy_time / our_time.
-    try:
-        dt_sci = scipy_time(ra, ca, va, rb, cb, vb, n)
-        out.update(scipy_csr_seconds=round(dt_sci, 3),
-                   vs_baseline=round(dt_sci / dt, 3))
-    except Exception as e:  # noqa: BLE001
-        out["scipy_error"] = _trim_err(e, 120)
-    # Crossover point (VERDICT r03 item 2: "a measured crossover policy"):
-    # at 10x the density the padded-work engines are nearly time-constant
-    # while the CPU baseline's real work grows ~100x.
-    try:
-        d2 = 1e-2
-        ra2, ca2, va2 = make(n, n, d2, 5)
-        rb2, cb2, vb2 = make(n, n, d2, 6)
-        a2 = DistSparseVecMatrix.from_coo(ra2, ca2, va2, (n, n))
-        b2 = DistSparseVecMatrix.from_coo(rb2, cb2, vb2, (n, n))
-        a2.multiply_sparse(b2).nnz  # warmup
-        t0 = time.perf_counter()
-        r2 = a2.multiply_sparse(b2)
-        _ = r2.nnz
-        dt2 = time.perf_counter() - t0
-        dt2_sci = scipy_time(ra2, ca2, va2, rb2, cb2, vb2, n)
-        out.update(d1e2_seconds=round(dt2, 4),
-                   d1e2_scipy_seconds=round(dt2_sci, 3),
-                   d1e2_vs_baseline=round(dt2_sci / dt2, 3))
-    except Exception as e:  # noqa: BLE001
-        out["d1e2_error"] = _trim_err(e, 160)
-    return out
-
-
-def _xla_ref(out: dict, label: str, fn, our_dt: float) -> dict:
-    """Attach the raw-XLA reference timing to a config line, defensively:
-    the baseline's own failure (e.g. XLA's LuDecompositionBlock scoped-vmem
-    bug at 16k on v5e) must not discard OUR measurement.
-
-    The reference runs under linalg_precision_scope, same as our op: an
-    ambient-default baseline would run its f32 matmuls as bf16 passes —
-    ~2x faster AND failing the very reconstruction bar our op is held to
-    (apples-to-oranges; observed cholesky 0.08s ambient vs 0.45s ours)."""
-    from marlin_tpu.config import linalg_precision_scope
-
-    def scoped():
-        with linalg_precision_scope():
-            return fn()
-
-    try:
-        dt_xla = _timed(scoped, iters=2)
-        out.update(vs_baseline=round(dt_xla / our_dt, 3),
-                   **{f"xla_{label}_seconds": round(dt_xla, 4)})
-    except Exception as e:  # noqa: BLE001
-        out.update(vs_baseline=0, **{f"xla_{label}_error": _trim_err(e, 160)})
-    return out
-
-
-def config_spmm():
-    """Distributed sparse x dense ring (dist_sparse.spmm — the GCN
-    propagation op) at 16k x 16k, 1e-3 density, times a (16k, 512) dense
-    block. Oracle at 2048 on hardware; effective rate counts nnz(A) * n
-    MACs."""
-    import numpy as np
-
-    from marlin_tpu.matrix.dist_sparse import DistSparseVecMatrix, spmm
-
-    def make(m, n, density, seed):
-        r = np.random.default_rng(seed)
-        nnz = int(m * n * density)
-        return (r.integers(0, m, nnz), r.integers(0, n, nnz),
-                r.standard_normal(nnz).astype(np.float32))
-
-    no = 2048
-    ra, ca, va = make(no, no, 5e-3, 1)
-    a = DistSparseVecMatrix.from_coo(ra, ca, va, (no, no))
-    bo = jnp.asarray(
-        np.random.default_rng(2).standard_normal((no, 128)), jnp.float32)
-    got = np.asarray(spmm(a, bo))
-    da = np.zeros((no, no)); np.add.at(da, (ra, ca), va)
-    ref = da @ np.asarray(bo, np.float64)
-    err = float(np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-30))
-
-    n, cols = _sized("BENCH_SPMM_N", 16384), _sized("BENCH_SPMM_C", 512)
-    ra, ca, va = make(n, n, 1e-3, 3)
-    a = DistSparseVecMatrix.from_coo(ra, ca, va, (n, n))
-    b = jax.random.normal(jax.random.PRNGKey(4), (n, cols), jnp.float32)
-    fence(spmm(a, b))  # warmup: engine compile
-    t0 = time.perf_counter()
-    out_arr = spmm(a, b)
-    fence(out_arr)
-    dt = time.perf_counter() - t0
-    eff = 2.0 * len(va) * cols / dt / 1e9
-    route = ("ell" if a._ell_wins(n, cols)
-             else "dense" if a._use_dense_route(n, cols, "auto")
-             else "ring")
-    out = {"metric": f"spmm_{n//1024}k_gflops", "value": round(eff, 2),
-           "unit": "GFLOP/s", "vs_baseline": 0, "route": route,
-           "oracle_max_err": round(err, 9), "oracle_ok": err < 1e-4}
-    if route == "ell":
-        # Static model (utils/cost_model.py, CI-asserted): the r03 0.884x
-        # was measured on the pre-ELL ring; the route + predicted bytes
-        # make the r05 capture diagnosable against the model.
-        from marlin_tpu.utils import cost_model as cm
-
-        _, _, r_slots = a.ell_stripes()
-        _, mbytes = cm.ell_product_cost(n, n, cols, r_slots,
-                                        len(jax.devices()), 4)
-        out.update(predicted_bytes_per_chip=mbytes, ell_r_slots=int(r_slots))
-    # Baseline (VERDICT r02 item 4): XLA's own sparse x dense on the same
-    # chip — BCOO dot_general; vs_baseline = bcoo_time / our_time. scipy
-    # CSR on the host CPU recorded alongside for a second frame.
-    try:
-        from jax.experimental import sparse as jsparse
-
-        am = jsparse.BCOO(
-            (jnp.asarray(va), jnp.stack(
-                [jnp.asarray(ra, jnp.int32), jnp.asarray(ca, jnp.int32)], 1)),
-            shape=(n, n))
-        bcoo_mm = jax.jit(lambda m, x: m @ x)
-        fence(bcoo_mm(am, b))
-        t0 = time.perf_counter()
-        fence(bcoo_mm(am, b))
-        dt_bcoo = time.perf_counter() - t0
-        out.update(xla_bcoo_seconds=round(dt_bcoo, 3),
-                   vs_baseline=round(dt_bcoo / dt, 3))
-    except Exception as e:  # noqa: BLE001
-        out["xla_bcoo_error"] = _trim_err(e, 120)
-    try:
-        import scipy.sparse as sp
-
-        sa = sp.csr_matrix((va, (ra, ca)), shape=(n, n))
-        bh = np.asarray(b, np.float32)
-        _ = sa @ bh
-        t0 = time.perf_counter()
-        _ = sa @ bh
-        out["scipy_csr_seconds"] = round(time.perf_counter() - t0, 3)
-    except Exception as e:  # noqa: BLE001
-        out["scipy_error"] = _trim_err(e, 120)
-    return out
-
-
-def config_lu():
-    """Blocked LU (single-jit fori_loop panel sweep) vs raw XLA lu at 16k f32.
-
-    vs_baseline = xla_time / our_time: >= 0.333 meets the VERDICT's
-    "within 3x of a raw XLA lu on the same chip" bar. Reconstruction error
-    ||A[perm] - L U||_max / ||A||_max at n=2048 recorded as oracle_max_err."""
-    import numpy as np
-
-    from marlin_tpu.linalg.lu import lu_factor_array, unpack_lu
-
-    # Oracle at 2048 on hardware.
-    rng = np.random.default_rng(0)
-    a_small = jnp.asarray(rng.standard_normal((2048, 2048)), jnp.float32)
-    with mt.config_override(lu_base_size=512):
-        packed, perm = lu_factor_array(a_small, mode="dist")
-    l, u = unpack_lu(np.asarray(packed, np.float64))
-    an = np.asarray(a_small, np.float64)
-    err = float(np.max(np.abs(an[perm] - l @ u)) / np.max(np.abs(an)))
-
-    n = _sized("BENCH_LU_N", 16384)
-    key = jax.random.PRNGKey(3)
-    a = jax.random.normal(key, (n, n), jnp.float32)
-    with mt.config_override(lu_base_size=1024):
-        dt = _timed(lambda: lu_factor_array(a, mode="dist")[0], iters=2)
-    out = {"metric": f"lu_dist_{n//1024}k_seconds", "value": round(dt, 4),
-           "unit": "s", "oracle_max_err": round(err, 9),
-           "oracle_ok": err < 1e-3}
-    out = _xla_ref(out, "lu", lambda: jax.lax.linalg.lu(a)[0], dt)
-    if not out.get("vs_baseline"):
-        # XLA's LuDecompositionBlock hits its own scoped-vmem bug at 16k on
-        # v5e (r02/r03 captures) — the BASELINE is broken, not our op. For
-        # a usable ratio, compare both at half size and report that.
-        n2 = n // 2
-        a2 = jax.random.normal(key, (n2, n2), jnp.float32)
-        with mt.config_override(lu_base_size=1024):
-            dt2 = _timed(lambda: lu_factor_array(a2, mode="dist")[0], iters=2)
-        half = _xla_ref({}, "lu_half", lambda: jax.lax.linalg.lu(a2)[0], dt2)
-        out.update(vs_baseline=half.get("vs_baseline", 0),
-                   vs_baseline_note=f"ratio measured at {n2} (XLA lu "
-                                    f"fails at {n}); ours_half={dt2:.3f}s",
-                   **{k: v for k, v in half.items() if k.startswith("xla_")})
-    return out
-
-
-def config_cholesky():
-    """Blocked Cholesky (single-jit panel sweep) vs raw XLA cholesky at 16k."""
-    import numpy as np
-
-    from marlin_tpu.linalg.cholesky import cholesky_factor_array
-
-    # Oracle at 2048: ||L L^T - A|| / ||A||.
-    rng = np.random.default_rng(0)
-    c = rng.standard_normal((2048, 2048)).astype(np.float32)
-    a_small = jnp.asarray(c @ c.T + 2048 * np.eye(2048, dtype=np.float32))
-    with mt.config_override(cholesky_base_size=512):
-        ln = np.asarray(cholesky_factor_array(a_small, mode="dist"), np.float64)
-    an = np.asarray(a_small, np.float64)
-    err = float(np.max(np.abs(ln @ ln.T - an)) / np.max(np.abs(an)))
-
-    n = _sized("BENCH_CHOL_N", 16384)
-    key = jax.random.PRNGKey(5)
-    g = jax.random.normal(key, (n, n), jnp.float32) / jnp.sqrt(float(n))
-    a = (g @ g.T + 2.0 * jnp.eye(n, dtype=jnp.float32))
-    with mt.config_override(cholesky_base_size=1024):
-        dt = _timed(lambda: cholesky_factor_array(a, mode="dist"), iters=2)
-    out = {"metric": f"cholesky_dist_{n//1024}k_seconds", "value": round(dt, 4),
-           "unit": "s", "oracle_max_err": round(err, 9),
-           "oracle_ok": err < 1e-3}
-    return _xla_ref(out, "cholesky", lambda: jnp.linalg.cholesky(a), dt)
-
-
-def config_inverse():
-    """Blocked inverse (LU + two triangular solves) vs raw XLA inv at 8k."""
-    from marlin_tpu.linalg.inverse import inverse
-
-    n = _sized("BENCH_INV_N", 8192)
-    key = jax.random.PRNGKey(9)
-    a = jax.random.normal(key, (n, n), jnp.float32) + n * jnp.eye(n, dtype=jnp.float32)
-    with mt.config_override(lu_base_size=1024):
-        dt, inv = _timed_r(lambda: inverse(a, mode="dist"), iters=2)
-    resid = float(jnp.max(jnp.abs(inv @ a - jnp.eye(n, dtype=jnp.float32))))
-    out = {"metric": f"inverse_dist_{n//1024}k_seconds", "value": round(dt, 4),
-           "unit": "s", "oracle_max_err": round(resid, 9),
-           "oracle_ok": resid < 1e-2}
-    return _xla_ref(out, "inv", lambda: jnp.linalg.inv(a), dt)
-
-
-def config_svd():
-    """Dist-eigs SVD (Gramian matvec + Lanczos) on a tall 200k x 2k matrix —
-    the reference's DistARPACK showpiece shape (DenseVecMatrix.scala:1599)."""
-    import numpy as np
-
-    from marlin_tpu.matrix.dense import DenseVecMatrix
-
-    m, n, k = _sized("BENCH_SVD_M", 200_000), _sized("BENCH_SVD_N", 2048), 10
-    a = mrand.random_den_vec_matrix(m, n, seed=11, dtype=jnp.float32)
-    t0 = time.perf_counter()
-    _, s, _ = a.compute_svd(k, compute_u=False, mode="dist-eigs", tol=1e-6)
-    dt = time.perf_counter() - t0
-    ok = bool(np.all(np.diff(np.asarray(s)) <= 1e-6)) and s.shape == (k,)
-    out = {"metric": f"svd_dist_eigs_{m // 1000}kx{n}_seconds",
-           "value": round(dt, 3),
-           "unit": "s", "vs_baseline": 0, "oracle_ok": ok}
-    # The fast arm for this shape (G = A^T A fits trivially at n=2048):
-    # one sharded Gramian + local SVD — what auto mode SHOULD pick here if
-    # speed were the only axis; dist-eigs is the operator-only arm whose
-    # point is never forming G (n x n) when n is huge.
-    try:
-        t0 = time.perf_counter()
-        _, s_loc, _ = a.compute_svd(k, compute_u=False, mode="local-svd")
-        out["local_svd_seconds"] = round(time.perf_counter() - t0, 3)
-        rel_loc = float(np.max(
-            np.abs(np.sort(np.asarray(s_loc)) - np.sort(np.asarray(s)))
-            / np.maximum(np.sort(np.asarray(s_loc)), 1e-30)))
-        out["dist_vs_local_rel_diff"] = round(rel_loc, 6)
-    except Exception as e:  # noqa: BLE001
-        out["local_svd_error"] = _trim_err(e, 120)
-    # Baseline (VERDICT r02 item 5): XLA's dense eigendecomposition of the
-    # explicit Gramian — the local-LAPACK arm of the reference's own mode
-    # switch (DenseVecMatrix.scala:1595-1598) run on the same chip; its
-    # top-k sqrt-eigenvalues answer the same question. vs_baseline =
-    # xla_time / our_time.
-    try:
-        def gram_eigh():
-            g = jnp.dot(a.data.T, a.data, precision="highest")
-            w = jnp.linalg.eigh(g)[0]
-            return jnp.sqrt(jnp.maximum(w[-k:], 0.0))
-        s_ref = np.asarray(jax.jit(gram_eigh)())  # warmup + values
-        t0 = time.perf_counter()
-        fence(jax.jit(gram_eigh)())
-        dt_xla = time.perf_counter() - t0
-        rel = float(np.max(np.abs(np.sort(s_ref) - np.sort(np.asarray(s)))
-                           / np.maximum(np.sort(s_ref), 1e-30)))
-        out.update(xla_gramian_eigh_seconds=round(dt_xla, 3),
-                   vs_baseline=round(dt_xla / dt, 3),
-                   topk_rel_diff_vs_xla=round(rel, 6))
-    except Exception as e:  # noqa: BLE001
-        out["xla_gramian_eigh_error"] = _trim_err(e, 160)
-    return out
-
-
-def _train_throughput(metric, cfg, batch):
-    """Shared train-step timing recipe: init, jit, warmup+fence, burst-timed
-    step, tokens/sec + 6*N*T model-FLOPs estimate."""
-    import numpy as np
-
-    from marlin_tpu.models import init_params, train_step
-
-    s = cfg.max_len
-    params = init_params(cfg, seed=0)
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, s), 0, cfg.vocab)
-    targets = jnp.roll(tokens, -1, axis=1)
-    step = jax.jit(train_step, static_argnames="cfg")
-    loss0, params = step(params, tokens, targets, cfg=cfg)
-    fence(loss0)
-    # Time against fixed params (throughput, not a training run); fetch
-    # only the scalar loss.
-    dt, loss = _timed_r(
-        lambda: step(params, tokens, targets, cfg=cfg)[0],
-        iters=5 if batch > 1 else 3,
-    )
-    n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
-    model_tflops = 6.0 * n_par * batch * s / dt / 1e12
-    # Full-step model incl. the attention term 6*N*T excludes
-    # (utils/cost_model.py, CI-locked to the flash kernel's grid): real
-    # MFU for the attribution the r04 verdict asked of this line.
-    from marlin_tpu.utils import cost_model as cm
-
-    full_flops = cm.transformer_step_flops(
-        n_par, batch, s, cfg.n_layers, cfg.n_heads,
-        cfg.d_model // cfg.n_heads, window=cfg.window)
-    # vs_baseline: model-FLOPs utilization against the same 50%-of-peak
-    # north star the headline GEMM uses (6*N*T is the standard lower-bound
-    # FLOP count — attention FLOPs excluded, so long-seq configs understate;
-    # mfu_frac_peak is the honest fraction including attention).
-    return {"metric": metric, "value": round(batch * s / dt, 1),
-            "unit": "tok/s",
-            "vs_baseline": round(model_tflops / (0.5 * guess_peak()), 3),
-            "model_tflops_est": round(model_tflops, 2),
-            "full_model_tflops": round(full_flops / dt / 1e12, 2),
-            "mfu_frac_peak": round(full_flops / dt / 1e12 / guess_peak(), 3),
-            "params_m": round(n_par / 1e6, 1),
-            # Config provenance: which variant this line measured (the
-            # capture ledger compares lines across sessions; dtype/arch
-            # knobs are exactly what moves them).
-            "dtype": cfg.dtype, "d_model": cfg.d_model,
-            "n_layers": cfg.n_layers, "batch": batch,
-            "seq_len": cfg.max_len,
-            "kv_heads": cfg.kv_heads, "rope": cfg.rope,
-            "window": cfg.window, "remat": cfg.remat,
-            "loss_finite": bool(np.isfinite(float(loss)))}
-
-
-def config_transformer():
-    """Flagship transformer LM train step (models/): tokens/sec on the chip
-    through the differentiable flash-attention path. Model-scale knobs via
-    BENCH_TF_* (default ~125M params, S=2048, B=8, bf16 activations via the
-    global default dtype)."""
-    from marlin_tpu.models import TransformerConfig
-
-    d = _sized("BENCH_TF_D", 1024)
-    cfg = TransformerConfig(
-        vocab=_sized("BENCH_TF_VOCAB", 32768), d_model=d,
-        n_heads=max(2, d // 128), n_layers=_sized("BENCH_TF_L", 8),
-        d_ff=4 * d, max_len=_sized("BENCH_TF_S", 2048),
-        # Architecture knobs so the capture can compare variants on chip.
-        n_kv_heads=_sized("BENCH_TF_KV", 0),
-        rope=bool(_sized("BENCH_TF_ROPE", 0)),
-        window=_sized("BENCH_TF_WINDOW", 0),
-        # Mixed precision (f32 master params, bf16 compute): halves HBM
-        # traffic and doubles MXU rate vs the r03 all-f32 runs.
-        dtype=os.environ.get("BENCH_TF_DTYPE", "bfloat16"),
-    )
-    return _train_throughput(
-        "transformer_train_tokens_per_s", cfg, _sized("BENCH_TF_B", 8))
-
-
-def config_longseq():
-    """Long-context train step: B=1 at S=8k (default; BENCH_LS_* to push
-    further) through the Pallas flash backward + per-block remat. Before
-    those landed this config was impossible on a 16 GB chip: the XLA
-    attention backward alone materialized H * S^2 f32 logits (8 GB per
-    layer at S=16k)."""
-    from marlin_tpu.models import TransformerConfig
-
-    d = _sized("BENCH_LS_D", 1024)
-    s = _sized("BENCH_LS_S", 8192)
-    cfg = TransformerConfig(
-        vocab=_sized("BENCH_LS_VOCAB", 16384), d_model=d,
-        n_heads=max(2, d // 128), n_layers=_sized("BENCH_LS_L", 8),
-        d_ff=4 * d, max_len=s, rope=True, remat=True,
-        n_kv_heads=_sized("BENCH_LS_KV", 0),
-        window=_sized("BENCH_LS_WINDOW", 0),
-        dtype=os.environ.get("BENCH_LS_DTYPE", "bfloat16"),
-    )
-    return _train_throughput(
-        f"longseq_train_s{s // 1024}k_tokens_per_s", cfg, batch=1)
-
-
-def config_decode():
-    """KV-cache autoregressive decode on the flagship transformer
-    (models.generate): tokens/sec/sequence at B=8. The whole decode loop is
-    ONE jitted lax.scan dispatch, so the tunnel RTT amortizes over all
-    generated tokens by construction."""
-    from marlin_tpu.models import TransformerConfig, generate, init_params
-
-    d = _sized("BENCH_DEC_D", 1024)
-    quant = bool(_sized("BENCH_DEC_QUANT", 0))
-    cfg = TransformerConfig(
-        vocab=_sized("BENCH_DEC_VOCAB", 32768), d_model=d,
-        n_heads=max(2, d // 128), n_layers=_sized("BENCH_DEC_L", 8),
-        d_ff=4 * d, max_len=_sized("BENCH_DEC_S", 1024),
-        # GQA/RoPE knobs: BENCH_DEC_KV=2 shows the cache shrink on hardware.
-        n_kv_heads=_sized("BENCH_DEC_KV", 0),
-        rope=bool(_sized("BENCH_DEC_ROPE", 0)),
-        dtype=os.environ.get("BENCH_DEC_DTYPE", "bfloat16"),
-        # The int8 arm streams int8 on BOTH sides of the roofline
-        # denominator: weights (models/quant.py) AND the KV cache.
-        kv_quant="int8" if quant else "",
-    )
-    b = _sized("BENCH_DEC_B", 8)
-    prompt_len = min(64, max(1, cfg.max_len // 2))
-    steps = cfg.max_len - prompt_len
-    params = init_params(cfg, seed=0)
-    if quant:
-        from marlin_tpu.models import quantize_params_int8
-
-        # donate: the masters are never read again in this config, so the
-        # quantizer may consume their buffers leaf by leaf.
-        params = quantize_params_int8(params, donate=True)
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(1), (b, prompt_len), 0, cfg.vocab)
-    out = generate(params, prompt, steps, cfg)  # warmup: prefill+scan compile
-    int(jnp.sum(out))  # host fetch — block_until_ready can return early here
-    t0 = time.perf_counter()
-    out = generate(params, prompt, steps, cfg)
-    n_out = int(jnp.sum(out >= 0))  # host fetch = the fence
-    dt = (time.perf_counter() - t0) / steps
-    # Baseline (VERDICT r02 item 5): the HBM roofline. Decode is
-    # bandwidth-bound: every step streams the full parameter set once
-    # (shared across the batch) plus each sequence's KV cache.
-    import numpy as np
-
-    kind = jax.devices()[0].device_kind
-    bw = next((v for kk, v in HBM_GBPS.items() if kk.lower() in kind.lower()),
-              819.0) * 1e9
-    # Streamed bytes per step are at the STREAMED dtype: int8 weights (with
-    # their small float scales) stream as-is; float leaves stream at the
-    # compute dtype (the scan-invariant cast of the f32 masters is hoisted
-    # and materialized once), and the KV cache is built at the compute
-    # dtype too.
-    it = jnp.dtype(cfg.dtype).itemsize
-    p_bytes = sum(
-        l.nbytes if jnp.issubdtype(l.dtype, jnp.integer) else l.size * it
-        for l in jax.tree.leaves(params))
-    kv_heads = cfg.n_kv_heads or cfg.n_heads
-    dh = cfg.d_model // cfg.n_heads
-    # K+V per sequence: int8 cache streams 1 byte/elem + one f32 scale per
-    # stored vector; float cache streams at the compute dtype.
-    per_vec = (dh + 4) if quant else dh * it
-    kv_bytes = 2 * cfg.n_layers * cfg.max_len * kv_heads * per_vec
-    # One step streams params once (batch-shared) + every sequence's cache:
-    # per-seq roofline tok/s = BW / (p_bytes + B * kv_bytes).
-    roofline = bw / (p_bytes + b * kv_bytes)
-    # Static model (utils/cost_model.py, CI-asserted band): predicted
-    # per-step streamed bytes — must agree with the roofline denominator.
-    # The int8 arm prices the per-vector f32 cache scales and the float
-    # remainder of the weights (biases, norms, s8 scales at the compute
-    # dtype) inside decode_step_cost itself, so the two figures share one
-    # per_vec/p_bytes accounting instead of diverging by a few percent
-    # (advisor r05 low #1; exactness pinned in tests/test_cost_model.py).
-    from marlin_tpu.utils import cost_model as cm
-
-    _, predicted_step_bytes = cm.decode_step_cost(
-        cfg, b, param_itemsize=it, cache_itemsize=it, quant_weights=quant)
-    # The int8 arm gets its own metric name: same-prefix lines share one
-    # replay slot per config, and the quant line must not shadow the base
-    # capture (or vice versa) in the dead-tunnel fallback.
-    metric = ("decode_int8_tokens_per_s_per_seq" if quant
-              else "decode_tokens_per_s_per_seq")
-    return {"metric": metric, "value": round(1.0 / dt, 1),
-            "unit": "tok/s", "vs_baseline": round((1.0 / dt) / roofline, 3),
-            "batch": b, "total_tok_s": round(b / dt, 1),
-            "hbm_roofline_tok_s_per_seq": round(roofline, 1),
-            "predicted_step_bytes": predicted_step_bytes,
-            # Config provenance (cross-session ledger comparability).
-            "dtype": cfg.dtype, "kv_heads": kv_heads, "rope": cfg.rope,
-            "cache_len": cfg.max_len, "d_model": cfg.d_model,
-            "quant": quant, "out_ok": n_out == b * steps}
-
-
-def config_decode_int8():
-    """config_decode with weight-only int8 streaming (models/quant.py) —
-    its own config so the int8 line gets its own dead-tunnel replay slot
-    (the per-config cache keys on the config FUNCTION; an env-var arm of
-    config_decode would silently replay the base decode line instead)."""
-    prev = os.environ.get("BENCH_DEC_QUANT")
-    os.environ["BENCH_DEC_QUANT"] = "1"
-    try:
-        return config_decode()
-    finally:
-        if prev is None:
-            os.environ.pop("BENCH_DEC_QUANT", None)
-        else:
-            os.environ["BENCH_DEC_QUANT"] = prev
-
-
-def config_decode_spec():
-    """Prompt-lookup speculative decode (models.generate_speculative) vs
-    plain greedy decode, B=1, same config — the latency axis next to
-    decodeint8's throughput axis. The prompt/continuation is a synthetic
-    REPETITIVE sequence (period-16 cycle), the regime speculation exists
-    for (code/chat/retrieval text repeats itself; pure random tokens
-    accept ~nothing and the config reports that bound too).
-    vs_baseline = speculative tok/s over plain tok/s: >= 1 means the
-    chunked verify's weight-stream amortization beat its overhead."""
-    import numpy as np
-
-    from marlin_tpu.models import (TransformerConfig, generate,
-                                   generate_speculative, init_params)
-
-    d = _sized("BENCH_SPEC_D", 1024)
-    steps = _sized("BENCH_SPEC_STEPS", 256)
-    draft_len = _sized("BENCH_SPEC_DRAFT", 8)
-    prompt_len = 64
-    cfg = TransformerConfig(
-        vocab=_sized("BENCH_SPEC_VOCAB", 32768), d_model=d,
-        n_heads=max(2, d // 128), n_layers=_sized("BENCH_SPEC_L", 8),
-        d_ff=4 * d, max_len=prompt_len + steps + draft_len,
-        dtype=os.environ.get("BENCH_SPEC_DTYPE", "bfloat16"),
-    )
-    params = init_params(cfg, seed=0)
-    cycle = np.random.default_rng(5).integers(0, cfg.vocab, 16)
-    prompt = jnp.asarray(
-        np.tile(cycle, prompt_len // 16 + 1)[:prompt_len][None], jnp.int32)
-
-    def timed(fn):
-        out = fn()  # warmup: prefill + loop compile
-        int(jnp.sum(out))
-        t0 = time.perf_counter()
-        out = fn()
-        n = int(jnp.sum(out >= 0))  # host fetch = the fence
-        return (time.perf_counter() - t0) / steps, n
-
-    dt_plain, n1 = timed(lambda: generate(params, prompt, steps, cfg))
-    dt_spec, n2 = timed(lambda: generate_speculative(
-        params, prompt, steps, cfg, draft_len=draft_len))
-    # The degradation bound: zero acceptances emit ONE token per verify
-    # chunk, so the floor is 1 / t_chunk — measured directly (a "random
-    # prompt" can't measure it: an untrained model's greedy continuation
-    # falls into repeating attractors, so acceptance goes UP, not down).
-    # Meaningful on the chip, where decode is weight-stream-bound and
-    # t_chunk ~ t_step (floor_vs_plain ~ 1); the CPU smoke's per-step
-    # loop overhead dominates its tiny matmuls and skews this field.
-    from marlin_tpu.models import decode_chunk, init_kv_cache, prefill
-
-    _, cache = prefill(params, prompt, cfg)
-    chunk = jnp.zeros((1, draft_len), jnp.int32)
-    dt_chunk = _scan_timed(
-        lambda c: decode_chunk(params, cache, c, prompt_len, cfg)[0],
-        chunk, loop=8, reps=3)
-    # Parity ON HARDWARE: the schedule-not-distribution contract is exact
-    # when argmax is roundoff-stable; near-tied UNTRAINED bf16 logits can
-    # flip between the chunked and per-step reduction orders (a dtype
-    # property, not a speculation bug — measured f32 parity is exact), so
-    # report the agreement fraction, with greedy_parity_ok = full match.
-    # The probe is capped at the configured step count: max_len is sized
-    # for BENCH_SPEC_STEPS, and a fixed 32-step probe under a smaller
-    # setting would trip generate_speculative's max_len guard and error
-    # the whole config (advisor r05 low #2).
-    probe = min(32, steps)
-    a = np.asarray(generate(params, prompt, probe, cfg))
-    b = np.asarray(generate_speculative(params, prompt, probe, cfg,
-                                        draft_len=draft_len))
-    agreement = float((a == b).mean())
-    return {"metric": "decode_spec_tokens_per_s", "value": round(1.0 / dt_spec, 1),
-            "unit": "tok/s",
-            "vs_baseline": round(dt_plain / dt_spec, 3),
-            "plain_tok_s": round(1.0 / dt_plain, 1),
-            "zero_accept_floor_tok_s": round(1.0 / dt_chunk, 1),
-            "floor_vs_plain": round(dt_plain / dt_chunk, 3),
-            "draft_len": draft_len, "steps": steps, "d_model": d,
-            "dtype": cfg.dtype, "greedy_parity_ok": agreement == 1.0,
-            "greedy_agreement": round(agreement, 3),
-            "out_ok": n1 == steps and n2 == steps}
-
-
-def config_trend_cpu():
-    """CPU trend-sweep validation (utils/cost_model.py trend harness): small
-    wall-clock sweeps — decode over (batch, steps, finished fraction) and
-    SUMMA over (m, k, n) — scored as model-vs-measured Spearman rank
-    correlation, plus the finished-fraction early-exit ratio. This is the
-    r05 verdict's dead-tunnel fallback (top_next): trend-validated evidence
-    that the cost models predict SCALING, not just per-shape structure. It
-    runs on any backend but is designed for the forced CPU mesh
-    (BENCH_FORCE_CPU=1 / the test suite's 8-device host platform); the same
-    sweeps are asserted in CI by tests/test_trend_sweep.py (rho >= 0.9),
-    so this config's job is the artifact line, not the gate."""
-    from marlin_tpu.utils import cost_model as cm
-
-    decode = cm.run_decode_trend_sweep()
-    summa = cm.run_summa_trend_sweep()
-    dv, sv = cm.trend_verdict(decode), cm.trend_verdict(summa)
-    # Early-exit cliff: the all-finished decode point against its
-    # same-shape all-live twin (skew-proofing made the while_loop exit
-    # before the first body; < 0.5 means the exit is real, not noise).
-    full = next(p for p in decode
-                if p["finished_frac"] == 0.0 and p["batch"] == 8)
-    done = next(p for p in decode if p["finished_frac"] == 1.0)
-    rho_min = min(dv["rho"], sv["rho"])
-    return {"metric": "trend_rank_correlation_min", "value": rho_min,
-            "unit": "rho", "vs_baseline": round(rho_min / 0.9, 3),
-            "decode_rho": dv["rho"], "summa_rho": sv["rho"],
-            "finished_exit_ratio": round(done["measured"] / full["measured"],
-                                         4),
-            "decode_points": [[p["batch"], p["steps"], p["finished_frac"],
-                               round(p["measured"], 5)] for p in decode],
-            "summa_points": [[p["m"], p["k"], p["n"],
-                              round(p["measured"], 5)] for p in summa]}
-
-
-def config_dispatch_sweep():
-    """Broadcast-vs-SUMMA crossover sweep (VERDICT next-6): times both arms
-    for a row-striped A (m x k) times (k x n) B over a range of B sizes, and
-    reports the measured crossover in MB — the data the 300 MB
-    Spark-derived default must be re-derived from (SURVEY §7 hard parts:
-    HBM residency vs ICI gather volume, not shuffle cost). Emits one line
-    per operand size on stderr and ONE summary JSON line."""
-    import math
-
-    m = _sized("BENCH_SWEEP_M", 16384)
-    results = []
-    for n in (256, 512, 1024, 2048, 4096, 8192):
-        k = n
-        a = mrand.random_den_vec_matrix(m, k, seed=1, dtype=DTYPE)
-        b = mrand.random_den_vec_matrix(k, n, seed=2, dtype=DTYPE)
-        size_mb = k * n * jnp.dtype(DTYPE).itemsize / 1e6
-        dt_b = _timed(lambda: a.multiply(b, mode="broadcast"), iters=5)
-        dt_s = _timed(lambda: a.multiply(b, mode="summa"), iters=5)
-        results.append((size_mb, dt_b, dt_s))
-        print(f"sweep n={n} B={size_mb:.1f}MB broadcast={dt_b*1e3:.2f}ms "
-              f"summa={dt_s*1e3:.2f}ms", file=sys.stderr, flush=True)
-    # Crossover: smallest operand size where SUMMA beats broadcast (None if
-    # broadcast always wins — then the threshold should exceed the sweep).
-    cross = next((mb for mb, db, ds in results if ds < db), None)
-    return {"metric": "dispatch_crossover_mb",
-            "value": round(cross, 1) if cross else -1.0,
-            "unit": "MB", "vs_baseline": 0,
-            "points": [[round(mb, 1), round(db, 5), round(ds, 5)]
-                       for mb, db, ds in results]}
-
-
-def config_attention_sweep():
-    """Flash-attention block-size sweep at the bench shape (S=8k, H=8,
-    D=128): times each (block_q, block_k) candidate plus the XLA
-    softmax-attention reference, prints per-point lines on stderr, and
-    returns the best point — the autotune data for picking kernel defaults
-    on this chip generation."""
-    from marlin_tpu.ops import flash_attention
-
-    s, h, d = _sized("BENCH_ATTN_S", 8192), 8, 128
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
-    flops = 4.0 * s * s * h * d
-
-    def xla_ref(q, k, v):
-        logits = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) / jnp.sqrt(float(d))
-        return jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, axis=-1),
-                          v.astype(jnp.float32))
-
-    try:
-        dt_xla = _scan_timed(xla_ref, q, k, v, loop=3)
-        print(f"attn sweep xla_ref {flops / dt_xla / 1e12:.1f} TFLOPS",
-              file=sys.stderr, flush=True)
-    except Exception as e:  # noqa: BLE001 - S x S logits can OOM; sweep on
-        dt_xla = None
-        print(f"attn sweep xla_ref failed: {_trim_err(e, 120)}",
-              file=sys.stderr, flush=True)
-
-    best = (None, 0.0)
-    for bq, bk in ((512, 512), (512, 1024), (1024, 512), (1024, 1024),
-                   (2048, 1024), (1024, 2048), (2048, 2048)):
-        try:
-            # Device-side scan timing: per-dispatch RTT noise (±2x between
-            # sessions) would otherwise pick blocks by tunnel weather.
-            dt = _scan_timed(
-                lambda q, k, v: flash_attention(
-                    q, k, v, block_q=bq, block_k=bk),
-                q, k, v,
-            )
-            tf = flops / dt / 1e12
-        except Exception as e:  # noqa: BLE001
-            print(f"attn sweep ({bq},{bk}) failed: {_trim_err(e, 120)}",
-                  file=sys.stderr, flush=True)
-            continue
-        print(f"attn sweep ({bq},{bk}) {tf:.1f} TFLOPS", file=sys.stderr,
-              flush=True)
-        if tf > best[1]:
-            best = ((bq, bk), tf)
-    if best[0] is None:
-        raise RuntimeError("every block-size candidate failed")
-    out = {"metric": "flash_attention_best_tflops", "value": round(best[1], 2),
-           "unit": "TFLOPS", "vs_baseline": 0,
-           "best_block": list(best[0])}
-    if dt_xla:
-        out["xla_ref_tflops"] = round(flops / dt_xla / 1e12, 2)
-    return out
-
-
-CONFIGS = {
-    "headline": [headline],
-    "square8k": [config_square_8k],
-    "tallskinny": [config_tall_skinny],
-    "chained": [config_chained],
-    "summa": [config_summa_mesh],
-    "attention": [config_attention],
-    "sparse": [config_sparse],
-    "sparsedist": [config_sparse_dist],
-    "spmm": [config_spmm],
-    "lu": [config_lu],
-    "cholesky": [config_cholesky],
-    "inverse": [config_inverse],
-    "svd": [config_svd],
-    "transformer": [config_transformer],
-    "longseq": [config_longseq],
-    "decode": [config_decode],
-    "decodeint8": [config_decode_int8],
-    "decodespec": [config_decode_spec],
-    "trend": [config_trend_cpu],
-    "sweep": [config_dispatch_sweep],
-    "attnsweep": [config_attention_sweep],
-}
-# "all" = the artifact configs; the sweeps and the CPU trend validation are
-# policy/tuning tools, run explicitly.
-CONFIGS["all"] = [
-    fns[0] for k, fns in CONFIGS.items()
-    if k not in ("sweep", "attnsweep", "trend")
-]
+    return _artifact._emit_cached_results(config, err, capture_dir)
 
 
 def main():
@@ -1463,10 +98,12 @@ def main():
     args = p.parse_args()
     _CONFIG[0] = args.config
     disarm = _start_watchdog()
-    init_backend()
-    mt.set_config(default_dtype=DTYPE, matmul_precision="default")
+    # Resolved through module globals on purpose: tests monkeypatch
+    # bench.init_backend / bench.mt / bench.CONFIGS.
+    globals()["init_backend"]()
+    globals()["mt"].set_config(default_dtype=DTYPE,
+                               matmul_precision="default")
     succeeded = 0
-    global _succeeded
     # A config must not START unless this much budget remains — letting the
     # hard watchdog kill a dispatch in flight wedges the TPU tunnel lease.
     budget = float(os.environ.get("BENCH_WATCHDOG", "3000"))
@@ -1483,7 +120,8 @@ def main():
     # of the status; the SKILL.md contract (last status authoritative, no
     # status = no live evidence, cached:true = replay) covers every case.
     status_out = False
-    for fn in CONFIGS[args.config]:
+    configs = globals()["CONFIGS"][args.config]
+    for fn in configs:
         name = fn.__name__.removeprefix("config_") or fn.__name__
         if _remaining() < soft_floor:
             line = _error_line(name, f"skipped: <{soft_floor:.0f}s of "
@@ -1496,10 +134,10 @@ def main():
             except Exception as e:  # noqa: BLE001 - parsable line, keep going
                 line = _error_line(name, _trim_err(e))
         if succeeded and not status_out:
-            _emit_run_status(live=True, n_lines=len(CONFIGS[args.config]))
+            _emit_run_status(live=True, n_lines=len(configs))
             status_out = True
         print(json.dumps(line), flush=True)
-        _succeeded = succeeded
+        _SUCCEEDED[0] = succeeded
     disarm.set()
     sys.exit(0 if succeeded else 1)
 

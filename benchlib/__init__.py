@@ -1,0 +1,9 @@
+"""bench.py's implementation package (ROADMAP item 7 split).
+
+Layout: ``harness`` (backend init + timing), ``artifact`` (JSON-line
+contract, watchdog, dead-tunnel replay), ``configs_*`` (the measurement
+functions), ``registry`` (the --config mapping). ``bench.py`` at the
+repo root remains the entry point and the stable attribute surface
+(tests and tools monkeypatch ``bench.X``, never ``benchlib.*``
+directly).
+"""

@@ -1,0 +1,208 @@
+"""Bench artifact contract: parsable JSON lines, run status, the
+dead-tunnel cached-capture replay, and the watchdog.
+
+Split out of the monolithic bench.py (ROADMAP item 7). Everything here
+exists so a round NEVER loses its perf artifact: error lines instead of
+tracebacks, a status line consumers can trust, replayed capture lines
+when the backend is unreachable, and a watchdog that turns a hang into
+a graceful truncation. State shared with bench.main() lives in the
+mutable containers `_CONFIG` / `_DEADLINE` / `_SUCCEEDED`.
+"""
+
+import json
+import os
+import sys
+import time
+
+def _trim_err(e: BaseException, limit: int = 400) -> str:
+    s = f"{type(e).__name__}: {e}"
+    return s[-limit:] if len(s) > limit else s
+
+
+def _error_line(metric: str, err: str) -> dict:
+    return {"metric": metric, "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0, "error": err}
+
+
+def _emit_error(metric: str, err: str):
+    print(json.dumps(_error_line(metric, err)), flush=True)
+
+
+_SUCCEEDED = [0]  # configs that printed a number; read by the watchdog
+_DEADLINE = [0.0]  # wall-clock instant the watchdog fires (set in main)
+_CONFIG = ["headline"]  # selected --config; read by the cached fallback
+
+_CAPTURE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "bench_captures")
+
+
+def _default_capture_dir() -> str:
+    """Resolve the capture dir at CALL time, honoring a monkeypatched
+    ``bench._CAPTURE_DIR`` (the documented patch surface) even on the
+    replay paths main() reaches without threading a dir — init_backend's
+    dead-tunnel fallback and the watchdog. In the pre-split monolith all
+    of these read one module global; this keeps that contract."""
+    bench_mod = sys.modules.get("bench")
+    return getattr(bench_mod, "_CAPTURE_DIR", None) or _CAPTURE_DIR
+_CACHE_PREFIX = {
+    "headline": "dense_gemm_tflops_per_chip",
+    "config_square_8k": "gemm_8k_seconds",
+    "config_tall_skinny": "tall_skinny_seconds",
+    "config_chained": "chained_abc_",
+    "config_summa_mesh": "summa_weak_scaling",
+    "config_attention": "flash_attention_tflops",
+    "config_sparse": "block_sparse_effective_tflops",
+    "config_sparse_dist": "sparse_dist_",
+    "config_spmm": "spmm_",
+    "config_lu": "lu_dist_",
+    "config_cholesky": "cholesky_dist_",
+    "config_inverse": "inverse_dist_",
+    "config_svd": "svd_dist_eigs_",
+    "config_transformer": "transformer_train_tokens",
+    "config_longseq": "longseq_train_",
+    "config_decode": "decode_tokens_per_s",
+    "config_decode_int8": "decode_int8_tokens_per_s",
+    "config_decode_spec": "decode_spec_tokens_per_s",
+    "config_serving": "serving_continuous_vs_static",
+}
+
+
+def _load_cached_lines(capture_dir: str = None) -> dict:
+    """Newest valid capture line per config function name. Files are visited
+    in session order and lines in file order, so the latest write wins;
+    error lines and failed-oracle lines never qualify as evidence.
+
+    Session order = (capture-file basename, mtime): the files follow the
+    ``rNN_<session>_YYYYMMDD[_HHMM].jsonl`` convention, which sorts
+    chronologically by name — mtimes alone are unreliable because a git
+    checkout stamps every historic file with the same time (observed: the
+    replay picking an old under-filled summa line over the same round's
+    corrected one)."""
+    import glob
+
+    capture_dir = capture_dir or _default_capture_dir()
+    best = {}
+    paths = sorted(
+        glob.glob(os.path.join(capture_dir, "*.jsonl")),
+        key=lambda p: (os.path.basename(p), os.path.getmtime(p)))
+    for path in paths:
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path) as f:
+                raw_lines = f.readlines()
+        except OSError:
+            continue
+        for raw in raw_lines:
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(line, dict) or "metric" not in line:
+                continue
+            if line.get("unit") == "error" or not line.get("value"):
+                continue
+            if line.get("oracle_ok") is False:
+                continue
+            if line.get("cached"):
+                # A replay that a dead-tunnel queue run appended into a
+                # capture file is NOT evidence — replaying it again would
+                # launder its provenance (age/file) as fresh.
+                continue
+            for key, prefix in _CACHE_PREFIX.items():
+                if str(line["metric"]).startswith(prefix):
+                    best[key] = (mtime, line, os.path.basename(path))
+    return best
+
+
+def _emit_cached_results(config: str, err: str,
+                         capture_dir: str = None) -> int:
+    """Emit the cached line for each function of ``config``; returns the
+    count emitted. Each line keeps its original metric/value/vs_baseline and
+    gains cached/cached_from/cached_age_hours/backend_error fields."""
+    from .registry import CONFIGS  # lazy: registry imports the configs
+
+    best = _load_cached_lines(capture_dir)
+    now = time.time()
+    hits = [best[fn.__name__] for fn in CONFIGS.get(config, ())
+            if fn.__name__ in best]
+    if hits:
+        # Machine-readable run status: rc alone cannot distinguish a replay
+        # from a live run (ADVICE r03), so automated consumers key on this.
+        _emit_run_status(live=False, n_lines=len(hits), backend_error=err)
+    for mtime, line, fname in hits:
+        print(json.dumps(dict(
+            line, cached=True,
+            cached_from=f"docs/bench_captures/{fname}",
+            cached_age_hours=round((now - mtime) / 3600.0, 1),
+            backend_error=err,
+        )), flush=True)
+    return len(hits)
+
+
+def _emit_run_status(live: bool, n_lines: int, backend_error: str = ""):
+    """Status precedes the measurement lines it vouches for (VERDICT r04
+    weak #1: the driver records the LAST stdout line as the round's parsed
+    metric, so the final line must be a measurement, never status) and is
+    emitted ONLY when evidence exists: a replay with cached lines, or a
+    live run once its first config succeeds. ``value`` = the run's
+    metric/error line count (exact for a replay; for a live run every
+    config emits one line — result or error — though error lines from
+    configs that failed before the first success print ahead of the
+    status, and a watchdog hard-exit can truncate below the count)."""
+    line = {"metric": "bench_run_status", "value": float(n_lines),
+            "unit": "lines", "vs_baseline": 0, "live": live}
+    if backend_error:
+        line["backend_error"] = backend_error
+    print(json.dumps(line), flush=True)
+
+
+
+def _remaining() -> float:
+    return _DEADLINE[0] - time.monotonic()
+
+
+def _start_watchdog():
+    """Guarantee a parsable artifact even if the backend HANGS (observed
+    failure mode: jax.devices() blocks forever on a dead tunnel — no
+    exception for the retry loop to catch). A daemon thread hard-exits
+    after BENCH_WATCHDOG seconds unless disarmed. Exit-code contract is
+    preserved: if some configs already produced numbers, their JSON lines
+    are the artifact — exit 0 and complain on stderr only; otherwise emit
+    the error line and exit 1.
+
+    The hard exit is the LAST resort: killing a TPU process mid-dispatch
+    wedges the axon tunnel lease for a long time (observed >1h — it cost
+    this round's interactive TPU access), so the config loop in main()
+    also checks the same deadline BETWEEN configs and skips cleanly when
+    the remaining budget can't fit another config."""
+    import threading
+
+    budget = float(os.environ.get("BENCH_WATCHDOG", "3000"))
+    _DEADLINE[0] = time.monotonic() + budget
+    disarm = threading.Event()
+
+    def _fire():
+        if not disarm.wait(budget):
+            if _SUCCEEDED[0]:
+                # The run-status line already went out FIRST (main() emits it
+                # just before the first config's result line) — adding one
+                # here would make status the last line and shadow the real
+                # metric in the driver's parsed field (VERDICT r04 weak #1).
+                print(f"bench watchdog: truncated after {budget:.0f}s with "
+                      f"{_SUCCEEDED[0]} config(s) done", file=sys.stderr,
+                      flush=True)
+                os._exit(0)
+            why = f"bench exceeded {budget:.0f}s (backend hang?)"
+            try:  # nothing measured live — replay cached captures if any
+                if _emit_cached_results(_CONFIG[0], why):
+                    print("bench watchdog: emitted cached capture lines",
+                          file=sys.stderr, flush=True)
+                    os._exit(0)
+            except Exception:  # noqa: BLE001 - fall through to the error line
+                pass
+            _emit_error("watchdog_timeout", why)
+            os._exit(1)
+
+    threading.Thread(target=_fire, daemon=True).start()
+    return disarm

@@ -1,0 +1,141 @@
+"""Dense-GEMM bench configs: headline 32k multiply, BASELINE shapes, SUMMA weak scaling, and the dispatch crossover sweep.
+
+Split out of the monolithic bench.py (ROADMAP item 7); see
+benchlib/harness.py for the timing recipes these configs share.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.utils import random as mrand
+
+from .artifact import _trim_err
+from .harness import (DTYPE, HBM_GBPS, N, _scan_timed, _sized, _timed,
+                      _timed_r, fence, guess_peak)
+
+def headline():
+    """Config: 32k x 32k auto-dispatch multiply (the MatrixMultiply shape)."""
+    n_dev = len(jax.devices())
+    a = mrand.random_den_vec_matrix(N, N, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(N, N, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b))
+    tflops_per_chip = 2.0 * N * N * N / dt / 1e12 / n_dev
+    target = 0.5 * guess_peak()
+    # Static cost model (utils/cost_model.py): the per-chip roofline this
+    # measurement is a fraction of — asserted in CI by test_cost_model.py,
+    # confirmed here by the chip.
+    from marlin_tpu.mesh import axis_sizes, default_mesh
+    from marlin_tpu.utils import cost_model as cm
+
+    pr, pc = axis_sizes(default_mesh())
+    mflops, mbytes = cm.summa_cost(N, N, N, pr, pc,
+                                   jnp.dtype(DTYPE).itemsize)
+    return {
+        "metric": "dense_gemm_tflops_per_chip_32k",
+        "value": round(tflops_per_chip, 2),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(tflops_per_chip / target, 3),
+        "device": jax.devices()[0].device_kind,
+        "n": N,
+        "predicted_flops_per_chip": mflops,
+        "predicted_bytes_per_chip": mbytes,
+    }
+
+
+def config_square_8k():
+    """BASELINE config #2: 8192^2 square GEMM."""
+    n = _sized("BENCH_8K_N", 8192)
+    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b))
+    return {"metric": "gemm_8k_seconds", "value": round(dt, 4), "unit": "s",
+            "vs_baseline": 0}
+
+
+def config_tall_skinny():
+    """BASELINE config #3: 1,000,000 x 512 times 512 x 512 (broadcast path)."""
+    m = _sized("BENCH_TALL_M", 1_000_000)
+    a = mrand.random_den_vec_matrix(m, 512, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(512, 512, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b))
+    return {"metric": "tall_skinny_seconds", "value": round(dt, 4), "unit": "s",
+            "vs_baseline": 0}
+
+
+def config_chained():
+    """BASELINE config #4: chained A.B.C at 16384^3 (HBM residency stress)."""
+    n = _sized("BENCH_CHAIN_N", 16384)
+    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
+    c = mrand.random_den_vec_matrix(n, n, seed=3, dtype=DTYPE)
+    def chain():
+        # The dispatch's first hop returns a BlockMatrix on the SUMMA arms
+        # and a DenseVecMatrix on the broadcast arm (small smoke sizes);
+        # re-stripe only when needed.
+        ab = a.multiply(b)
+        if hasattr(ab, "to_dense_vec_matrix"):
+            ab = ab.to_dense_vec_matrix()
+        return ab.multiply(c)
+
+    dt = _timed(chain, iters=3)
+    tflops = 2 * 2.0 * n**3 / dt / 1e12
+    return {"metric": f"chained_abc_{n//1024}k_tflops", "value": round(tflops, 2),
+            "unit": "TFLOPS", "vs_baseline": 0}
+
+
+def config_summa_mesh():
+    """BASELINE config #5 (scaled to the available mesh): explicit SUMMA over
+    the full device mesh. The side scales as 8192 * sqrt(n_dev), so a v5e-64
+    runs the named 65536^2 config and per-chip MEMORY stays constant
+    (per-chip FLOPs grow as sqrt(n_dev) — memory-weak scaling, matching how
+    the baseline config was sized)."""
+    import math
+
+    n_dev = len(jax.devices())
+    # Base side 16384: 8192 under-fills the MXU pipeline (38 vs ~150
+    # TFLOPS/chip measured on v5e); per-chip memory stays ~1.6 GB at any
+    # mesh size under this weak-scaling rule.
+    n = int(_sized("BENCH_SUMMA_BASE", 16384) * math.sqrt(n_dev))
+    a = mrand.random_den_vec_matrix(n, n, seed=1, dtype=DTYPE)
+    b = mrand.random_den_vec_matrix(n, n, seed=2, dtype=DTYPE)
+    dt = _timed(lambda: a.multiply(b, mode="summa"), iters=3)
+    tflops_chip = 2.0 * n**3 / dt / 1e12 / n_dev
+    return {"metric": f"summa_weak_scaling_tflops_chip_n{n_dev}",
+            "value": round(tflops_chip, 2), "unit": "TFLOPS/chip",
+            "vs_baseline": round(tflops_chip / (0.5 * guess_peak()), 3)}
+
+
+def config_dispatch_sweep():
+    """Broadcast-vs-SUMMA crossover sweep (VERDICT next-6): times both arms
+    for a row-striped A (m x k) times (k x n) B over a range of B sizes, and
+    reports the measured crossover in MB — the data the 300 MB
+    Spark-derived default must be re-derived from (SURVEY §7 hard parts:
+    HBM residency vs ICI gather volume, not shuffle cost). Emits one line
+    per operand size on stderr and ONE summary JSON line."""
+    import math
+
+    m = _sized("BENCH_SWEEP_M", 16384)
+    results = []
+    for n in (256, 512, 1024, 2048, 4096, 8192):
+        k = n
+        a = mrand.random_den_vec_matrix(m, k, seed=1, dtype=DTYPE)
+        b = mrand.random_den_vec_matrix(k, n, seed=2, dtype=DTYPE)
+        size_mb = k * n * jnp.dtype(DTYPE).itemsize / 1e6
+        dt_b = _timed(lambda: a.multiply(b, mode="broadcast"), iters=5)
+        dt_s = _timed(lambda: a.multiply(b, mode="summa"), iters=5)
+        results.append((size_mb, dt_b, dt_s))
+        print(f"sweep n={n} B={size_mb:.1f}MB broadcast={dt_b*1e3:.2f}ms "
+              f"summa={dt_s*1e3:.2f}ms", file=sys.stderr, flush=True)
+    # Crossover: smallest operand size where SUMMA beats broadcast (None if
+    # broadcast always wins — then the threshold should exceed the sweep).
+    cross = next((mb for mb, db, ds in results if ds < db), None)
+    return {"metric": "dispatch_crossover_mb",
+            "value": round(cross, 1) if cross else -1.0,
+            "unit": "MB", "vs_baseline": 0,
+            "points": [[round(mb, 1), round(db, 5), round(ds, 5)]
+                       for mb, db, ds in results]}

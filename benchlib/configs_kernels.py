@@ -1,0 +1,213 @@
+"""Pallas kernel bench configs: flash attention (+window sweep) and block-sparse GEMM, each oracle-checked on hardware first.
+
+Split out of the monolithic bench.py (ROADMAP item 7); see
+benchlib/harness.py for the timing recipes these configs share.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.utils import random as mrand
+
+from .artifact import _trim_err
+from .harness import (DTYPE, HBM_GBPS, N, _scan_timed, _sized, _timed,
+                      _timed_r, fence, guess_peak)
+
+def config_attention():
+    """Pallas flash attention (ops/flash_attention.py) at S=8k, H=8, D=128.
+
+    Doubles as on-hardware validation: the Pallas kernel is first checked
+    against the XLA softmax-attention oracle at S=1024 and the max relative
+    error lands in the JSON line (docs/design.md §9: interpret-mode runs
+    alone provably miss precision bugs)."""
+    from marlin_tpu.ops import flash_attention
+
+    # Oracle check at a small shape on the real hardware path.
+    so, ho, do = 1024, 2, 128
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    qo, ko, vo = (jax.random.normal(kk, (so, ho, do), DTYPE) for kk in ks)
+    got = flash_attention(qo, ko, vo)
+    qf, kf, vf = (x.astype(jnp.float32) for x in (qo, ko, vo))
+    logits = jnp.einsum("shd,thd->hst", qf, kf) / jnp.sqrt(float(do))
+    ref = jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, axis=-1), vf)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
+                / jnp.max(jnp.abs(ref)))
+
+    s, h, d = 8192, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
+    dt = _scan_timed(flash_attention, q, k, v)
+    tflops = 4.0 * s * s * h * d / dt / 1e12  # QK^T + PV
+    out = {"metric": "flash_attention_tflops", "value": round(tflops, 2),
+           "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
+           "oracle_max_err": round(err, 6), "oracle_ok": err < 0.02}
+    w = _sized("BENCH_ATTN_WINDOW", 1024)
+    if w:  # sliding-window speedup: out-of-band blocks skip their compute
+        dt_w = _scan_timed(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, window=w),
+            q, k, v)
+        dt_c = _scan_timed(
+            lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        # Analytic block-MAC ceiling — derivation in docs/ROUND4.md §7:
+        # causal (1024-blocks) ~ S*(S+1024)/2, banded ~ S*(bq + w + bk).
+        # bq/bk must mirror flash_attention's windowed clamp EXACTLY
+        # (ops/flash_attention.py: block_k floor 128, block_q floor 256,
+        # both capped ~w/2) or ceiling_frac misattributes the gap.
+        # Predicate-derived ceiling (utils/cost_model.py): enumerates the
+        # kernel's own grid plan instead of the closed form, evaluated at
+        # the kernel's FULL entry block selection (window + sequence
+        # clamps, shared helper — a clamp or default-block change moves
+        # this bar automatically).
+        from marlin_tpu.ops.flash_attention import (DEFAULT_BLOCK_K,
+                                                    DEFAULT_BLOCK_Q,
+                                                    effective_blocks)
+        from marlin_tpu.utils import cost_model as cm
+
+        bq_eff, bk_eff = effective_blocks(s, s, DEFAULT_BLOCK_Q,
+                                          DEFAULT_BLOCK_K, w)
+        ideal = cm.speedup_ceiling(s, w, (bq_eff, bk_eff))
+        out.update(window=w,
+                   window_speedup_vs_causal=round(dt_c / dt_w, 2),
+                   causal_ms=round(dt_c * 1e3, 2),
+                   window_ms=round(dt_w * 1e3, 2),
+                   window_block_ceiling=round(ideal, 2),
+                   window_ceiling_frac=round((dt_c / dt_w) / ideal, 3))
+        # Block sweep inside the band: the best (bq, bk) is a
+        # measurement, not a formula — smaller blocks shrink the diagonal
+        # overhang but raise grid overhead. The clamped-default point is
+        # dt_w, already measured; time only the new shapes.
+        sweep = [[bq_eff, bk_eff, round(dt_c / dt_w, 2),
+                  round(cm.speedup_ceiling(s, w, (bq_eff, bk_eff)), 2)]]
+        for bq, bk in ((256, 256), (256, 128), (512, 128)):
+            if (bq, bk) == (bq_eff, bk_eff):
+                continue
+            try:
+                dt_s = _scan_timed(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, causal=True, window=w,
+                        block_q=bq, block_k=bk),
+                    q, k, v)
+                sweep.append([bq, bk, round(dt_c / dt_s, 2),
+                              round(cm.speedup_ceiling(s, w, (bq, bk)), 2)])
+            except Exception as e:  # noqa: BLE001
+                print(f"wsweep ({bq},{bk}) failed: {_trim_err(e, 100)}",
+                      file=sys.stderr, flush=True)
+        best = max(sweep, key=lambda t: t[2])
+        out.update(window_sweep=sweep,
+                   window_best_speedup=best[2],
+                   window_best_block=best[:2])
+
+    # Training path: fwd + Pallas flash backward (dQ + dK/dV kernels — no
+    # (S, S) buffer in either direction). 3.5x the fwd MAC count (2 fwd
+    # matmuls + 5 bwd: recomputed logits, dP, dV, dQ, dK).
+    def fwdbwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return dq + dk + dv
+
+    dt_b = _scan_timed(fwdbwd, q, k, v)
+    out.update(fwd_bwd_ms=round(dt_b * 1e3, 2),
+               fwd_bwd_tflops=round(3.5 * 4.0 * s * s * h * d / dt_b / 1e12,
+                                    2))
+    return out
+
+
+def config_sparse():
+    """Block-sparse GEMM (gather-grid Pallas kernel) at 12% block density.
+
+    Oracle-checked on hardware first: kernel vs jnp.dot on the zero-filled
+    backing at n=2048, max relative error recorded."""
+    import numpy as np
+
+    from marlin_tpu.ops.block_sparse import BlockSparse, block_sparse_matmul
+
+    rng = np.random.default_rng(0)
+
+    # Oracle check.
+    no, bso = 1024, 256
+    mo = rng.random((no // bso, no // bso)) < 0.3
+    bo = BlockSparse(
+        jnp.asarray(rng.standard_normal((no, no)), DTYPE), jnp.asarray(mo), bso
+    )
+    ao = jnp.asarray(rng.standard_normal((no, no)), DTYPE)
+    got = block_sparse_matmul(ao, bo).astype(jnp.float32)
+    ref = jnp.dot(ao.astype(jnp.float32), bo.data.astype(jnp.float32))
+    scale = float(jnp.max(jnp.abs(ref)))
+    err = float(jnp.max(jnp.abs(got - ref))) / max(scale, 1e-30)
+
+    n, bs = _sized("BENCH_SPARSE_N", 8192), 512
+    mask = rng.random((n // bs, n // bs)) < 0.12
+    arr = rng.standard_normal((n, n)).astype(np.float32)
+    # The ctor zeroes unmasked blocks itself — no host-side mask expansion.
+    b = BlockSparse(jnp.asarray(arr, DTYPE), jnp.asarray(mask), bs)
+    a = jnp.asarray(rng.standard_normal((n, n)), DTYPE)
+    dt = _scan_timed(lambda a: block_sparse_matmul(a, b), a)
+    eff = 2.0 * n**3 * b.block_density / dt / 1e12
+    return {"metric": "block_sparse_effective_tflops", "value": round(eff, 2),
+            "unit": "TFLOPS", "vs_baseline": 0, "timing": "device_scan_loop",
+            "oracle_max_err": round(err, 6), "oracle_ok": err < 0.05}
+
+
+def config_attention_sweep():
+    """Flash-attention block-size sweep at the bench shape (S=8k, H=8,
+    D=128): times each (block_q, block_k) candidate plus the XLA
+    softmax-attention reference, prints per-point lines on stderr, and
+    returns the best point — the autotune data for picking kernel defaults
+    on this chip generation."""
+    from marlin_tpu.ops import flash_attention
+
+    s, h, d = _sized("BENCH_ATTN_S", 8192), 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (s, h, d), DTYPE) for kk in ks)
+    flops = 4.0 * s * s * h * d
+
+    def xla_ref(q, k, v):
+        logits = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(float(d))
+        return jnp.einsum("hst,thd->shd", jax.nn.softmax(logits, axis=-1),
+                          v.astype(jnp.float32))
+
+    try:
+        dt_xla = _scan_timed(xla_ref, q, k, v, loop=3)
+        print(f"attn sweep xla_ref {flops / dt_xla / 1e12:.1f} TFLOPS",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 - S x S logits can OOM; sweep on
+        dt_xla = None
+        print(f"attn sweep xla_ref failed: {_trim_err(e, 120)}",
+              file=sys.stderr, flush=True)
+
+    best = (None, 0.0)
+    for bq, bk in ((512, 512), (512, 1024), (1024, 512), (1024, 1024),
+                   (2048, 1024), (1024, 2048), (2048, 2048)):
+        try:
+            # Device-side scan timing: per-dispatch RTT noise (±2x between
+            # sessions) would otherwise pick blocks by tunnel weather.
+            dt = _scan_timed(
+                lambda q, k, v: flash_attention(
+                    q, k, v, block_q=bq, block_k=bk),
+                q, k, v,
+            )
+            tf = flops / dt / 1e12
+        except Exception as e:  # noqa: BLE001
+            print(f"attn sweep ({bq},{bk}) failed: {_trim_err(e, 120)}",
+                  file=sys.stderr, flush=True)
+            continue
+        print(f"attn sweep ({bq},{bk}) {tf:.1f} TFLOPS", file=sys.stderr,
+              flush=True)
+        if tf > best[1]:
+            best = ((bq, bk), tf)
+    if best[0] is None:
+        raise RuntimeError("every block-size candidate failed")
+    out = {"metric": "flash_attention_best_tflops", "value": round(best[1], 2),
+           "unit": "TFLOPS", "vs_baseline": 0,
+           "best_block": list(best[0])}
+    if dt_xla:
+        out["xla_ref_tflops"] = round(flops / dt_xla / 1e12, 2)
+    return out

@@ -1,0 +1,183 @@
+"""Dense linear-algebra bench configs: blocked LU / Cholesky / inverse vs raw XLA, and the dist-eigs SVD showpiece.
+
+Split out of the monolithic bench.py (ROADMAP item 7); see
+benchlib/harness.py for the timing recipes these configs share.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.utils import random as mrand
+
+from .artifact import _trim_err
+from .harness import (DTYPE, HBM_GBPS, N, _scan_timed, _sized, _timed,
+                      _timed_r, fence, guess_peak)
+
+def _xla_ref(out: dict, label: str, fn, our_dt: float) -> dict:
+    """Attach the raw-XLA reference timing to a config line, defensively:
+    the baseline's own failure (e.g. XLA's LuDecompositionBlock scoped-vmem
+    bug at 16k on v5e) must not discard OUR measurement.
+
+    The reference runs under linalg_precision_scope, same as our op: an
+    ambient-default baseline would run its f32 matmuls as bf16 passes —
+    ~2x faster AND failing the very reconstruction bar our op is held to
+    (apples-to-oranges; observed cholesky 0.08s ambient vs 0.45s ours)."""
+    from marlin_tpu.config import linalg_precision_scope
+
+    def scoped():
+        with linalg_precision_scope():
+            return fn()
+
+    try:
+        dt_xla = _timed(scoped, iters=2)
+        out.update(vs_baseline=round(dt_xla / our_dt, 3),
+                   **{f"xla_{label}_seconds": round(dt_xla, 4)})
+    except Exception as e:  # noqa: BLE001
+        out.update(vs_baseline=0, **{f"xla_{label}_error": _trim_err(e, 160)})
+    return out
+
+
+def config_lu():
+    """Blocked LU (single-jit fori_loop panel sweep) vs raw XLA lu at 16k f32.
+
+    vs_baseline = xla_time / our_time: >= 0.333 meets the VERDICT's
+    "within 3x of a raw XLA lu on the same chip" bar. Reconstruction error
+    ||A[perm] - L U||_max / ||A||_max at n=2048 recorded as oracle_max_err."""
+    import numpy as np
+
+    from marlin_tpu.linalg.lu import lu_factor_array, unpack_lu
+
+    # Oracle at 2048 on hardware.
+    rng = np.random.default_rng(0)
+    a_small = jnp.asarray(rng.standard_normal((2048, 2048)), jnp.float32)
+    with mt.config_override(lu_base_size=512):
+        packed, perm = lu_factor_array(a_small, mode="dist")
+    l, u = unpack_lu(np.asarray(packed, np.float64))
+    an = np.asarray(a_small, np.float64)
+    err = float(np.max(np.abs(an[perm] - l @ u)) / np.max(np.abs(an)))
+
+    n = _sized("BENCH_LU_N", 16384)
+    key = jax.random.PRNGKey(3)
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    with mt.config_override(lu_base_size=1024):
+        dt = _timed(lambda: lu_factor_array(a, mode="dist")[0], iters=2)
+    out = {"metric": f"lu_dist_{n//1024}k_seconds", "value": round(dt, 4),
+           "unit": "s", "oracle_max_err": round(err, 9),
+           "oracle_ok": err < 1e-3}
+    out = _xla_ref(out, "lu", lambda: jax.lax.linalg.lu(a)[0], dt)
+    if not out.get("vs_baseline"):
+        # XLA's LuDecompositionBlock hits its own scoped-vmem bug at 16k on
+        # v5e (r02/r03 captures) — the BASELINE is broken, not our op. For
+        # a usable ratio, compare both at half size and report that.
+        n2 = n // 2
+        a2 = jax.random.normal(key, (n2, n2), jnp.float32)
+        with mt.config_override(lu_base_size=1024):
+            dt2 = _timed(lambda: lu_factor_array(a2, mode="dist")[0], iters=2)
+        half = _xla_ref({}, "lu_half", lambda: jax.lax.linalg.lu(a2)[0], dt2)
+        out.update(vs_baseline=half.get("vs_baseline", 0),
+                   vs_baseline_note=f"ratio measured at {n2} (XLA lu "
+                                    f"fails at {n}); ours_half={dt2:.3f}s",
+                   **{k: v for k, v in half.items() if k.startswith("xla_")})
+    return out
+
+
+def config_cholesky():
+    """Blocked Cholesky (single-jit panel sweep) vs raw XLA cholesky at 16k."""
+    import numpy as np
+
+    from marlin_tpu.linalg.cholesky import cholesky_factor_array
+
+    # Oracle at 2048: ||L L^T - A|| / ||A||.
+    rng = np.random.default_rng(0)
+    c = rng.standard_normal((2048, 2048)).astype(np.float32)
+    a_small = jnp.asarray(c @ c.T + 2048 * np.eye(2048, dtype=np.float32))
+    with mt.config_override(cholesky_base_size=512):
+        ln = np.asarray(cholesky_factor_array(a_small, mode="dist"), np.float64)
+    an = np.asarray(a_small, np.float64)
+    err = float(np.max(np.abs(ln @ ln.T - an)) / np.max(np.abs(an)))
+
+    n = _sized("BENCH_CHOL_N", 16384)
+    key = jax.random.PRNGKey(5)
+    g = jax.random.normal(key, (n, n), jnp.float32) / jnp.sqrt(float(n))
+    a = (g @ g.T + 2.0 * jnp.eye(n, dtype=jnp.float32))
+    with mt.config_override(cholesky_base_size=1024):
+        dt = _timed(lambda: cholesky_factor_array(a, mode="dist"), iters=2)
+    out = {"metric": f"cholesky_dist_{n//1024}k_seconds", "value": round(dt, 4),
+           "unit": "s", "oracle_max_err": round(err, 9),
+           "oracle_ok": err < 1e-3}
+    return _xla_ref(out, "cholesky", lambda: jnp.linalg.cholesky(a), dt)
+
+
+def config_inverse():
+    """Blocked inverse (LU + two triangular solves) vs raw XLA inv at 8k."""
+    from marlin_tpu.linalg.inverse import inverse
+
+    n = _sized("BENCH_INV_N", 8192)
+    key = jax.random.PRNGKey(9)
+    a = jax.random.normal(key, (n, n), jnp.float32) + n * jnp.eye(n, dtype=jnp.float32)
+    with mt.config_override(lu_base_size=1024):
+        dt, inv = _timed_r(lambda: inverse(a, mode="dist"), iters=2)
+    resid = float(jnp.max(jnp.abs(inv @ a - jnp.eye(n, dtype=jnp.float32))))
+    out = {"metric": f"inverse_dist_{n//1024}k_seconds", "value": round(dt, 4),
+           "unit": "s", "oracle_max_err": round(resid, 9),
+           "oracle_ok": resid < 1e-2}
+    return _xla_ref(out, "inv", lambda: jnp.linalg.inv(a), dt)
+
+
+def config_svd():
+    """Dist-eigs SVD (Gramian matvec + Lanczos) on a tall 200k x 2k matrix —
+    the reference's DistARPACK showpiece shape (DenseVecMatrix.scala:1599)."""
+    import numpy as np
+
+    from marlin_tpu.matrix.dense import DenseVecMatrix
+
+    m, n, k = _sized("BENCH_SVD_M", 200_000), _sized("BENCH_SVD_N", 2048), 10
+    a = mrand.random_den_vec_matrix(m, n, seed=11, dtype=jnp.float32)
+    t0 = time.perf_counter()
+    _, s, _ = a.compute_svd(k, compute_u=False, mode="dist-eigs", tol=1e-6)
+    dt = time.perf_counter() - t0
+    ok = bool(np.all(np.diff(np.asarray(s)) <= 1e-6)) and s.shape == (k,)
+    out = {"metric": f"svd_dist_eigs_{m // 1000}kx{n}_seconds",
+           "value": round(dt, 3),
+           "unit": "s", "vs_baseline": 0, "oracle_ok": ok}
+    # The fast arm for this shape (G = A^T A fits trivially at n=2048):
+    # one sharded Gramian + local SVD — what auto mode SHOULD pick here if
+    # speed were the only axis; dist-eigs is the operator-only arm whose
+    # point is never forming G (n x n) when n is huge.
+    try:
+        t0 = time.perf_counter()
+        _, s_loc, _ = a.compute_svd(k, compute_u=False, mode="local-svd")
+        out["local_svd_seconds"] = round(time.perf_counter() - t0, 3)
+        rel_loc = float(np.max(
+            np.abs(np.sort(np.asarray(s_loc)) - np.sort(np.asarray(s)))
+            / np.maximum(np.sort(np.asarray(s_loc)), 1e-30)))
+        out["dist_vs_local_rel_diff"] = round(rel_loc, 6)
+    except Exception as e:  # noqa: BLE001
+        out["local_svd_error"] = _trim_err(e, 120)
+    # Baseline (VERDICT r02 item 5): XLA's dense eigendecomposition of the
+    # explicit Gramian — the local-LAPACK arm of the reference's own mode
+    # switch (DenseVecMatrix.scala:1595-1598) run on the same chip; its
+    # top-k sqrt-eigenvalues answer the same question. vs_baseline =
+    # xla_time / our_time.
+    try:
+        def gram_eigh():
+            g = jnp.dot(a.data.T, a.data, precision="highest")
+            w = jnp.linalg.eigh(g)[0]
+            return jnp.sqrt(jnp.maximum(w[-k:], 0.0))
+        s_ref = np.asarray(jax.jit(gram_eigh)())  # warmup + values
+        t0 = time.perf_counter()
+        fence(jax.jit(gram_eigh)())
+        dt_xla = time.perf_counter() - t0
+        rel = float(np.max(np.abs(np.sort(s_ref) - np.sort(np.asarray(s)))
+                           / np.maximum(np.sort(s_ref), 1e-30)))
+        out.update(xla_gramian_eigh_seconds=round(dt_xla, 3),
+                   vs_baseline=round(dt_xla / dt, 3),
+                   topk_rel_diff_vs_xla=round(rel, 6))
+    except Exception as e:  # noqa: BLE001
+        out["xla_gramian_eigh_error"] = _trim_err(e, 160)
+    return out

@@ -1,0 +1,305 @@
+"""Transformer bench configs: train throughput (flagship + long-context) and the three decode arms (batched, int8, speculative).
+
+Split out of the monolithic bench.py (ROADMAP item 7); see
+benchlib/harness.py for the timing recipes these configs share.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.utils import random as mrand
+
+from .artifact import _trim_err
+from .harness import (DTYPE, HBM_GBPS, N, _scan_timed, _sized, _timed,
+                      _timed_r, fence, guess_peak)
+
+def _train_throughput(metric, cfg, batch):
+    """Shared train-step timing recipe: init, jit, warmup+fence, burst-timed
+    step, tokens/sec + 6*N*T model-FLOPs estimate."""
+    import numpy as np
+
+    from marlin_tpu.models import init_params, train_step
+
+    s = cfg.max_len
+    params = init_params(cfg, seed=0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, s), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    step = jax.jit(train_step, static_argnames="cfg")
+    loss0, params = step(params, tokens, targets, cfg=cfg)
+    fence(loss0)
+    # Time against fixed params (throughput, not a training run); fetch
+    # only the scalar loss.
+    dt, loss = _timed_r(
+        lambda: step(params, tokens, targets, cfg=cfg)[0],
+        iters=5 if batch > 1 else 3,
+    )
+    n_par = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    model_tflops = 6.0 * n_par * batch * s / dt / 1e12
+    # Full-step model incl. the attention term 6*N*T excludes
+    # (utils/cost_model.py, CI-locked to the flash kernel's grid): real
+    # MFU for the attribution the r04 verdict asked of this line.
+    from marlin_tpu.utils import cost_model as cm
+
+    full_flops = cm.transformer_step_flops(
+        n_par, batch, s, cfg.n_layers, cfg.n_heads,
+        cfg.d_model // cfg.n_heads, window=cfg.window)
+    # vs_baseline: model-FLOPs utilization against the same 50%-of-peak
+    # north star the headline GEMM uses (6*N*T is the standard lower-bound
+    # FLOP count — attention FLOPs excluded, so long-seq configs understate;
+    # mfu_frac_peak is the honest fraction including attention).
+    return {"metric": metric, "value": round(batch * s / dt, 1),
+            "unit": "tok/s",
+            "vs_baseline": round(model_tflops / (0.5 * guess_peak()), 3),
+            "model_tflops_est": round(model_tflops, 2),
+            "full_model_tflops": round(full_flops / dt / 1e12, 2),
+            "mfu_frac_peak": round(full_flops / dt / 1e12 / guess_peak(), 3),
+            "params_m": round(n_par / 1e6, 1),
+            # Config provenance: which variant this line measured (the
+            # capture ledger compares lines across sessions; dtype/arch
+            # knobs are exactly what moves them).
+            "dtype": cfg.dtype, "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers, "batch": batch,
+            "seq_len": cfg.max_len,
+            "kv_heads": cfg.kv_heads, "rope": cfg.rope,
+            "window": cfg.window, "remat": cfg.remat,
+            "loss_finite": bool(np.isfinite(float(loss)))}
+
+
+def config_transformer():
+    """Flagship transformer LM train step (models/): tokens/sec on the chip
+    through the differentiable flash-attention path. Model-scale knobs via
+    BENCH_TF_* (default ~125M params, S=2048, B=8, bf16 activations via the
+    global default dtype)."""
+    from marlin_tpu.models import TransformerConfig
+
+    d = _sized("BENCH_TF_D", 1024)
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_TF_VOCAB", 32768), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_TF_L", 8),
+        d_ff=4 * d, max_len=_sized("BENCH_TF_S", 2048),
+        # Architecture knobs so the capture can compare variants on chip.
+        n_kv_heads=_sized("BENCH_TF_KV", 0),
+        rope=bool(_sized("BENCH_TF_ROPE", 0)),
+        window=_sized("BENCH_TF_WINDOW", 0),
+        # Mixed precision (f32 master params, bf16 compute): halves HBM
+        # traffic and doubles MXU rate vs the r03 all-f32 runs.
+        dtype=os.environ.get("BENCH_TF_DTYPE", "bfloat16"),
+    )
+    return _train_throughput(
+        "transformer_train_tokens_per_s", cfg, _sized("BENCH_TF_B", 8))
+
+
+def config_longseq():
+    """Long-context train step: B=1 at S=8k (default; BENCH_LS_* to push
+    further) through the Pallas flash backward + per-block remat. Before
+    those landed this config was impossible on a 16 GB chip: the XLA
+    attention backward alone materialized H * S^2 f32 logits (8 GB per
+    layer at S=16k)."""
+    from marlin_tpu.models import TransformerConfig
+
+    d = _sized("BENCH_LS_D", 1024)
+    s = _sized("BENCH_LS_S", 8192)
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_LS_VOCAB", 16384), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_LS_L", 8),
+        d_ff=4 * d, max_len=s, rope=True, remat=True,
+        n_kv_heads=_sized("BENCH_LS_KV", 0),
+        window=_sized("BENCH_LS_WINDOW", 0),
+        dtype=os.environ.get("BENCH_LS_DTYPE", "bfloat16"),
+    )
+    return _train_throughput(
+        f"longseq_train_s{s // 1024}k_tokens_per_s", cfg, batch=1)
+
+
+def config_decode():
+    """KV-cache autoregressive decode on the flagship transformer
+    (models.generate): tokens/sec/sequence at B=8. The whole decode loop is
+    ONE jitted lax.scan dispatch, so the tunnel RTT amortizes over all
+    generated tokens by construction."""
+    from marlin_tpu.models import TransformerConfig, generate, init_params
+
+    d = _sized("BENCH_DEC_D", 1024)
+    quant = bool(_sized("BENCH_DEC_QUANT", 0))
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_DEC_VOCAB", 32768), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_DEC_L", 8),
+        d_ff=4 * d, max_len=_sized("BENCH_DEC_S", 1024),
+        # GQA/RoPE knobs: BENCH_DEC_KV=2 shows the cache shrink on hardware.
+        n_kv_heads=_sized("BENCH_DEC_KV", 0),
+        rope=bool(_sized("BENCH_DEC_ROPE", 0)),
+        dtype=os.environ.get("BENCH_DEC_DTYPE", "bfloat16"),
+        # The int8 arm streams int8 on BOTH sides of the roofline
+        # denominator: weights (models/quant.py) AND the KV cache.
+        kv_quant="int8" if quant else "",
+    )
+    b = _sized("BENCH_DEC_B", 8)
+    prompt_len = min(64, max(1, cfg.max_len // 2))
+    steps = cfg.max_len - prompt_len
+    params = init_params(cfg, seed=0)
+    if quant:
+        from marlin_tpu.models import quantize_params_int8
+
+        # donate: the masters are never read again in this config, so the
+        # quantizer may consume their buffers leaf by leaf.
+        params = quantize_params_int8(params, donate=True)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (b, prompt_len), 0, cfg.vocab)
+    out = generate(params, prompt, steps, cfg)  # warmup: prefill+scan compile
+    int(jnp.sum(out))  # host fetch — block_until_ready can return early here
+    t0 = time.perf_counter()
+    out = generate(params, prompt, steps, cfg)
+    n_out = int(jnp.sum(out >= 0))  # host fetch = the fence
+    dt = (time.perf_counter() - t0) / steps
+    # Baseline (VERDICT r02 item 5): the HBM roofline. Decode is
+    # bandwidth-bound: every step streams the full parameter set once
+    # (shared across the batch) plus each sequence's KV cache.
+    import numpy as np
+
+    kind = jax.devices()[0].device_kind
+    bw = next((v for kk, v in HBM_GBPS.items() if kk.lower() in kind.lower()),
+              819.0) * 1e9
+    # Streamed bytes per step are at the STREAMED dtype: int8 weights (with
+    # their small float scales) stream as-is; float leaves stream at the
+    # compute dtype (the scan-invariant cast of the f32 masters is hoisted
+    # and materialized once), and the KV cache is built at the compute
+    # dtype too.
+    it = jnp.dtype(cfg.dtype).itemsize
+    p_bytes = sum(
+        l.nbytes if jnp.issubdtype(l.dtype, jnp.integer) else l.size * it
+        for l in jax.tree.leaves(params))
+    kv_heads = cfg.n_kv_heads or cfg.n_heads
+    dh = cfg.d_model // cfg.n_heads
+    # K+V per sequence: int8 cache streams 1 byte/elem + one f32 scale per
+    # stored vector; float cache streams at the compute dtype.
+    per_vec = (dh + 4) if quant else dh * it
+    kv_bytes = 2 * cfg.n_layers * cfg.max_len * kv_heads * per_vec
+    # One step streams params once (batch-shared) + every sequence's cache:
+    # per-seq roofline tok/s = BW / (p_bytes + B * kv_bytes).
+    roofline = bw / (p_bytes + b * kv_bytes)
+    # Static model (utils/cost_model.py, CI-asserted band): predicted
+    # per-step streamed bytes — must agree with the roofline denominator.
+    # The int8 arm prices the per-vector f32 cache scales and the float
+    # remainder of the weights (biases, norms, s8 scales at the compute
+    # dtype) inside decode_step_cost itself, so the two figures share one
+    # per_vec/p_bytes accounting instead of diverging by a few percent
+    # (advisor r05 low #1; exactness pinned in tests/test_cost_model.py).
+    from marlin_tpu.utils import cost_model as cm
+
+    _, predicted_step_bytes = cm.decode_step_cost(
+        cfg, b, param_itemsize=it, cache_itemsize=it, quant_weights=quant)
+    # The int8 arm gets its own metric name: same-prefix lines share one
+    # replay slot per config, and the quant line must not shadow the base
+    # capture (or vice versa) in the dead-tunnel fallback.
+    metric = ("decode_int8_tokens_per_s_per_seq" if quant
+              else "decode_tokens_per_s_per_seq")
+    return {"metric": metric, "value": round(1.0 / dt, 1),
+            "unit": "tok/s", "vs_baseline": round((1.0 / dt) / roofline, 3),
+            "batch": b, "total_tok_s": round(b / dt, 1),
+            "hbm_roofline_tok_s_per_seq": round(roofline, 1),
+            "predicted_step_bytes": predicted_step_bytes,
+            # Config provenance (cross-session ledger comparability).
+            "dtype": cfg.dtype, "kv_heads": kv_heads, "rope": cfg.rope,
+            "cache_len": cfg.max_len, "d_model": cfg.d_model,
+            "quant": quant, "out_ok": n_out == b * steps}
+
+
+def config_decode_int8():
+    """config_decode with weight-only int8 streaming (models/quant.py) —
+    its own config so the int8 line gets its own dead-tunnel replay slot
+    (the per-config cache keys on the config FUNCTION; an env-var arm of
+    config_decode would silently replay the base decode line instead)."""
+    prev = os.environ.get("BENCH_DEC_QUANT")
+    os.environ["BENCH_DEC_QUANT"] = "1"
+    try:
+        return config_decode()
+    finally:
+        if prev is None:
+            os.environ.pop("BENCH_DEC_QUANT", None)
+        else:
+            os.environ["BENCH_DEC_QUANT"] = prev
+
+
+def config_decode_spec():
+    """Prompt-lookup speculative decode (models.generate_speculative) vs
+    plain greedy decode, B=1, same config — the latency axis next to
+    decodeint8's throughput axis. The prompt/continuation is a synthetic
+    REPETITIVE sequence (period-16 cycle), the regime speculation exists
+    for (code/chat/retrieval text repeats itself; pure random tokens
+    accept ~nothing and the config reports that bound too).
+    vs_baseline = speculative tok/s over plain tok/s: >= 1 means the
+    chunked verify's weight-stream amortization beat its overhead."""
+    import numpy as np
+
+    from marlin_tpu.models import (TransformerConfig, generate,
+                                   generate_speculative, init_params)
+
+    d = _sized("BENCH_SPEC_D", 1024)
+    steps = _sized("BENCH_SPEC_STEPS", 256)
+    draft_len = _sized("BENCH_SPEC_DRAFT", 8)
+    prompt_len = 64
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_SPEC_VOCAB", 32768), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_SPEC_L", 8),
+        d_ff=4 * d, max_len=prompt_len + steps + draft_len,
+        dtype=os.environ.get("BENCH_SPEC_DTYPE", "bfloat16"),
+    )
+    params = init_params(cfg, seed=0)
+    cycle = np.random.default_rng(5).integers(0, cfg.vocab, 16)
+    prompt = jnp.asarray(
+        np.tile(cycle, prompt_len // 16 + 1)[:prompt_len][None], jnp.int32)
+
+    def timed(fn):
+        out = fn()  # warmup: prefill + loop compile
+        int(jnp.sum(out))
+        t0 = time.perf_counter()
+        out = fn()
+        n = int(jnp.sum(out >= 0))  # host fetch = the fence
+        return (time.perf_counter() - t0) / steps, n
+
+    dt_plain, n1 = timed(lambda: generate(params, prompt, steps, cfg))
+    dt_spec, n2 = timed(lambda: generate_speculative(
+        params, prompt, steps, cfg, draft_len=draft_len))
+    # The degradation bound: zero acceptances emit ONE token per verify
+    # chunk, so the floor is 1 / t_chunk — measured directly (a "random
+    # prompt" can't measure it: an untrained model's greedy continuation
+    # falls into repeating attractors, so acceptance goes UP, not down).
+    # Meaningful on the chip, where decode is weight-stream-bound and
+    # t_chunk ~ t_step (floor_vs_plain ~ 1); the CPU smoke's per-step
+    # loop overhead dominates its tiny matmuls and skews this field.
+    from marlin_tpu.models import decode_chunk, init_kv_cache, prefill
+
+    _, cache = prefill(params, prompt, cfg)
+    chunk = jnp.zeros((1, draft_len), jnp.int32)
+    dt_chunk = _scan_timed(
+        lambda c: decode_chunk(params, cache, c, prompt_len, cfg)[0],
+        chunk, loop=8, reps=3)
+    # Parity ON HARDWARE: the schedule-not-distribution contract is exact
+    # when argmax is roundoff-stable; near-tied UNTRAINED bf16 logits can
+    # flip between the chunked and per-step reduction orders (a dtype
+    # property, not a speculation bug — measured f32 parity is exact), so
+    # report the agreement fraction, with greedy_parity_ok = full match.
+    # The probe is capped at the configured step count: max_len is sized
+    # for BENCH_SPEC_STEPS, and a fixed 32-step probe under a smaller
+    # setting would trip generate_speculative's max_len guard and error
+    # the whole config (advisor r05 low #2).
+    probe = min(32, steps)
+    a = np.asarray(generate(params, prompt, probe, cfg))
+    b = np.asarray(generate_speculative(params, prompt, probe, cfg,
+                                        draft_len=draft_len))
+    agreement = float((a == b).mean())
+    return {"metric": "decode_spec_tokens_per_s", "value": round(1.0 / dt_spec, 1),
+            "unit": "tok/s",
+            "vs_baseline": round(dt_plain / dt_spec, 3),
+            "plain_tok_s": round(1.0 / dt_plain, 1),
+            "zero_accept_floor_tok_s": round(1.0 / dt_chunk, 1),
+            "floor_vs_plain": round(dt_plain / dt_chunk, 3),
+            "draft_len": draft_len, "steps": steps, "d_model": d,
+            "dtype": cfg.dtype, "greedy_parity_ok": agreement == 1.0,
+            "greedy_agreement": round(agreement, 3),
+            "out_ok": n1 == steps and n2 == steps}

@@ -1,0 +1,207 @@
+"""Bench harness: backend bring-up, fencing, and timing recipes.
+
+Split out of the monolithic bench.py (ROADMAP item 7): this module owns
+everything about MEASURING — robust backend init (subprocess probe +
+retry/backoff), the tunnel-safe fence, and the three timing idioms
+(burst `_timed_r`, device-side `_scan_timed`, and the shared `_sized`
+env knob). The artifact contract (JSON lines, watchdog, dead-tunnel
+replay) lives in benchlib/artifact.py; the config functions live in the
+benchlib/configs_* modules; bench.py remains the entry point and the
+stable monkeypatching surface for tests.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt  # noqa: F401 - configs reach the package via here
+from marlin_tpu.utils import random as mrand  # noqa: F401 - config modules
+
+from .artifact import _emit_cached_results, _emit_error, _trim_err, _CONFIG
+
+# TPU-fast mode: bf16 operands (f32 accumulation on the MXU); float64 stays the
+# correctness reference in the tests.
+N = int(os.environ.get("BENCH_N", 32768))
+DTYPE = jnp.bfloat16
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,  # bf16 peak per v5e chip
+    "TPU v5e": 197.0,
+    "TPU v4": 275.0,
+    "TPU v6 lite": 918.0,
+    "cpu": 1.0,
+}
+HBM_GBPS = {  # per-chip HBM bandwidth, the decode roofline denominator
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v4": 1228.0,
+    "TPU v6 lite": 1640.0,
+    "cpu": 50.0,
+}
+
+
+def _probe_backend_subprocess(timeout: float) -> str:
+    """Run backend init in a child so a HANG becomes a catchable timeout —
+    an in-process jax.devices() that wedges would otherwise take the whole
+    bench (and the round's artifact) with it. Returns '' on success."""
+    force_cpu = (
+        "jax.config.update('jax_platforms', 'cpu');"
+        if os.environ.get("BENCH_FORCE_CPU")
+        else ""
+    )
+    code = (
+        "import jax;" + force_cpu + "import jax.numpy as jnp;"
+        "x = jnp.ones((128, 128), jnp.bfloat16);"
+        "jax.block_until_ready(x @ x);"
+        "print('ok')"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend probe hung past {timeout:.0f}s"
+    if r.returncode == 0 and "ok" in r.stdout:
+        return ""
+    return (r.stderr or r.stdout).strip()[-400:] or f"probe rc={r.returncode}"
+
+
+def init_backend():
+    """Backend bring-up with retry/backoff; emits a parsable JSON error line
+    and exits 1 if the backend never comes up (round 1 lost its artifact to a
+    bare traceback here — BENCH_r01.json rc=1, parsed null). Each attempt
+    first probes in a SUBPROCESS with a timeout, so both failure modes —
+    init raising and init hanging — are retried."""
+    retries = int(os.environ.get("BENCH_RETRIES", "3"))
+    backoff = float(os.environ.get("BENCH_BACKOFF", "60"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    last = "unknown"
+    for attempt in range(retries):
+        err = _probe_backend_subprocess(probe_timeout)
+        if not err:
+            try:
+                devs = jax.devices()
+                x = jnp.ones((128, 128), jnp.bfloat16)
+                jax.block_until_ready(x @ x)
+                return devs
+            except Exception as e:  # noqa: BLE001
+                err = _trim_err(e)
+        last = err
+        if attempt + 1 < retries:
+            time.sleep(backoff)
+    # Lost cause for THIS process — but the round's on-hardware numbers
+    # exist as in-repo capture files: replay the newest valid line per
+    # config as "cached": true results so a transient tunnel wedge at
+    # capture time doesn't erase the round's evidence (BENCH_r01/r02 both
+    # went rc=1 this way).
+    n = _emit_cached_results(_CONFIG[0], last)
+    if n:
+        print(f"backend unreachable ({last}); emitted {n} cached capture "
+              "line(s)", file=sys.stderr, flush=True)
+        sys.exit(0)
+    _emit_error("backend_init", last)
+    sys.exit(1)
+
+
+def guess_peak() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_TFLOPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 197.0
+
+
+# Sync via a scalar fetch: on the remote-tunnel (axon) platform,
+# block_until_ready can return before execution finishes, so the timing fence
+# is a device_get of a reduction over the result.
+_fence = None
+
+
+def _raw(x) -> jax.Array:
+    """Unwrap a distributed type to its device array; pass arrays through.
+    (An attribute check on .data would misfire: ndarray.data is a memoryview.)"""
+    from marlin_tpu.matrix.base import DistributedMatrix
+
+    return x.data if isinstance(x, DistributedMatrix) else x
+
+
+def fence(mat) -> float:
+    global _fence
+    if _fence is None:
+        _fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    return float(_fence(_raw(mat)))
+
+
+def _timed_r(fn, iters=5):
+    """(seconds/iter, last result) — returning the result lets callers that
+    need it for a residual check avoid recomputing it."""
+    r = fn()  # warmup / compile
+    out_bytes = int(_raw(r).nbytes)
+    fence(r)
+    # Fence once after the loop: device execution is in-order, so fetching a
+    # reduction of the last result implies all queued iterations finished.
+    # Fencing every iteration would add a tunnel round-trip per iter and
+    # serialize dispatch, understating throughput by ~15%. Async dispatch
+    # keeps every queued output buffer live at once, so cap the burst at
+    # ~8 GiB of outputs to stay clear of HBM exhaustion.
+    iters = max(1, min(iters, (8 << 30) // max(out_bytes, 1)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn()
+    fence(r)
+    return (time.perf_counter() - t0) / iters, r
+
+
+def _timed(fn, iters=5):
+    return _timed_r(fn, iters)[0]
+
+
+def _scan_timed(fn, x, *rest, loop=10, reps=4):
+    """Device-side scan-loop timing: ONE dispatch covers ``loop`` chained
+    invocations of ``fn(x, *rest)``, so the per-call tunnel RTT (comparable
+    to the kernel itself for ~10 ms ops) drops out of the measurement. The
+    scan carry perturbs ``x`` by a tiny amount so XLA cannot hoist the call
+    out of the loop; ``float()`` of the final carry is the tunnel-safe fence
+    (block_until_ready can return early on the axon platform).
+
+    A single fenced scan still pays ONE tunnel RTT over only ``loop``
+    invocations — on a slow-tunnel day (RTT ~100 ms vs ~120 ms of device
+    time) that alone understates throughput by ~40% (observed: the same
+    attention kernel read 45 vs 31 TFLOPS across sessions). So: time one
+    fenced call, then ``reps`` back-to-back calls fenced once at the end
+    (device execution is in-order, dispatch is async); both measurements
+    contain exactly one RTT + one fence, and their DIFFERENCE is pure
+    device time for ``(reps - 1) * loop`` invocations. Returns seconds per
+    invocation."""
+
+    @jax.jit
+    def scan_loop(x, *rest):
+        def body(c, _):
+            o = fn(x + (c * 1e-8).astype(x.dtype), *rest)
+            return jnp.sum(jnp.ravel(o)[:2].astype(jnp.float32)), None
+        return jax.lax.scan(body, jnp.float32(0), None, length=loop)[0]
+
+    float(scan_loop(x, *rest))  # warmup compile + fence
+    t0 = time.perf_counter()
+    float(scan_loop(x, *rest))
+    t_one = time.perf_counter() - t0
+    if reps < 2:  # single-shot behavior: one fenced scan, RTT included
+        return t_one / loop
+    t0 = time.perf_counter()
+    for _ in range(reps - 1):
+        scan_loop(x, *rest)  # queue without fetching
+    float(scan_loop(x, *rest))
+    t_many = time.perf_counter() - t0
+    dt = (t_many - t_one) / ((reps - 1) * loop)
+    if dt <= 0:  # timing noise exceeded the spread — fall back, RTT included
+        dt = t_many / (reps * loop)
+    return dt
+
+
+def _sized(env, default):
+    return int(os.environ.get(env, default))
+

@@ -1,0 +1,44 @@
+"""Config registry: the --config name -> function mapping bench.py runs.
+
+Split out of the monolithic bench.py (ROADMAP item 7). Importing this
+module pulls in every configs_* module; the artifact replay
+(benchlib/artifact.py) imports it lazily to avoid a cycle. A new
+artifact config needs BOTH a CONFIGS entry and a _CACHE_PREFIX entry in
+benchlib/artifact.py (tests/test_bench_harness.py enforces it), or it
+silently drops out of the dead-tunnel fallback.
+"""
+
+from . import (configs_gemm, configs_kernels, configs_linalg, configs_ml,
+               configs_sparse, configs_trend)
+
+CONFIGS = {
+    "headline": [configs_gemm.headline],
+    "square8k": [configs_gemm.config_square_8k],
+    "tallskinny": [configs_gemm.config_tall_skinny],
+    "chained": [configs_gemm.config_chained],
+    "summa": [configs_gemm.config_summa_mesh],
+    "attention": [configs_kernels.config_attention],
+    "sparse": [configs_kernels.config_sparse],
+    "sparsedist": [configs_sparse.config_sparse_dist],
+    "spmm": [configs_sparse.config_spmm],
+    "lu": [configs_linalg.config_lu],
+    "cholesky": [configs_linalg.config_cholesky],
+    "inverse": [configs_linalg.config_inverse],
+    "svd": [configs_linalg.config_svd],
+    "transformer": [configs_ml.config_transformer],
+    "longseq": [configs_ml.config_longseq],
+    "decode": [configs_ml.config_decode],
+    "decodeint8": [configs_ml.config_decode_int8],
+    "decodespec": [configs_ml.config_decode_spec],
+    "trend": [configs_trend.config_trend_cpu],
+    "serving": [configs_trend.config_serving],
+    "sweep": [configs_gemm.config_dispatch_sweep],
+    "attnsweep": [configs_kernels.config_attention_sweep],
+}
+# "all" = the artifact configs; the sweeps and the CPU-oriented
+# validation configs (trend, serving) are policy/tuning tools, run
+# explicitly.
+CONFIGS["all"] = [
+    fns[0] for k, fns in CONFIGS.items()
+    if k not in ("sweep", "attnsweep", "trend", "serving")
+]

@@ -1,0 +1,30 @@
+"""Continuous-batching serving engine (Orca-style iteration-level
+scheduling, vLLM-style slot reuse) over the frozen-row decode substrate.
+
+``ServingEngine.submit`` queues requests; ``step``/``run`` decode in
+bounded rounds, retiring finished rows and swapping queued work into
+the freed slots so the batch stays full under skewed traffic — the step
+that converts PR 1's "skew-proof" into reclaimed throughput
+(docs/serving.md).
+"""
+
+from .engine import ServingEngine, _decode_round
+from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
+from .slots import SlotManager, pad_prompt_len, prefill_into_row
+from .stats import (EngineStats, request_stats, static_completed_at_budget,
+                    static_schedule_iters)
+
+__all__ = [
+    "AdmissionQueue",
+    "EngineStats",
+    "QueueClosed",
+    "QueueFull",
+    "Request",
+    "ServingEngine",
+    "SlotManager",
+    "pad_prompt_len",
+    "prefill_into_row",
+    "request_stats",
+    "static_completed_at_budget",
+    "static_schedule_iters",
+]

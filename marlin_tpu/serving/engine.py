@@ -1,0 +1,349 @@
+"""Continuous-batching serving engine on the frozen-row decode substrate.
+
+The PR-1 freeze made finished rows inert but their FLOPs still burn in
+every dispatch (docs/decode_serving.md §1 "The cost that remains"):
+wall-clock = slowest member's iterations x full-batch chunk cost. This
+engine converts that dead compute into throughput the Orca/vLLM way —
+iteration-level scheduling over a fixed-shape batch:
+
+* decode runs in bounded ROUNDS (:func:`_decode_round`): the eos-style
+  ``lax.while_loop`` capped at ``round_steps`` iterations, still one
+  dispatch per round so the per-dispatch overhead amortizes;
+* between rounds the engine RETIRES finished rows (their tokens are
+  fetched, their slot freed) and ADMITS queued requests into the freed
+  rows via :func:`slots.prefill_into_row` — the batch stays full under
+  skewed traffic instead of draining to its slowest member.
+
+``round_steps`` is the scheduling latency knob: a request that finishes
+mid-round stays frozen (inert, PR-1 freeze) until the round boundary,
+so admission latency is at most one round. Smaller rounds admit sooner
+but pay more host round-trips; the static-shape dispatch cost per
+iteration is occupancy-independent either way (that is exactly why idle
+rows are pure waste, and why swapping work into them is pure win).
+
+Exactness: rows of ``decode_chunk`` are independent, so neither a
+frozen neighbor nor a mid-stream admission can move a live row's
+logits; with the 16-bucket admission prefill (slots.py) every request's
+greedy output is BIT-EXACT vs its own B=1 ``generate`` run
+(tests/test_serving.py pins this, plus the zero-recompile and >= 1.3x
+throughput claims). At temperature > 0 the engine samples through the
+same ``_sample`` kernel but shares one key stream across the batch, so
+sampled outputs are distribution-honest yet not replay-identical to a
+B=1 run's key schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import init_kv_cache
+from ..models import transformer as tr
+from .queue import AdmissionQueue, Request
+from .slots import SlotManager, pad_prompt_len, prefill_into_row
+from .stats import EngineStats
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
+    donate_argnums=(1, 2),
+)
+def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
+                  round_steps: int, temperature: float,
+                  eos_id: Optional[int] = None):
+    """One bounded decode round over the full batch (ONE dispatch).
+
+    ``cache`` and ``buf`` are DONATED (returned aliased — the engine
+    re-threads them). ``filled`` (B,) counts tokens in each row's
+    buffer; the row's last token (index ``filled - 1``) has not yet been
+    fed. ``done0`` marks rows frozen at entry (free slots, or finished
+    but not yet retired). Each iteration feeds every row's last token at
+    its own position through ``decode_chunk`` (C=1, per-row positions —
+    continuously batched rows are desynchronized by construction),
+    samples the next token, and freezes rows as they reach ``target`` or
+    emit ``eos_id``. Frozen rows repeat their last token at their last
+    position: the rewrite is a FIXED POINT (same token, same position,
+    same params -> identical KV) landing in already-dead state, so live
+    rows are bit-exact vs any other freeze/admission pattern.
+
+    The loop exits at ``round_steps`` or as soon as EVERY row is frozen
+    — an all-idle round costs one dispatch, not round_steps iterations.
+
+    Returns ``(buf, filled, done, cache, iters, live_iters)`` with
+    ``iters`` the loop trips taken and ``live_iters`` (B,) the per-row
+    live-iteration count — the verify_chunks-style ledger stats.py
+    turns into occupancy and reclaimed-FLOPs figures.
+    """
+    bsz = buf.shape[0]
+    brange = jnp.arange(bsz)
+
+    def cond(carry):
+        i, _, _, done, _, _, _ = carry
+        return (i < round_steps) & ~jnp.all(done)
+
+    def body(carry):
+        i, buf, filled, done, cache, key, live = carry
+        tok = buf[brange, filled - 1]
+        # Freeze-at-entry, BEFORE this iteration appends: a row admitted
+        # already at target (steps == 1: the admission prefill's first
+        # token was the whole request) must not decode — at target ==
+        # max_len the appended extra token would clamp onto index
+        # max_len - 1 and overwrite the real one.
+        done = done | (filled >= target)
+        if eos_id is not None:
+            # A row whose LAST token is eos is finished — this also
+            # catches an admission whose first sampled token was eos.
+            done = done | (tok == eos_id)
+        logits, cache = tr.decode_chunk(params, cache, tok[:, None],
+                                        filled - 1, cfg)
+        key, ks = jax.random.split(key)
+        nxt = tr._sample(logits[:, 0], temperature, ks)
+        nxt = jnp.where(done, tok, nxt).astype(buf.dtype)
+        # Frozen rows re-write their last token in place (dead, fixed
+        # point); live rows append at ``filled`` (< target <= L always).
+        w = jnp.where(done, filled - 1, filled)
+        buf = jax.vmap(
+            lambda b, t, p: jax.lax.dynamic_update_slice(b, t[None], (p,))
+        )(buf, nxt, w)
+        live = live + (~done).astype(jnp.int32)
+        filled = jnp.where(done, filled, filled + 1)
+        done = done | (filled >= target)
+        return i + 1, buf, filled, done, cache, key, live
+
+    live0 = jnp.zeros((bsz,), jnp.int32)
+    iters, buf, filled, done, cache, _, live = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), buf, filled, done0, cache, key, live0))
+    if eos_id is not None:
+        # An eos emitted on the round's last iteration only freezes the
+        # row at the NEXT feed; report it finished now so the engine
+        # retires it at this round boundary.
+        done = done | (buf[brange, filled - 1] == eos_id)
+    return buf, filled, done, cache, iters, live
+
+
+class ServingEngine:
+    """Continuous-batching engine: ``submit`` -> ``step``/``run``.
+
+    Owns the device state (cache, token buffer) and the host scheduling
+    state (queue, slots, per-request records). ``batch`` is the static
+    row count — the hardware-shaped knob; the queue absorbs everything
+    beyond it. All device mutation goes through the two jitted,
+    donation-aliased primitives, so steady-state serving allocates
+    nothing per admission and compiles nothing after warmup (one
+    ``_decode_round`` compile + one ``prefill_into_row`` compile per
+    distinct 16-bucket of prompt length).
+    """
+
+    def __init__(self, params, cfg, batch: int = 8, round_steps: int = 8,
+                 max_pending: int = 64, temperature: float = 0.0,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        if cfg.window:
+            raise NotImplementedError(
+                "serving needs the dense slot==position cache "
+                "(cfg.window == 0): a ring cache cannot host per-row "
+                "admission overwrites (see decode_chunk)")
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "serving decodes through decode_chunk, which does not "
+                "fit the MoE router's (T, D) batch contract")
+        if cfg.sequence_parallel:
+            raise NotImplementedError(
+                "sequence-parallel decode is not meaningful; shard the "
+                "batch instead")
+        if round_steps < 1:
+            raise ValueError(f"round_steps must be >= 1, got {round_steps}")
+        self.params = params
+        self.cfg = cfg
+        self.batch = batch
+        self.round_steps = round_steps
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.queue = AdmissionQueue(max_pending=max_pending)
+        self.slots = SlotManager(batch)
+        self.stats = EngineStats(batch=batch, cfg=cfg)
+        self._key = jax.random.PRNGKey(seed)
+        self._next_id = 0
+        self.round_idx = 0
+        # Pending + active requests ONLY: finished/timed-out requests
+        # are returned from step()/run() and dropped here, so a
+        # long-running engine holds O(batch + max_pending) requests.
+        self.requests: Dict[int, Request] = {}
+        # Device state. Free rows sit at filled=1 over a zero buffer so
+        # the frozen feed (buf[row, 0] at position 0) is well-defined
+        # dead state; target=0 keeps them done from round one.
+        self._cache = init_kv_cache(cfg, batch, dtype=cfg.compute_dtype)
+        self._buf = jnp.zeros((batch, cfg.max_len), jnp.int32)
+        self._filled = np.ones((batch,), np.int32)
+        self._target = np.zeros((batch,), np.int32)
+        self._active = np.zeros((batch,), bool)
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, prompt, steps: int,
+               deadline_rounds: Optional[int] = None) -> int:
+        """Queue one generation request; returns its request id.
+
+        ``prompt`` is a host/device 1-D int array; ``steps`` tokens will
+        be generated. Raises ``QueueFull`` (backpressure) or
+        ``QueueClosed`` (draining); validates against the cache extent
+        now so a hopeless request fails at submit, not at admission.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        s = int(prompt.shape[0])
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if s + steps > self.cfg.max_len:
+            raise ValueError(
+                f"prompt {s} + steps {steps} exceeds max_len "
+                f"{self.cfg.max_len}")
+        if pad_prompt_len(s) > self.cfg.max_len:
+            raise ValueError(
+                f"padded prompt {pad_prompt_len(s)} exceeds max_len "
+                f"{self.cfg.max_len}")
+        req = Request(request_id=self._next_id, prompt=prompt,
+                      steps=int(steps), deadline_rounds=deadline_rounds,
+                      submit_round=self.round_idx,
+                      submit_time=time.perf_counter())
+        self._next_id += 1
+        self.queue.submit(req)
+        self.requests[req.request_id] = req
+        return req.request_id
+
+    def close(self) -> None:
+        """Graceful drain: no new submits; ``run`` finishes queued work."""
+        self.queue.close()
+
+    # -- scheduling ---------------------------------------------------
+
+    def _admit(self) -> List[Request]:
+        """Fill free slots from the queue (FIFO); returns timed-out
+        requests dropped on the way."""
+        expired: List[Request] = []
+        while self.slots.n_free:
+            req, dropped = self.queue.pop_ready(self.round_idx)
+            expired.extend(dropped)
+            if req is None:
+                break
+            row = self.slots.acquire(req.request_id)
+            s = req.prompt_len
+            padded = np.zeros((pad_prompt_len(s),), np.int32)
+            padded[:s] = req.prompt
+            self._key, k_admit = jax.random.split(self._key)
+            self._cache, self._buf, _, _ = prefill_into_row(
+                self.params, self._cache, self._buf, jnp.int32(row),
+                jnp.asarray(padded), jnp.int32(s), k_admit,
+                cfg=self.cfg, temperature=self.temperature)
+            self._filled[row] = s + 1
+            self._target[row] = s + req.steps
+            self._active[row] = True
+            req.row = row
+            req.admit_round = self.round_idx
+            req.admit_time = time.perf_counter()
+            req.status = "active"
+            self.stats.record_admission(req)
+        for req in expired:
+            self.stats.record_timeout(req)
+            # Same ownership transfer as retirement: timed-out requests
+            # go back to the caller, not into an ever-growing dict.
+            self.requests.pop(req.request_id, None)
+        return expired
+
+    def _retire(self, filled: np.ndarray, done: np.ndarray) -> List[Request]:
+        """Free finished rows, extract their outputs (eos-padded past the
+        emitted span, matching ``generate``'s contract)."""
+        finished: List[Request] = []
+        rows = [r for r in self.slots.occupied_rows()
+                if done[r] and self._active[r]]
+        if not rows:
+            return finished
+        # np.array (an explicit copy) rather than device_get: the CPU
+        # backend's device_get returns a ZERO-COPY view that marks the
+        # buffer externally referenced, which silently disables the
+        # donation aliasing every later round/admission relies on (the
+        # pointer-pin test catches this).
+        buf_host = np.array(self._buf)
+        for row in rows:
+            req = self.requests[self.slots.owner_of(row)]
+            s = req.prompt_len
+            out = buf_host[row, s:s + req.steps].copy()
+            emitted = min(int(filled[row]) - s, req.steps)
+            if self.eos_id is not None and emitted < req.steps:
+                out[emitted:] = self.eos_id
+            req.tokens = out
+            req.emitted = emitted  # honest token count for the ledger
+            req.status = "done"
+            req.finish_round = self.round_idx
+            req.finish_time = time.perf_counter()
+            self._active[row] = False
+            self._target[row] = 0
+            self.slots.release(row)
+            self.stats.record_completion(req)
+            # Ownership of a finished request transfers to the caller
+            # (step()/run() return it); holding it here would grow host
+            # memory without bound on a long-running server — the queue
+            # bounds PENDING work, this bounds FINISHED work.
+            del self.requests[req.request_id]
+            finished.append(req)
+        return finished
+
+    def step(self) -> List[Request]:
+        """One scheduling round: admit into free rows, decode one
+        bounded round, retire finished rows. Returns the requests that
+        finished (or timed out) this round."""
+        expired = self._admit()
+        self._key, k_round = jax.random.split(self._key)
+        # done0: free rows, plus any row already at target (a steps=1
+        # admission emits its whole request inside the prefill) — the
+        # round also freezes such rows at body entry; marking them here
+        # saves the all-done round a no-op loop trip.
+        done0 = ~self._active | (self._filled >= self._target)
+        self._buf, filled_d, done_d, self._cache, iters_d, live_d = \
+            _decode_round(
+                self.params, self._cache, self._buf,
+                jnp.asarray(self._filled), jnp.asarray(self._target),
+                jnp.asarray(done0), k_round, cfg=self.cfg,
+                round_steps=self.round_steps,
+                temperature=self.temperature, eos_id=self.eos_id)
+        filled, done, iters, live = jax.device_get(
+            (filled_d, done_d, iters_d, live_d))
+        self._filled = np.array(filled, np.int32)  # writable host copy
+        for row in self.slots.occupied_rows():
+            self.requests[self.slots.owner_of(row)].live_iters += int(
+                live[row])
+        self.stats.record_round(
+            self.round_idx, int(iters),
+            occupied=self.slots.n_occupied, live_iters=int(live.sum()))
+        finished = self._retire(self._filled, np.asarray(done))
+        self.round_idx += 1
+        return expired + finished
+
+    def run(self, max_rounds: int = 10_000) -> List[Request]:
+        """Step until the queue and every slot are empty (graceful
+        drain); returns all requests finished along the way.
+
+        Exceeding ``max_rounds`` raises RuntimeError, but finished
+        requests are NOT lost: ownership of retired work transferred
+        out of the engine at each step, so the error carries them as
+        ``err.finished`` — a caller that hits the guard can still
+        deliver every completed output."""
+        out: List[Request] = []
+        rounds = 0
+        while len(self.queue) or self.slots.n_occupied:
+            if rounds >= max_rounds:
+                err = RuntimeError(
+                    f"run() exceeded max_rounds={max_rounds} with "
+                    f"{len(self.queue)} queued / "
+                    f"{self.slots.n_occupied} active "
+                    f"({len(out)} finished requests attached as "
+                    "err.finished)")
+                err.finished = out
+                raise err
+            out.extend(self.step())
+            rounds += 1
+        return out

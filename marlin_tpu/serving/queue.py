@@ -1,0 +1,115 @@
+"""Admission queue for the serving engine: FIFO with backpressure.
+
+The queue is the host-side half of continuous batching — requests wait
+here until the slot manager frees a batch row, then admit in strict FIFO
+order (iteration-level scheduling needs no priority machinery to beat
+static batching; arrival order is the fairness contract). Three policies
+live here so the engine stays a pure scheduling loop:
+
+* **Backpressure** (``max_pending``): ``submit`` on a full queue raises
+  :class:`QueueFull` instead of growing without bound — the caller (an
+  RPC frontend, a bench workload driver) owns the retry/shed decision,
+  the engine never silently buffers unbounded work.
+* **Deadlines**: a request may carry ``deadline_rounds`` — the engine
+  round index after which admission is pointless. Expired requests are
+  dropped at pop time with a TIMEOUT status rather than occupying a slot
+  a live request could use (the admission cost is a full prefill; paying
+  it for a request whose caller has hung up is the one waste continuous
+  batching can avoid for free).
+* **Graceful drain** (``close``): no new submits, already-queued work
+  still runs — ``ServingEngine.run`` keeps stepping until the closed
+  queue and the slots are both empty.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Raised by submit when the pending queue is at ``max_pending``."""
+
+
+class QueueClosed(RuntimeError):
+    """Raised by submit after :meth:`AdmissionQueue.close`."""
+
+
+@dataclass
+class Request:
+    """One generation request as the queue/engine track it. ``prompt`` is
+    a host int array (the device transfer happens at admission, inside
+    the jitted row swap); timing fields are filled in by the engine as
+    the request moves submit -> admit -> finish."""
+
+    request_id: int
+    prompt: np.ndarray  # (prompt_len,) int32, host-side
+    steps: int
+    deadline_rounds: Optional[int] = None  # absolute engine round index
+    submit_round: int = 0
+    submit_time: float = 0.0
+    # Engine-owned lifecycle fields:
+    row: int = -1
+    admit_round: int = -1
+    admit_time: float = 0.0
+    finish_round: int = -1
+    finish_time: float = 0.0
+    live_iters: int = 0  # decode iterations this request was live for
+    emitted: int = 0  # tokens actually generated (< steps if eos fired)
+    status: str = "pending"  # pending -> active -> done | timeout
+    tokens: Optional[np.ndarray] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+
+@dataclass
+class AdmissionQueue:
+    """FIFO of :class:`Request` with backpressure and deadline drop."""
+
+    max_pending: int = 64
+    _q: deque = field(default_factory=deque)
+    _closed: bool = False
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def submit(self, req: Request) -> None:
+        if self._closed:
+            raise QueueClosed(
+                "queue is draining (close() was called); no new requests")
+        if len(self._q) >= self.max_pending:
+            raise QueueFull(
+                f"{len(self._q)} pending requests >= max_pending "
+                f"{self.max_pending}; retry after the engine drains")
+        self._q.append(req)
+
+    def pop_ready(self, round_idx: int):
+        """Next admissible request, honoring FIFO order and deadlines:
+        requests whose ``deadline_rounds`` has passed are marked
+        ``timeout`` and returned in ``expired`` (the engine records them
+        as completed-without-output). Returns ``(request | None,
+        expired_list)``."""
+        expired = []
+        while self._q:
+            req = self._q.popleft()
+            if (req.deadline_rounds is not None
+                    and round_idx > req.deadline_rounds):
+                req.status = "timeout"
+                req.finish_round = round_idx
+                expired.append(req)
+                continue
+            return req, expired
+        return None, expired
+
+    def close(self) -> None:
+        """Stop accepting new work; queued requests still drain."""
+        self._closed = True

@@ -1,0 +1,190 @@
+"""Slot management + the row-swap primitive for continuous batching.
+
+A *slot* is one row of the live batch: one row of every KV-cache layer,
+one row of the token buffer, one entry of the ``filled``/``done`` state
+vectors. The decode substrate made finished rows inert
+(docs/decode_serving.md §1: a frozen row "can be replaced without
+touching any live row's state"); :func:`prefill_into_row` is that
+replacement — it prefills ONE request's prompt and writes its KV and
+tokens into a single row of the donated cache/buffer, in place.
+
+Why this is copy-free and recompile-free:
+
+* The cache and token buffer are DONATED (input->output aliasing), so an
+  admission updates the serving engine's live buffers in place — no
+  per-admission cache rebuild, and no copy of the (B, max_len, Hk, Dh)
+  layers a fresh ``init_kv_cache`` + merge would cost.
+* ``row`` and ``prompt_len`` are traced scalars — admitting into row 0
+  vs row 7, or a 9-token vs 14-token prompt, hits the same compile.
+  The only static axis is the padded prompt shape, bucketed to the
+  flash kernel's own 16-sublane granularity (see ``pad_prompt_len``),
+  so the compile count is bounded by the number of DISTINCT 16-buckets
+  ever admitted, not by the number of admissions.
+
+Why the padding bucket is 16 — the bit-exactness invariant: the flash
+prefill clamps its blocks to ``ceil(s / 16) * 16``
+(ops/flash_attention.effective_blocks), i.e. it already computes on
+16-padded tiles with the tail masked. Padding the prompt to exactly that
+length reproduces the SAME tile shapes and masked key sets for every
+real query row, so the admitted row's KV slots [0, prompt_len) and the
+first-token logits (read at ``prompt_len - 1``) are BIT-IDENTICAL to
+what an unpadded B=1 ``prefill`` computes. Padding to any other length
+changes the reduction tiling and drifts low bits (measured: ~1e-7 at
+f32, enough to flip a near-tied argmax). Pad slots — cache [prompt_len,
+P) and buffer tail — hold garbage but are DEAD state: decode at
+position p writes slot p before attending it and masks slots > p, so a
+stale slot is overwritten before any live read reaches it (the same
+argument that makes frozen-row writes safe in PR 1).
+
+Per-row independence (row-wise matmuls, per-row vmapped attention)
+means the single-row write cannot move any other row's logits: live
+rows decode bit-exactly through an admission into their batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer as tr
+
+
+def pad_prompt_len(prompt_len: int) -> int:
+    """The padded (static) admission shape for a prompt: the flash
+    kernel's 16-sublane bucket — the unique padding that keeps the
+    prefill bit-identical to the unpadded computation (module docstring).
+    """
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    return -(-prompt_len // 16) * 16
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature"),
+    donate_argnums=(1, 2),
+)
+def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
+                     cfg, temperature: float = 0.0):
+    """Prefill one request and swap it into batch row ``row``, in place.
+
+    Args:
+      params: model pytree (never donated).
+      cache: the LIVE serving cache (donated — consumed and returned
+        aliased; the caller must re-thread the returned cache).
+      buf: the (B, L) int32 token buffer (donated, same contract).
+      row: traced int32 — the slot to overwrite (must be frozen/free:
+        writing a live row would corrupt that request).
+      prompt: (P,) int32 with P = ``pad_prompt_len(prompt_len)`` —
+        entries past ``prompt_len`` are ignored (masked to 0 in the
+        buffer; their cache slots are dead state).
+      prompt_len: traced int32, the real prompt length.
+      key: PRNG key for the first-token sample (unused at greedy).
+      cfg / temperature: static.
+
+    Returns ``(cache, buf, filled_row, first)`` where ``filled_row`` is
+    the row's new fill count (prompt_len + 1: the first generated token
+    is already in the buffer at index ``prompt_len``) and ``first`` is
+    that token. Eos handling stays out of this compile — the decode
+    round freezes a row whose last token is the engine's eos_id.
+    """
+    params = tr._cast_params(params, cfg)
+    p = prompt.shape[0]
+    x = tr._embed_prefix(params, prompt[None], cfg)  # (1, P, D)
+    quant = bool(cfg.kv_quant)
+
+    zero = jnp.zeros((), row.dtype)
+
+    def write_row(layer_buf, val):
+        # val: (P, Hk, Dh) or (P, Hk, 1) scales -> one row, slots [0, P).
+        return jax.lax.dynamic_update_slice(
+            layer_buf, val[None].astype(layer_buf.dtype),
+            (row, zero, zero, zero))
+
+    for i, bp in enumerate(params["blocks"]):
+        x, k, v = tr._map_seqs(
+            lambda xi: tr._block(bp, xi, cfg, return_kv=True), x, cfg)
+        layer = cache[i]
+        if quant:
+            from ..models.quant import kv_quantize
+
+            kq, ks = kv_quantize(k[0])
+            vq, vs = kv_quantize(v[0])
+            layer = {"k": write_row(layer["k"], kq),
+                     "v": write_row(layer["v"], vq),
+                     "ks": write_row(layer["ks"], ks),
+                     "vs": write_row(layer["vs"], vs)}
+        else:
+            layer = {"k": write_row(layer["k"], k[0]),
+                     "v": write_row(layer["v"], v[0])}
+        cache[i] = layer
+    x = tr._layer_norm(params["ln_f"], x)
+    # Logits at the LAST REAL position (prompt_len - 1), not the padded
+    # tail — causality makes this hidden state independent of the pad.
+    h = jax.lax.dynamic_slice(x[0], (prompt_len - 1, zero),
+                              (1, x.shape[-1]))
+    logits = tr._readout(params, h)  # (1, V)
+    first = tr._sample(logits, temperature, key)[0]
+
+    # Token-buffer row: real prompt, zeros past it, first token at
+    # prompt_len. Built full-width then written as one row update.
+    length = buf.shape[1]
+    rowbuf = jnp.zeros((length,), buf.dtype)
+    rowbuf = jax.lax.dynamic_update_slice(rowbuf, prompt.astype(buf.dtype),
+                                          (0,))
+    rowbuf = jnp.where(jnp.arange(length) < prompt_len, rowbuf, 0)
+    rowbuf = jax.lax.dynamic_update_slice(
+        rowbuf, first[None].astype(buf.dtype), (prompt_len,))
+    buf = jax.lax.dynamic_update_slice(buf, rowbuf[None], (row, zero))
+    return cache, buf, prompt_len + 1, first
+
+
+class SlotManager:
+    """Host-side request -> batch-row bookkeeping for the engine.
+
+    Tracks which rows are free and which request occupies each occupied
+    row. Pure bookkeeping — all device state (cache rows, buffer rows)
+    is owned by the engine and mutated only through the jitted
+    primitives; this class guarantees the engine never admits into a
+    live row and never double-frees."""
+
+    def __init__(self, batch: int):
+        if batch < 1:
+            raise ValueError(f"batch must be >= 1, got {batch}")
+        self.batch = batch
+        self._free: List[int] = list(range(batch))[::-1]  # pop() -> row 0 first
+        self._owner: List[Optional[int]] = [None] * batch
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_occupied(self) -> int:
+        return self.batch - len(self._free)
+
+    def owner_of(self, row: int) -> Optional[int]:
+        return self._owner[row]
+
+    def occupied_rows(self) -> List[int]:
+        return [r for r, o in enumerate(self._owner) if o is not None]
+
+    def acquire(self, request_id: int) -> int:
+        """Claim a free row for ``request_id``; raises if none free."""
+        if not self._free:
+            raise RuntimeError("no free slot (scheduler bug: admission "
+                               "must check n_free first)")
+        row = self._free.pop()
+        self._owner[row] = request_id
+        return row
+
+    def release(self, row: int) -> None:
+        """Return ``row`` to the free pool (its device state stays as-is
+        — frozen rows are inert; the next admission overwrites it)."""
+        if self._owner[row] is None:
+            raise RuntimeError(f"double free of slot {row}")
+        self._owner[row] = None
+        self._free.append(row)

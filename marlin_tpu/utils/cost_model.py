@@ -39,6 +39,9 @@ __all__ = [
     "spearman_rho", "measure_wallclock", "decode_trend_model",
     "run_decode_trend_sweep", "run_summa_trend_sweep", "trend_verdict",
     "DECODE_TREND_GRID", "SUMMA_TREND_GRID",
+    "serving_trend_model", "run_serving_trend_sweep",
+    "SERVING_TREND_GRID",
+    "powerlaw_fit", "run_gemm_trend_sweep", "GEMM_TREND_GRID",
 ]
 
 
@@ -497,11 +500,11 @@ def run_decode_trend_sweep(cfg=None, grid=DECODE_TREND_GRID, reps: int = 3):
     cfg = cfg or tr.TransformerConfig(
         vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128, max_len=80)
     key = jax.random.PRNGKey(0)
+    params = tr.init_params(cfg, seed=0)  # shared: never donated/mutated
     out = []
     for pt in grid:
         b, steps, frac = pt["batch"], pt["steps"], pt["finished_frac"]
         assert steps < cfg.max_len
-        params = tr.init_params(cfg, seed=0)
         first = jnp.zeros((b,), jnp.int32)
         done0 = jnp.arange(b) < round(frac * b)
         state = {"cache": tr.init_kv_cache(cfg, b)}
@@ -559,6 +562,132 @@ def run_summa_trend_sweep(mesh=None, grid=SUMMA_TREND_GRID, reps: int = 3):
         out.append({"m": m, "k": k, "n": n, "predicted": flops,
                     "measured": measured})
     return out
+
+
+def serving_trend_model(cfg, batch: int, round_steps: int,
+                        live_rows: int) -> float:
+    """Predicted RELATIVE wall-clock of one serving engine round
+    (serving/engine._decode_round) at the given slot occupancy.
+
+    The dispatch has STATIC shapes, so as long as ANY row is live the
+    round runs its full ``round_steps`` iterations at the FULL batch's
+    per-step FLOPs — occupancy does not change what one round costs,
+    only how much of it is useful. That flatness IS the claim continuous
+    batching rests on: an idle row is pure waste (same wall-clock, no
+    tokens), so swapping queued work into it converts waste to
+    throughput at zero marginal round cost. The model therefore predicts
+    wall-clock flat in ``live_rows`` for live_rows >= 1 and collapsing
+    to the dispatch constant at live_rows == 0 (the while_loop exits
+    before the first body — the same early-exit cliff as
+    :func:`decode_trend_model`); units are arbitrary, the sweep scores
+    RANKS. Throughput, not modeled here, scales as
+    ``live_rows / batch`` — the stats ledger's utilization figure."""
+    flops, _ = decode_step_cost(cfg, batch)
+    iters = 0 if live_rows == 0 else round_steps
+    return iters * flops + 1.0
+
+
+# Serving grid: round_steps >= 2x-spaced at full occupancy for the rank
+# claim, a half-occupancy twin for the flatness claim (tied prediction,
+# tied measurement), and the live_rows=0 early-exit cliff.
+SERVING_TREND_GRID = (
+    {"batch": 4, "round_steps": 8, "live_rows": 4},
+    {"batch": 4, "round_steps": 24, "live_rows": 4},
+    {"batch": 4, "round_steps": 64, "live_rows": 2},
+    {"batch": 4, "round_steps": 64, "live_rows": 4},
+    {"batch": 4, "round_steps": 64, "live_rows": 0},
+)
+
+
+def run_serving_trend_sweep(cfg=None, grid=SERVING_TREND_GRID,
+                            reps: int = 3):
+    """Measure one serving decode round (serving/engine._decode_round)
+    at each grid point and pair it with :func:`serving_trend_model`.
+
+    Drives the round directly with explicit ``done0`` masks (the first
+    ``live_rows`` rows live, targets far enough that no live row
+    finishes mid-round), re-threading the donated cache/buffer between
+    timed calls exactly as the engine does. ``filled`` is re-passed
+    unchanged, so every timed call decodes the same round — repeatable
+    by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import transformer as tr
+    from ..serving.engine import _decode_round
+
+    cfg = cfg or tr.TransformerConfig(
+        vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128, max_len=96)
+    key = jax.random.PRNGKey(0)
+    params = tr.init_params(cfg, seed=0)  # shared: never donated/mutated
+    out = []
+    for pt in grid:
+        b, rs, live = pt["batch"], pt["round_steps"], pt["live_rows"]
+        assert rs + 2 <= cfg.max_len and live <= b
+        filled = jnp.ones((b,), jnp.int32)
+        # Live rows never reach target inside the round; dead rows are
+        # born done (target 0 + the done0 mask both hold).
+        target = jnp.where(jnp.arange(b) < live, rs + 2, 0).astype(
+            jnp.int32)
+        done0 = jnp.arange(b) >= live
+        state = {"cache": tr.init_kv_cache(cfg, b),
+                 "buf": jnp.zeros((b, cfg.max_len), jnp.int32)}
+
+        def step(state=state, filled=filled, target=target, done0=done0,
+                 rs=rs):
+            state["buf"], _, _, state["cache"], iters, _ = _decode_round(
+                params, state["cache"], state["buf"], filled, target,
+                done0, key, cfg=cfg, round_steps=rs, temperature=0.0,
+                eos_id=None)
+            return iters
+
+        measured = measure_wallclock(step, reps=reps)
+        out.append({**pt,
+                    "predicted": serving_trend_model(cfg, b, rs, live),
+                    "measured": measured})
+    return out
+
+
+def powerlaw_fit(xs, ys) -> dict:
+    """Least-squares fit ``log ys = a + e * log xs``: the measured
+    scaling exponent plus the RMS log-residual — the
+    model-vs-measured-fit quality figure the bench trend line reports.
+    Degenerate inputs (any nonpositive value, < 2 points) return
+    exponent 0 / residual inf rather than raising."""
+    import numpy as np
+
+    xs = np.asarray(xs, float)
+    ys = np.asarray(ys, float)
+    if len(xs) < 2 or (xs <= 0).any() or (ys <= 0).any():
+        return {"exponent": 0.0, "residual_rms": float("inf")}
+    lx, ly = np.log(xs), np.log(ys)
+    a = np.stack([np.ones_like(lx), lx], axis=1)
+    coef, *_ = np.linalg.lstsq(a, ly, rcond=None)
+    resid = ly - a @ coef
+    return {"exponent": float(coef[1]),
+            "residual_rms": float(np.sqrt(np.mean(resid ** 2)))}
+
+
+# GEMM n-sweep grid (square m = k = n through the SUMMA engine): 8x
+# FLOP spacing per step; the smallest point is sized so local BLAS time
+# dominates the CPU mesh's per-dispatch overhead (a 256-point measures
+# dispatch, not matmul, and flattens the exponent). Divisible by every
+# 8-device mesh factorization.
+GEMM_TREND_GRID = (512, 1024, 2048)
+
+
+def run_gemm_trend_sweep(mesh=None, grid=GEMM_TREND_GRID, reps: int = 3):
+    """Square-GEMM n-sweep (ROADMAP item 2, first slice): the SUMMA
+    measurement recipe (:func:`run_summa_trend_sweep` — one engine, one
+    timing/fencing discipline) on a square (n, n, n) grid, paired with
+    the ``summa_cost`` FLOPs term whose exponent in n is exactly 3. The
+    test asserts the MEASURED exponent (``powerlaw_fit`` over these
+    points) lands in a band around it; the bench trend line reports the
+    exponent and the model-fit residual."""
+    pts = run_summa_trend_sweep(mesh=mesh, grid=[(n, n, n) for n in grid],
+                                reps=reps)
+    return [{"n": p["m"], "predicted": p["predicted"],
+             "measured": p["measured"]} for p in pts]
 
 
 def trend_verdict(points) -> dict:

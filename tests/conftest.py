@@ -32,6 +32,20 @@ import pytest
 import marlin_tpu as mt
 
 
+def pytest_configure(config):
+    # Suite wall-clock guard (ROADMAP item 9): tier-1 runs with
+    # `-m 'not slow' --durations=25`; any test measured > 60 s CPU gets
+    # @pytest.mark.slow and moves to the weekly tier. As of PR 2 the
+    # durations report tops out at ~37 s (test_windowed_forward_matches_
+    # banded_oracle), so nothing currently carries the mark — the
+    # registration keeps `-m 'not slow'` warning-free and the policy
+    # enforceable the moment a test crosses the line.
+    config.addinivalue_line(
+        "markers",
+        "slow: test exceeding 60 s on the CPU mesh; excluded from the "
+        "tier-1 run (-m 'not slow'), exercised by the weekly tier")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _setup():
     assert len(jax.devices()) == 8, "tests need the 8-device virtual CPU mesh"

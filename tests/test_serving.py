@@ -1,0 +1,350 @@
+"""Continuous-batching serving engine tests (marlin_tpu/serving/).
+
+The three acceptance claims, each pinned mechanically:
+
+* EXACTNESS — every request's emitted tokens are BIT-exact vs a B=1
+  ``generate`` run of the same prompt (greedy), for plain / rope+GQA /
+  int8-cache configs, regardless of which rows its neighbors occupied,
+  when it was admitted, or what was swapped in next to it mid-stream
+  (per-row independence + the 16-bucket admission prefill,
+  serving/slots.py module docstring).
+* RECLAIM — on a skewed workload, continuous batching completes >= 1.3x
+  the requests a static batcher completes in the same number of decode
+  iterations (simulated rounds: iteration counts, not wall-clock, so CI
+  noise cannot vote), and the reclaimed-FLOPs ledger is positive.
+* NO RECOMPILE / NO REBUILD — admissions and rounds hit exactly one
+  compile each (plus one per distinct prompt 16-bucket), and the cache
+  and token buffer stay in the SAME device buffers (donation aliasing)
+  across every swap — the test_decode_donation.py contract extended to
+  the serving loop.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from marlin_tpu.models import TransformerConfig, generate, init_params
+from marlin_tpu.serving import (AdmissionQueue, QueueClosed, QueueFull,
+                                Request, ServingEngine, SlotManager,
+                                pad_prompt_len, static_completed_at_budget,
+                                static_schedule_iters)
+from marlin_tpu.serving.engine import _decode_round
+from marlin_tpu.serving.slots import prefill_into_row
+
+
+def _cfg(**kw):
+    base = dict(vocab=48, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=96)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _req(rid=0, steps=4, prompt_len=4, **kw):
+    return Request(request_id=rid, steps=steps,
+                   prompt=np.zeros((prompt_len,), np.int32), **kw)
+
+
+class TestAdmissionQueue:
+    def test_fifo_and_backpressure(self):
+        q = AdmissionQueue(max_pending=2)
+        q.submit(_req(0))
+        q.submit(_req(1))
+        with pytest.raises(QueueFull, match="max_pending"):
+            q.submit(_req(2))
+        got, expired = q.pop_ready(0)
+        assert got.request_id == 0 and not expired
+        q.submit(_req(2))  # freed capacity accepts again
+        assert q.pop_ready(0)[0].request_id == 1
+
+    def test_close_drains_but_rejects_new(self):
+        q = AdmissionQueue()
+        q.submit(_req(0))
+        q.close()
+        with pytest.raises(QueueClosed):
+            q.submit(_req(1))
+        assert q.pop_ready(0)[0].request_id == 0  # queued work survives
+
+    def test_deadline_expiry_drops_at_pop(self):
+        q = AdmissionQueue()
+        q.submit(_req(0, deadline_rounds=2))
+        q.submit(_req(1))
+        got, expired = q.pop_ready(5)  # round 5 > deadline 2
+        assert got.request_id == 1
+        assert [r.request_id for r in expired] == [0]
+        assert expired[0].status == "timeout"
+
+
+class TestSlotManager:
+    def test_acquire_release_cycle(self):
+        sm = SlotManager(2)
+        a, b = sm.acquire(10), sm.acquire(11)
+        assert {a, b} == {0, 1} and sm.n_free == 0
+        with pytest.raises(RuntimeError, match="no free slot"):
+            sm.acquire(12)
+        sm.release(a)
+        assert sm.n_free == 1 and sm.owner_of(a) is None
+        with pytest.raises(RuntimeError, match="double free"):
+            sm.release(a)
+        assert sm.acquire(12) == a  # freed row is reusable
+
+    def test_pad_prompt_len_is_the_16_bucket(self):
+        assert [pad_prompt_len(s) for s in (1, 15, 16, 17, 32, 33)] == \
+            [16, 16, 16, 32, 32, 48]
+        with pytest.raises(ValueError):
+            pad_prompt_len(0)
+
+
+def _run_workload(engine, workload, waves=1):
+    """Submit ``workload`` [(prompt, steps), ...] in ``waves`` batches
+    with engine steps in between (mid-stream admission), then drain.
+    Returns ``(ids, finished)``: {request_id: (prompt, steps)} and the
+    finished Request objects by id (the engine TRANSFERS ownership of
+    finished requests through step()/run() and drops them from its own
+    dict — bounded host memory is part of the serving contract)."""
+    ids = {}
+    finished = []
+    per = -(-len(workload) // waves)
+    for w in range(waves):
+        for prompt, steps in workload[w * per:(w + 1) * per]:
+            ids[engine.submit(prompt, steps)] = (prompt, steps)
+        if w + 1 < waves:
+            finished += engine.step()  # queue only partly submitted
+    finished += engine.run()
+    return ids, {r.request_id: r for r in finished}
+
+
+class TestServingExactness:
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"rope": True, "n_kv_heads": 1},
+        {"kv_quant": "int8"},
+    ])
+    def test_outputs_bit_exact_vs_b1_generate(self, kw):
+        # Mixed prompt lengths (three distinct 16-buckets) and skewed
+        # step counts, submitted in two waves so admissions land while
+        # neighbors are mid-decode: every request must emit exactly its
+        # own B=1 greedy generate tokens.
+        cfg = _cfg(**kw)
+        params = init_params(cfg, seed=0)
+        eng = ServingEngine(params, cfg, batch=3, round_steps=5)
+        rng = np.random.default_rng(7)
+        workload = [(rng.integers(0, cfg.vocab, s), steps)
+                    for s, steps in ((9, 20), (17, 5), (20, 12), (5, 30),
+                                     (33, 7), (12, 18), (6, 3))]
+        ids, done = _run_workload(eng, workload, waves=3)
+        assert eng.stats.n_completed == len(workload)
+        assert not eng.requests  # finished work is handed back, not held
+        for rid, (prompt, steps) in ids.items():
+            ref = np.asarray(generate(
+                params, jnp.asarray(prompt[None], jnp.int32), steps,
+                cfg))[0]
+            np.testing.assert_array_equal(done[rid].tokens, ref,
+                                          err_msg=f"request {rid}")
+
+    def test_arrival_pattern_cannot_move_outputs(self):
+        # The same workload through different batch sizes and wave
+        # splits — different slot assignments, different freeze/swap
+        # interleavings — must produce identical per-request tokens
+        # (per-row independence is THE serving invariant).
+        cfg = _cfg()
+        params = init_params(cfg, seed=3)
+        rng = np.random.default_rng(11)
+        workload = [(rng.integers(0, cfg.vocab, int(s)), int(st))
+                    for s, st in zip(rng.integers(4, 30, 8),
+                                     rng.integers(2, 24, 8))]
+        outs = []
+        for batch, waves, rsteps in ((2, 1, 4), (4, 4, 7), (3, 2, 16)):
+            eng = ServingEngine(params, cfg, batch=batch,
+                                round_steps=rsteps)
+            ids, done = _run_workload(eng, workload, waves=waves)
+            # Submission order == workload order, so request ids are the
+            # workload indices on a fresh engine.
+            outs.append([done[rid].tokens.tolist() for rid in sorted(ids)])
+        assert outs[0] == outs[1] == outs[2]
+
+    def test_steps_one_at_max_len_boundary_is_exact(self):
+        # Regression (PR-2 review): a steps=1 request is COMPLETE at
+        # admission (the prefill's first sample is the whole request).
+        # Pre-fix, the decode round still appended one extra token; at
+        # prompt_len + 1 == max_len the append clamped onto index
+        # max_len - 1 and OVERWROTE the real token. Pin the boundary,
+        # an off-boundary steps=1, and the zero-useful-work ledger.
+        cfg = _cfg()
+        params = init_params(cfg, seed=4)
+        rng = np.random.default_rng(6)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4)
+        prompts = [rng.integers(0, cfg.vocab, cfg.max_len - 1),  # boundary
+                   rng.integers(0, cfg.vocab, 9)]               # interior
+        ids = [eng.submit(p, 1) for p in prompts]
+        done = {r.request_id: r for r in eng.run()}
+        for rid, p in zip(ids, prompts):
+            ref = np.asarray(generate(
+                params, jnp.asarray(p[None], jnp.int32), 1, cfg))[0]
+            np.testing.assert_array_equal(done[rid].tokens, ref)
+            # No decode iteration was live work for a prefill-complete
+            # request — the utilization ledger must not bill any.
+            assert done[rid].live_iters == 0
+            assert done[rid].emitted == 1
+
+    def test_eos_freeze_matches_generate(self):
+        # Pick an eos the model actually emits (greedy attractors make
+        # untrained continuations repeat), then pin serving's outputs —
+        # eos at its position, eos padding after — against
+        # generate(eos_id=...) per request.
+        cfg = _cfg()
+        params = init_params(cfg, seed=5)
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, cfg.vocab, s) for s in (8, 13, 21)]
+        steps = 16
+        free = [np.asarray(generate(
+            params, jnp.asarray(p[None], jnp.int32), steps, cfg))[0]
+            for p in prompts]
+        eos = int(free[0][steps // 2])  # mid-stream token: fires early
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            eos_id=eos)
+        ids = {eng.submit(p, steps): p for p in prompts}
+        done = {r.request_id: r for r in eng.run()}
+        fired = 0
+        for rid, p in ids.items():
+            ref = np.asarray(generate(
+                params, jnp.asarray(p[None], jnp.int32), steps, cfg,
+                eos_id=eos))[0]
+            np.testing.assert_array_equal(done[rid].tokens, ref)
+            fired += int((ref == eos).any())
+        assert fired >= 1  # the eos path actually ran
+        # The ledger counts tokens actually generated, not the request's
+        # step budget: an early-eos request reports emitted < steps and
+        # tokens_out sums the honest figure (PR-2 review finding).
+        emitted = [done[r].emitted for r in ids]
+        assert eng.stats.tokens_out == sum(emitted)
+        assert any(e < steps for e in emitted)
+
+
+class TestServingReclaim:
+    def test_skewed_workload_beats_static_by_1_3x(self):
+        # Skewed arrivals: each static FIFO group of 4 carries one
+        # straggler, so static batching drains 3 finished rows per
+        # group while continuous batching refills them. Equal simulated
+        # rounds = equal decode-iteration budget; >= 1.3x completions
+        # is the acceptance bar (this workload clears it with margin).
+        cfg = _cfg()
+        params = init_params(cfg, seed=1)
+        rng = np.random.default_rng(4)
+        batch = 4
+        steps_list = [4, 3, 5, 40, 4, 6, 3, 40, 5, 4, 6, 40]
+        workload = [(rng.integers(0, cfg.vocab, int(s)), st)
+                    for s, st in zip(rng.integers(4, 16, len(steps_list)),
+                                     steps_list)]
+        eng = ServingEngine(params, cfg, batch=batch, round_steps=8)
+        _run_workload(eng, workload)
+        assert eng.stats.n_completed == len(workload)
+        # Budget = decode iterations + one per admission prefill
+        # (sim_iters: the bias-corrected accounting — a bare iteration
+        # count would under-bill continuous requests by their prefill-
+        # emitted first token while charging static the full steps).
+        budget = eng.stats.sim_iters
+        # Static batching on the same FIFO workload, same accounting the
+        # bench serving config uses (shared helper in serving/stats.py).
+        completed_static = static_completed_at_budget(steps_list, batch,
+                                                      budget)
+        ratio = eng.stats.n_completed / max(completed_static, 1)
+        assert ratio >= 1.3, (ratio, budget, completed_static)
+
+        # The ledger agrees: static spends static_schedule_iters to
+        # finish everything; continuous reclaims a positive FLOP count.
+        static_iters = static_schedule_iters(steps_list, batch)
+        assert budget < static_iters
+        assert eng.stats.reclaimed_flops(static_iters=static_iters) > 0
+        assert 0.0 < eng.stats.utilization() <= 1.0
+
+    def test_deadline_timeout_and_drain(self):
+        cfg = _cfg()
+        params = init_params(cfg, seed=2)
+        eng = ServingEngine(params, cfg, batch=1, round_steps=2)
+        rng = np.random.default_rng(9)
+        blocker = eng.submit(rng.integers(0, cfg.vocab, 8), steps=30)
+        doomed = eng.submit(rng.integers(0, cfg.vocab, 8), steps=4,
+                            deadline_rounds=1)
+        eng.close()
+        with pytest.raises(QueueClosed):
+            eng.submit(rng.integers(0, cfg.vocab, 8), steps=2)
+        done = eng.run()  # graceful drain of already-queued work
+        by_id = {r.request_id: r for r in done}
+        assert by_id[blocker].status == "done"
+        assert by_id[doomed].status == "timeout"
+        assert by_id[doomed].tokens is None
+        assert eng.stats.n_timeout == 1
+
+    def test_submit_guards(self):
+        cfg = _cfg()
+        eng = ServingEngine(init_params(cfg, seed=0), cfg, batch=1)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit(np.zeros(90, np.int32), steps=10)
+        with pytest.raises(ValueError, match="steps"):
+            eng.submit(np.zeros(4, np.int32), steps=0)
+        with pytest.raises(NotImplementedError, match="dense"):
+            ServingEngine(init_params(_cfg(window=8), seed=0),
+                          _cfg(window=8))
+        moe = _cfg(n_experts=2)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            ServingEngine(init_params(moe, seed=0), moe)
+
+
+class TestServingCompileAndDonation:
+    def test_no_recompile_across_admissions_and_rows(self):
+        # Compile-count teeth (the test_decode_donation.py idiom): a
+        # serving run with 9 admissions across every row of the batch,
+        # all prompts inside one 16-bucket, costs exactly ONE admission
+        # compile and ONE round compile — row index, prompt length, and
+        # fill state are traced, never baked in. vocab=52 makes this
+        # cfg unique to the test, so the jit-cache delta is exact no
+        # matter which tests compiled what before it.
+        cfg = _cfg(vocab=52)
+        params = init_params(cfg, seed=6)
+        eng = ServingEngine(params, cfg, batch=3, round_steps=4)
+        rng = np.random.default_rng(1)
+        admit0 = prefill_into_row._cache_size()
+        round0 = _decode_round._cache_size()
+        workload = [(rng.integers(0, cfg.vocab, int(s)), int(st))
+                    for s, st in zip(rng.integers(4, 16, 9),
+                                     rng.integers(2, 12, 9))]
+        _run_workload(eng, workload, waves=3)
+        assert eng.stats.n_completed == 9
+        assert prefill_into_row._cache_size() == admit0 + 1
+        assert _decode_round._cache_size() == round0 + 1
+        # A second engine on the same shapes adds nothing either.
+        eng2 = ServingEngine(params, cfg, batch=3, round_steps=4)
+        eng2.submit(rng.integers(0, cfg.vocab, 8), 4)
+        eng2.run()
+        assert prefill_into_row._cache_size() == admit0 + 1
+        assert _decode_round._cache_size() == round0 + 1
+
+    def test_cache_and_buffer_stay_in_place_across_swaps(self):
+        # Donation aliasing across the whole serving lifetime: after
+        # warmup, every admission and every round updates the SAME
+        # device buffers — no per-admission cache rebuild, no round
+        # copy. (unsafe_buffer_pointer equality, as in
+        # test_decode_donation.py.)
+        cfg = _cfg()
+        params = init_params(cfg, seed=8)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4)
+        rng = np.random.default_rng(3)
+        # Warmup: first admission + first round allocate the aliased
+        # storage the engine then lives in.
+        eng.submit(rng.integers(0, cfg.vocab, 8), 3)
+        eng.run()
+
+        def pointers():
+            ptrs = [eng._buf.unsafe_buffer_pointer()]
+            for layer in eng._cache:
+                ptrs += [v.unsafe_buffer_pointer()
+                         for v in layer.values()]
+            return ptrs
+
+        before = pointers()
+        for _ in range(4):
+            eng.submit(rng.integers(0, cfg.vocab, 8), 5)
+        eng.run()
+        assert pointers() == before

@@ -111,6 +111,81 @@ class TestDecodeTrendSweep:
         assert meas[-1] < 0.5 * meas[0], meas
 
 
+class TestServingTrendSweep:
+    @pytest.fixture(scope="class")
+    def sweep(self):
+        return cm.run_serving_trend_sweep()
+
+    def test_rank_correlation_meets_bar(self, sweep):
+        v = cm.trend_verdict(sweep)
+        assert v["rho"] >= 0.9, sweep
+
+    def test_round_cost_is_flat_in_occupancy(self, sweep):
+        # The static-shape claim continuous batching rests on: at fixed
+        # round_steps, a half-occupied round costs what a full round
+        # costs (within CI noise) — idle rows are pure waste, so
+        # swapping work into them is free throughput.
+        half = next(p for p in sweep if p["live_rows"] == 2)
+        full = next(p for p in sweep if p["live_rows"] == 4
+                    and p["round_steps"] == half["round_steps"])
+        assert half["measured"] <= 1.5 * full["measured"], sweep
+        assert full["measured"] <= 1.5 * half["measured"], sweep
+
+    def test_empty_round_collapses(self, sweep):
+        # All-idle rounds exit before the first body: the engine can
+        # spin on an empty batch without burning round_steps dispatches.
+        empty = next(p for p in sweep if p["live_rows"] == 0)
+        full = next(p for p in sweep
+                    if p["live_rows"] == 4
+                    and p["round_steps"] == empty["round_steps"])
+        assert empty["measured"] < 0.5 * full["measured"], sweep
+
+
+class TestGemmTrendSweep:
+    @pytest.fixture(scope="class")
+    def sweep(self, mesh):
+        return cm.run_gemm_trend_sweep(mesh=mesh)
+
+    def test_grid_is_8x_spaced_in_model_flops(self):
+        preds = [cm.summa_cost(n, n, n, 4, 2)[0]
+                 for n in cm.GEMM_TREND_GRID]
+        for lo, hi in zip(preds[:-1], preds[1:]):
+            assert hi == 8 * lo, preds  # square n-doubling: exactly n^3
+
+    def test_rank_correlation_meets_bar(self, sweep):
+        assert cm.trend_verdict(sweep)["rho"] >= 0.9, sweep
+
+    def test_measured_exponent_tracks_flops_term(self, sweep):
+        # summa_cost's FLOPs term is exactly n^3; the measured
+        # wall-clock exponent must land in a band around it. The band
+        # is wide on purpose: a shared-host CPU mesh mixes BLAS
+        # efficiency shifts and dispatch overhead into the small end of
+        # the grid (memory-bound floor ~n^2), but an op that stopped
+        # scaling with its model (n^1 constant-dominated, or n^4 from
+        # an accidental re-materialization) still fails loudly.
+        fit = cm.powerlaw_fit([p["n"] for p in sweep],
+                              [p["measured"] for p in sweep])
+        model = cm.powerlaw_fit([p["n"] for p in sweep],
+                                [p["predicted"] for p in sweep])
+        assert model["exponent"] == pytest.approx(3.0, abs=1e-9)
+        assert 1.5 <= fit["exponent"] <= 4.2, (fit, sweep)
+        # The fit itself must be tight enough to mean something.
+        assert fit["residual_rms"] < 0.75, (fit, sweep)
+
+
+class TestPowerlawFit:
+    def test_recovers_exact_exponent(self):
+        xs = [1, 2, 4, 8]
+        fit = cm.powerlaw_fit(xs, [5.0 * x ** 3 for x in xs])
+        assert fit["exponent"] == pytest.approx(3.0)
+        assert fit["residual_rms"] == pytest.approx(0.0, abs=1e-12)
+
+    def test_degenerate_inputs_do_not_raise(self):
+        assert cm.powerlaw_fit([1], [1])["exponent"] == 0.0
+        assert cm.powerlaw_fit([1, 2], [0, 1])["residual_rms"] \
+            == float("inf")
+
+
 class TestSummaTrendSweep:
     def test_rank_correlation_meets_bar(self, mesh):
         sweep = cm.run_summa_trend_sweep(mesh=mesh)
