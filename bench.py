@@ -57,7 +57,8 @@ from benchlib.artifact import (  # noqa: E402,F401 - re-exported surface
     _emit_run_status, _error_line, _remaining, _start_watchdog, _trim_err)
 from benchlib.harness import (  # noqa: E402,F401 - re-exported surface
     DTYPE, HBM_GBPS, N, PEAK_TFLOPS, _probe_backend_subprocess, _raw,
-    _scan_timed, _sized, _timed, _timed_r, fence, guess_peak, init_backend)
+    _scan_timed, _sized, _timed, _timed_r, attach_metrics, fence,
+    guess_peak, init_backend)
 from benchlib.configs_gemm import (  # noqa: E402,F401
     config_chained, config_dispatch_sweep, config_square_8k,
     config_summa_mesh, config_tall_skinny, headline)
@@ -136,6 +137,10 @@ def main():
         if succeeded and not status_out:
             _emit_run_status(live=True, n_lines=len(configs))
             status_out = True
+        # Every artifact line carries the obs metrics snapshot (a bare
+        # module-global reference, so bench.attach_metrics stays
+        # monkeypatchable like the rest of the surface).
+        line = attach_metrics(line)
         print(json.dumps(line), flush=True)
         _SUCCEEDED[0] = succeeded
     disarm.set()

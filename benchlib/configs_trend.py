@@ -81,13 +81,29 @@ def config_serving():
     CPU smoke mesh and the chip. Wall-clock throughput for both
     schedulers, slot utilization, and the reclaimed-FLOPs ledger ride
     along; ``vs_baseline`` is the ratio against the 1.3x acceptance
-    bar (>= 1 means the bar is met)."""
+    bar (>= 1 means the bar is met).
+
+    Observability ride-alongs (docs/observability.md): the measured run
+    executes under the process tracer and exports a Chrome/Perfetto
+    trace-event JSON (``BENCH_TRACE_PATH``, default
+    ``<tmpdir>/marlin_serving_trace.json`` — ``trace_path`` /
+    ``trace_events`` fields); a compile watchdog baselined AFTER warmup
+    reports ``recompiles_after_warmup`` (the zero-recompile guarantee as
+    an artifact field); and bench.main() attaches the metrics snapshot
+    (TTFT / per-token-latency histograms included) to this line like
+    every other."""
+    import tempfile
+
     import numpy as np
 
     from marlin_tpu.models import TransformerConfig, generate, init_params
+    from marlin_tpu.obs import trace as obs_trace
+    from marlin_tpu.obs.watch import CompileWatchdog
     from marlin_tpu.serving import (ServingEngine,
                                     static_completed_at_budget,
                                     static_schedule_iters)
+    from marlin_tpu.serving.engine import _decode_round
+    from marlin_tpu.serving.slots import prefill_into_row
 
     d = _sized("BENCH_SRV_D", 256)
     batch = _sized("BENCH_SRV_B", 4)
@@ -117,7 +133,26 @@ def config_serving():
         return eng, time.perf_counter() - t0
 
     run_continuous()  # warmup: round + admission compiles
-    eng, dt_cont = run_continuous()
+    # Post-warmup watchdog: the measured run must not compile anything —
+    # the PR-2 zero-recompile guarantee, checked live and reported in
+    # the artifact line instead of only in tests.
+    wd = CompileWatchdog()
+    wd.register("serving.decode_round", _decode_round)
+    wd.register("serving.prefill_into_row", prefill_into_row)
+    tracer = obs_trace.tracer
+    was_enabled = tracer.enabled
+    tracer.reset()
+    tracer.enable()
+    try:
+        eng, dt_cont = run_continuous()
+    finally:
+        if not was_enabled:
+            tracer.disable()
+    recompiles = sum(r.new_compiles for r in wd.poll())
+    trace_path = os.environ.get("BENCH_TRACE_PATH") or os.path.join(
+        tempfile.gettempdir(), "marlin_serving_trace.json")
+    n_trace_events = len(tracer.events())
+    tracer.export(trace_path)
 
     def run_static():
         t0 = time.perf_counter()
@@ -166,4 +201,6 @@ def config_serving():
         "mean_ttft_s": eng.stats.summary().get("mean_ttft_s", 0.0),
         "batch": batch, "n_requests": n_req, "round_steps": round_steps,
         "steps_short": short, "steps_long": long_, "d_model": d,
+        "recompiles_after_warmup": recompiles,
+        "trace_path": trace_path, "trace_events": n_trace_events,
     }

@@ -205,3 +205,19 @@ def _scan_timed(fn, x, *rest, loop=10, reps=4):
 def _sized(env, default):
     return int(os.environ.get(env, default))
 
+
+def attach_metrics(line: dict) -> dict:
+    """Attach the obs metric-registry snapshot to a bench artifact line.
+
+    Every config line — result or error — carries the counters, gauges,
+    and latency histograms accumulated in the process (obs/metrics.py),
+    so a perf number never travels without the instrumentation that
+    contextualizes it (e.g. the serving line's TTFT / per-token-latency
+    histograms, the watchdog's recompile counters). Idempotent: a line
+    that already carries a ``metrics`` block keeps it."""
+    from marlin_tpu.obs import metrics as obs_metrics
+
+    if isinstance(line, dict) and "metrics" not in line:
+        line = dict(line, metrics=obs_metrics.registry.snapshot())
+    return line
+

@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.trace import tracer as _tracer
+
 
 class TransformerConfig(NamedTuple):
     vocab: int = 256
@@ -811,6 +813,7 @@ _sample_jit = functools.partial(
                      "eos_id"),
     donate_argnums=(3,),
 )
+@jax.named_scope("marlin.decode_scan")
 def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
                  steps: int, temperature: float, top_k: int, top_p: float,
                  eos_id: Optional[int] = None, done0=None):
@@ -904,6 +907,7 @@ def _spec_emit(lp, drafts, key):
     jax.jit,
     static_argnames=("cfg", "steps", "draft_len", "ngram", "temperature"),
     donate_argnums=(1, 3))
+@jax.named_scope("marlin.speculative_loop")
 def _speculative_loop(params, buf, filled0, cache, key,
                       cfg: TransformerConfig,
                       steps: int, draft_len: int, ngram: int,
@@ -1079,21 +1083,24 @@ def generate_speculative(params, prompt, steps: int, cfg: TransformerConfig,
             f"prompt {s} + steps {steps} + draft_len {draft_len} exceeds "
             f"max_len {cfg.max_len} (the last chunk writes draft_len "
             "cache slots past the final emitted position)")
-    logits, cache = _prefill_jit(params, prompt, cfg=cfg)
-    key = jax.random.PRNGKey(seed)
-    key, k0 = jax.random.split(key)
-    # First token through the same sampler plain generate uses, so the
-    # whole output sequence shares one distributional contract.
-    first = _sample_jit(logits, float(temperature), k0, top_k=0, top_p=0.0)
-    buf = jnp.zeros((b, s + steps + draft_len), jnp.int32)
-    buf = buf.at[:, :s].set(prompt).at[:, s].set(first)
-    # buf and cache are donated into the loop (updated in place and
-    # returned aliased); neither is touched again here except through the
-    # returned arrays.
-    buf, vsteps, iters, _ = _speculative_loop(params, buf, s + 1, cache,
-                                              key, cfg, steps, draft_len,
-                                              ngram, float(temperature))
-    toks = buf[:, s:s + steps]
+    with _tracer.span("transformer.generate_speculative", batch=b,
+                      steps=int(steps), draft_len=int(draft_len)):
+        logits, cache = _prefill_jit(params, prompt, cfg=cfg)
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        # First token through the same sampler plain generate uses, so
+        # the whole output sequence shares one distributional contract.
+        first = _sample_jit(logits, float(temperature), k0, top_k=0,
+                            top_p=0.0)
+        buf = jnp.zeros((b, s + steps + draft_len), jnp.int32)
+        buf = buf.at[:, :s].set(prompt).at[:, s].set(first)
+        # buf and cache are donated into the loop (updated in place and
+        # returned aliased); neither is touched again here except
+        # through the returned arrays.
+        buf, vsteps, iters, _ = _speculative_loop(
+            params, buf, s + 1, cache, key, cfg, steps, draft_len,
+            ngram, float(temperature))
+        toks = buf[:, s:s + steps]
     if return_stats:
         return toks, {"verify_chunks": vsteps, "iterations": iters}
     return toks
@@ -1196,13 +1203,18 @@ def generate(params, prompt, steps: int, cfg: TransformerConfig,
     if s + steps > cfg.max_len:
         raise ValueError(
             f"prompt {s} + steps {steps} exceeds max_len {cfg.max_len}")
-    logits, cache = _prefill_jit(params, prompt, cfg=cfg)
-    key = jax.random.PRNGKey(seed)
-    key, k0 = jax.random.split(key)
-    first = _sample_jit(logits, float(temperature), k0, top_k=int(top_k),
-                        top_p=float(top_p))
-    toks, _ = _decode_scan(params, first, jnp.int32(s), cache, key, cfg,
-                           int(steps), float(temperature), int(top_k),
-                           float(top_p),
-                           None if eos_id is None else int(eos_id))
+    with _tracer.span("transformer.generate", batch=b, prompt_len=s,
+                      steps=int(steps)):
+        with _tracer.span("transformer.prefill"):
+            logits, cache = _prefill_jit(params, prompt, cfg=cfg)
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        first = _sample_jit(logits, float(temperature), k0,
+                            top_k=int(top_k), top_p=float(top_p))
+        with _tracer.span("transformer.decode_scan"):
+            toks, _ = _decode_scan(
+                params, first, jnp.int32(s), cache, key, cfg,
+                int(steps), float(temperature), int(top_k),
+                float(top_p),
+                None if eos_id is None else int(eos_id))
     return jnp.moveaxis(toks, 0, 1)  # (steps, B) -> (B, steps)

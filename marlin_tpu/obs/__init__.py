@@ -1,0 +1,40 @@
+"""End-to-end observability: tracing, metrics, compile watchdog, runlog.
+
+SURVEY.md §5: the reference has NO tracing/metrics subsystem (ad-hoc
+``currentTimeMillis`` prints); this package is the parity-plus answer,
+sized for the serving stack PR 2 started:
+
+* :mod:`.trace`   — nested host spans mirrored into
+  ``jax.profiler.TraceAnnotation``/``named_scope``; Chrome/Perfetto
+  ``trace_event`` JSON export.
+* :mod:`.metrics` — labeled counters/gauges/fixed-bucket histograms;
+  JSON snapshot + Prometheus text exposition. ``utils/timing.py`` is a
+  thin shim over the default registry here.
+* :mod:`.watch`   — compile/retrace watchdog (``_cache_size`` polling +
+  ``jax.monitoring`` listeners) and the scoped transfer guard.
+* :mod:`.runlog`  — bounded structured JSONL event log for the engine.
+
+See docs/observability.md.
+"""
+
+from . import metrics, runlog, trace, watch
+from .metrics import MetricsRegistry, registry
+from .runlog import RunLog
+from .trace import Tracer, tracer
+from .watch import CompileLedger, CompileWatchdog, RetraceError, no_transfers
+
+__all__ = [
+    "CompileLedger",
+    "CompileWatchdog",
+    "MetricsRegistry",
+    "RetraceError",
+    "RunLog",
+    "Tracer",
+    "metrics",
+    "no_transfers",
+    "registry",
+    "runlog",
+    "trace",
+    "tracer",
+    "watch",
+]

@@ -1,0 +1,306 @@
+"""Labeled metrics: counters, gauges, and fixed-bucket histograms.
+
+The reference has NO metrics subsystem — ad-hoc ``currentTimeMillis``
+deltas printed inside algorithms (SURVEY.md §5); ``utils/timing.py``
+replaced the prints with a flat registry, and this module is that
+registry grown into a real one: LABELED series (one logical metric,
+many ``{key="value"}`` children, the Prometheus data model), gauges for
+last-value surfaces (queue depth, occupancy), and fixed-bucket
+histograms for latency distributions (TTFT, per-token latency, op
+timings). Two exporters:
+
+* :meth:`MetricsRegistry.snapshot` — a JSON-able dict, attached to
+  every bench artifact line (``benchlib/harness.attach_metrics``) so a
+  perf number never travels without the counters that contextualize it;
+* :meth:`MetricsRegistry.prometheus` — the Prometheus text exposition
+  format (``# TYPE`` headers, cumulative ``_bucket{le=...}`` lines), so
+  a serving frontend can expose ``/metrics`` with zero extra deps.
+
+``utils/timing.py``'s ``Metrics``/``timed``/``timeit`` are thin shims
+over the default registry here, so every existing call site keeps
+working and ONE ``snapshot()`` covers engine gauges, request
+histograms, and op timings alike. Deliberately dependency-free (no jax
+import): importable from anywhere in the package without cycles.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import re
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+# Default histogram buckets, seconds-oriented (100 us .. 10 s): wide
+# enough for a decode round on the CPU mesh and a TTFT on chip alike.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _series(name: str, key: LabelKey) -> str:
+    if not key:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name charset (op-timing labels
+    like ``DenseVecMatrix.multiply`` carry dots)."""
+    return _NAME_RE.sub("_", name)
+
+
+class Counter:
+    """Monotonic counter (one labeled child of a counter family).
+
+    ``lock`` is the owning registry's lock, shared by every child and
+    both exporters: inc() is a read-modify-write, and a /metrics scrape
+    concurrent with unlocked mutation could lose increments or report
+    torn histogram state. One registry-wide lock keeps every export a
+    consistent point-in-time view (contention is trivial at metric
+    rates)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock=None):
+        self.value = 0.0
+        self._lock = lock or threading.RLock()
+
+    def inc(self, by: float = 1.0) -> None:
+        if by < 0:
+            raise ValueError(f"counters only go up; inc({by})")
+        with self._lock:
+            self.value += by
+
+
+class Gauge:
+    """Last-value gauge (occupancy, queue depth, utilization)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock=None):
+        self.value = 0.0
+        self._lock = lock or threading.RLock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value += by
+
+    def dec(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value -= by
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact count/sum/min/max.
+
+    ``buckets`` are ascending upper bounds; an implicit +Inf bucket
+    catches the overflow. Per-bucket counts are stored NON-cumulative
+    (the snapshot view); :meth:`MetricsRegistry.prometheus` accumulates
+    them into the exposition format's cumulative ``le`` convention.
+    """
+
+    __slots__ = ("buckets", "bucket_counts", "count", "sum", "min", "max",
+                 "_lock")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 lock=None):
+        bs = tuple(float(b) for b in buckets)
+        if not bs or list(bs) != sorted(set(bs)):
+            raise ValueError(
+                f"buckets must be non-empty, ascending, unique: {buckets}")
+        self.buckets = bs
+        self.bucket_counts = [0] * (len(bs) + 1)  # +1: the +Inf overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._lock = lock or threading.RLock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:  # five coupled writes: see Counter on the lock
+            self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            out: Dict[str, object] = {
+                "count": self.count,
+                "sum": self.sum,
+                "mean": self.sum / self.count if self.count else 0.0,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "buckets": {
+                    **{repr(b): c for b, c in zip(self.buckets,
+                                                  self.bucket_counts)},
+                    "+Inf": self.bucket_counts[-1],
+                },
+            }
+        return out
+
+
+class _Family:
+    """One metric name: kind + labeled children (sharing the registry
+    lock, see Counter)."""
+
+    __slots__ = ("kind", "name", "buckets", "children", "lock")
+
+    def __init__(self, kind: str, name: str,
+                 buckets: Optional[Tuple[float, ...]] = None, lock=None):
+        self.kind = kind
+        self.name = name
+        self.buckets = buckets
+        self.children: Dict[LabelKey, object] = {}
+        self.lock = lock
+
+    def child(self, key: LabelKey):
+        c = self.children.get(key)
+        if c is None:
+            if self.kind == "counter":
+                c = Counter(lock=self.lock)
+            elif self.kind == "gauge":
+                c = Gauge(lock=self.lock)
+            else:
+                c = Histogram(self.buckets, lock=self.lock)
+            self.children[key] = c
+        return c
+
+
+class MetricsRegistry:
+    """Process-wide named metric families; thread-safe.
+
+    Accessors create on first use: ``registry.counter("x", route="a")``
+    returns the ``route="a"`` child of counter family ``x``. Re-using a
+    name with a different kind raises (a counter silently shadowing a
+    histogram would corrupt both exporters); re-using a histogram name
+    with different buckets keeps the family's original buckets — bucket
+    layout is a property of the series, not of one call site.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, kind: str, name: str,
+                buckets: Optional[Sequence[float]] = None) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(kind, name,
+                              tuple(buckets) if buckets else None,
+                              lock=self._lock)
+                self._families[name] = fam
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} is a {fam.kind}, not a {kind}")
+            return fam
+
+    def counter(self, name: str, **labels) -> Counter:
+        fam = self._family("counter", name)
+        with self._lock:
+            return fam.child(_label_key(labels))
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        fam = self._family("gauge", name)
+        with self._lock:
+            return fam.child(_label_key(labels))
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        fam = self._family("histogram", name, buckets=buckets)
+        with self._lock:
+            return fam.child(_label_key(labels))
+
+    def remove(self, name: str) -> None:
+        """Drop a whole family (``utils.timing.Metrics.reset`` path)."""
+        with self._lock:
+            self._families.pop(name, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._families.clear()
+
+    # -- exporters ----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-able view: counters/gauges as {series: value}, histograms
+        as {series: {count, sum, mean, min, max, buckets}}."""
+        out: Dict[str, Dict[str, object]] = {
+            "counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            for fam in self._families.values():
+                dest = out[fam.kind + "s"]
+                for key, child in fam.children.items():
+                    s = _series(fam.name, key)
+                    if fam.kind == "histogram":
+                        dest[s] = child.summary()
+                    else:
+                        dest[s] = child.value
+        return out
+
+    def dump(self) -> str:
+        return json.dumps(self.snapshot(), indent=2, sort_keys=True)
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4): ``# TYPE`` per
+        family, cumulative ``_bucket{le=...}`` + ``_sum``/``_count`` for
+        histograms. Names are sanitized to the Prometheus charset."""
+        lines = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                pname = _prom_name(name)
+                lines.append(f"# TYPE {pname} {fam.kind}")
+                for key in sorted(fam.children):
+                    child = fam.children[key]
+                    if fam.kind != "histogram":
+                        lines.append(
+                            f"{_series(pname, key)} {child.value:g}")
+                        continue
+                    cum = 0
+                    for b, c in zip(child.buckets, child.bucket_counts):
+                        cum += c
+                        lk = key + (("le", f"{b:g}"),)
+                        lines.append(f"{_series(pname + '_bucket', lk)} "
+                                     f"{cum}")
+                    lk = key + (("le", "+Inf"),)
+                    lines.append(
+                        f"{_series(pname + '_bucket', lk)} {child.count}")
+                    lines.append(
+                        f"{_series(pname + '_sum', key)} {child.sum:g}")
+                    lines.append(
+                        f"{_series(pname + '_count', key)} {child.count}")
+        return "\n".join(lines) + "\n"
+
+
+# The process-default registry: engine gauges, request histograms, op
+# timings (via utils/timing.py), and the compile watchdog all land here
+# unless a caller wires their own.
+registry = MetricsRegistry()
+
+
+def snapshot() -> Dict[str, object]:
+    return registry.snapshot()
+
+
+def prometheus() -> str:
+    return registry.prometheus()

@@ -1,0 +1,79 @@
+"""Bounded structured event log (JSONL) for the serving engine.
+
+The serving engine's runtime narrative — rounds, admissions,
+retirements, compile deltas — as structured events instead of prints:
+each event is one flat dict with a ``kind``, a monotonic timestamp, and
+the caller's fields, held in a bounded deque (a long-running server
+holds O(maxlen) events, the EngineStats HISTORY discipline) and dumped
+as JSON Lines for offline analysis.
+
+Per round the engine emits occupancy, live rows, admitted/retired
+counts, queue depth, and deadline drops; per request it emits the
+submit → admit (first token) → completion span timestamps — the raw
+material the TTFT and per-token-latency histograms in
+``obs.metrics`` aggregate.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+class RunLog:
+    """Thread-safe bounded structured event log."""
+
+    def __init__(self, maxlen: int = 4096, clock=time.monotonic):
+        if maxlen < 1:
+            raise ValueError(f"maxlen must be >= 1, got {maxlen}")
+        self.maxlen = maxlen
+        self._clock = clock
+        self._events: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._n_emitted = 0  # exact, unlike len() past the cap
+
+    def emit(self, kind: str, **fields) -> dict:
+        ev = {"kind": kind, "t": self._clock(), **fields}
+        with self._lock:
+            self._events.append(ev)
+            self._n_emitted += 1
+        return ev
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def n_emitted(self) -> int:
+        """Events emitted over the log's lifetime (the deque only bounds
+        what is RETAINED)."""
+        with self._lock:
+            return self._n_emitted
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e["kind"] == kind]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # -- export -------------------------------------------------------
+
+    def dumps(self) -> str:
+        """JSON Lines: one event per line."""
+        return "\n".join(json.dumps(e, default=str) for e in self.events())
+
+    def dump(self, path) -> str:
+        path = str(path)
+        with open(path, "w") as f:
+            text = self.dumps()
+            if text:
+                f.write(text + "\n")
+        return path

@@ -1,0 +1,151 @@
+"""Nested-span request tracing, aligned with the XLA device timeline.
+
+Host-side spans (monotonic clocks, thread-safe, nestable) that mirror
+into ``jax.profiler.TraceAnnotation`` — so when a ``jax.profiler``
+device trace is active, every host span shows up on the SAME timeline
+as the XLA ops it dispatched — and into ``jax.named_scope``, so ops
+traced INSIDE a span carry its name in the compiled HLO. Export is
+Chrome/Perfetto ``trace_event`` JSON (``{"traceEvents": [...]}``, phase
+``X`` complete events, microsecond timestamps): load the file at
+``chrome://tracing`` or https://ui.perfetto.dev.
+
+The default :data:`tracer` starts DISABLED: a span on a disabled tracer
+is a bare generator yield (no clock reads, no profiler call, no event),
+so instrumented hot paths — the serving round, ``generate`` — cost
+nothing until someone turns tracing on. ``tests/test_obs.py`` pins the
+instrumented serving round within 5% of the disabled-tracer path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+class Tracer:
+    """Bounded in-memory span recorder with Chrome-trace export.
+
+    Spans nest lexically per thread (a thread-local stack records each
+    span's parent and depth); events live in a bounded deque so a
+    long-running server holds O(max_events) of trace state, never
+    O(requests served).
+    """
+
+    def __init__(self, enabled: bool = False, max_events: int = 100_000):
+        self._enabled = bool(enabled)
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._epoch_ns = time.perf_counter_ns()
+
+    # -- switches -----------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+        self._epoch_ns = time.perf_counter_ns()
+
+    # -- recording ----------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, scope: bool = True, **attrs):
+        """Record one nested span; mirrors into ``TraceAnnotation`` (host
+        timeline of a live ``jax.profiler`` trace) and — with
+        ``scope=True`` — ``jax.named_scope`` (HLO op names of anything
+        TRACED inside). Pass ``scope=False`` on hot spans whose jitted
+        callees are steady-state compiled (the serving round): the
+        name-stack push costs ~5 us/span and names nothing there — the
+        jitted entry points carry their own module-level named scopes.
+        No-op when disabled."""
+        if not self._enabled:
+            yield
+            return
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        ns = jax.named_scope(name) if scope else contextlib.nullcontext()
+        t0 = time.perf_counter_ns()
+        try:
+            with jax.profiler.TraceAnnotation(name), ns:
+                yield
+        finally:
+            dur = time.perf_counter_ns() - t0
+            stack.pop()
+            args: Dict[str, Any] = dict(attrs)
+            args["depth"] = len(stack)
+            if parent is not None:
+                args["parent"] = parent
+            ev = {
+                "name": name,
+                "ph": "X",  # complete event: ts + dur in microseconds
+                "ts": (t0 - self._epoch_ns) / 1e3,
+                "dur": dur / 1e3,
+                "pid": 0,
+                "tid": threading.get_ident() % (1 << 31),
+                "args": args,
+            }
+            with self._lock:
+                self._events.append(ev)
+
+    def trace(self, fn=None, *, name: Optional[str] = None):
+        """Decorator form of :meth:`span`."""
+
+        def wrap(f):
+            label = name or f.__qualname__
+
+            @functools.wraps(f)
+            def inner(*args, **kwargs):
+                with self.span(label):
+                    return f(*args, **kwargs)
+
+            return inner
+
+        return wrap(fn) if fn is not None else wrap
+
+    # -- export -------------------------------------------------------
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def export(self, path) -> str:
+        """Write Chrome/Perfetto trace-event JSON; returns the path."""
+        path = str(path)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f, default=str)
+        return path
+
+
+# Process-default tracer: the serving engine, generate(), and the bench
+# harness all record here unless handed their own. Disabled (free)
+# until someone calls tracer.enable().
+tracer = Tracer()
+
+span = tracer.span
+trace = tracer.trace

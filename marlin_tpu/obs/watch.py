@@ -1,0 +1,242 @@
+"""Compile/retrace watchdog and scoped transfer guard.
+
+Two serving-killers are silent by default in JAX:
+
+* **Silent recompiles** — a traced-vs-static mistake (a Python float
+  that should be traced, a shape that drifted) turns a steady-state
+  serving loop into one XLA compile per request. PR 2's tests pin
+  "exactly one compile across swaps" by hand-polling
+  ``fn._cache_size()`` (tests/test_serving.py); this module makes that
+  idiom a runtime subsystem: register jitted entry points, poll deltas
+  per round, and read a :class:`CompileLedger` report. Where this jax
+  exposes ``jax.monitoring``, a duration listener additionally records
+  every backend compile in the process — entry points you forgot to
+  register included.
+* **Accidental host transfers** — the ``device_get``-in-a-hot-loop
+  hazard (and its sharper cousin: a CPU ``device_get`` that silently
+  disables donation aliasing, see ``serving/engine._retire``).
+  :func:`no_transfers` scopes ``utils.doctor.transfer_guard`` around a
+  block so implicit transfers error at their call site.
+
+Recompile deltas also feed the metrics registry
+(``obs_recompiles_total{entry=...}``, ``obs_backend_compiles_total``)
+so a scrape shows compile churn next to the latency it explains.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax
+
+from ..utils import doctor
+from . import metrics as _metrics
+
+
+class RetraceError(RuntimeError):
+    """Raised by :meth:`CompileWatchdog.no_recompiles` when a registered
+    entry point compiled inside the scope; carries the records."""
+
+    def __init__(self, records: List["CompileRecord"]):
+        self.records = records
+        super().__init__(
+            "unexpected recompiles: " + ", ".join(
+                f"{r.name} (+{r.new_compiles})" for r in records))
+
+
+@dataclass
+class CompileRecord:
+    """One registered entry point's compile-cache delta."""
+
+    name: str
+    baseline: int
+    current: int
+
+    @property
+    def new_compiles(self) -> int:
+        return self.current - self.baseline
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "baseline": self.baseline,
+                "current": self.current,
+                "new_compiles": self.new_compiles}
+
+
+@dataclass
+class CompileLedger:
+    """Point-in-time watchdog report: per-entry cache deltas plus every
+    backend compile the ``jax.monitoring`` listener saw (with
+    durations), if installed."""
+
+    entries: List[CompileRecord] = field(default_factory=list)
+    backend_compile_events: List[dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.new_compiles == 0 for r in self.entries)
+
+    @property
+    def backend_compile_seconds(self) -> float:
+        return sum(e["seconds"] for e in self.backend_compile_events)
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "entries": [r.to_dict() for r in self.entries],
+            "backend_compiles": len(self.backend_compile_events),
+            "backend_compile_seconds": self.backend_compile_seconds,
+        }
+
+    def report(self) -> str:
+        lines = [f"CompileLedger: {'OK' if self.ok else 'RETRACED'}"]
+        for r in self.entries:
+            lines.append(f"  {r.name}: {r.current} compiled "
+                         f"(+{r.new_compiles} since baseline)")
+        if self.backend_compile_events:
+            lines.append(
+                f"  backend compiles observed: "
+                f"{len(self.backend_compile_events)} "
+                f"({self.backend_compile_seconds:.3f}s)")
+        return "\n".join(lines)
+
+
+def cache_size(fn) -> int:
+    """Compile-cache entry count of a jitted function (the
+    tests/test_serving.py idiom, wrapped so a jax without the private
+    accessor degrades to an explanatory error at registration)."""
+    size = getattr(fn, "_cache_size", None)
+    if size is None:
+        raise ValueError(
+            f"{fn!r} has no _cache_size(); register jax.jit-wrapped "
+            "callables (this jax exposes the cache on PjitFunction)")
+    return int(size())
+
+
+class CompileWatchdog:
+    """Registry of jitted entry points polled for retraces.
+
+    ``register`` snapshots the entry's current cache size as its
+    baseline; :meth:`poll` reports entries that compiled since, and
+    (optionally) rebaselines so a serving loop can poll every round and
+    see PER-ROUND deltas — warmup rounds report their expected compiles,
+    steady-state rounds report zero, and the zero is the invariant.
+    """
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        self._fns: Dict[str, Callable] = {}
+        self._baseline: Dict[str, int] = {}
+        self._registry = registry if registry is not None \
+            else _metrics.registry
+        self._mon_events: List[dict] = []
+        self._mon_cb = None
+
+    def register(self, name: str, fn) -> None:
+        cache_size(fn)  # validate up front
+        self._fns[name] = fn
+        self._baseline[name] = cache_size(fn)
+
+    @property
+    def entries(self) -> List[str]:
+        return list(self._fns)
+
+    def rebaseline(self, name: Optional[str] = None) -> None:
+        for n in ([name] if name else self._fns):
+            self._baseline[n] = cache_size(self._fns[n])
+
+    def poll(self, rebaseline: bool = False) -> List[CompileRecord]:
+        """Entries that compiled since their baseline. With
+        ``rebaseline=True`` the reported deltas are consumed (the
+        per-round polling mode)."""
+        out = []
+        for n, fn in self._fns.items():
+            cur = cache_size(fn)
+            if cur != self._baseline[n]:
+                rec = CompileRecord(n, self._baseline[n], cur)
+                out.append(rec)
+                if rec.new_compiles > 0:
+                    self._registry.counter(
+                        "obs_recompiles_total", entry=n).inc(
+                            rec.new_compiles)
+                if rebaseline:
+                    self._baseline[n] = cur
+        return out
+
+    @contextlib.contextmanager
+    def no_recompiles(self, rebaseline: bool = True):
+        """Assert no registered entry point compiles inside the block;
+        raises :class:`RetraceError` naming the offenders. This is the
+        PR-2 "zero recompiles across swaps" guarantee as a scoped
+        runtime check instead of a test-only hand count."""
+        before = {n: cache_size(fn) for n, fn in self._fns.items()}
+        try:
+            yield self
+        finally:
+            bad = [CompileRecord(n, before[n], cache_size(fn))
+                   for n, fn in self._fns.items()
+                   if cache_size(fn) != before[n]]
+            if rebaseline:
+                self.rebaseline()
+            if bad:
+                for rec in bad:
+                    self._registry.counter(
+                        "obs_recompiles_total", entry=rec.name).inc(
+                            rec.new_compiles)
+                raise RetraceError(bad)
+
+    # -- jax.monitoring listener (where available) --------------------
+
+    def install_monitoring(self) -> bool:
+        """Record EVERY backend compile in the process via the
+        ``jax.monitoring`` duration events (jax >= 0.4.x exposes
+        ``/jax/core/compile/backend_compile_duration``); returns False
+        (and stays inert) on a jax without the hook."""
+        if self._mon_cb is not None:
+            return True
+        mon = getattr(jax, "monitoring", None)
+        reg = getattr(mon, "register_event_duration_secs_listener", None)
+        if reg is None:
+            return False
+
+        def _cb(event, duration, **kwargs):
+            if "backend_compile" not in event:
+                return
+            self._mon_events.append(
+                {"event": event, "seconds": float(duration)})
+            self._registry.counter("obs_backend_compiles_total").inc()
+
+        reg(_cb)
+        self._mon_cb = _cb
+        return True
+
+    def uninstall_monitoring(self) -> None:
+        if self._mon_cb is None:
+            return
+        try:  # public jax.monitoring only exposes clear-ALL; use the
+            # targeted private unregister and leave other listeners alone
+            from jax._src import monitoring as _m
+
+            _m._unregister_event_duration_listener_by_callback(self._mon_cb)
+        except Exception:  # noqa: BLE001 - listener stays; it is inert
+            pass
+        self._mon_cb = None
+
+    def ledger(self) -> CompileLedger:
+        return CompileLedger(
+            entries=[CompileRecord(n, self._baseline[n],
+                                   cache_size(fn))
+                     for n, fn in self._fns.items()],
+            backend_compile_events=list(self._mon_events),
+        )
+
+
+@contextlib.contextmanager
+def no_transfers(level: str = "disallow"):
+    """Scope ``utils.doctor.transfer_guard`` around a block: implicit
+    host<->device transfers error at their call site (note: CPU-backend
+    copies are zero-copy exempt in jax — the guard has real teeth on
+    accelerators; the scope is still the documented place to hang the
+    invariant)."""
+    with doctor.transfer_guard(level):
+        yield

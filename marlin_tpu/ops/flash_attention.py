@@ -687,9 +687,13 @@ def flash_attention(
         raise ValueError(f"window must be >= 0, got {window}")
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
-    out = _flash_hsd(
-        qt, kt, vt, bool(causal), float(scale), int(block_q), int(block_k),
-        bool(interpret), int(window),
-    )
+    # Named scope: the kernel's ops carry this label in the HLO, so a
+    # device trace shows "marlin.flash_attention" where the host spans of
+    # obs/trace.py show the dispatch (docs/observability.md).
+    with jax.named_scope("marlin.flash_attention"):
+        out = _flash_hsd(
+            qt, kt, vt, bool(causal), float(scale), int(block_q),
+            int(block_k), bool(interpret), int(window),
+        )
     out = jnp.swapaxes(out[..., :d0], 0, 1)
     return out[:, 0] if single else out

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import get_config
 from ..mesh import axis_sizes, block_sharding, default_mesh
+from ..obs.trace import tracer as _tracer
 
 from ..utils.jax_compat import shard_map_compat
 
@@ -86,6 +87,7 @@ def _gspmd_fn(mesh: Mesh, precision: str, ar: str, ac: str):
 
 @functools.cache
 def _summa_fn(mesh: Mesh, precision: str, ar: str, ac: str):
+    @jax.named_scope("marlin.summa.kernel")
     def kernel(a_blk, b_blk):
         # a_blk: (m/P, k/Q); gather the full row panel of A along the col axis.
         a_panel = jax.lax.all_gather(a_blk, ac, axis=1, tiled=True)  # (m/P, k)
@@ -263,7 +265,8 @@ def matmul(
         fn = _cannon_fn(mesh, precision, ar, ac)
     else:
         raise ValueError(f"unknown gemm engine: {engine!r}")
-    cp = fn(ap, bp)
+    with _tracer.span("summa.matmul", engine=engine, m=m, k=k, n=n):
+        cp = fn(ap, bp)
     if cp.shape != (m, n):
         cp = cp[:m, :n]
     return cp

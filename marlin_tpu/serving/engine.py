@@ -44,6 +44,10 @@ import numpy as np
 
 from ..models import init_kv_cache
 from ..models import transformer as tr
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..obs.runlog import RunLog
+from ..obs.watch import CompileWatchdog
 from .queue import AdmissionQueue, Request
 from .slots import SlotManager, pad_prompt_len, prefill_into_row
 from .stats import EngineStats
@@ -54,6 +58,7 @@ from .stats import EngineStats
     static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
     donate_argnums=(1, 2),
 )
+@jax.named_scope("marlin.serving.decode_round")
 def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
                   round_steps: int, temperature: float,
                   eos_id: Optional[int] = None):
@@ -142,7 +147,9 @@ class ServingEngine:
 
     def __init__(self, params, cfg, batch: int = 8, round_steps: int = 8,
                  max_pending: int = 64, temperature: float = 0.0,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 tracer=None, runlog: Optional[RunLog] = None,
+                 metrics_registry=None):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -166,7 +173,24 @@ class ServingEngine:
         self.eos_id = eos_id
         self.queue = AdmissionQueue(max_pending=max_pending)
         self.slots = SlotManager(batch)
-        self.stats = EngineStats(batch=batch, cfg=cfg)
+        # Observability (docs/observability.md): host spans via the
+        # process tracer (a DISABLED tracer's span is a no-op — the <5%
+        # round-overhead pin in tests/test_obs.py holds the enabled path
+        # to that), a bounded structured runlog, the shared metric
+        # registry (EngineStats mirrors its ledger into it), and the
+        # compile watchdog polled at every round boundary so the PR-2
+        # "zero recompiles across swaps" guarantee is a continuously
+        # checked runtime invariant, not just a test assertion.
+        self.tracer = tracer if tracer is not None else obs_trace.tracer
+        self.runlog = runlog if runlog is not None else RunLog()
+        self.metrics = metrics_registry if metrics_registry is not None \
+            else obs_metrics.registry
+        self.stats = EngineStats(batch=batch, cfg=cfg,
+                                 registry=self.metrics)
+        self.watchdog = CompileWatchdog(registry=self.metrics)
+        self.watchdog.register("serving.decode_round", _decode_round)
+        self.watchdog.register("serving.prefill_into_row",
+                               prefill_into_row)
         self._key = jax.random.PRNGKey(seed)
         self._next_id = 0
         self.round_idx = 0
@@ -211,8 +235,16 @@ class ServingEngine:
                       submit_round=self.round_idx,
                       submit_time=time.perf_counter())
         self._next_id += 1
-        self.queue.submit(req)
+        with self.tracer.span("serving.submit", scope=False,
+                              request_id=req.request_id):
+            self.queue.submit(req)
         self.requests[req.request_id] = req
+        self.metrics.counter("serving_submitted_total").inc()
+        self.metrics.gauge("serving_queue_depth").set(len(self.queue))
+        self.runlog.emit("submit", request_id=req.request_id,
+                         prompt_len=s, steps=int(steps),
+                         round=self.round_idx,
+                         queue_depth=len(self.queue))
         return req.request_id
 
     def close(self) -> None:
@@ -235,10 +267,13 @@ class ServingEngine:
             padded = np.zeros((pad_prompt_len(s),), np.int32)
             padded[:s] = req.prompt
             self._key, k_admit = jax.random.split(self._key)
-            self._cache, self._buf, _, _ = prefill_into_row(
-                self.params, self._cache, self._buf, jnp.int32(row),
-                jnp.asarray(padded), jnp.int32(s), k_admit,
-                cfg=self.cfg, temperature=self.temperature)
+            with self.tracer.span("serving.admit", scope=False,
+                                  request_id=req.request_id, row=row,
+                                  prompt_len=s):
+                self._cache, self._buf, _, _ = prefill_into_row(
+                    self.params, self._cache, self._buf, jnp.int32(row),
+                    jnp.asarray(padded), jnp.int32(s), k_admit,
+                    cfg=self.cfg, temperature=self.temperature)
             self._filled[row] = s + 1
             self._target[row] = s + req.steps
             self._active[row] = True
@@ -247,8 +282,16 @@ class ServingEngine:
             req.admit_time = time.perf_counter()
             req.status = "active"
             self.stats.record_admission(req)
+            self.runlog.emit(
+                "admit", request_id=req.request_id, row=row,
+                round=self.round_idx,
+                wait_rounds=self.round_idx - req.submit_round,
+                queue_depth=len(self.queue))
         for req in expired:
             self.stats.record_timeout(req)
+            self.runlog.emit("timeout", request_id=req.request_id,
+                             round=self.round_idx,
+                             deadline_rounds=req.deadline_rounds)
             # Same ownership transfer as retirement: timed-out requests
             # go back to the caller, not into an ever-growing dict.
             self.requests.pop(req.request_id, None)
@@ -267,7 +310,8 @@ class ServingEngine:
         # buffer externally referenced, which silently disables the
         # donation aliasing every later round/admission relies on (the
         # pointer-pin test catches this).
-        buf_host = np.array(self._buf)
+        with self.tracer.span("serving.retire", scope=False, rows=len(rows)):
+            buf_host = np.array(self._buf)
         for row in rows:
             req = self.requests[self.slots.owner_of(row)]
             s = req.prompt_len
@@ -284,6 +328,12 @@ class ServingEngine:
             self._target[row] = 0
             self.slots.release(row)
             self.stats.record_completion(req)
+            self.runlog.emit(
+                "complete", request_id=req.request_id, row=row,
+                emitted=req.emitted, live_iters=req.live_iters,
+                submit_t=req.submit_time, admit_t=req.admit_time,
+                finish_t=req.finish_time,
+                rounds=req.finish_round - req.admit_round + 1)
             # Ownership of a finished request transfers to the caller
             # (step()/run() return it); holding it here would grow host
             # memory without bound on a long-running server — the queue
@@ -296,30 +346,54 @@ class ServingEngine:
         """One scheduling round: admit into free rows, decode one
         bounded round, retire finished rows. Returns the requests that
         finished (or timed out) this round."""
-        expired = self._admit()
-        self._key, k_round = jax.random.split(self._key)
-        # done0: free rows, plus any row already at target (a steps=1
-        # admission emits its whole request inside the prefill) — the
-        # round also freezes such rows at body entry; marking them here
-        # saves the all-done round a no-op loop trip.
-        done0 = ~self._active | (self._filled >= self._target)
-        self._buf, filled_d, done_d, self._cache, iters_d, live_d = \
-            _decode_round(
-                self.params, self._cache, self._buf,
-                jnp.asarray(self._filled), jnp.asarray(self._target),
-                jnp.asarray(done0), k_round, cfg=self.cfg,
-                round_steps=self.round_steps,
-                temperature=self.temperature, eos_id=self.eos_id)
-        filled, done, iters, live = jax.device_get(
-            (filled_d, done_d, iters_d, live_d))
-        self._filled = np.array(filled, np.int32)  # writable host copy
-        for row in self.slots.occupied_rows():
-            self.requests[self.slots.owner_of(row)].live_iters += int(
-                live[row])
-        self.stats.record_round(
-            self.round_idx, int(iters),
-            occupied=self.slots.n_occupied, live_iters=int(live.sum()))
-        finished = self._retire(self._filled, np.asarray(done))
+        admitted0 = self.stats.n_admitted
+        with self.tracer.span("serving.round", scope=False,
+                              round=self.round_idx):
+            expired = self._admit()
+            self._key, k_round = jax.random.split(self._key)
+            # done0: free rows, plus any row already at target (a
+            # steps=1 admission emits its whole request inside the
+            # prefill) — the round also freezes such rows at body entry;
+            # marking them here saves the all-done round a no-op trip.
+            done0 = ~self._active | (self._filled >= self._target)
+            with self.tracer.span("serving.decode_round", scope=False,
+                                  occupied=self.slots.n_occupied):
+                self._buf, filled_d, done_d, self._cache, iters_d, \
+                    live_d = _decode_round(
+                        self.params, self._cache, self._buf,
+                        jnp.asarray(self._filled),
+                        jnp.asarray(self._target),
+                        jnp.asarray(done0), k_round, cfg=self.cfg,
+                        round_steps=self.round_steps,
+                        temperature=self.temperature, eos_id=self.eos_id)
+                filled, done, iters, live = jax.device_get(
+                    (filled_d, done_d, iters_d, live_d))
+            self._filled = np.array(filled, np.int32)  # writable copy
+            for row in self.slots.occupied_rows():
+                self.requests[self.slots.owner_of(row)].live_iters += \
+                    int(live[row])
+            occupied = self.slots.n_occupied  # pre-retire, as decoded
+            self.stats.record_round(
+                self.round_idx, int(iters), occupied=occupied,
+                live_iters=int(live.sum()))
+            finished = self._retire(self._filled, np.asarray(done))
+        # Per-round compile ledger: warmup rounds log their expected
+        # compiles; a steady-state round logging ANY compile is the
+        # silent-retrace signal the watchdog exists for (the poll also
+        # bumps obs_recompiles_total{entry=...}).
+        for rec in self.watchdog.poll(rebaseline=True):
+            self.runlog.emit("compile", round=self.round_idx,
+                             entry=rec.name,
+                             new_compiles=rec.new_compiles)
+        self.metrics.gauge("serving_queue_depth").set(len(self.queue))
+        live_sum = int(live.sum())
+        self.runlog.emit(
+            "round", round=self.round_idx, iters=int(iters),
+            occupied=occupied, live_iters=live_sum,
+            admitted=self.stats.n_admitted - admitted0,
+            retired=len(finished), expired=len(expired),
+            queue_depth=len(self.queue),
+            wasted_row_iters=int(iters) * self.batch - live_sum)
         self.round_idx += 1
         return expired + finished
 

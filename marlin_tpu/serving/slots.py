@@ -67,6 +67,7 @@ def pad_prompt_len(prompt_len: int) -> int:
     static_argnames=("cfg", "temperature"),
     donate_argnums=(1, 2),
 )
+@jax.named_scope("marlin.serving.prefill_into_row")
 def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
                      cfg, temperature: float = 0.0):
     """Prefill one request and swap it into batch row ``row``, in place.

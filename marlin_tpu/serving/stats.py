@@ -29,6 +29,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..obs import metrics as obs_metrics
 from ..utils import cost_model as cm
 
 # Per-entry history kept for inspection (rounds, completed requests).
@@ -100,10 +101,22 @@ def static_completed_at_budget(steps_list: List[int], batch: int,
 
 @dataclass
 class EngineStats:
-    """Engine-level ledger, fed by ``ServingEngine`` callbacks."""
+    """Engine-level ledger, fed by ``ServingEngine`` callbacks.
+
+    The ledger scalars stay the source of truth; when ``registry`` is
+    set (the engine passes ``obs.metrics.registry`` by default) every
+    callback also MIRRORS its figure into the shared metric registry —
+    counters (``serving_admitted_total``...), gauges
+    (``serving_occupancy``/``serving_utilization``), and the request
+    latency histograms (``serving_ttft_seconds``,
+    ``serving_token_latency_seconds``) — so one ``metrics.snapshot()``
+    covers the engine next to the op timings, instead of the two
+    parallel accounting surfaces PR 2 left behind.
+    """
 
     batch: int
     cfg: object = None
+    registry: Optional[obs_metrics.MetricsRegistry] = None
     n_admitted: int = 0
     n_completed: int = 0
     n_timeout: int = 0
@@ -118,9 +131,18 @@ class EngineStats:
 
     def record_admission(self, req) -> None:
         self.n_admitted += 1
+        if self.registry is not None:
+            self.registry.counter("serving_admitted_total").inc()
+            if req.submit_time:
+                # First token lands with the admission prefill: TTFT is
+                # the submit -> admission-dispatch wall-clock.
+                self.registry.histogram("serving_ttft_seconds").observe(
+                    max(0.0, req.admit_time - req.submit_time))
 
     def record_timeout(self, req) -> None:
         self.n_timeout += 1
+        if self.registry is not None:
+            self.registry.counter("serving_timeout_total").inc()
 
     def record_round(self, round_idx: int, iters: int, occupied: int,
                      live_iters: int) -> None:
@@ -130,11 +152,24 @@ class EngineStats:
         self.rounds.append({"round": round_idx, "iters": iters,
                             "occupied": occupied,
                             "live_iters": live_iters})
+        if self.registry is not None:
+            self.registry.counter("serving_decode_iters_total").inc(iters)
+            self.registry.gauge("serving_occupancy").set(occupied)
+            self.registry.gauge("serving_utilization").set(
+                self.utilization())
 
     def record_completion(self, req) -> None:
         self.n_completed += 1
         self.tokens_out += req.emitted  # eos-padded tail is not output
         self.completed.append(request_stats(req))
+        if self.registry is not None:
+            self.registry.counter("serving_completed_total").inc()
+            self.registry.counter("serving_tokens_out_total").inc(
+                req.emitted)
+            dt = max(req.finish_time - req.admit_time, 0.0)
+            self.registry.histogram(
+                "serving_token_latency_seconds").observe(
+                    dt / max(req.emitted, 1))
 
     # -- the ledger ---------------------------------------------------
 

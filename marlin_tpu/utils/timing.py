@@ -4,27 +4,38 @@ The reference has NO tracing/metrics subsystem — ad-hoc
 ``System.currentTimeMillis`` deltas printed inside algorithms
 (DenseVecMatrix.scala:348-350, NeuralNetwork.scala:257) and
 ``MTUtils.evaluate`` to force lazy materialization for timing
-(MTUtils.scala:218-220). SURVEY.md §5 calls for a real subsystem in the new
-framework: this module provides a metrics registry (named counters + timing
-histories), a ``timed`` context/decorator that fences device work correctly,
-and ``jax.profiler`` trace hooks.
+(MTUtils.scala:218-220). SURVEY.md §5 calls for a real subsystem in the
+new framework; since PR 3 that subsystem is ``marlin_tpu/obs``
+(labeled metrics + exporters, tracing, watchdog — docs/observability.md)
+and THIS module is the thin compatibility shim over it: ``Metrics``,
+``timed``, and ``timeit`` keep their historical API but every sample
+lands in ``obs.metrics.registry``, so one ``snapshot()`` covers op
+timings next to the serving gauges and request histograms.
 
-Fencing: on the remote-tunnel TPU platform ``block_until_ready`` can return
-before execution completes, so ``fence(x)`` synchronizes via a scalar-sum
-device_get — the reliable analogue of the reference's forcing action.
+``timed`` and ``timeit`` share one recording path: both record a
+timing histogram sample AND increment the ``{label}.calls`` counter
+(pre-PR-3 ``timeit`` skipped the counter — tests/test_timing.py pins
+the unification).
+
+Fencing: on the remote-tunnel TPU platform ``block_until_ready`` can
+return before execution completes, so ``fence(x)`` synchronizes via a
+scalar-sum device_get — the reliable analogue of the reference's
+forcing action.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
-import json
 import time
-from collections import defaultdict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+
+from typing import Mapping
+
+from ..obs import metrics as _obs_metrics
 
 
 @functools.cache
@@ -43,36 +54,80 @@ def fence(*arrays) -> None:
 
 
 class Metrics:
-    """Process-wide registry of counters and op timings."""
+    """Historical registry API, shimmed over ``obs.metrics``.
 
-    def __init__(self):
-        self.counters: Dict[str, float] = defaultdict(float)
-        self.timings: Dict[str, List[float]] = defaultdict(list)
+    ``incr``/``record`` write straight into the shared labeled registry
+    (counters / timing histograms); ``summary()`` keeps its original
+    shape — ``{"counters": ..., "timings": {name: {count, total_s,
+    mean_s, min_s, max_s}}}`` — reconstructed exactly from the
+    histogram's tracked count/sum/min/max. ``reset()`` removes only the
+    series THIS instance created, so the module-level ``metrics``
+    behaves as before without wiping engine gauges that happen to share
+    the registry.
+    """
+
+    def __init__(self, registry: Optional[_obs_metrics.MetricsRegistry]
+                 = None):
+        self._registry = registry if registry is not None \
+            else _obs_metrics.registry
+        self._counter_names: set = set()
+        self._timing_names: set = set()
+
+    @property
+    def registry(self) -> _obs_metrics.MetricsRegistry:
+        return self._registry
+
+    @property
+    def counters(self) -> Mapping[str, float]:
+        # Read view with defaultdict semantics, like the pre-shim
+        # registry: a counter that never fired reads 0.0 (call sites
+        # probe before the first incr). READ-ONLY by proxy: the pre-shim
+        # dict accepted direct writes, but a write to this snapshot
+        # would silently vanish — raising beats losing data; write
+        # through incr().
+        from collections import defaultdict
+        from types import MappingProxyType
+
+        return MappingProxyType(
+            defaultdict(float,
+                        {n: self._registry.counter(n).value
+                         for n in sorted(self._counter_names)}))
 
     def incr(self, name: str, by: float = 1.0) -> None:
-        self.counters[name] += by
+        self._counter_names.add(name)
+        self._registry.counter(name).inc(by)
 
     def record(self, name: str, seconds: float) -> None:
-        self.timings[name].append(seconds)
+        self._timing_names.add(name)
+        self._registry.histogram(name).observe(seconds)
 
     def summary(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {"counters": dict(self.counters), "timings": {}}
-        for name, vals in self.timings.items():
+        # dict(): summary is a plain JSON-able dict, not the read proxy.
+        out: Dict[str, Any] = {"counters": dict(self.counters),
+                               "timings": {}}
+        for name in sorted(self._timing_names):
+            h = self._registry.histogram(name)
+            if not h.count:
+                continue
             out["timings"][name] = {
-                "count": len(vals),
-                "total_s": sum(vals),
-                "mean_s": sum(vals) / len(vals),
-                "min_s": min(vals),
-                "max_s": max(vals),
+                "count": h.count,
+                "total_s": h.sum,
+                "mean_s": h.sum / h.count,
+                "min_s": h.min,
+                "max_s": h.max,
             }
         return out
 
     def dump(self) -> str:
+        import json
+
         return json.dumps(self.summary(), indent=2, sort_keys=True)
 
     def reset(self) -> None:
-        self.counters.clear()
-        self.timings.clear()
+        for name in self._counter_names | self._timing_names:
+            self._registry.remove(name)
+        self._counter_names.clear()
+        self._timing_names.clear()
 
 
 metrics = Metrics()
@@ -94,18 +149,18 @@ def timed(name: str, *fence_arrays, verbose: bool = False):
 
 
 def timeit(fn=None, *, name: Optional[str] = None):
-    """Decorator form of :func:`timed` (fences a returned distributed type or
-    jax.Array automatically)."""
+    """Decorator form of :func:`timed` (fences a returned distributed type
+    or jax.Array automatically). Shares ``timed``'s recording path, so —
+    unlike the pre-PR-3 version — it increments ``{label}.calls`` too."""
 
     def wrap(f):
         label = name or f.__qualname__
 
         @functools.wraps(f)
         def inner(*args, **kwargs):
-            t0 = time.perf_counter()
-            out = f(*args, **kwargs)
-            fence(out)
-            metrics.record(label, time.perf_counter() - t0)
+            with timed(label):
+                out = f(*args, **kwargs)
+                fence(out)  # inside the block: the fence is part of the op
             return out
 
         return inner

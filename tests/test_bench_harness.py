@@ -227,6 +227,13 @@ class TestCachedFallback:
         assert len(lines) == 3
         assert lines[1]["metric"] == "fake_metric_seconds"
         assert lines[-1]["unit"] == "error"  # boom's parsable error line
+        # Every artifact line — result AND error — carries the obs
+        # metrics snapshot block (the status line does not; it is run
+        # bookkeeping, not an artifact).
+        assert "metrics" not in lines[0]
+        for d in lines[1:]:
+            assert set(d["metrics"]) == {"counters", "gauges",
+                                         "histograms"}
 
     def test_all_error_live_run_has_no_status_line(self, capsys,
                                                    monkeypatch):
@@ -250,6 +257,68 @@ class TestCachedFallback:
                  for l in capsys.readouterr().out.strip().splitlines()]
         assert len(lines) == 1 and lines[0]["unit"] == "error"
         assert all(d["metric"] != "bench_run_status" for d in lines)
+
+
+class TestMetricsAttachment:
+    def test_attach_metrics_adds_snapshot_block(self):
+        from marlin_tpu.obs import metrics as om
+
+        om.registry.counter("bench_test_counter").inc(2)
+        try:
+            line = bench.attach_metrics({"metric": "m", "value": 1.0})
+            assert line["metrics"]["counters"]["bench_test_counter"] == 2
+            json.dumps(line)  # the artifact line must stay one JSON line
+        finally:
+            om.registry.remove("bench_test_counter")
+
+    def test_attach_metrics_is_idempotent(self):
+        # A config that attached its own block keeps it.
+        line = bench.attach_metrics({"metric": "m", "metrics": {"x": 1}})
+        assert line["metrics"] == {"x": 1}
+
+
+class TestServingTraceSmoke:
+    def test_bench_serving_writes_loadable_trace(self, tmp_path):
+        # Tier-1-safe smoke (CPU mesh, tiny knobs): `bench.py --config
+        # serving` must produce an artifact line carrying the metrics
+        # block (counters + TTFT/per-token histograms) and export a
+        # Chrome/Perfetto trace JSON that json.load()s — the PR-3
+        # acceptance bar, end to end through the real entry point.
+        import os
+        import subprocess
+        import sys
+
+        trace_path = tmp_path / "serving_trace.json"
+        env = dict(
+            os.environ, BENCH_FORCE_CPU="1", BENCH_RETRIES="1",
+            BENCH_TRACE_PATH=str(trace_path), BENCH_SRV_D="32",
+            BENCH_SRV_L="2", BENCH_SRV_REQS="6", BENCH_SRV_SHORT="3",
+            BENCH_SRV_LONG="10", BENCH_SRV_ROUND="4",
+            BENCH_SRV_VOCAB="64")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--config", "serving"],
+            capture_output=True, text=True, timeout=240, env=env)
+        assert r.returncode == 0, r.stderr[-800:]
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        (line,) = [d for d in lines
+                   if d["metric"].startswith("serving_continuous")]
+        m = line["metrics"]
+        assert m["histograms"]["serving_ttft_seconds"]["count"] > 0
+        assert m["histograms"]["serving_token_latency_seconds"][
+            "count"] > 0
+        assert m["counters"]["serving_completed_total"] > 0
+        # The measured run compiled nothing after warmup — the artifact
+        # field form of the zero-recompile guarantee.
+        assert line["recompiles_after_warmup"] == 0
+        assert line["trace_path"] == str(trace_path)
+        with open(trace_path) as f:
+            doc = json.load(f)
+        evs = doc["traceEvents"]
+        assert line["trace_events"] == len(evs) > 0
+        names = {e["name"] for e in evs}
+        assert {"serving.round", "serving.decode_round"} <= names
+        for e in evs:
+            assert e["ph"] == "X" and "ts" in e and "dur" in e
 
 
 class TestCaptureSummaryHistory:

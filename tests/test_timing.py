@@ -68,6 +68,33 @@ class TestTimed:
         assert out.shape == (8, 8)
         assert timing.metrics.summary()["timings"]["f"]["count"] == 1
 
+    def test_timeit_increments_calls_counter_like_timed(self):
+        # Satellite fix (PR 3): pre-fix, timeit recorded the timing but
+        # never bumped {label}.calls — timed and timeit now share one
+        # registry path, so the counter and the histogram count agree.
+        @timing.timeit(name="g")
+        def g():
+            return jnp.ones((4,))
+
+        g()
+        g()
+        s = timing.metrics.summary()
+        assert s["timings"]["g"]["count"] == 2
+        assert s["counters"]["g.calls"] == 2
+
+    def test_shim_lands_in_obs_registry(self):
+        # timing.Metrics is a thin shim over obs.metrics.registry: the
+        # same sample is visible through the obs snapshot (and therefore
+        # through every bench artifact's metrics block).
+        from marlin_tpu.obs import metrics as om
+
+        timing.metrics.record("shimmed", 0.25)
+        timing.metrics.incr("shimmed.calls")
+        snap = om.registry.snapshot()
+        assert snap["histograms"]["shimmed"]["count"] == 1
+        assert snap["histograms"]["shimmed"]["sum"] == 0.25
+        assert snap["counters"]["shimmed.calls"] == 1
+
     def test_fence_accepts_distributed_and_raw(self):
         timing.fence(DenseVecMatrix(np.ones((3, 3))), jnp.ones(4), "not-an-array")
 
