@@ -72,7 +72,7 @@ from benchlib.configs_ml import (  # noqa: E402,F401
 from benchlib.configs_sparse import (  # noqa: E402,F401
     config_sparse_dist, config_spmm)
 from benchlib.configs_trend import (  # noqa: E402,F401
-    config_serving, config_trend_cpu)
+    config_serving, config_serving_prefix, config_trend_cpu)
 from benchlib.registry import CONFIGS  # noqa: E402
 
 # Monkeypatch-friendly module global: tests/tools set bench._CAPTURE_DIR,

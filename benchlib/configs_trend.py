@@ -32,26 +32,42 @@ def config_trend_cpu():
     summa = cm.run_summa_trend_sweep()
     serving = cm.run_serving_trend_sweep()
     gemm = cm.run_gemm_trend_sweep()
+    lu = cm.run_lu_trend_sweep()
+    chol = cm.run_cholesky_trend_sweep()
     dv, sv = cm.trend_verdict(decode), cm.trend_verdict(summa)
     rv, gv = cm.trend_verdict(serving), cm.trend_verdict(gemm)
+    lv, cv = cm.trend_verdict(lu), cm.trend_verdict(chol)
     # Early-exit cliff: the all-finished decode point against its
     # same-shape all-live twin (skew-proofing made the while_loop exit
     # before the first body; < 0.5 means the exit is real, not noise).
     full = next(p for p in decode
                 if p["finished_frac"] == 0.0 and p["batch"] == 8)
     done = next(p for p in decode if p["finished_frac"] == 1.0)
-    # GEMM exponent vs the n^3 FLOPs term, plus the measured-vs-model
-    # log-fit residual (the model-fit quality figure item 2 asked for).
-    gfit = cm.powerlaw_fit([p["n"] for p in gemm],
-                           [p["measured"] for p in gemm])
-    rho_min = min(dv["rho"], sv["rho"], rv["rho"], gv["rho"])
+    # Measured exponent vs each n^3 FLOPs term, plus the
+    # measured-vs-model log-fit residual (the model-fit quality figure
+    # item 2 asked for) — GEMM, and the ROADMAP-2 LU/Cholesky slices.
+    def fit(points):
+        f = cm.powerlaw_fit([p["n"] for p in points],
+                            [p["measured"] for p in points])
+        return round(f["exponent"], 3), round(f["residual_rms"], 4)
+
+    gemm_exp, gemm_res = fit(gemm)
+    lu_exp, lu_res = fit(lu)
+    ch_exp, ch_res = fit(chol)
+    rho_min = min(dv["rho"], sv["rho"], rv["rho"], gv["rho"], lv["rho"],
+                  cv["rho"])
     return {"metric": "trend_rank_correlation_min", "value": rho_min,
             "unit": "rho", "vs_baseline": round(rho_min / 0.9, 3),
             "decode_rho": dv["rho"], "summa_rho": sv["rho"],
             "serving_rho": rv["rho"], "gemm_rho": gv["rho"],
-            "gemm_exponent": round(gfit["exponent"], 3),
+            "lu_rho": lv["rho"], "cholesky_rho": cv["rho"],
+            "gemm_exponent": gemm_exp,
             "gemm_model_exponent": 3.0,
-            "gemm_fit_residual_rms": round(gfit["residual_rms"], 4),
+            "gemm_fit_residual_rms": gemm_res,
+            "lu_exponent": lu_exp, "lu_fit_residual_rms": lu_res,
+            "cholesky_exponent": ch_exp,
+            "cholesky_fit_residual_rms": ch_res,
+            "factor_model_exponent": 3.0,
             "finished_exit_ratio": round(done["measured"] / full["measured"],
                                          4),
             "decode_points": [[p["batch"], p["steps"], p["finished_frac"],
@@ -62,7 +78,10 @@ def config_trend_cpu():
                                 p["live_rows"], round(p["measured"], 5)]
                                for p in serving],
             "gemm_points": [[p["n"], round(p["measured"], 5)]
-                            for p in gemm]}
+                            for p in gemm],
+            "lu_points": [[p["n"], round(p["measured"], 5)] for p in lu],
+            "cholesky_points": [[p["n"], round(p["measured"], 5)]
+                                for p in chol]}
 
 
 def config_serving():
@@ -203,4 +222,112 @@ def config_serving():
         "steps_short": short, "steps_long": long_, "d_model": d,
         "recompiles_after_warmup": recompiles,
         "trace_path": trace_path, "trace_events": n_trace_events,
+    }
+
+
+def config_serving_prefix():
+    """Shared-prefix KV reuse, cache-on vs cache-off (serving/prefix.py):
+    the artifact line for the ROADMAP item-10 "paged/shared-prefix KV"
+    follow-up.
+
+    Workload: ``BENCH_SRV_PREQS`` requests sharing one
+    ``BENCH_SRV_PREFIX``-token system prompt with short unique tails —
+    the dominant real-traffic shape. BOTH arms run the CHUNKED admission
+    discipline (``prefill_chunk``; the substrate prefix reuse is
+    bit-exact on), so the measured delta is pure reuse: the cache-on arm
+    copies each hit's KV rows and prefills only the tail chunks, the
+    cache-off arm recomputes every chunk. The headline value is the
+    drain-to-drain WALL-CLOCK speedup (acceptance bar 1.3x); the
+    round-normalized twin (``wallclock_per_round_speedup``) is the
+    "equal rounds" view — cache-on also drains in fewer rounds because
+    admissions complete sooner, and per-round cost is what the batch
+    actually buys. ``prefix_hit_rate`` and
+    ``prefix_reclaimed_prefill_tokens`` come from the engine ledger;
+    a post-warmup watchdog pins ``recompiles_after_warmup == 0`` in
+    BOTH arms (copy/chunk shapes are traced — compiles are bounded by
+    distinct 16-buckets, not admissions). tools/slo_check.py holds this
+    line to the committed baseline in the tier-1 serving smoke."""
+    import numpy as np
+
+    from marlin_tpu.models import TransformerConfig, init_params
+    from marlin_tpu.obs.watch import CompileWatchdog
+    from marlin_tpu.serving import (PrefixCache, ServingEngine,
+                                    copy_kv_rows, prefill_chunk_into_row)
+    from marlin_tpu.serving.engine import _decode_round
+
+    d = _sized("BENCH_SRV_D", 256)
+    batch = _sized("BENCH_SRV_B", 4)
+    n_req = _sized("BENCH_SRV_PREQS", 12)
+    prefix_len = _sized("BENCH_SRV_PREFIX", 96)
+    tail_len = _sized("BENCH_SRV_TAIL", 8)
+    steps = _sized("BENCH_SRV_PSTEPS", 4)
+    chunk = _sized("BENCH_SRV_CHUNK", 32)
+    round_steps = _sized("BENCH_SRV_ROUND", 8)
+    pool_rows = _sized("BENCH_SRV_POOL", 4)
+    max_len = -(-(prefix_len + tail_len) // 16) * 16 + steps + 4
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_SRV_VOCAB", 1024), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_SRV_L", 4),
+        d_ff=4 * d, max_len=max_len,
+        dtype=os.environ.get("BENCH_SRV_DTYPE", "float32"))
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, cfg.vocab, prefix_len).astype(np.int32)
+    prompts = [np.concatenate([shared, rng.integers(
+        0, cfg.vocab, tail_len).astype(np.int32)]) for _ in range(n_req)]
+
+    def run(with_cache: bool):
+        pc = PrefixCache(cfg, pool_rows=pool_rows) if with_cache else None
+        eng = ServingEngine(params, cfg, batch=batch,
+                            round_steps=round_steps, prefill_chunk=chunk,
+                            prefix_cache=pc)
+        for p in prompts:
+            eng.submit(p, steps)
+        t0 = time.perf_counter()
+        eng.run()
+        return eng, pc, time.perf_counter() - t0
+
+    run(False)  # warmup: chunk-bucket + round compiles
+    run(True)   # warmup: copy compiles (hit + store lengths)
+    wd = CompileWatchdog()
+    wd.register("serving.decode_round", _decode_round)
+    wd.register("serving.prefill_chunk_into_row", prefill_chunk_into_row)
+    wd.register("serving.prefix_copy", copy_kv_rows)
+    # Min-of-2 trials per arm: the headline is a WALL-CLOCK ratio on a
+    # shared host (weather), and the tier-1 SLO gate holds it to 1.3x —
+    # min is the noise-floor estimator the repo's timing discipline
+    # uses, so a noisy-neighbor spike during one trial can't flake CI.
+    eng_off, _, dt_off = run(False)
+    dt_off = min(dt_off, run(False)[2])
+    rec_off = sum(r.new_compiles for r in wd.poll(rebaseline=True))
+    eng_on, pc, dt_on = run(True)
+    dt_on = min(dt_on, run(True)[2])
+    rec_on = sum(r.new_compiles for r in wd.poll(rebaseline=True))
+
+    rounds_off, rounds_on = eng_off.stats.n_rounds, eng_on.stats.n_rounds
+    speedup = dt_off / dt_on
+    per_round = (dt_off / max(rounds_off, 1)) / (dt_on / max(rounds_on, 1))
+    summ = eng_on.stats.summary()
+    return {
+        "metric": "serving_prefix_reuse_speedup",
+        "value": round(speedup, 3), "unit": "x",
+        "vs_baseline": round(speedup / 1.3, 3),
+        "wallclock_on_s": round(dt_on, 4),
+        "wallclock_off_s": round(dt_off, 4),
+        "rounds_on": rounds_on, "rounds_off": rounds_off,
+        "wallclock_per_round_speedup": round(per_round, 3),
+        "prefix_hit_rate": summ.get("prefix_hit_rate", 0.0),
+        "prefix_reclaimed_prefill_tokens": summ.get(
+            "prefix_reclaimed_prefill_tokens", 0),
+        "prefix_reclaimed_prefill_gflops": summ.get(
+            "prefix_reclaimed_prefill_gflops", 0.0),
+        "prefix_pool": pc.summary(),
+        "utilization": round(eng_on.stats.utilization(), 4),
+        "completed_on": eng_on.stats.n_completed,
+        "completed_off": eng_off.stats.n_completed,
+        "recompiles_after_warmup": rec_on,
+        "recompiles_after_warmup_off": rec_off,
+        "batch": batch, "n_requests": n_req, "prefix_len": prefix_len,
+        "tail_len": tail_len, "steps": steps, "prefill_chunk": chunk,
+        "pool_rows": pool_rows, "d_model": d,
     }

@@ -31,7 +31,8 @@ CONFIGS = {
     "decodeint8": [configs_ml.config_decode_int8],
     "decodespec": [configs_ml.config_decode_spec],
     "trend": [configs_trend.config_trend_cpu],
-    "serving": [configs_trend.config_serving],
+    "serving": [configs_trend.config_serving,
+                configs_trend.config_serving_prefix],
     "sweep": [configs_gemm.config_dispatch_sweep],
     "attnsweep": [configs_kernels.config_attention_sweep],
 }

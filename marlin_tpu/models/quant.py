@@ -34,7 +34,27 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["quantize_params_int8", "dequantize_params", "is_quantized",
-           "kv_quantize"]
+           "kv_quantize", "kv_layer_keys"]
+
+# Cache-layer buffer names by quantization mode: the float cache holds
+# K/V only; the int8 cache carries one f32 scale buffer per quantized
+# buffer (kv_quantize's per-vector scales). Row-granular cache movement —
+# the serving prefix cache's pool copies (serving/prefix.py), any future
+# cache migration — must move the SCALES alongside the int8 slots or the
+# copied rows dequantize with the destination's stale scales: iterate
+# these keys, never just ("k", "v").
+_KV_KEYS = ("k", "v")
+_KV_QUANT_KEYS = ("k", "v", "ks", "vs")
+
+
+def kv_layer_keys(layer_or_quant) -> tuple:
+    """The buffer names one KV-cache layer carries, given a layer dict (or
+    the ``cfg.kv_quant`` truthiness): ("k", "v") for a float cache,
+    ("k", "v", "ks", "vs") for the int8 cache — the per-vector scale
+    buffers travel with their slots (module comment above)."""
+    if isinstance(layer_or_quant, dict):
+        return _KV_QUANT_KEYS if "ks" in layer_or_quant else _KV_KEYS
+    return _KV_QUANT_KEYS if layer_or_quant else _KV_KEYS
 
 # Per-block 2-D weights that stream every decode step. Biases, layer norms
 # and the router stay float (tiny), the learned ``pos`` table too (decode

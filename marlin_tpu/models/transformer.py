@@ -643,25 +643,9 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     return _readout(params, x), new_cache
 
 
-def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
-    """Multi-position decode: tokens (B, C) at positions pos..pos+C-1 ->
-    (logits (B, C, vocab), updated cache).
-
-    The speculative-verify step (``generate_speculative``): C candidate
-    tokens stream the weights ONCE — the whole point, since decode is
-    bound by parameter streaming — and each position attends the cache
-    prefix up to itself (within-chunk causality falls out of the
-    per-position slot mask; the chunk's K/V are written before attending).
-    A partially REJECTED chunk needs no rollback: slot == position in the
-    dense cache, so stale rejected-draft slots sit beyond the accepted
-    position and are overwritten before they are ever attendable. That
-    self-healing property is exactly what a ring cache lacks (overwritten
-    slots held still-live earlier positions), so ``cfg.window`` is
-    unsupported here. ``pos`` is a scalar or a per-sequence (B,) vector —
-    the latter is what batched speculation needs, since acceptance counts
-    desynchronize the sequences. Caller contract: pos + C <= cache length
-    per sequence (JAX's update-slice clamp would otherwise silently shift
-    the write)."""
+def _chunk_guards(cache, cfg: TransformerConfig):
+    """Shared contract checks for the chunk paths (decode_chunk /
+    prefill_chunk): dense slot==position cache only, no MoE routing."""
     if cfg.window:
         raise NotImplementedError(
             "decode_chunk needs the dense slot==position cache: a ring "
@@ -672,7 +656,22 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
             "decode_chunk's (B, C, D) activations don't fit the MoE "
             "router's (T, D) batch contract; use decode_step/generate "
             "for MoE configs")
-    params = _cast_params(params, cfg)
+    _check_cache(cache, cfg, expect_len=cfg.max_len)
+
+
+def _chunk_states(params, cache, tokens, pos, cfg: TransformerConfig):
+    """The shared chunk body of :func:`decode_chunk` and
+    :func:`prefill_chunk`: run (B, C) tokens at positions pos..pos+C-1
+    against the cache — write each position's K/V, attend each position
+    over its own prefix — and return ``(hidden states (B, C, D) BEFORE
+    the final LN, updated cache)``. ``params`` must already be cast.
+
+    Every op in here is PER-POSITION (row-wise matmuls, vmapped
+    attention, per-position norms), which is what makes the chunk split
+    BIT-stable: computing positions [0, 32) as one chunk or as two
+    16-chunks writes identical cache bits and identical hidden states
+    (tests/test_prefix_cache.py pins it) — the property the serving
+    prefix cache's copy-instead-of-recompute admission rests on."""
     b, c = tokens.shape
     x = _embed_rows(params, tokens, cfg.compute_dtype)  # (B, C, D)
     pos = jnp.asarray(pos, jnp.int32)
@@ -682,7 +681,6 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
     if not cfg.rope:
         x = x + params["pos"][chunk_pos].astype(x.dtype)
     positions = chunk_pos.reshape(-1) if cfg.rope else None
-    _check_cache(cache, cfg, expect_len=cfg.max_len)
     hk, dh = cache[0]["k"].shape[2:]
     quant = bool(cfg.kv_quant)
     new_cache = []
@@ -721,8 +719,73 @@ def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
         new_cache.append(layer)
         x = _mlp_residual(
             bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
+    return x, new_cache
+
+
+def decode_chunk(params, cache, tokens, pos, cfg: TransformerConfig):
+    """Multi-position decode: tokens (B, C) at positions pos..pos+C-1 ->
+    (logits (B, C, vocab), updated cache).
+
+    The speculative-verify step (``generate_speculative``): C candidate
+    tokens stream the weights ONCE — the whole point, since decode is
+    bound by parameter streaming — and each position attends the cache
+    prefix up to itself (within-chunk causality falls out of the
+    per-position slot mask; the chunk's K/V are written before attending).
+    A partially REJECTED chunk needs no rollback: slot == position in the
+    dense cache, so stale rejected-draft slots sit beyond the accepted
+    position and are overwritten before they are ever attendable. That
+    self-healing property is exactly what a ring cache lacks (overwritten
+    slots held still-live earlier positions), so ``cfg.window`` is
+    unsupported here. ``pos`` is a scalar or a per-sequence (B,) vector —
+    the latter is what batched speculation needs, since acceptance counts
+    desynchronize the sequences. Caller contract: pos + C <= cache length
+    per sequence (JAX's update-slice clamp would otherwise silently shift
+    the write)."""
+    _chunk_guards(cache, cfg)
+    params = _cast_params(params, cfg)
+    x, new_cache = _chunk_states(params, cache, tokens, pos, cfg)
     x = _layer_norm(params["ln_f"], x)
     return _readout(params, x), new_cache
+
+
+def prefill_chunk(params, cache, tokens, pos, cfg: TransformerConfig,
+                  last=None):
+    """Chunked-prefill continuation: run (B, C) prompt tokens at positions
+    pos..pos+C-1 against a PRE-POPULATED cache (K/V for [0, pos) already
+    written — by earlier chunks, or by a prefix-cache copy), writing this
+    chunk's K/V and returning ``(logits (B, vocab) at chunk index
+    ``last``, updated cache)``.
+
+    This is :func:`decode_chunk`'s chunk body (same per-position K/V
+    writes, same per-position attention over the cache prefix, rope
+    positions offset by ``pos`` for free) with the vocab readout at ONE
+    position instead of all C: a prefill chunk needs logits only when it
+    is the FINAL chunk of a prompt (the first-token sample at
+    ``prompt_len - 1``), so the (C, vocab) readout matmul — ~d*vocab
+    FLOPs per position — is not paid per intermediate chunk. ``last`` is
+    TRACED (default C-1), so a ragged final chunk (real length <
+    padded C) shares the full chunk's compile; entries past ``last``'s
+    position may be padding — their K/V writes land in dead slots beyond
+    the prompt, overwritten by decode before any live read (the PR-2
+    admission argument).
+
+    Bit-exactness contract (the serving prefix cache's foundation): the
+    chunk computation is per-position, so prefilling a prompt in ANY
+    16-aligned chunk split — including resuming at ``pos = hit_len`` over
+    copied prefix K/V — produces bit-identical cache state and logits to
+    the one-chunk computation (pinned in tests/test_prefix_cache.py)."""
+    _chunk_guards(cache, cfg)
+    params = _cast_params(params, cfg)
+    x, new_cache = _chunk_states(params, cache, tokens, pos, cfg)
+    if last is None:
+        last = tokens.shape[1] - 1
+    # Slice the ONE position first, then LN + readout on (B, 1, D): both
+    # are per-position ops, so this equals decode_chunk's
+    # LN-then-readout-then-index on the same position, ~C x cheaper.
+    h = jax.vmap(
+        lambda xi: jax.lax.dynamic_slice_in_dim(xi, last, 1, axis=0))(x)
+    h = _layer_norm(params["ln_f"], h)
+    return _readout(params, h)[:, 0], new_cache
 
 
 def prefill(params, tokens, cfg: TransformerConfig):

@@ -9,20 +9,25 @@ that converts PR 1's "skew-proof" into reclaimed throughput
 """
 
 from .engine import ServingEngine, _decode_round
+from .prefix import PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
-from .slots import SlotManager, pad_prompt_len, prefill_into_row
+from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
+                    prefill_into_row)
 from .stats import (EngineStats, request_stats, static_completed_at_budget,
                     static_schedule_iters)
 
 __all__ = [
     "AdmissionQueue",
     "EngineStats",
+    "PrefixCache",
     "QueueClosed",
     "QueueFull",
     "Request",
     "ServingEngine",
     "SlotManager",
+    "copy_kv_rows",
     "pad_prompt_len",
+    "prefill_chunk_into_row",
     "prefill_into_row",
     "request_stats",
     "static_completed_at_budget",

@@ -26,14 +26,26 @@ frozen neighbor nor a mid-stream admission can move a live row's
 logits; with the 16-bucket admission prefill (slots.py) every request's
 greedy output is BIT-EXACT vs its own B=1 ``generate`` run
 (tests/test_serving.py pins this, plus the zero-recompile and >= 1.3x
-throughput claims). At temperature > 0 the engine samples through the
-same ``_sample`` kernel but shares one key stream across the batch, so
-sampled outputs are distribution-honest yet not replay-identical to a
-B=1 run's key schedule.
+throughput claims). At temperature > 0 every request carries its OWN
+PRNG stream (seeded from ``fold_in(engine key, request_id)``, advanced
+only on the request's live iterations), so sampled outputs are
+reproducible per request and invariant to batch composition and arrival
+pattern (tests/test_prefix_cache.py pins it) — though still not
+replay-identical to a B=1 ``generate`` run's key schedule.
+
+Admission disciplines: the default is the one-shot flash prefill
+(``slots.prefill_into_row``). ``prefill_chunk=N`` switches to CHUNKED
+admission — fixed 16-aligned chunks (``slots.prefill_chunk_into_row``)
+interleaved with decode rounds, Sarathi-style, so a long cold prompt
+amortizes over rounds — which is also the substrate shared-prefix KV
+reuse (``prefix_cache=PrefixCache(...)``, serving/prefix.py) is
+bit-exact on: a prefix hit copies the donor's cached K/V rows and
+prefills only the tail chunks (docs/serving.md §prefix cache).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import Dict, List, Optional
@@ -48,9 +60,28 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..obs.runlog import RunLog
 from ..obs.watch import CompileWatchdog
+from .prefix import PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, Request
-from .slots import SlotManager, pad_prompt_len, prefill_into_row
+from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
+                    prefill_into_row)
 from .stats import EngineStats
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """One in-flight chunked admission: the host cursor of a prompt being
+    prefilled into a claimed row across rounds (serving/slots.
+    prefill_chunk_into_row), starting past any prefix-cache hit."""
+
+    req: Request
+    row: int
+    pos: int            # next uncovered prompt position (16-aligned)
+    hit_len: int        # prefix-cache hit this admission started from
+    k_first: np.ndarray  # first-token sample key (request-derived)
+    k_decode: np.ndarray  # decode key-stream seed (request-derived)
+    start_round: int
+    chunks: int = 0
+    done: bool = False
 
 
 @functools.partial(
@@ -59,7 +90,7 @@ from .stats import EngineStats
     donate_argnums=(1, 2),
 )
 @jax.named_scope("marlin.serving.decode_round")
-def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
+def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
                   round_steps: int, temperature: float,
                   eos_id: Optional[int] = None):
     """One bounded decode round over the full batch (ONE dispatch).
@@ -77,10 +108,18 @@ def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
     same params -> identical KV) landing in already-dead state, so live
     rows are bit-exact vs any other freeze/admission pattern.
 
+    ``keys`` is the (B, 2) uint32 PER-ROW key-stream state (one PRNG
+    stream per REQUEST, seeded at admission from the request's own key):
+    each iteration splits every row's key, samples that row with its own
+    subkey, and advances the stream ONLY on the row's live iterations —
+    so request r's n-th sampled token is drawn from the n-th split of
+    r's key regardless of neighbors, slot, or arrival pattern (the
+    sampled-path reproducibility contract; greedy ignores the keys).
+
     The loop exits at ``round_steps`` or as soon as EVERY row is frozen
     — an all-idle round costs one dispatch, not round_steps iterations.
 
-    Returns ``(buf, filled, done, cache, iters, live_iters)`` with
+    Returns ``(buf, filled, done, cache, iters, live_iters, keys)`` with
     ``iters`` the loop trips taken and ``live_iters`` (B,) the per-row
     live-iteration count — the verify_chunks-style ledger stats.py
     turns into occupancy and reclaimed-FLOPs figures.
@@ -93,7 +132,7 @@ def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
         return (i < round_steps) & ~jnp.all(done)
 
     def body(carry):
-        i, buf, filled, done, cache, key, live = carry
+        i, buf, filled, done, cache, keys, live = carry
         tok = buf[brange, filled - 1]
         # Freeze-at-entry, BEFORE this iteration appends: a row admitted
         # already at target (steps == 1: the admission prefill's first
@@ -107,9 +146,15 @@ def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
             done = done | (tok == eos_id)
         logits, cache = tr.decode_chunk(params, cache, tok[:, None],
                                         filled - 1, cfg)
-        key, ks = jax.random.split(key)
-        nxt = tr._sample(logits[:, 0], temperature, ks)
+        ks_all = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+        nxt = jax.vmap(
+            lambda lg, kk: tr._sample(lg, temperature, kk)
+        )(logits[:, 0], ks_all[:, 1])
         nxt = jnp.where(done, tok, nxt).astype(buf.dtype)
+        # A frozen row's stream must NOT advance (its sample was
+        # discarded): the stream position counts the row's LIVE samples
+        # only, which is what makes it a pure function of the request.
+        keys = jnp.where(done[:, None], keys, ks_all[:, 0])
         # Frozen rows re-write their last token in place (dead, fixed
         # point); live rows append at ``filled`` (< target <= L always).
         w = jnp.where(done, filled - 1, filled)
@@ -119,17 +164,17 @@ def _decode_round(params, cache, buf, filled, target, done0, key, cfg,
         live = live + (~done).astype(jnp.int32)
         filled = jnp.where(done, filled, filled + 1)
         done = done | (filled >= target)
-        return i + 1, buf, filled, done, cache, key, live
+        return i + 1, buf, filled, done, cache, keys, live
 
     live0 = jnp.zeros((bsz,), jnp.int32)
-    iters, buf, filled, done, cache, _, live = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), buf, filled, done0, cache, key, live0))
+    iters, buf, filled, done, cache, keys, live = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), buf, filled, done0, cache, keys, live0))
     if eos_id is not None:
         # An eos emitted on the round's last iteration only freezes the
         # row at the NEXT feed; report it finished now so the engine
         # retires it at this round boundary.
         done = done | (buf[brange, filled - 1] == eos_id)
-    return buf, filled, done, cache, iters, live
+    return buf, filled, done, cache, iters, live, keys
 
 
 class ServingEngine:
@@ -149,7 +194,10 @@ class ServingEngine:
                  max_pending: int = 64, temperature: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  tracer=None, runlog: Optional[RunLog] = None,
-                 metrics_registry=None):
+                 metrics_registry=None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: Optional[PrefixCache] = None,
+                 prefill_chunks_per_round: int = 2):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -165,10 +213,47 @@ class ServingEngine:
                 "batch instead")
         if round_steps < 1:
             raise ValueError(f"round_steps must be >= 1, got {round_steps}")
+        # Admission discipline (docs/serving.md §prefix cache): the
+        # DEFAULT is PR 2's one-shot flash prefill (bit-exact vs an
+        # unpadded B=1 generate). ``prefill_chunk`` switches admissions
+        # to the canonical CHUNKED path — fixed 16-aligned chunks of
+        # transformer.prefill_chunk interleaved with decode rounds, so a
+        # long cold prompt can no longer stall the live batch — which is
+        # also the substrate prefix reuse is bit-exact on; attaching a
+        # ``prefix_cache`` therefore implies (and defaults) it.
+        if prefix_cache is not None and prefill_chunk is None:
+            prefill_chunk = 32
+        if prefill_chunk is not None and (prefill_chunk < 16
+                                          or prefill_chunk % 16):
+            raise ValueError(
+                f"prefill_chunk must be a multiple of 16 (the admission "
+                f"bucket), got {prefill_chunk}")
+        if prefill_chunks_per_round < 1:
+            raise ValueError(
+                f"prefill_chunks_per_round must be >= 1, got "
+                f"{prefill_chunks_per_round}")
+        if prefix_cache is not None and prefix_cache.cfg != cfg:
+            raise ValueError(
+                "prefix_cache was built for a different TransformerConfig; "
+                "its pool rows would not be shape/quantization-compatible "
+                "with this engine's cache")
+        if prefix_cache is not None and prefix_cache._registry is None:
+            # Bind the cache's store/evict/pool series to THIS engine's
+            # registry (unless the caller pinned one explicitly), so one
+            # snapshot covers the whole prefix surface next to the
+            # engine's hit/miss mirrors. First attach wins for a SHARED
+            # cache — engines with different registries sharing one
+            # cache should pin PrefixCache(registry=...) explicitly
+            # (class docstring).
+            prefix_cache._registry = metrics_registry \
+                if metrics_registry is not None else obs_metrics.registry
         self.params = params
         self.cfg = cfg
         self.batch = batch
         self.round_steps = round_steps
+        self.prefill_chunk = prefill_chunk
+        self.prefill_chunks_per_round = prefill_chunks_per_round
+        self.prefix_cache = prefix_cache
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.queue = AdmissionQueue(max_pending=max_pending)
@@ -191,13 +276,25 @@ class ServingEngine:
         self.watchdog.register("serving.decode_round", _decode_round)
         self.watchdog.register("serving.prefill_into_row",
                                prefill_into_row)
-        self._key = jax.random.PRNGKey(seed)
+        if prefill_chunk is not None:
+            self.watchdog.register("serving.prefill_chunk_into_row",
+                                   prefill_chunk_into_row)
+            self.watchdog.register("serving.prefix_copy", copy_kv_rows)
+        # Per-request PRNG streams (the sampled-path reproducibility
+        # contract): every request's keys derive from fold_in(base,
+        # request_id), so its sampled tokens are a pure function of
+        # (prompt, steps, engine seed, request_id) — independent of
+        # batch composition, slot, or arrival pattern.
+        self._base_key = jax.random.PRNGKey(seed)
         self._next_id = 0
         self.round_idx = 0
         # Pending + active requests ONLY: finished/timed-out requests
         # are returned from step()/run() and dropped here, so a
         # long-running engine holds O(batch + max_pending) requests.
         self.requests: Dict[int, Request] = {}
+        # In-flight chunked admissions (row -> job); empty in the
+        # default one-shot mode.
+        self._prefilling: Dict[int, _PrefillJob] = {}
         # Device state. Free rows sit at filled=1 over a zero buffer so
         # the frozen feed (buf[row, 0] at position 0) is well-defined
         # dead state; target=0 keeps them done from round one.
@@ -206,6 +303,10 @@ class ServingEngine:
         self._filled = np.ones((batch,), np.int32)
         self._target = np.zeros((batch,), np.int32)
         self._active = np.zeros((batch,), bool)
+        # Per-row decode key-stream state, (B, 2) uint32: seeded from the
+        # owning request's key at admission, advanced (live iterations
+        # only) inside _decode_round. Host-side like filled/target.
+        self._keys = np.zeros((batch, 2), np.uint32)
 
     # -- submission ---------------------------------------------------
 
@@ -253,9 +354,53 @@ class ServingEngine:
 
     # -- scheduling ---------------------------------------------------
 
+    def _request_keys(self, req: Request):
+        """(first-token key, decode-stream seed) from the request's own
+        key root — the whole of its sampling randomness. Derived LAZILY
+        at admission (request_id is fixed at submit, so the stream is
+        already determined there): submit stays a pure-host path with no
+        device dispatch, and requests that time out in the queue never
+        pay one. Derived from the id via fold_in, not from a shared
+        mutable key, so no other submission can shift it."""
+        req.key = np.asarray(
+            jax.random.fold_in(self._base_key, req.request_id))
+        k_first, k_decode = jax.random.split(jnp.asarray(req.key))
+        return np.asarray(k_first), np.asarray(k_decode)
+
+    def _activate_row(self, req: Request, row: int, k_decode) -> None:
+        """Shared admission epilogue: the row's prompt K/V and first
+        token are in place; arm it for decode and record the ledger."""
+        s = req.prompt_len
+        self._filled[row] = s + 1
+        self._target[row] = s + req.steps
+        self._active[row] = True
+        self._keys[row] = np.asarray(k_decode, np.uint32)
+        req.row = row
+        req.admit_round = self.round_idx
+        req.admit_time = time.perf_counter()
+        req.status = "active"
+        self.stats.record_admission(req)
+
+    def _drop_expired(self, expired: List[Request]) -> None:
+        for req in expired:
+            self.stats.record_timeout(req)
+            self.runlog.emit("timeout", request_id=req.request_id,
+                             round=self.round_idx,
+                             deadline_rounds=req.deadline_rounds)
+            # Same ownership transfer as retirement: timed-out requests
+            # go back to the caller, not into an ever-growing dict.
+            self.requests.pop(req.request_id, None)
+
     def _admit(self) -> List[Request]:
         """Fill free slots from the queue (FIFO); returns timed-out
-        requests dropped on the way."""
+        requests dropped on the way. Dispatches on the admission
+        discipline: the default ONE-SHOT flash prefill, or the CHUNKED
+        path (``prefill_chunk`` set) that also serves prefix reuse."""
+        if self.prefill_chunk is None:
+            return self._admit_oneshot()
+        return self._admit_chunked()
+
+    def _admit_oneshot(self) -> List[Request]:
         expired: List[Request] = []
         while self.slots.n_free:
             req, dropped = self.queue.pop_ready(self.round_idx)
@@ -266,36 +411,134 @@ class ServingEngine:
             s = req.prompt_len
             padded = np.zeros((pad_prompt_len(s),), np.int32)
             padded[:s] = req.prompt
-            self._key, k_admit = jax.random.split(self._key)
+            k_first, k_decode = self._request_keys(req)
             with self.tracer.span("serving.admit", scope=False,
                                   request_id=req.request_id, row=row,
                                   prompt_len=s):
                 self._cache, self._buf, _, _ = prefill_into_row(
                     self.params, self._cache, self._buf, jnp.int32(row),
-                    jnp.asarray(padded), jnp.int32(s), k_admit,
-                    cfg=self.cfg, temperature=self.temperature)
-            self._filled[row] = s + 1
-            self._target[row] = s + req.steps
-            self._active[row] = True
-            req.row = row
-            req.admit_round = self.round_idx
-            req.admit_time = time.perf_counter()
-            req.status = "active"
-            self.stats.record_admission(req)
+                    jnp.asarray(padded), jnp.int32(s),
+                    jnp.asarray(k_first), cfg=self.cfg,
+                    temperature=self.temperature)
+            self._activate_row(req, row, k_decode)
             self.runlog.emit(
                 "admit", request_id=req.request_id, row=row,
                 round=self.round_idx,
                 wait_rounds=self.round_idx - req.submit_round,
                 queue_depth=len(self.queue))
-        for req in expired:
-            self.stats.record_timeout(req)
-            self.runlog.emit("timeout", request_id=req.request_id,
-                             round=self.round_idx,
-                             deadline_rounds=req.deadline_rounds)
-            # Same ownership transfer as retirement: timed-out requests
-            # go back to the caller, not into an ever-growing dict.
-            self.requests.pop(req.request_id, None)
+        self._drop_expired(expired)
         return expired
+
+    # -- chunked admission (prefix-reuse mode) ------------------------
+
+    def _admit_chunked(self) -> List[Request]:
+        """Chunked admission round: claim free rows for queued requests
+        (taking any prefix-cache hit as a row copy), then advance every
+        in-flight prefill by up to ``prefill_chunks_per_round`` chunks —
+        Sarathi-style interleaving, so a long cold prompt spreads its
+        prefill across rounds instead of stalling the live batch."""
+        expired: List[Request] = []
+        while self.slots.n_free:
+            req, dropped = self.queue.pop_ready(self.round_idx)
+            expired.extend(dropped)
+            if req is None:
+                break
+            self._start_prefill(req)
+        for row in sorted(self._prefilling):  # deterministic order
+            job = self._prefilling[row]
+            for _ in range(self.prefill_chunks_per_round):
+                self._advance_chunk(job)
+                if job.done:
+                    break
+            if job.done:
+                del self._prefilling[row]
+                self._finish_admission(job)
+        self._drop_expired(expired)
+        return expired
+
+    def _start_prefill(self, req: Request) -> None:
+        row = self.slots.acquire(req.request_id)
+        hit_row, hit = (None, 0)
+        if self.prefix_cache is not None:
+            hit_row, hit = self.prefix_cache.lookup(req.prompt)
+            if hit:
+                # Donor slots [0, hit) land in the claimed row as one
+                # copy — the reuse that replaces recomputing them; the
+                # engine cache is donated through, so its buffer
+                # pointers stay stable across prefix-hit admissions.
+                with self.tracer.span("serving.prefix_copy", scope=False,
+                                      request_id=req.request_id, row=row,
+                                      hit_len=hit):
+                    self._cache = self.prefix_cache.load_into(
+                        self._cache, row, hit_row, hit)
+            self.stats.record_prefix_lookup(hit, req.prompt_len)
+        k_first, k_decode = self._request_keys(req)
+        # Mid-prefill rows ride through decode rounds FROZEN, and a
+        # frozen row's fixed-point rewrite lands at slot filled - 1. The
+        # free-row default (filled = 1) would park that at slot 0 —
+        # which the chunks have made LIVE KV (unlike one-shot admission,
+        # which rewrites the whole row afterwards). Park the feed at the
+        # buffer's LAST slot instead: it is dead by the write-before-
+        # read argument (decode writes position max_len - 1 before the
+        # only step that can attend it), so interleaved rounds cannot
+        # clobber a partially prefilled prompt.
+        self._filled[row] = self.cfg.max_len
+        self._prefilling[row] = _PrefillJob(
+            req=req, row=row, pos=hit, hit_len=hit, k_first=k_first,
+            k_decode=k_decode, start_round=self.round_idx)
+        self.runlog.emit("prefill_start", request_id=req.request_id,
+                         row=row, round=self.round_idx,
+                         prompt_len=req.prompt_len, prefix_hit_len=hit)
+
+    def _advance_chunk(self, job: _PrefillJob) -> None:
+        req = job.req
+        s = req.prompt_len
+        c0 = job.pos
+        c1 = min(c0 + self.prefill_chunk, s)
+        clen = c1 - c0
+        seg = np.zeros((pad_prompt_len(clen),), np.int32)
+        seg[:clen] = req.prompt[c0:c1]
+        final = c1 == s
+        with self.tracer.span("serving.admit_chunk", scope=False,
+                              request_id=req.request_id, row=job.row,
+                              start=c0, chunk_len=clen, final=final):
+            if final:
+                padded = np.zeros((pad_prompt_len(s),), np.int32)
+                padded[:s] = req.prompt
+                self._cache, self._buf, _ = prefill_chunk_into_row(
+                    self.params, self._cache, self._buf,
+                    jnp.int32(job.row), jnp.asarray(seg), jnp.int32(c0),
+                    jnp.int32(clen), jnp.asarray(padded), jnp.int32(s),
+                    jnp.asarray(job.k_first), cfg=self.cfg,
+                    temperature=self.temperature, final=True)
+                job.done = True
+            else:
+                # Interior chunk: K/V only; prompt/key unused (the
+                # chunk doubles as the dummy prompt operand).
+                self._cache, self._buf = prefill_chunk_into_row(
+                    self.params, self._cache, self._buf,
+                    jnp.int32(job.row), jnp.asarray(seg), jnp.int32(c0),
+                    jnp.int32(clen), jnp.asarray(seg), jnp.int32(s),
+                    jnp.asarray(job.k_first), cfg=self.cfg,
+                    temperature=self.temperature, final=False)
+        job.pos = c1
+        job.chunks += 1
+
+    def _finish_admission(self, job: _PrefillJob) -> None:
+        req = job.req
+        self._activate_row(req, job.row, job.k_decode)
+        if self.prefix_cache is not None:
+            # The row now holds canonical-path K/V for the whole prompt
+            # — store its 16-aligned prefix so later admissions of the
+            # same system prompt copy instead of recompute.
+            self.prefix_cache.store_from(self._cache, job.row, req.prompt)
+        self.runlog.emit(
+            "admit", request_id=req.request_id, row=job.row,
+            round=self.round_idx,
+            wait_rounds=self.round_idx - req.submit_round,
+            prefill_rounds=self.round_idx - job.start_round + 1,
+            chunks=job.chunks, prefix_hit_len=job.hit_len,
+            queue_depth=len(self.queue))
 
     def _retire(self, filled: np.ndarray, done: np.ndarray) -> List[Request]:
         """Free finished rows, extract their outputs (eos-padded past the
@@ -350,25 +593,28 @@ class ServingEngine:
         with self.tracer.span("serving.round", scope=False,
                               round=self.round_idx):
             expired = self._admit()
-            self._key, k_round = jax.random.split(self._key)
-            # done0: free rows, plus any row already at target (a
-            # steps=1 admission emits its whole request inside the
-            # prefill) — the round also freezes such rows at body entry;
-            # marking them here saves the all-done round a no-op trip.
+            # done0: free rows (mid-prefill rows included — a chunked
+            # admission's row stays inert until its final chunk), plus
+            # any row already at target (a steps=1 admission emits its
+            # whole request inside the prefill) — the round also freezes
+            # such rows at body entry; marking them here saves the
+            # all-done round a no-op trip.
             done0 = ~self._active | (self._filled >= self._target)
             with self.tracer.span("serving.decode_round", scope=False,
                                   occupied=self.slots.n_occupied):
                 self._buf, filled_d, done_d, self._cache, iters_d, \
-                    live_d = _decode_round(
+                    live_d, keys_d = _decode_round(
                         self.params, self._cache, self._buf,
                         jnp.asarray(self._filled),
                         jnp.asarray(self._target),
-                        jnp.asarray(done0), k_round, cfg=self.cfg,
+                        jnp.asarray(done0), jnp.asarray(self._keys),
+                        cfg=self.cfg,
                         round_steps=self.round_steps,
                         temperature=self.temperature, eos_id=self.eos_id)
-                filled, done, iters, live = jax.device_get(
-                    (filled_d, done_d, iters_d, live_d))
+                filled, done, iters, live, keys = jax.device_get(
+                    (filled_d, done_d, iters_d, live_d, keys_d))
             self._filled = np.array(filled, np.int32)  # writable copy
+            self._keys = np.array(keys, np.uint32)
             for row in self.slots.occupied_rows():
                 self.requests[self.slots.owner_of(row)].live_iters += \
                     int(live[row])
@@ -392,6 +638,7 @@ class ServingEngine:
             occupied=occupied, live_iters=live_sum,
             admitted=self.stats.n_admitted - admitted0,
             retired=len(finished), expired=len(expired),
+            prefilling=len(self._prefilling),
             queue_depth=len(self.queue),
             wasted_row_iters=int(iters) * self.batch - live_sum)
         self.round_idx += 1

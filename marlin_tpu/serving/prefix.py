@@ -1,0 +1,347 @@
+"""Shared-prefix KV reuse: a host-side radix trie over token chunks plus
+a bounded device pool of donor KV rows.
+
+Real serving traffic is prefix-heavy — a million-user service front-loads
+every request with the same system prompt — yet each admission today
+recomputes the full prompt prefill. vLLM/PagedAttention (PAPERS.md) names
+shared-prefix reuse as the step after slot reuse; this module is that
+step, shaped for the fixed-row substrate rather than paged blocks:
+
+* :class:`PrefixCache` keys a radix trie on 16-token chunks — the flash
+  kernel's sublane granularity, the same bucket PR 2's admission padding
+  pinned — so a hit length is always a multiple of 16 and always
+  chunk-aligned with the engine's chunked admission path;
+* stored prefixes live in a BOUNDED device pool (``pool_rows`` rows of a
+  second ``init_kv_cache`` allocation), LRU-evicted under pressure, with
+  per-row REFCOUNTS so a donor row cannot be evicted while an admission
+  copy is in flight;
+* the device copies (:func:`copy_kv_rows`) move whole KV row-prefixes
+  with ``dynamic_slice``/``dynamic_update_slice`` — rows traced, only
+  the copy LENGTH static, so compiles are bounded by distinct 16-buckets
+  — and iterate :func:`models.quant.kv_layer_keys`, so an int8 cache's
+  per-vector scale buffers travel with their slots.
+
+Bit-exactness (the load-bearing claim, pinned in
+tests/test_prefix_cache.py): the engine's chunked admission path
+(transformer.prefill_chunk) is PER-POSITION — causal K/V at position i
+depends only on tokens <= i, and the chunk computation of a position is
+bit-stable under any 16-aligned split. A stored prefix row therefore
+holds exactly the bits the cache-off engine would recompute for the same
+tokens, and a copy-then-tail admission is bit-identical to a cold
+chunked admission — hit/miss decisions change the SCHEDULE, never the
+output. (The one-shot flash admission path is a different kernel with
+bucket-dependent tiling; the engine never mixes the two disciplines
+within a mode — docs/serving.md §prefix cache.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import init_kv_cache
+from ..models.quant import kv_layer_keys
+from ..obs import metrics as obs_metrics
+
+GRAIN = 16  # trie chunk / hit-length granularity: the flash 16-sublane
+# bucket PR 2 pinned, and the finest split the chunked admission path
+# is bit-stable under.
+
+
+@functools.partial(jax.jit, static_argnames=("length",),
+                   donate_argnums=(0,))
+@jax.named_scope("marlin.serving.prefix_copy")
+def copy_kv_rows(dst, src, dst_row, src_row, length: int):
+    """Copy KV slots [0, length) of row ``src_row`` of cache pytree
+    ``src`` into row ``dst_row`` of ``dst``, in place.
+
+    ``dst`` is DONATED (returned aliased — the caller re-threads it, so
+    an engine cache keeps its buffer pointers across prefix-hit
+    admissions); ``src`` is read-only. Rows are traced; ``length`` is
+    the one static axis (a 16-multiple), so compiles are bounded by
+    distinct hit/store buckets, not admissions. Iterates
+    :func:`models.quant.kv_layer_keys` per layer, so an int8 cache's
+    ``ks``/``vs`` scale vectors copy alongside the int8 slots."""
+    zero = jnp.zeros((), dst_row.dtype)
+    out = []
+    for dl, sl in zip(dst, src):
+        nl = {}
+        for name in kv_layer_keys(dl):
+            seg = jax.lax.dynamic_slice(
+                sl[name], (src_row, zero, zero, zero),
+                (1, length) + sl[name].shape[2:])
+            nl[name] = jax.lax.dynamic_update_slice(
+                dl[name], seg.astype(dl[name].dtype),
+                (dst_row, zero, zero, zero))
+        out.append(nl)
+    return out
+
+
+def _floor_grain(n: int) -> int:
+    return (n // GRAIN) * GRAIN
+
+
+class _TrieNode:
+    """One radix-trie node: children keyed by the next 16-token chunk's
+    bytes; ``rows`` = pool rows whose stored prefix passes through this
+    node (i.e. covers this depth) — lookup's hit set at this depth."""
+
+    __slots__ = ("children", "rows")
+
+    def __init__(self):
+        self.children: Dict[bytes, "_TrieNode"] = {}
+        self.rows: set = set()
+
+
+class PrefixCache:
+    """Host-side prefix trie + bounded device KV pool with LRU eviction
+    and refcounts.
+
+    Construct with the SAME :class:`TransformerConfig` as the engine
+    (the pool rows must be shape- and quantization-identical to the
+    engine's cache rows) and attach via
+    ``ServingEngine(..., prefix_cache=...)``. One PrefixCache may serve
+    several engines over the same config — the pool is keyed by tokens,
+    not by engine.
+
+    Host memory is O(stored tokens); device memory is exactly
+    ``pool_rows`` cache rows (``2 * n_layers * max_len * kv_heads * Dh``
+    elements each, plus scales when quantized). Internal counters
+    (``hits``/``misses``/``stores``/``store_skips``/``evictions``/
+    ``reclaimed_tokens``) feed the engine ledger and the bench line;
+    stores/evictions/pool occupancy also mirror into the metrics
+    registry (docs/observability.md §prefix counters). Registry binding:
+    an explicit ``registry`` argument is pinned; otherwise the FIRST
+    attaching engine binds its own registry and later engines sharing
+    the cache inherit that binding — when engines with different
+    registries must share a cache, pin the registry explicitly so the
+    store/evict series land where you expect.
+    """
+
+    def __init__(self, cfg, pool_rows: int = 8, registry=None):
+        if pool_rows < 1:
+            raise ValueError(f"pool_rows must be >= 1, got {pool_rows}")
+        self.cfg = cfg
+        self.pool_rows = pool_rows
+        self.pool = init_kv_cache(cfg, pool_rows, dtype=cfg.compute_dtype)
+        # Resolved lazily (see ``registry``): an explicit registry wins;
+        # otherwise the attaching engine binds its own at construction
+        # (ServingEngine.__init__), so the store/evict/pool series land
+        # in the SAME snapshot as the engine's hit/miss mirrors instead
+        # of splitting across two registries; unattached caches fall
+        # back to the process default.
+        self._registry = registry
+        self._free: List[int] = list(range(pool_rows))[::-1]
+        self._root = _TrieNode()
+        self._len: Dict[int, int] = {}        # row -> stored prefix length
+        self._tokens: Dict[int, np.ndarray] = {}  # row -> stored tokens
+        self._refs: Dict[int, int] = {}       # row -> in-flight copies
+        self._used: Dict[int, int] = {}       # row -> LRU clock stamp
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.store_skips = 0
+        self.evictions = 0
+        self.reclaimed_tokens = 0
+
+    # -- bookkeeping --------------------------------------------------
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None \
+            else obs_metrics.registry
+
+    @property
+    def rows_used(self) -> int:
+        return self.pool_rows - len(self._free)
+
+    def stored_len(self, row: int) -> int:
+        return self._len.get(row, 0)
+
+    def refcount(self, row: int) -> int:
+        return self._refs.get(row, 0)
+
+    def _touch(self, row: int) -> None:
+        self._clock += 1
+        self._used[row] = self._clock
+
+    def _chunks(self, tokens: np.ndarray, length: int):
+        for d in range(length // GRAIN):
+            yield tokens[d * GRAIN:(d + 1) * GRAIN].tobytes()
+
+    def _descend(self, prompt: np.ndarray, limit: int):
+        """Walk the trie along ``prompt``'s 16-chunks up to ``limit``
+        tokens; returns ``(node, depth)`` for the DEEPEST node holding
+        live rows (``(None, 0)`` on a clean miss) — the one walk both
+        :meth:`lookup` (hit selection) and :meth:`store_from` (coverage
+        dedup) are defined by, so hit and dedup semantics cannot
+        drift apart."""
+        node = self._root
+        best, best_depth = None, 0
+        for d in range(limit // GRAIN):
+            key = prompt[d * GRAIN:(d + 1) * GRAIN].tobytes()
+            node = node.children.get(key)
+            if node is None:
+                break
+            if node.rows:
+                best, best_depth = node, (d + 1) * GRAIN
+        return best, best_depth
+
+    # -- refcounts ----------------------------------------------------
+
+    def acquire(self, row: int) -> None:
+        """Pin ``row`` against eviction while a copy out of it is in
+        flight; pair with :meth:`release`."""
+        if row not in self._len:
+            raise KeyError(f"pool row {row} holds no prefix")
+        self._refs[row] = self._refs.get(row, 0) + 1
+
+    def release(self, row: int) -> None:
+        n = self._refs.get(row, 0)
+        if n <= 0:
+            raise RuntimeError(f"release of unacquired pool row {row}")
+        self._refs[row] = n - 1
+
+    # -- lookup / load ------------------------------------------------
+
+    def lookup(self, prompt: np.ndarray) -> Tuple[Optional[int], int]:
+        """Longest stored prefix of ``prompt``, at 16-token granularity:
+        returns ``(pool_row, hit_len)`` or ``(None, 0)``.
+
+        The hit is capped at the largest GRAIN multiple <= prompt_len-1:
+        the admission must still compute at least the prompt's last
+        position itself (the first-token logits live at prompt_len - 1
+        and are never stored). Counts hits/misses/reclaimed tokens and
+        touches the donor's LRU stamp."""
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        limit = _floor_grain(int(prompt.shape[0]) - 1)
+        node, hit = self._descend(prompt, limit)
+        row = None
+        if hit:
+            row = max(node.rows, key=lambda r: self._used.get(r, 0))
+            self.hits += 1
+            self.reclaimed_tokens += hit
+            self._touch(row)
+        else:
+            self.misses += 1
+        return row, hit
+
+    def load_into(self, cache, dst_row: int, row: int, length: int):
+        """Copy stored slots [0, length) of pool row ``row`` into row
+        ``dst_row`` of the (donated) engine ``cache``; returns the
+        re-threaded cache. Refcounted around the device dispatch, so a
+        concurrent :meth:`store_from` cannot evict the donor from under
+        the copy."""
+        if length % GRAIN or length < GRAIN:
+            raise ValueError(f"length must be a positive multiple of "
+                             f"{GRAIN}, got {length}")
+        if self._len.get(row, 0) < length:
+            raise ValueError(
+                f"pool row {row} holds {self._len.get(row, 0)} slots, "
+                f"asked for {length} (evicted under the caller?)")
+        self.acquire(row)
+        try:
+            cache = copy_kv_rows(cache, self.pool, jnp.int32(dst_row),
+                                 jnp.int32(row), length=length)
+        finally:
+            self.release(row)
+        return cache
+
+    # -- store / evict ------------------------------------------------
+
+    def _evictable(self) -> Optional[int]:
+        """LRU row with no in-flight copies, or None."""
+        rows = [r for r in self._len if self._refs.get(r, 0) == 0]
+        if not rows:
+            return None
+        return min(rows, key=lambda r: self._used.get(r, 0))
+
+    def _evict(self, row: int) -> None:
+        tokens, length = self._tokens[row], self._len[row]
+        node = self._root
+        path = []
+        for key in self._chunks(tokens, length):
+            path.append((node, key))
+            node = node.children[key]
+            node.rows.discard(row)
+        # Prune now-empty branches bottom-up so the trie stays O(stored
+        # tokens), not O(ever-stored tokens).
+        for parent, key in reversed(path):
+            child = parent.children[key]
+            if not child.rows and not child.children:
+                del parent.children[key]
+        del self._tokens[row], self._len[row]
+        self._used.pop(row, None)
+        self._refs.pop(row, None)
+        self._free.append(row)
+        self.evictions += 1
+        self.registry.counter("serving_prefix_evictions_total").inc()
+
+    def store_from(self, cache, src_row: int, prompt: np.ndarray) -> int:
+        """Store ``prompt``'s longest GRAIN-aligned prefix from row
+        ``src_row`` of the engine ``cache`` into the pool; returns the
+        stored length (0 when skipped).
+
+        Called by the engine right after an admission's final chunk —
+        the row then holds valid K/V for [0, prompt_len), computed (or
+        copied) by the canonical chunked path, so the stored bits equal
+        what any later admission of the same prefix would recompute.
+        Skips when the prefix is already covered at least as deep, or
+        when every pool row is refcount-pinned; evicts the LRU row when
+        the pool is full."""
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        length = _floor_grain(int(prompt.shape[0]))
+        if length == 0:
+            return 0
+        # Covered already? The same walk lookup hits by, without
+        # counting a hit/miss.
+        _, covered = self._descend(prompt, length)
+        if covered >= length:
+            self.store_skips += 1
+            return 0
+        if self._free:
+            row = self._free.pop()
+        else:
+            row = self._evictable()
+            if row is None:  # every row pinned by in-flight copies
+                self.store_skips += 1
+                return 0
+            self._evict(row)
+            row = self._free.pop()
+        self.pool = copy_kv_rows(self.pool, cache, jnp.int32(row),
+                                 jnp.int32(src_row), length=length)
+        tokens = prompt[:length].copy()
+        node = self._root
+        for key in self._chunks(tokens, length):
+            node = node.children.setdefault(key, _TrieNode())
+            node.rows.add(row)
+        self._len[row] = length
+        self._tokens[row] = tokens
+        self._touch(row)
+        self.stores += 1
+        self.registry.counter("serving_prefix_stores_total").inc()
+        self.registry.gauge("serving_prefix_pool_rows_used").set(
+            self.rows_used)
+        return length
+
+    # -- observability ------------------------------------------------
+
+    def summary(self) -> dict:
+        """The bench/ledger block: hit traffic, pool state, reclaim."""
+        total = self.hits + self.misses
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "prefix_reclaimed_prefill_tokens": self.reclaimed_tokens,
+            "prefix_stores": self.stores,
+            "prefix_store_skips": self.store_skips,
+            "prefix_evictions": self.evictions,
+            "prefix_pool_rows_used": self.rows_used,
+            "prefix_pool_rows": self.pool_rows,
+        }

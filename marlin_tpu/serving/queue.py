@@ -52,6 +52,13 @@ class Request:
     submit_round: int = 0
     submit_time: float = 0.0
     # Engine-owned lifecycle fields:
+    key: Optional[np.ndarray] = None  # (2,) uint32 per-request PRNG root,
+    # derived at admission as fold_in(engine key, request_id) — fully
+    # determined at submit (the id is fixed there) but materialized
+    # lazily so submit stays device-dispatch-free: the WHOLE of this
+    # request's sampling randomness — first token and decode stream both
+    # derive from it, so sampled outputs replay per request regardless
+    # of batch composition (engine docstring, sampled-path contract).
     row: int = -1
     admit_round: int = -1
     admit_time: float = 0.0

@@ -143,6 +143,78 @@ def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
     return cache, buf, prompt_len + 1, first
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "final"),
+    donate_argnums=(1, 2),
+)
+@jax.named_scope("marlin.serving.prefill_chunk_into_row")
+def prefill_chunk_into_row(params, cache, buf, row, chunk, start, chunk_len,
+                           prompt, prompt_len, key, cfg,
+                           temperature: float = 0.0, final: bool = False):
+    """One admission-prefill CHUNK into batch row ``row``, in place — the
+    chunked-admission sibling of :func:`prefill_into_row` (the engine's
+    prefix-reuse/chunked mode; the one-shot flash path above stays the
+    default). Computes K/V for prompt positions [start, start+chunk_len)
+    through :func:`models.transformer.prefill_chunk` against the row's
+    OWN cache prefix — which must already hold [0, start): earlier
+    chunks, or a prefix-cache copy (serving/prefix.py) — and writes them
+    into the row.
+
+    Shapes and compiles: ``row``/``start``/``chunk_len``/``prompt_len``
+    are traced; the static axes are the padded chunk length (a 16-bucket
+    <= the engine's chunk size), the padded prompt length (a 16-bucket,
+    used only when ``final``), and the ``final`` flag — so the compile
+    count is bounded by distinct 16-buckets, not by admissions or chunk
+    schedules.
+
+    ``final=False`` (an interior chunk): K/V only; ``prompt``/``key``
+    are ignored (pass the chunk and any key) and the token buffer rides
+    through untouched. Returns ``(cache, buf)``.
+
+    ``final=True`` (the chunk reaching ``prompt_len``): additionally
+    samples the request's first token from the logits at
+    ``prompt_len - 1`` and writes the row's whole token buffer (real
+    prompt, zeros past it, first token at ``prompt_len`` — exactly
+    :func:`prefill_into_row`'s buffer contract, wiping the previous
+    occupant's stale tokens). Returns ``(cache, buf, first)``.
+
+    Bit-exactness: the chunk body is per-position (transformer.
+    _chunk_states), so any 16-aligned chunk split of a prompt — and any
+    prefix-copy + tail-chunk split — produces bit-identical cache rows
+    and first-token logits (tests/test_prefix_cache.py). Exactness vs
+    the flash one-shot path is ARGMAX-level, not bitwise (different
+    attention kernels); the engine therefore never mixes the two
+    disciplines within one mode (docs/serving.md §prefix cache)."""
+    zero = jnp.zeros((), row.dtype)
+    row_cache = [
+        {name: jax.lax.dynamic_slice_in_dim(layer[name], row, 1, axis=0)
+         for name in layer}
+        for layer in cache
+    ]
+    logits, row_cache = tr.prefill_chunk(
+        params, row_cache, chunk[None], start, cfg, last=chunk_len - 1)
+    cache = [
+        {name: jax.lax.dynamic_update_slice_in_dim(
+            layer[name], row_layer[name].astype(layer[name].dtype),
+            row, axis=0)
+         for name in layer}
+        for layer, row_layer in zip(cache, row_cache)
+    ]
+    if not final:
+        return cache, buf
+    first = tr._sample(logits, temperature, key)[0]
+    length = buf.shape[1]
+    rowbuf = jnp.zeros((length,), buf.dtype)
+    rowbuf = jax.lax.dynamic_update_slice(rowbuf, prompt.astype(buf.dtype),
+                                          (0,))
+    rowbuf = jnp.where(jnp.arange(length) < prompt_len, rowbuf, 0)
+    rowbuf = jax.lax.dynamic_update_slice(
+        rowbuf, first[None].astype(buf.dtype), (prompt_len,))
+    buf = jax.lax.dynamic_update_slice(buf, rowbuf[None], (row, zero))
+    return cache, buf, first
+
+
 class SlotManager:
     """Host-side request -> batch-row bookkeeping for the engine.
 

@@ -124,6 +124,13 @@ class EngineStats:
     tokens_out: int = 0
     total_iters: int = 0        # decode iterations across all rounds
     useful_row_iters: int = 0   # sum of per-row live iterations
+    # Prefix-reuse ledger (serving/prefix.py; zero in engines without a
+    # prefix cache): lookup traffic plus the prompt positions and
+    # cost-model-priced FLOPs admission prefill did NOT recompute.
+    n_prefix_hits: int = 0
+    n_prefix_misses: int = 0
+    reclaimed_prefill_tokens: int = 0
+    reclaimed_prefill_flops: float = 0.0
     rounds: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
     completed: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
 
@@ -138,6 +145,34 @@ class EngineStats:
                 # the submit -> admission-dispatch wall-clock.
                 self.registry.histogram("serving_ttft_seconds").observe(
                     max(0.0, req.admit_time - req.submit_time))
+
+    def record_prefix_lookup(self, hit_len: int, prompt_len: int) -> None:
+        """One admission's prefix-cache outcome: ``hit_len`` prompt
+        positions (0 = miss) whose prefill the engine skipped. Prices the
+        skipped work with the admission cost model (hit-length term,
+        utils/cost_model.admission_cost) when ``cfg`` is present."""
+        if hit_len:
+            self.n_prefix_hits += 1
+            self.reclaimed_prefill_tokens += hit_len
+            if self.cfg is not None:
+                cold, _ = cm.admission_cost(self.cfg, prompt_len)
+                warm, _ = cm.admission_cost(self.cfg, prompt_len,
+                                            hit_len=hit_len)
+                self.reclaimed_prefill_flops += cold - warm
+        else:
+            self.n_prefix_misses += 1
+        if self.registry is not None:
+            name = "serving_prefix_hits_total" if hit_len \
+                else "serving_prefix_misses_total"
+            self.registry.counter(name).inc()
+            if hit_len:
+                self.registry.counter(
+                    "serving_prefix_reclaimed_prefill_tokens_total").inc(
+                        hit_len)
+
+    def prefix_hit_rate(self) -> float:
+        total = self.n_prefix_hits + self.n_prefix_misses
+        return self.n_prefix_hits / total if total else 0.0
 
     def record_timeout(self, req) -> None:
         self.n_timeout += 1
@@ -245,6 +280,16 @@ class EngineStats:
             "wasted_row_iters": self.wasted_row_iters,
             "utilization": round(self.utilization(), 4),
         }
+        if self.n_prefix_hits or self.n_prefix_misses:
+            out.update({
+                "prefix_hits": self.n_prefix_hits,
+                "prefix_misses": self.n_prefix_misses,
+                "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
+                "prefix_reclaimed_prefill_tokens":
+                    self.reclaimed_prefill_tokens,
+                "prefix_reclaimed_prefill_gflops": round(
+                    self.reclaimed_prefill_flops / 1e9, 4),
+            })
         done = [c for c in self.completed if c["status"] == "done"]
         if done:
             waits = [c["queue_wait_rounds"] for c in done]

@@ -42,6 +42,9 @@ __all__ = [
     "serving_trend_model", "run_serving_trend_sweep",
     "SERVING_TREND_GRID",
     "powerlaw_fit", "run_gemm_trend_sweep", "GEMM_TREND_GRID",
+    "admission_cost",
+    "run_lu_trend_sweep", "LU_TREND_GRID",
+    "run_cholesky_trend_sweep", "CHOLESKY_TREND_GRID",
 ]
 
 
@@ -225,6 +228,56 @@ def decode_step_cost(cfg, batch: int, param_itemsize: int = 4,
     else:
         p_bytes = float(params * param_itemsize)
     byts = p_bytes + cache_bytes + cache_bytes / cache_len
+    return flops, float(byts)
+
+
+def admission_cost(cfg, prompt_len: int, hit_len: int = 0,
+                   chunk: Optional[int] = None,
+                   param_itemsize: int = 4) -> Tuple[float, float]:
+    """(flops, bytes) of ONE serving admission prefill with a
+    shared-prefix hit of ``hit_len`` positions (serving/prefix.py): the
+    engine computes only the TAIL [hit_len, prompt_len) and copies the
+    hit's K/V rows instead of recomputing them — the hit-length term the
+    prefix cache's reclaimed-FLOPs ledger is priced with
+    (stats.EngineStats.record_prefix_lookup).
+
+    FLOPs: the tail's matmul work (``2 * params`` per position — the
+    same per-position pricing as :func:`decode_step_cost`) plus the
+    causal attention triangle the tail positions actually compute,
+    sum_{p in [hit, s)} of (p + 1) keys per head — quadratic in the
+    prompt for a cold admission, collapsing to the thin tail wedge on a
+    hit. ``hit_len == 0`` is the cold admission; the reclaimed figure
+    for a hit is ``cost(s, 0) - cost(s, hit)``.
+
+    Bytes: the parameter set streams once per CHUNK dispatch (the
+    chunked admission path re-reads the weights per chunk — pass
+    ``chunk`` to price that; default one stream), plus the tail's cache
+    writes and the hit copy's read+write traffic (int8 caches price
+    slots at 1 byte plus the per-vector f32 scale, exactly as
+    :func:`decode_step_cost` does)."""
+    if not 0 <= hit_len <= prompt_len:
+        raise ValueError(
+            f"hit_len {hit_len} outside [0, {prompt_len}]")
+    params = transformer_param_count(cfg)
+    dh = cfg.d_model // cfg.n_heads
+    tail = prompt_len - hit_len
+
+    def tri(n):
+        return n * (n + 1) / 2.0
+
+    attn_macs = 2.0 * cfg.n_layers * cfg.n_heads * dh \
+        * (tri(prompt_len) - tri(hit_len))
+    flops = 2.0 * params * tail + 2.0 * attn_macs
+    # Per-position cache traffic: 2 * layers * Hk * Dh elements (K + V).
+    pos_elems = 2 * cfg.n_layers * cfg.kv_heads * dh
+    if getattr(cfg, "kv_quant", ""):
+        pos_bytes = pos_elems * 1.0 + (pos_elems // dh) * 4.0
+    else:
+        pos_bytes = float(pos_elems * param_itemsize)
+    n_streams = -(-tail // chunk) if (chunk and tail) else (1 if tail else 0)
+    byts = n_streams * params * float(param_itemsize) \
+        + tail * pos_bytes \
+        + 2.0 * hit_len * pos_bytes  # pool read + row write of the copy
     return flops, float(byts)
 
 
@@ -618,7 +671,6 @@ def run_serving_trend_sweep(cfg=None, grid=SERVING_TREND_GRID,
 
     cfg = cfg or tr.TransformerConfig(
         vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128, max_len=96)
-    key = jax.random.PRNGKey(0)
     params = tr.init_params(cfg, seed=0)  # shared: never donated/mutated
     out = []
     for pt in grid:
@@ -633,12 +685,15 @@ def run_serving_trend_sweep(cfg=None, grid=SERVING_TREND_GRID,
         state = {"cache": tr.init_kv_cache(cfg, b),
                  "buf": jnp.zeros((b, cfg.max_len), jnp.int32)}
 
+        keys = jnp.zeros((b, 2), jnp.uint32)  # greedy: streams unused
+
         def step(state=state, filled=filled, target=target, done0=done0,
-                 rs=rs):
-            state["buf"], _, _, state["cache"], iters, _ = _decode_round(
-                params, state["cache"], state["buf"], filled, target,
-                done0, key, cfg=cfg, round_steps=rs, temperature=0.0,
-                eos_id=None)
+                 rs=rs, keys=keys):
+            state["buf"], _, _, state["cache"], iters, _, _ = \
+                _decode_round(
+                    params, state["cache"], state["buf"], filled, target,
+                    done0, keys, cfg=cfg, round_steps=rs, temperature=0.0,
+                    eos_id=None)
             return iters
 
         measured = measure_wallclock(step, reps=reps)
@@ -688,6 +743,84 @@ def run_gemm_trend_sweep(mesh=None, grid=GEMM_TREND_GRID, reps: int = 3):
                                 reps=reps)
     return [{"n": p["m"], "predicted": p["predicted"],
              "measured": p["measured"]} for p in pts]
+
+
+# LU / Cholesky n-sweeps (ROADMAP item 2, next slice after the GEMM
+# one): same recipe — n-doubling square grids whose model FLOPs term is
+# exactly n^3 (8x per step), measured through OUR blocked factorizations
+# (mode="dist" with a small base so the panel path runs, not LAPACK),
+# scored with the same powerlaw_fit exponent + residual contract. The
+# smallest point is sized so the panel GEMMs dominate the host panel
+# loop's dispatch overhead.
+LU_TREND_GRID = (256, 512, 1024)
+CHOLESKY_TREND_GRID = (256, 512, 1024)
+
+
+def _factor_trend_sweep(grid, make_input, factor_fn, model_coeff, reps):
+    """Shared n-sweep recipe for the blocked factorizations: inputs are
+    built (and fenced) OUTSIDE the timed region — an SPD construction's
+    own 2n^3 matmul would otherwise dominate the potrf term it is
+    supposed to validate."""
+    import jax
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    out = []
+    for n in grid:
+        a = make_input(rng, n)
+        jax.block_until_ready(a)
+        out.append({
+            "n": n,
+            "predicted": model_coeff * float(n) ** 3,
+            "measured": measure_wallclock(
+                lambda a=a: factor_fn(a), reps=reps),
+        })
+    return out
+
+
+def run_lu_trend_sweep(grid=LU_TREND_GRID, reps: int = 3,
+                       base_size: int = 64):
+    """Square-LU n-sweep through the blocked panel factorization
+    (linalg/lu._lu_blocked via ``mode="dist"``): measured wall-clock
+    paired with the (2/3) n^3 getrf FLOPs term. The test asserts the
+    measured exponent lands in a band around 3 with a bounded log-fit
+    residual; the bench trend line reports both (same contract as
+    :func:`run_gemm_trend_sweep`)."""
+    import jax.numpy as jnp
+
+    from ..linalg.lu import lu_factor_array
+
+    def make(rng, n):
+        return jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+
+    def factor(a):
+        packed, _ = lu_factor_array(a, mode="dist", base_size=base_size)
+        return packed
+
+    return _factor_trend_sweep(grid, make, factor, 2.0 / 3.0, reps)
+
+
+def run_cholesky_trend_sweep(grid=CHOLESKY_TREND_GRID, reps: int = 3,
+                             base_size: int = 64):
+    """Square-Cholesky n-sweep through the recursive-halving blocked
+    factorization (linalg/cholesky via ``mode="dist"``): measured
+    wall-clock paired with the (1/3) n^3 potrf FLOPs term, same
+    exponent-band + residual contract as the LU/GEMM slices. Inputs are
+    made SPD (G G^T + n I, built outside the timed region) from the
+    same deterministic generator."""
+    import jax.numpy as jnp
+
+    from ..linalg.cholesky import cholesky_factor_array
+
+    def make(rng, n):
+        g = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        return g @ g.T + n * jnp.eye(n, dtype=g.dtype)
+
+    def factor(a):
+        return cholesky_factor_array(a, mode="dist", base_size=base_size)
+
+    return _factor_trend_sweep(grid, make, factor, 1.0 / 3.0, reps)
 
 
 def trend_verdict(points) -> dict:

@@ -278,12 +278,16 @@ class TestMetricsAttachment:
 
 
 class TestServingTraceSmoke:
-    def test_bench_serving_writes_loadable_trace(self, tmp_path):
+    def test_bench_serving_trace_prefix_line_and_slo_gate(self, tmp_path):
         # Tier-1-safe smoke (CPU mesh, tiny knobs): `bench.py --config
-        # serving` must produce an artifact line carrying the metrics
-        # block (counters + TTFT/per-token histograms) and export a
-        # Chrome/Perfetto trace JSON that json.load()s — the PR-3
-        # acceptance bar, end to end through the real entry point.
+        # serving` must produce artifact lines carrying the metrics
+        # block (counters + TTFT/per-token histograms), export a
+        # Chrome/Perfetto trace JSON that json.load()s, and include the
+        # shared-prefix reuse line (hit rate, reclaimed tokens, >= 1.3x
+        # cache-on wall-clock, zero recompiles in both arms) — then the
+        # whole artifact must pass tools/slo_check.py against the
+        # COMMITTED baseline, which is how an SLO regression fails fast
+        # in tier-1 instead of rounds later in a bench diff.
         import os
         import subprocess
         import sys
@@ -294,10 +298,13 @@ class TestServingTraceSmoke:
             BENCH_TRACE_PATH=str(trace_path), BENCH_SRV_D="32",
             BENCH_SRV_L="2", BENCH_SRV_REQS="6", BENCH_SRV_SHORT="3",
             BENCH_SRV_LONG="10", BENCH_SRV_ROUND="4",
-            BENCH_SRV_VOCAB="64")
+            BENCH_SRV_VOCAB="64", BENCH_SRV_PREQS="10",
+            BENCH_SRV_PREFIX="64", BENCH_SRV_TAIL="6",
+            BENCH_SRV_PSTEPS="3", BENCH_SRV_CHUNK="16",
+            BENCH_SRV_POOL="2")
         r = subprocess.run(
             [sys.executable, "bench.py", "--config", "serving"],
-            capture_output=True, text=True, timeout=240, env=env)
+            capture_output=True, text=True, timeout=300, env=env)
         assert r.returncode == 0, r.stderr[-800:]
         lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
         (line,) = [d for d in lines
@@ -319,6 +326,24 @@ class TestServingTraceSmoke:
         assert {"serving.round", "serving.decode_round"} <= names
         for e in evs:
             assert e["ph"] == "X" and "ts" in e and "dur" in e
+        # The shared-prefix reuse line (ROADMAP item 10 follow-up).
+        (pline,) = [d for d in lines
+                    if d["metric"] == "serving_prefix_reuse_speedup"]
+        assert pline["value"] >= 1.3, pline
+        assert pline["prefix_hit_rate"] >= 0.5
+        assert pline["prefix_reclaimed_prefill_tokens"] > 0
+        assert pline["recompiles_after_warmup"] == 0
+        assert pline["recompiles_after_warmup_off"] == 0
+        assert pline["metrics"]["counters"][
+            "serving_prefix_hits_total"] > 0
+        # The SLO gate, end to end: artifact -> committed baseline.
+        artifact = tmp_path / "serving_artifact.jsonl"
+        artifact.write_text(r.stdout)
+        slo = subprocess.run(
+            [sys.executable, "tools/slo_check.py", str(artifact)],
+            capture_output=True, text=True, timeout=60)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "SLO OK" in slo.stdout
 
 
 class TestCaptureSummaryHistory:

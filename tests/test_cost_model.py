@@ -350,3 +350,44 @@ class TestAttentionBlockModel:
         # move far fewer bytes than the causal sweep.
         _, byts_w = cm.flash_attention_cost(s, h, d, bq, bk, window=1024)
         assert byts_w < 0.6 * byts
+
+
+class TestAdmissionCostModel:
+    """The serving admission model's hit-length term (PR 4; priced into
+    EngineStats.reclaimed_prefill_flops — the deeper behavioral checks
+    live in tests/test_prefix_cache.py next to the engine they price)."""
+
+    def _cfg(self):
+        from marlin_tpu.models import TransformerConfig
+
+        return TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=256)
+
+    def test_cold_admission_scales_with_prompt(self):
+        cfg = self._cfg()
+        f1, _ = cm.admission_cost(cfg, 64)
+        f2, _ = cm.admission_cost(cfg, 128)
+        assert f2 > 2 * f1  # superlinear: matmul term + attention triangle
+
+    def test_hit_zero_is_the_cold_cost(self):
+        cfg = self._cfg()
+        assert cm.admission_cost(cfg, 96) == cm.admission_cost(
+            cfg, 96, hit_len=0)
+
+
+class TestFactorTrendPrograms:
+    def test_factor_sweep_programs_compile_early(self):
+        # Deliberately EARLY in the suite (this module sorts near the
+        # front): one reps=1 pass of each factor sweep compiles the
+        # blocked LU panel / Cholesky core programs at the grid shapes
+        # into the process-global jit cache, so the real sweep fixtures
+        # in tests/test_trend_sweep.py (which run ~650 tests later in
+        # tier-1's single-core process) measure CACHE-HIT dispatches
+        # instead of paying fresh LLVM compiles at hour N — a late
+        # backend_compile of exactly these programs segfaulted XLA CPU
+        # once in a full-suite run; fresh/short processes never have.
+        for sweep in (cm.run_lu_trend_sweep(reps=1),
+                      cm.run_cholesky_trend_sweep(reps=1)):
+            assert len(sweep) == 3
+            for p in sweep:
+                assert p["measured"] > 0 and p["predicted"] > 0

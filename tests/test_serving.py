@@ -17,6 +17,12 @@ The three acceptance claims, each pinned mechanically:
   and token buffer stay in the SAME device buffers (donation aliasing)
   across every swap — the test_decode_donation.py contract extended to
   the serving loop.
+
+The PR-4 admission disciplines extend these pins in
+tests/test_prefix_cache.py: the chunked path's B=1-generate exactness,
+cache-on-vs-cache-off bitwise identity (incl. eviction pressure),
+prefix-hit pointer stability and compile bounds, and the sampled-path
+per-request key-stream invariance (greedy=False).
 """
 
 import numpy as np

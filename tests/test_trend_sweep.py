@@ -173,6 +173,58 @@ class TestGemmTrendSweep:
         assert fit["residual_rms"] < 0.75, (fit, sweep)
 
 
+class _FactorSweepContract:
+    """Shared contract for the blocked-factorization n-sweeps (ROADMAP
+    item 2, LU/Cholesky slice): model FLOPs term exactly n^3 (8x-spaced
+    along the n-doubling grid), measured rank agreement, and a measured
+    exponent inside a wide band around 3 with a bounded log-fit
+    residual. The band is generous for the same reason the GEMM slice's
+    is: a shared-host CPU mesh mixes BLAS-efficiency shifts and
+    per-panel dispatch overhead into the small end (memory-bound floor
+    ~n^2), but an op that stopped scaling with its model — constant-
+    dominated n^1, or n^4 from a re-materialization — still fails."""
+
+    model_coeff = None
+
+    def run_sweep(self):
+        raise NotImplementedError
+
+    @pytest.fixture(scope="class")
+    def sweep(self):
+        return self.run_sweep()
+
+    def test_model_term_is_exactly_n_cubed(self, sweep):
+        for p in sweep:
+            assert p["predicted"] == pytest.approx(
+                self.model_coeff * p["n"] ** 3)
+        preds = [p["predicted"] for p in sweep]
+        for lo, hi in zip(preds[:-1], preds[1:]):
+            assert hi == pytest.approx(8 * lo)
+
+    def test_rank_correlation_meets_bar(self, sweep):
+        assert cm.trend_verdict(sweep)["rho"] >= 0.9, sweep
+
+    def test_measured_exponent_band_and_residual(self, sweep):
+        fit = cm.powerlaw_fit([p["n"] for p in sweep],
+                              [p["measured"] for p in sweep])
+        assert 1.2 <= fit["exponent"] <= 4.2, (fit, sweep)
+        assert fit["residual_rms"] < 0.5, (fit, sweep)
+
+
+class TestLuTrendSweep(_FactorSweepContract):
+    model_coeff = 2.0 / 3.0
+
+    def run_sweep(self):
+        return cm.run_lu_trend_sweep()
+
+
+class TestCholeskyTrendSweep(_FactorSweepContract):
+    model_coeff = 1.0 / 3.0
+
+    def run_sweep(self):
+        return cm.run_cholesky_trend_sweep()
+
+
 class TestPowerlawFit:
     def test_recovers_exact_exponent(self):
         xs = [1, 2, 4, 8]

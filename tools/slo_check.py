@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""SLO regression check over serving bench artifacts (ROADMAP item 12:
+"runlog-based SLO regression checks", first slice).
+
+Compares the metrics of `bench.py --config serving` artifact lines —
+the continuous-vs-static ratio, the prefix-reuse speedup, utilization,
+`recompiles_after_warmup`, prefix hit rate, and the TTFT histogram from
+the attached obs metrics block — against a COMMITTED baseline JSON with
+explicit tolerances, so an SLO regression fails fast in the tier-1
+serving smoke instead of surfacing rounds later in a bench diff.
+
+Usage:
+    python tools/slo_check.py ARTIFACT.jsonl \
+        [--baseline tools/serving_slo_baseline.json]
+
+ARTIFACT.jsonl holds one JSON object per line (bench.py stdout, or a
+capture file). Exit code 0 = every check passed, 1 = violations (each
+printed as `VIOLATION: ...`), 2 = usage/shape errors (missing artifact
+metric, unreadable files) — a missing line is a failure, not a skip,
+so a config silently dropping out of the bench cannot pass the gate.
+
+Baseline schema (see tools/serving_slo_baseline.json):
+    {"metrics": {<metric name>: {<field>: <check>, ...}, ...}}
+where <check> is one of
+    {"min": x} / {"max": x}      bound on a numeric field of the line
+    {..., "optional": true}      field may be absent (skip, not fail)
+    {"histogram": <name>, "min_count": n, "max_mean_s": s}
+                                 bound on an attached obs histogram's
+                                 sample count and mean (sum / count)
+Bounds are exact; encode tolerance IN the committed bound (wall-clock
+fields get generous bounds — CI hosts are weather; the sharp teeth are
+the ratio / hit-rate / recompile checks, which are schedule-determined).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_BASELINE = "tools/serving_slo_baseline.json"
+
+
+def load_lines(path: str) -> List[dict]:
+    """Parse one-JSON-object-per-line artifacts; non-JSON lines are
+    skipped (bench stderr noise must not break the gate)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                out.append(obj)
+    return out
+
+
+def find_metric(lines: List[dict], name: str) -> Optional[dict]:
+    """LAST matching line wins (a rerun appended to the same artifact
+    supersedes earlier attempts)."""
+    found = None
+    for obj in lines:
+        if obj.get("metric") == name:
+            found = obj
+    return found
+
+
+def _check_histogram(line: dict, field: str, spec: dict) -> List[str]:
+    name = spec["histogram"]
+    hist = (line.get("metrics") or {}).get("histograms", {}).get(name)
+    if hist is None:
+        return [f"{field}: histogram {name!r} missing from the metrics "
+                "block"]
+    out = []
+    count = hist.get("count", 0)
+    if count < spec.get("min_count", 1):
+        out.append(f"{field}: {name} count {count} < "
+                   f"min_count {spec.get('min_count', 1)}")
+    if count and "max_mean_s" in spec:
+        mean = hist.get("sum", 0.0) / count
+        if mean > spec["max_mean_s"]:
+            out.append(f"{field}: {name} mean {mean:.4f}s > "
+                       f"max_mean_s {spec['max_mean_s']}")
+    return out
+
+
+def check_line(line: dict, checks: Dict[str, dict]) -> List[str]:
+    """Violations of ``checks`` (baseline block for one metric) in one
+    artifact line; empty list = pass."""
+    out = []
+    for field, spec in checks.items():
+        if "histogram" in spec:
+            out.extend(_check_histogram(line, field, spec))
+            continue
+        val = line.get(field)
+        if val is None:
+            if not spec.get("optional"):
+                out.append(f"{field}: missing from artifact line")
+            continue
+        if "min" in spec and val < spec["min"]:
+            out.append(f"{field}: {val} < min {spec['min']}")
+        if "max" in spec and val > spec["max"]:
+            out.append(f"{field}: {val} > max {spec['max']}")
+    return out
+
+
+def run_checks(lines: List[dict], baseline: dict):
+    """(violations, hard_errors) over every baseline metric block."""
+    violations, errors = [], []
+    for name, checks in baseline.get("metrics", {}).items():
+        line = find_metric(lines, name)
+        if line is None:
+            errors.append(f"metric {name!r} not found in the artifact")
+            continue
+        if line.get("unit") == "error":
+            errors.append(f"metric {name!r} is an error line: "
+                          f"{line.get('error', '?')}")
+            continue
+        violations.extend(f"{name}: {v}" for v in check_line(line, checks))
+    return violations, errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("artifact", help="bench artifact (JSON lines)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline JSON (default {DEFAULT_BASELINE})")
+    args = p.parse_args(argv)
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        lines = load_lines(args.artifact)
+    except (OSError, json.JSONDecodeError) as e:
+        # A malformed committed baseline is a shape error (exit 2 with a
+        # diagnostic), not a silent violation-class traceback.
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    violations, errors = run_checks(lines, baseline)
+    for e in errors:
+        print(f"ERROR: {e}")
+    for v in violations:
+        print(f"VIOLATION: {v}")
+    if errors:
+        return 2
+    if violations:
+        return 1
+    n = len(baseline.get("metrics", {}))
+    print(f"SLO OK: {n} metric(s) within baseline {args.baseline}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
