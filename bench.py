@@ -62,6 +62,7 @@ from benchlib.harness import (  # noqa: E402,F401 - re-exported surface
 from benchlib.configs_gemm import (  # noqa: E402,F401
     config_chained, config_dispatch_sweep, config_square_8k,
     config_summa_mesh, config_tall_skinny, headline)
+from benchlib.configs_http import config_http  # noqa: E402,F401
 from benchlib.configs_kernels import (  # noqa: E402,F401
     config_attention, config_attention_sweep, config_sparse)
 from benchlib.configs_linalg import (  # noqa: E402,F401

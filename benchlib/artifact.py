@@ -65,6 +65,7 @@ _CACHE_PREFIX = {
     "config_decode_int8": "decode_int8_tokens_per_s",
     "config_decode_spec": "decode_spec_tokens_per_s",
     "config_serving": "serving_continuous_vs_static",
+    "config_http": "serving_http_frontend",
 }
 
 

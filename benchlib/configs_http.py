@@ -1,0 +1,192 @@
+"""End-to-end HTTP serving bench: the artifact line for the PR-5
+frontend (docs/frontend.md).
+
+Boots the real server (marlin_tpu/serving/server.py) on an ephemeral
+port IN-PROCESS (background listener thread + engine driver thread —
+one shared metrics registry, so bench.main()'s attached metrics block
+carries the serving_http_* series next to the engine's), then drives it
+with tools/serving_client.py through the full network stack:
+
+* closed-loop streaming phase — end-to-end TTFT p50/p99, inter-token
+  latency, completions/s as a REAL client measures them (socket,
+  chunked SSE framing, handler threads included);
+* exactness phase — every prompt's streamed token sequence must be
+  byte-identical to its blocking response AND to an in-process
+  ``engine.run()`` golden of the same prompts (the bridge adds no
+  reordering, the acceptance-criteria form of the PR-2 bit-exactness
+  contract);
+* ``recompiles_after_warmup`` read FROM THE SCRAPED ``/metrics``
+  (obs_recompiles_total delta across the measured window) — the
+  zero-recompile guarantee as seen by an external scraper, not an
+  in-process handle;
+* overload phase — an open-loop burst past ``max_pending`` so the
+  429 backpressure path sheds for real (``overload_429_rate``);
+* scrape-latency samples taken WHILE the load runs (the registry lock
+  must give point-in-time consistent exports without stalling either
+  side);
+* SIGTERM-shaped drain via ``begin_drain`` (``drain_s``,
+  ``drain_ok``).
+
+tools/slo_check.py holds this line to the committed baseline's HTTP
+block in the tier-1 HTTP smoke (tests/test_frontend.py).
+"""
+
+import importlib.util
+import os
+import threading
+import time
+
+from .harness import _sized
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _load_client():
+    """tools/ is not a package; load serving_client.py by path (the
+    capture_summary idiom from tests/test_bench_harness.py)."""
+    spec = importlib.util.spec_from_file_location(
+        "serving_client", os.path.join(_TOOLS, "serving_client.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def config_http():
+    import numpy as np
+
+    from marlin_tpu.models import TransformerConfig, init_params
+    from marlin_tpu.serving import ServingEngine, serve
+
+    sc = _load_client()
+
+    d = _sized("BENCH_HTTP_D", 64)
+    batch = _sized("BENCH_HTTP_B", 4)
+    n_req = _sized("BENCH_HTTP_REQS", 12)
+    prompt_len = _sized("BENCH_HTTP_PROMPT", 16)
+    steps = _sized("BENCH_HTTP_STEPS", 12)
+    conc = _sized("BENCH_HTTP_CONC", 4)
+    round_steps = _sized("BENCH_HTTP_ROUND", 8)
+    max_pending = _sized("BENCH_HTTP_PEND", 16)
+    burst = _sized("BENCH_HTTP_BURST", max_pending + batch + 24)
+    n_scrapes = _sized("BENCH_HTTP_SCRAPES", 25)
+    cfg = TransformerConfig(
+        vocab=_sized("BENCH_HTTP_VOCAB", 256), d_model=d,
+        n_heads=max(2, d // 128), n_layers=_sized("BENCH_HTTP_L", 2),
+        d_ff=4 * d, max_len=prompt_len + max(steps, 3 * round_steps) + 4,
+        dtype="float32")
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab, prompt_len).astype(np.int32)
+               for _ in range(n_req)]
+
+    # In-process golden: the same engine discipline the server drives,
+    # minus every bridge/network layer — submission order is request id
+    # order, so golden[i] pairs with prompts[i].
+    golden_eng = ServingEngine(params, cfg, batch=batch,
+                               round_steps=round_steps, seed=0)
+    for p in prompts:
+        golden_eng.submit(p, steps)
+    golden = {r.request_id: list(map(int, r.tokens))
+              for r in golden_eng.run()}
+
+    server = serve(params, cfg, port=0, batch=batch,
+                   round_steps=round_steps, max_pending=max_pending,
+                   seed=0).start_background()
+    port = server.port
+    client = sc.ServingClient("127.0.0.1", port)
+    try:
+        # Warmup through the full stack (compiles happen here), then
+        # baseline the recompile counters FROM A SCRAPE — the external
+        # view the acceptance criterion names.
+        warm = client.stream(prompts[0], steps)
+        assert warm["code"] == 200, warm
+        warm_b = client.generate(prompts[0], steps)
+        assert warm_b["code"] == 200, warm_b
+
+        def scraped_recompiles():
+            samples = client.metrics()["samples"]
+            return sum(v for k, v in samples.items()
+                       if k.startswith("obs_recompiles_total"))
+
+        recompiles_before = scraped_recompiles()
+
+        # Measured phase: closed-loop streaming with concurrent
+        # /metrics scrapes riding along (scrape-consistency under load).
+        scrape_times = []
+        stop_scraping = threading.Event()
+
+        def scraper():
+            while not stop_scraping.is_set() \
+                    and len(scrape_times) < n_scrapes:
+                scrape_times.append(client.metrics()["scrape_s"])
+                time.sleep(0.02)
+
+        s_thread = threading.Thread(target=scraper, daemon=True)
+        s_thread.start()
+        load = sc.run_closed_loop("127.0.0.1", port, prompts, steps,
+                                  concurrency=conc, stream=True)
+        stop_scraping.set()
+        s_thread.join(10.0)
+        while len(scrape_times) < n_scrapes:  # top up if load was quick
+            scrape_times.append(client.metrics()["scrape_s"])
+        digest = sc.summarize(load["results"])
+        completions_per_s = digest["n_ok"] / load["wall_s"]
+
+        # Exactness: streamed == blocking == in-process golden, per
+        # prompt, byte for byte.
+        bitexact = digest["n_ok"] == n_req
+        for i, res in enumerate(load["results"]):
+            blocking = client.generate(prompts[i], steps)
+            gold = golden[i]
+            if not (res and res["tokens"] == blocking.get("tokens")
+                    == gold):
+                bitexact = False
+
+        # Overload: an open-loop burst the queue cannot absorb — the
+        # 429 shed path measured as a rate.
+        overload_steps = min(steps, 3 * round_steps)
+        o_prompts = [prompts[i % n_req] for i in range(burst)]
+        over = sc.run_open_loop("127.0.0.1", port, o_prompts,
+                                overload_steps, rate_per_s=10_000.0)
+        over_digest = sc.summarize(over["results"])
+        n_429 = over_digest["codes"].get("429", 0)
+
+        recompiles = scraped_recompiles() - recompiles_before
+    finally:
+        t_drain = time.perf_counter()
+        drain_ok = server.begin_drain(120.0)
+        drain_s = time.perf_counter() - t_drain
+
+    return {
+        "metric": "serving_http_frontend",
+        "value": round(completions_per_s, 3),
+        "unit": "req/s",
+        # The gate fields ARE the claim; vs_baseline reports whether
+        # both structural guarantees held through the network stack.
+        "vs_baseline": 1.0 if (bitexact and recompiles == 0) else 0.0,
+        "ttft_p50_s": round(digest.get("ttft_p50_s", 0.0), 5),
+        "ttft_p99_s": round(digest.get("ttft_p99_s", 0.0), 5),
+        "intertoken_mean_s": round(digest.get("intertoken_mean_s", 0.0),
+                                   6),
+        "intertoken_p99_s": round(digest.get("intertoken_p99_s", 0.0),
+                                  6),
+        "completions_per_s": round(completions_per_s, 3),
+        "wall_s": round(load["wall_s"], 4),
+        "streams_bitexact": bitexact,
+        "recompiles_after_warmup": int(recompiles),
+        "overload_requests": burst,
+        "overload_429s": n_429,
+        "overload_429_rate": round(n_429 / burst, 4),
+        "overload_codes": over_digest["codes"],
+        "metrics_scrape_p50_s": round(
+            sc.quantile(scrape_times, 0.50), 5),
+        "metrics_scrape_p99_s": round(
+            sc.quantile(scrape_times, 0.99), 5),
+        "drain_ok": bool(drain_ok),
+        "drain_s": round(drain_s, 4),
+        "n_requests": n_req, "concurrency": conc, "steps": steps,
+        "prompt_len": prompt_len, "batch": batch,
+        "round_steps": round_steps, "max_pending": max_pending,
+        "d_model": d,
+    }

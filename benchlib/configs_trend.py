@@ -34,9 +34,11 @@ def config_trend_cpu():
     gemm = cm.run_gemm_trend_sweep()
     lu = cm.run_lu_trend_sweep()
     chol = cm.run_cholesky_trend_sweep()
+    attn = cm.run_attention_trend_sweep()
     dv, sv = cm.trend_verdict(decode), cm.trend_verdict(summa)
     rv, gv = cm.trend_verdict(serving), cm.trend_verdict(gemm)
     lv, cv = cm.trend_verdict(lu), cm.trend_verdict(chol)
+    av = cm.trend_verdict(attn)
     # Early-exit cliff: the all-finished decode point against its
     # same-shape all-live twin (skew-proofing made the while_loop exit
     # before the first body; < 0.5 means the exit is real, not noise).
@@ -46,21 +48,26 @@ def config_trend_cpu():
     # Measured exponent vs each n^3 FLOPs term, plus the
     # measured-vs-model log-fit residual (the model-fit quality figure
     # item 2 asked for) — GEMM, and the ROADMAP-2 LU/Cholesky slices.
-    def fit(points):
-        f = cm.powerlaw_fit([p["n"] for p in points],
+    def fit(points, key="n"):
+        f = cm.powerlaw_fit([p[key] for p in points],
                             [p["measured"] for p in points])
         return round(f["exponent"], 3), round(f["residual_rms"], 4)
 
     gemm_exp, gemm_res = fit(gemm)
     lu_exp, lu_res = fit(lu)
     ch_exp, ch_res = fit(chol)
+    attn_exp, attn_res = fit(attn, key="s")
     rho_min = min(dv["rho"], sv["rho"], rv["rho"], gv["rho"], lv["rho"],
-                  cv["rho"])
+                  cv["rho"], av["rho"])
     return {"metric": "trend_rank_correlation_min", "value": rho_min,
             "unit": "rho", "vs_baseline": round(rho_min / 0.9, 3),
             "decode_rho": dv["rho"], "summa_rho": sv["rho"],
             "serving_rho": rv["rho"], "gemm_rho": gv["rho"],
             "lu_rho": lv["rho"], "cholesky_rho": cv["rho"],
+            "attention_rho": av["rho"],
+            "attention_exponent": attn_exp,
+            "attention_model_exponent": 2.0,
+            "attention_fit_residual_rms": attn_res,
             "gemm_exponent": gemm_exp,
             "gemm_model_exponent": 3.0,
             "gemm_fit_residual_rms": gemm_res,
@@ -81,7 +88,9 @@ def config_trend_cpu():
                             for p in gemm],
             "lu_points": [[p["n"], round(p["measured"], 5)] for p in lu],
             "cholesky_points": [[p["n"], round(p["measured"], 5)]
-                                for p in chol]}
+                                for p in chol],
+            "attention_points": [[p["s"], round(p["measured"], 5)]
+                                 for p in attn]}
 
 
 def config_serving():

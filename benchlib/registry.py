@@ -8,8 +8,8 @@ benchlib/artifact.py (tests/test_bench_harness.py enforces it), or it
 silently drops out of the dead-tunnel fallback.
 """
 
-from . import (configs_gemm, configs_kernels, configs_linalg, configs_ml,
-               configs_sparse, configs_trend)
+from . import (configs_gemm, configs_http, configs_kernels,
+               configs_linalg, configs_ml, configs_sparse, configs_trend)
 
 CONFIGS = {
     "headline": [configs_gemm.headline],
@@ -33,13 +33,14 @@ CONFIGS = {
     "trend": [configs_trend.config_trend_cpu],
     "serving": [configs_trend.config_serving,
                 configs_trend.config_serving_prefix],
+    "http": [configs_http.config_http],
     "sweep": [configs_gemm.config_dispatch_sweep],
     "attnsweep": [configs_kernels.config_attention_sweep],
 }
 # "all" = the artifact configs; the sweeps and the CPU-oriented
-# validation configs (trend, serving) are policy/tuning tools, run
-# explicitly.
+# validation configs (trend, serving, http) are policy/tuning tools,
+# run explicitly.
 CONFIGS["all"] = [
     fns[0] for k, fns in CONFIGS.items()
-    if k not in ("sweep", "attnsweep", "trend", "serving")
+    if k not in ("sweep", "attnsweep", "trend", "serving", "http")
 ]

@@ -12,11 +12,20 @@ counts, queue depth, and deadline drops; per request it emits the
 submit → admit (first token) → completion span timestamps — the raw
 material the TTFT and per-token-latency histograms in
 ``obs.metrics`` aggregate.
+
+With ``path`` set the log additionally STREAMS every event to that file
+as it is emitted (append, one JSON line each), so the on-disk JSONL is
+the UNBOUNDED record while the in-memory deque stays the bounded
+inspection window. The file handle is buffered — a process that exits
+without :meth:`flush` can lose the tail — which is exactly why the
+serving engine's drain path flushes before reporting
+``drain_complete`` (serving/engine.py, docs/frontend.md §drain).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -26,7 +35,8 @@ from typing import List, Optional
 class RunLog:
     """Thread-safe bounded structured event log."""
 
-    def __init__(self, maxlen: int = 4096, clock=time.monotonic):
+    def __init__(self, maxlen: int = 4096, clock=time.monotonic,
+                 path=None):
         if maxlen < 1:
             raise ValueError(f"maxlen must be >= 1, got {maxlen}")
         self.maxlen = maxlen
@@ -34,13 +44,35 @@ class RunLog:
         self._events: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self._n_emitted = 0  # exact, unlike len() past the cap
+        self.path = str(path) if path is not None else None
+        self._sink = open(self.path, "a") if self.path else None
 
     def emit(self, kind: str, **fields) -> dict:
         ev = {"kind": kind, "t": self._clock(), **fields}
         with self._lock:
             self._events.append(ev)
             self._n_emitted += 1
+            if self._sink is not None:
+                self._sink.write(json.dumps(ev, default=str) + "\n")
         return ev
+
+    def flush(self) -> None:
+        """Push buffered sink writes to the OS — the drain-path
+        guarantee that the JSONL tail survives process exit. A no-op
+        without ``path``."""
+        with self._lock:
+            if self._sink is not None:
+                self._sink.flush()
+                os.fsync(self._sink.fileno())
+
+    def close(self) -> None:
+        """Flush and close the file sink (idempotent); in-memory events
+        stay readable."""
+        with self._lock:
+            if self._sink is not None:
+                self._sink.flush()
+                self._sink.close()
+                self._sink = None
 
     def __len__(self) -> int:
         with self._lock:

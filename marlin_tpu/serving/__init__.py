@@ -9,8 +9,10 @@ that converts PR 1's "skew-proof" into reclaimed throughput
 """
 
 from .engine import ServingEngine, _decode_round
+from .frontend import EngineFrontend, FrontendError, FrontendRequest
 from .prefix import PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
+from .server import ServingHTTPServer, install_signal_handlers, serve
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
                     prefill_into_row)
 from .stats import (EngineStats, request_stats, static_completed_at_budget,
@@ -18,14 +20,20 @@ from .stats import (EngineStats, request_stats, static_completed_at_budget,
 
 __all__ = [
     "AdmissionQueue",
+    "EngineFrontend",
     "EngineStats",
+    "FrontendError",
+    "FrontendRequest",
     "PrefixCache",
     "QueueClosed",
     "QueueFull",
     "Request",
     "ServingEngine",
+    "ServingHTTPServer",
     "SlotManager",
     "copy_kv_rows",
+    "install_signal_handlers",
+    "serve",
     "pad_prompt_len",
     "prefill_chunk_into_row",
     "prefill_into_row",

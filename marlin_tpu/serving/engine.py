@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -292,6 +293,16 @@ class ServingEngine:
         # are returned from step()/run() and dropped here, so a
         # long-running engine holds O(batch + max_pending) requests.
         self.requests: Dict[int, Request] = {}
+        # Concurrent-submitter safety (the HTTP frontend's handler
+        # threads call submit() while the driver thread steps): this
+        # lock makes id allocation + queue submit + requests-dict insert
+        # one atomic unit, and the driver takes it for its own
+        # requests-dict mutations (admission pops, retire/timeout
+        # deletes). EVERYTHING else in the engine — device state, slots,
+        # stats, the round loop — remains single-threaded by contract:
+        # only submit() and close() may be called off the driver thread.
+        self._submit_lock = threading.Lock()
+        self._drain_reported = False
         # In-flight chunked admissions (row -> job); empty in the
         # default one-shot mode.
         self._prefilling: Dict[int, _PrefillJob] = {}
@@ -311,18 +322,28 @@ class ServingEngine:
     # -- submission ---------------------------------------------------
 
     def submit(self, prompt, steps: int,
-               deadline_rounds: Optional[int] = None) -> int:
+               deadline_rounds: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one generation request; returns its request id.
 
         ``prompt`` is a host/device 1-D int array; ``steps`` tokens will
-        be generated. Raises ``QueueFull`` (backpressure) or
-        ``QueueClosed`` (draining); validates against the cache extent
-        now so a hopeless request fails at submit, not at admission.
+        be generated. ``deadline_rounds`` (engine round index) and
+        ``deadline_s`` (wall-clock seconds from now — what an HTTP
+        caller's per-request deadline maps onto) both gate ADMISSION: a
+        request still queued past either is dropped with a timeout
+        status at pop time (queue.pop_ready). Raises ``QueueFull``
+        (backpressure) or ``QueueClosed`` (draining); validates against
+        the cache extent now so a hopeless request fails at submit, not
+        at admission. Thread-safe: handler threads may call this
+        concurrently with the driver thread's step()/run()
+        (``_submit_lock``; the queue carries its own lock).
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         s = int(prompt.shape[0])
         if steps < 1:
             raise ValueError(f"steps must be >= 1, got {steps}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         if s + steps > self.cfg.max_len:
             raise ValueError(
                 f"prompt {s} + steps {steps} exceeds max_len "
@@ -331,15 +352,21 @@ class ServingEngine:
             raise ValueError(
                 f"padded prompt {pad_prompt_len(s)} exceeds max_len "
                 f"{self.cfg.max_len}")
-        req = Request(request_id=self._next_id, prompt=prompt,
-                      steps=int(steps), deadline_rounds=deadline_rounds,
-                      submit_round=self.round_idx,
-                      submit_time=time.perf_counter())
-        self._next_id += 1
-        with self.tracer.span("serving.submit", scope=False,
-                              request_id=req.request_id):
-            self.queue.submit(req)
-        self.requests[req.request_id] = req
+        now = time.perf_counter()
+        with self._submit_lock:
+            req = Request(
+                request_id=self._next_id, prompt=prompt,
+                steps=int(steps), deadline_rounds=deadline_rounds,
+                deadline_time=(now + deadline_s
+                               if deadline_s is not None else None),
+                submit_round=self.round_idx, submit_time=now)
+            with self.tracer.span("serving.submit", scope=False,
+                                  request_id=req.request_id):
+                # Raises Full/Closed BEFORE the id advances or the
+                # request registers — a rejected submit leaves no trace.
+                self.queue.submit(req)
+            self._next_id += 1
+            self.requests[req.request_id] = req
         self.metrics.counter("serving_submitted_total").inc()
         self.metrics.gauge("serving_queue_depth").set(len(self.queue))
         self.runlog.emit("submit", request_id=req.request_id,
@@ -388,8 +415,10 @@ class ServingEngine:
                              round=self.round_idx,
                              deadline_rounds=req.deadline_rounds)
             # Same ownership transfer as retirement: timed-out requests
-            # go back to the caller, not into an ever-growing dict.
-            self.requests.pop(req.request_id, None)
+            # go back to the caller, not into an ever-growing dict (the
+            # lock pairs the delete with submit()'s insert).
+            with self._submit_lock:
+                self.requests.pop(req.request_id, None)
 
     def _admit(self) -> List[Request]:
         """Fill free slots from the queue (FIFO); returns timed-out
@@ -581,7 +610,8 @@ class ServingEngine:
             # (step()/run() return it); holding it here would grow host
             # memory without bound on a long-running server — the queue
             # bounds PENDING work, this bounds FINISHED work.
-            del self.requests[req.request_id]
+            with self._submit_lock:
+                del self.requests[req.request_id]
             finished.append(req)
         return finished
 
@@ -648,6 +678,15 @@ class ServingEngine:
         """Step until the queue and every slot are empty (graceful
         drain); returns all requests finished along the way.
 
+        When the queue was CLOSED (``close()``/``drain()``) the empty
+        exit is terminal — no submit can ever revive this engine — so
+        run() seals the drain: it emits one ``drain_complete`` runlog
+        event carrying the final ledger (``stats.summary()``) and
+        FLUSHES the runlog's file sink, guaranteeing the JSONL tail is
+        on disk before the process exits (pre-PR-5 nothing did, and a
+        SIGTERM'd server lost its last buffered events). An open-queue
+        run() exiting just means "idle right now" and seals nothing.
+
         Exceeding ``max_rounds`` raises RuntimeError, but finished
         requests are NOT lost: ownership of retired work transferred
         out of the engine at each step, so the error carries them as
@@ -667,4 +706,30 @@ class ServingEngine:
                 raise err
             out.extend(self.step())
             rounds += 1
+        self._seal_drain()
         return out
+
+    def _seal_drain(self) -> None:
+        """Seal a completed drain: once the queue is CLOSED and both it
+        and the slots are empty, emit the terminal ``drain_complete``
+        event (final ledger attached) and flush the runlog sink —
+        exactly once. Shared by :meth:`run` and the HTTP frontend's
+        driver loop (serving/frontend.py), which steps the engine itself
+        instead of calling run(). A no-op while work remains or the
+        queue is still open."""
+        if (not self.queue.closed or self._drain_reported
+                or len(self.queue) or self.slots.n_occupied):
+            return
+        self._drain_reported = True
+        self.runlog.emit("drain_complete", round=self.round_idx,
+                         ledger=self.stats.summary())
+        self.runlog.flush()
+
+    def drain(self, max_rounds: int = 10_000) -> List[Request]:
+        """Graceful drain, one call: stop admissions (``close()``),
+        finish every queued + in-flight request, seal the runlog (the
+        ``drain_complete`` event and flush — see :meth:`run`). The
+        SIGTERM path of the HTTP frontend (serving/server.py) and any
+        embedding caller share this."""
+        self.close()
+        return self.run(max_rounds=max_rounds)

@@ -1,0 +1,314 @@
+"""Engine-driver bridge: many HTTP handler threads, one engine thread.
+
+``ServingEngine`` is single-threaded by contract — device state, slots,
+stats, and the round loop all assume one caller (engine.py docstring;
+only ``submit``/``close`` are thread-safe). An HTTP server is the
+opposite shape: one thread per in-flight connection. This module is the
+adapter between the two, and it is deliberately the ONLY place the two
+threading models touch:
+
+* the engine runs on a dedicated DRIVER thread (:meth:`EngineFrontend.
+  start`), stepping rounds while work exists and parking on an event
+  when idle — submissions wake it, so an idle server burns no CPU and
+  an empty round costs nothing (the while_loop's all-done early exit);
+* handler threads call :meth:`submit`, which registers a
+  :class:`FrontendRequest` HANDLE and enqueues into the engine's locked
+  admission queue in one atomic section — the locked submission
+  mailbox. Backpressure surfaces synchronously: ``QueueFull`` /
+  ``QueueClosed`` propagate to the caller for the 429 / 503 mapping
+  (serving/server.py);
+* after every round the driver FANS OUT results: streaming handles get
+  the round's newly visible tokens pushed into their per-request
+  chunk queues (one bounded host fetch of the token buffer per round,
+  only while streamers are active — ``np.array``, never ``device_get``,
+  the CPU donation-aliasing hazard of engine._retire), and finished /
+  timed-out requests complete their handle's event. A blocking caller
+  waits on the event; a streaming caller iterates the chunk queue.
+
+Exactness rides through untouched: the bridge never reorders or
+re-samples anything — tokens come straight out of the engine's buffer
+rows, so a streamed sequence is byte-identical to the blocking response
+and to an in-process ``engine.run()`` of the same prompts/seeds
+(pinned by tests/test_frontend.py and the ``--config http`` bench).
+
+Drain (:meth:`drain`): stop admissions (engine queue closes — new
+submits raise ``QueueClosed``), let the driver finish every in-flight
+and queued request, seal the runlog (``drain_complete`` + flush via
+``engine._seal_drain``), then join the driver thread. The HTTP layer
+maps this onto SIGTERM (docs/frontend.md §drain).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+
+# Sentinel closing a streaming handle's chunk queue. A unique object —
+# never equal to a token chunk.
+_EOS = object()
+
+
+class FrontendError(RuntimeError):
+    """The driver thread died; carried by every handle it abandoned."""
+
+
+class FrontendRequest:
+    """One submission's handle: the completion event, the stream queue,
+    and (after completion) the engine's finished ``Request``.
+
+    Handler-thread surface: :meth:`result` (block until done),
+    :meth:`chunks` (iterate streamed token chunks). Driver-thread
+    surface: ``_push``/``_complete``/``_fail`` — never call these from
+    handlers."""
+
+    def __init__(self, request_id: int, stream: bool,
+                 submit_time: float):
+        self.request_id = request_id
+        self.stream = stream
+        self.submit_time = submit_time
+        self.first_token_time: Optional[float] = None
+        self.done = threading.Event()
+        self.request = None  # engine Request, set at completion
+        self.error: Optional[BaseException] = None
+        # Streamed-token cursor, driver-thread-only: how many of the
+        # request's generated tokens have been pushed already.
+        self._streamed = 0
+        self._chunks: Optional[_queue.Queue] = \
+            _queue.Queue() if stream else None
+
+    # -- handler-thread side -----------------------------------------
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the request finishes; returns the engine's
+        finished ``Request`` (status ``done`` or ``timeout``). Raises
+        :class:`FrontendError` if the driver died, ``TimeoutError`` on
+        ``timeout``."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done after {timeout}s")
+        if self.error is not None:
+            raise FrontendError(
+                f"driver thread failed serving request "
+                f"{self.request_id}") from self.error
+        return self.request
+
+    def chunks(self):
+        """Yield token chunks (1-D int numpy arrays) as rounds retire
+        them, in generation order, ending when the request completes;
+        concatenated they are exactly the blocking ``tokens`` array.
+        Raises :class:`FrontendError` mid-iteration if the driver
+        died."""
+        if self._chunks is None:
+            raise ValueError("not a streaming request")
+        while True:
+            item = self._chunks.get()
+            if item is _EOS:
+                if self.error is not None:
+                    raise FrontendError(
+                        f"driver thread failed serving request "
+                        f"{self.request_id}") from self.error
+                return
+            yield item
+
+    # -- driver-thread side ------------------------------------------
+
+    def _push(self, chunk: np.ndarray, now: float) -> None:
+        if self.first_token_time is None:
+            self.first_token_time = now
+        if self._chunks is not None and len(chunk):
+            self._chunks.put(chunk)
+
+    def _complete(self, req, now: float) -> None:
+        self.request = req
+        if self.first_token_time is None and req.emitted:
+            self.first_token_time = now
+        if self._chunks is not None:
+            self._chunks.put(_EOS)
+        self.done.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        if self._chunks is not None:
+            self._chunks.put(_EOS)
+        self.done.set()
+
+
+class EngineFrontend:
+    """Run a :class:`~marlin_tpu.serving.ServingEngine` on a driver
+    thread and bridge concurrent submitters into it (module docstring).
+
+    ``idle_wait`` bounds how long the parked driver sleeps between
+    wake checks — the worst-case submit-to-first-round latency added
+    by an idle engine (a submission's wake event usually cuts it to
+    ~0)."""
+
+    def __init__(self, engine, idle_wait: float = 0.05):
+        self.engine = engine
+        self.idle_wait = float(idle_wait)
+        self.metrics = engine.metrics
+        self._handles: Dict[int, FrontendRequest] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._fatal: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "EngineFrontend":
+        if self._thread is not None:
+            raise RuntimeError("frontend already started")
+        self._thread = threading.Thread(
+            target=self._drive, name="marlin-engine-driver", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def alive(self) -> bool:
+        """Driver thread running and not crashed — the /readyz
+        substrate (ready additionally requires not draining)."""
+        return (self._thread is not None and self._thread.is_alive()
+                and self._fatal is None)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def ready(self) -> bool:
+        return self.alive and not self.draining
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: close admissions, finish in-flight + queued
+        work, seal the runlog, stop the driver. Idempotent. Returns
+        True if the driver exited within ``timeout``."""
+        # Close BEFORE flagging: the driver's exit path seals the drain
+        # via engine._seal_drain(), which is a no-op while the queue is
+        # open — flag-first would let an idle driver wake in the gap,
+        # see draining with an open queue, and exit unsealed (no
+        # drain_complete, no flush).
+        self.engine.close()  # new submits now raise QueueClosed
+        self._draining.set()
+        self._wake.set()
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def stop(self) -> None:
+        """Hard stop after the current round — pending handles fail.
+        Prefer :meth:`drain`."""
+        self._stopped.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join()
+
+    # -- submission (handler threads) --------------------------------
+
+    def submit(self, prompt, steps: int,
+               deadline_s: Optional[float] = None,
+               stream: bool = False) -> FrontendRequest:
+        """Thread-safe submit; returns the request's handle.
+
+        Registering the handle and enqueueing the request happen under
+        ONE lock hold, so the driver's post-round fanout (which takes
+        the same lock) can never observe a finished request whose
+        handle is not yet registered — even a steps=1 request admitted
+        and retired within the very round that is executing during this
+        call. ``QueueFull``/``QueueClosed``/``ValueError`` propagate to
+        the caller (the HTTP 429/503/400 mapping)."""
+        if self._fatal is not None:
+            raise FrontendError("driver thread died") from self._fatal
+        with self._lock:
+            rid = self.engine.submit(prompt, steps, deadline_s=deadline_s)
+            handle = FrontendRequest(rid, stream=stream,
+                                     submit_time=time.perf_counter())
+            self._handles[rid] = handle
+        self._wake.set()
+        return handle
+
+    # -- the driver loop ----------------------------------------------
+
+    def _has_work(self) -> bool:
+        eng = self.engine
+        return bool(len(eng.queue) or eng.slots.n_occupied)
+
+    def _drive(self) -> None:
+        eng = self.engine
+        try:
+            while not self._stopped.is_set():
+                if not self._has_work():
+                    if self._draining.is_set():
+                        eng._seal_drain()
+                        return
+                    self._wake.wait(self.idle_wait)
+                    self._wake.clear()
+                    continue
+                finished = eng.step()
+                self._fanout(finished)
+            # Hard stop: anything still in flight will never finish —
+            # fail the waiters instead of hanging them.
+            self._abandon(FrontendError("frontend stopped mid-flight"))
+        except BaseException as e:  # noqa: BLE001 - handed to waiters
+            self._fatal = e
+            self._abandon(e)
+            raise
+
+    def _abandon(self, err: BaseException) -> None:
+        with self._lock:
+            orphans = list(self._handles.values())
+            self._handles.clear()
+        for h in orphans:
+            h._fail(err)
+
+    def _fanout(self, finished: List) -> None:
+        """Post-round delivery: push newly visible tokens to live
+        streaming handles, complete finished/timed-out ones."""
+        eng = self.engine
+        now = time.perf_counter()
+        with self._lock:
+            live_streams = [
+                h for h in self._handles.values()
+                if h.stream and h.request_id in eng.requests
+                and eng.requests[h.request_id].status == "active"]
+            done_handles = [(req, self._handles.pop(req.request_id, None))
+                            for req in finished]
+        if live_streams:
+            # One host copy of the token buffer per round serves every
+            # active streamer; np.array (explicit copy) keeps the
+            # donation aliasing alive (see engine._retire).
+            buf = np.array(eng._buf)
+            for h in live_streams:
+                req = eng.requests.get(h.request_id)
+                if req is None or req.row < 0:
+                    continue  # retired or not yet admitted this instant
+                s = req.prompt_len
+                n_vis = min(int(eng._filled[req.row]) - s, req.steps)
+                if n_vis > h._streamed:
+                    h._push(buf[req.row, s + h._streamed:s + n_vis]
+                            .astype(np.int32), now)
+                    h._streamed = n_vis
+        for req, h in done_handles:
+            if h is None:
+                continue  # submitted directly on the engine, no handle
+            if req.status == "done" and req.tokens is not None:
+                # The tail: tokens past the streamed cursor, including
+                # the eos padding `generate`'s contract fills — the
+                # concatenated stream equals the blocking array exactly.
+                h._push(np.asarray(req.tokens[h._streamed:], np.int32),
+                        now)
+            if h.first_token_time is not None:
+                self.metrics.histogram(
+                    "serving_http_ttft_seconds").observe(
+                        h.first_token_time - h.submit_time)
+            self.metrics.histogram(
+                "serving_http_request_seconds").observe(
+                    now - h.submit_time)
+            h._complete(req, now)

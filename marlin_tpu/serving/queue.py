@@ -19,10 +19,24 @@ live here so the engine stays a pure scheduling loop:
 * **Graceful drain** (``close``): no new submits, already-queued work
   still runs — ``ServingEngine.run`` keeps stepping until the closed
   queue and the slots are both empty.
+
+Thread-safety: every public method takes the queue's internal lock, so
+CONCURRENT submitters (the HTTP frontend's handler threads,
+serving/frontend.py) compose with the single driver thread popping at
+round boundaries — no request can be lost to a torn ``len`` check,
+duplicated, or double-popped. The lock covers the whole
+check-then-mutate of ``submit`` (the backpressure/closed checks and the
+append are one atomic decision) and the pop-inspect-requeue loop of
+``pop_ready``. Deadlines come in two currencies: ``deadline_rounds``
+(engine round index — the simulation/CI unit) and ``deadline_time``
+(absolute ``time.perf_counter()`` instant — what an HTTP caller's
+``deadline_s`` maps onto); either one expiring drops the request at pop
+time.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -49,6 +63,7 @@ class Request:
     prompt: np.ndarray  # (prompt_len,) int32, host-side
     steps: int
     deadline_rounds: Optional[int] = None  # absolute engine round index
+    deadline_time: Optional[float] = None  # absolute perf_counter instant
     submit_round: int = 0
     submit_time: float = 0.0
     # Engine-owned lifecycle fields:
@@ -76,47 +91,65 @@ class Request:
 
 @dataclass
 class AdmissionQueue:
-    """FIFO of :class:`Request` with backpressure and deadline drop."""
+    """FIFO of :class:`Request` with backpressure and deadline drop;
+    safe under concurrent submitters (module docstring)."""
 
     max_pending: int = 64
     _q: deque = field(default_factory=deque)
     _closed: bool = False
 
+    def __post_init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+
     def __len__(self) -> int:
-        return len(self._q)
+        with self._lock:
+            return len(self._q)
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def submit(self, req: Request) -> None:
-        if self._closed:
-            raise QueueClosed(
-                "queue is draining (close() was called); no new requests")
-        if len(self._q) >= self.max_pending:
-            raise QueueFull(
-                f"{len(self._q)} pending requests >= max_pending "
-                f"{self.max_pending}; retry after the engine drains")
-        self._q.append(req)
+        with self._lock:  # check-then-append is one atomic decision
+            if self._closed:
+                raise QueueClosed(
+                    "queue is draining (close() was called); no new "
+                    "requests")
+            if len(self._q) >= self.max_pending:
+                raise QueueFull(
+                    f"{len(self._q)} pending requests >= max_pending "
+                    f"{self.max_pending}; retry after the engine drains")
+            self._q.append(req)
 
-    def pop_ready(self, round_idx: int):
+    def pop_ready(self, round_idx: int, now: Optional[float] = None):
         """Next admissible request, honoring FIFO order and deadlines:
-        requests whose ``deadline_rounds`` has passed are marked
-        ``timeout`` and returned in ``expired`` (the engine records them
-        as completed-without-output). Returns ``(request | None,
-        expired_list)``."""
+        requests whose ``deadline_rounds`` round or ``deadline_time``
+        wall-clock instant has passed are marked ``timeout`` and
+        returned in ``expired`` (the engine records them as
+        completed-without-output). ``now`` defaults to
+        ``time.perf_counter()`` — the clock ``deadline_time`` is set
+        against. Returns ``(request | None, expired_list)``."""
         expired = []
-        while self._q:
-            req = self._q.popleft()
-            if (req.deadline_rounds is not None
-                    and round_idx > req.deadline_rounds):
-                req.status = "timeout"
-                req.finish_round = round_idx
-                expired.append(req)
-                continue
-            return req, expired
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            while self._q:
+                req = self._q.popleft()
+                if ((req.deadline_rounds is not None
+                        and round_idx > req.deadline_rounds)
+                        or (req.deadline_time is not None
+                            and now > req.deadline_time)):
+                    req.status = "timeout"
+                    req.finish_round = round_idx
+                    expired.append(req)
+                    continue
+                return req, expired
         return None, expired
 
     def close(self) -> None:
         """Stop accepting new work; queued requests still drain."""
-        self._closed = True
+        with self._lock:
+            self._closed = True

@@ -1,0 +1,404 @@
+"""HTTP serving frontend: stdlib-only, threaded, drain-aware.
+
+The thinnest real service the engine stack supports — ``http.server.
+ThreadingHTTPServer`` (one thread per connection) over the
+:class:`~marlin_tpu.serving.frontend.EngineFrontend` bridge, zero
+dependencies beyond the stdlib. Endpoints (docs/frontend.md):
+
+* ``POST /v1/generate`` — body ``{"prompt": [ints], "steps": n,
+  "deadline_s": t?, "stream": bool?}``. Blocking form returns one JSON
+  object with the full ``tokens`` array; ``stream: true`` returns
+  Server-Sent Events (``text/event-stream``, chunked), one ``data:``
+  event per round's newly generated tokens and a terminal ``done``
+  event — the concatenated stream is byte-identical to the blocking
+  array (frontend contract). ``deadline_s`` maps onto the admission
+  queue's wall-clock deadline drop; a request that times out queued
+  returns 504. The engine's request id is echoed in ``X-Request-Id``
+  (or the caller's own header value, if sent, with the engine id in
+  ``X-Engine-Request-Id``) and carried as the ``http.request`` span's
+  ``request_id`` attr, so a request's spans are findable by id in the
+  exported trace.
+* ``GET /metrics`` — ``obs.metrics.prometheus()`` text exposition.
+  Scrape-consistent under load: the registry lock makes every export a
+  point-in-time view (obs/metrics.py), closing ROADMAP item 12's
+  "`/metrics` handler once an RPC frontend exists".
+* ``GET /healthz`` — 200 while the listener accepts (liveness).
+* ``GET /readyz`` — 200 only while the driver thread is alive and NOT
+  draining; 503 otherwise (readiness — what a load balancer keys on).
+
+Backpressure maps to status codes instead of silent buffering:
+``QueueFull`` → 429 with ``Retry-After``; draining (``QueueClosed``) →
+503 with ``Retry-After``; malformed request → 400.
+
+Graceful drain: SIGTERM (``install_signal_handlers``) or
+:meth:`ServingHTTPServer.begin_drain` stops admissions (new generates
+get 503), lets the driver finish every in-flight row through the
+engine's drain path (runlog sealed with ``drain_complete`` + flush),
+then closes the listener — in-flight HTTP responses complete, the
+process exits 0. ``python -m marlin_tpu.serving.server`` serves a tiny
+randomly initialized demo model (the subprocess-smoke/demo entry
+point); real deployments build params/cfg and call :func:`serve`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .frontend import EngineFrontend, FrontendError
+from .queue import QueueClosed, QueueFull
+
+RETRY_AFTER_S = 1  # hint on 429/503: one engine round is usually enough
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One request; the server object carries the shared state."""
+
+    protocol_version = "HTTP/1.1"
+    server_version = "marlin-serving/1"
+
+    # -- plumbing -----------------------------------------------------
+
+    @property
+    def frontend(self) -> EngineFrontend:
+        return self.server.frontend
+
+    @property
+    def metrics(self):
+        return self.server.frontend.metrics
+
+    def log_message(self, fmt, *args):  # runlog, not stderr
+        self.server.runlog.emit("http_access", line=fmt % args)
+
+    def _count(self, route: str, code: int) -> None:
+        self.metrics.counter("serving_http_requests_total",
+                             route=route).inc()
+        self.metrics.counter("serving_http_responses_total",
+                             code=str(code)).inc()
+
+    def _send_json(self, code: int, obj: dict, route: str,
+                   headers: Optional[dict] = None) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+        self._count(route, code)
+
+    # -- GET ----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.server.registry.prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            self._count("/metrics", 200)
+        elif path == "/healthz":
+            self._send_json(200, {"ok": True}, "/healthz")
+        elif path == "/readyz":
+            ready = self.frontend.ready
+            self._send_json(
+                200 if ready else 503,
+                {"ready": ready, "draining": self.frontend.draining,
+                 "driver_alive": self.frontend.alive},
+                "/readyz",
+                headers=None if ready else {"Retry-After": RETRY_AFTER_S})
+        else:
+            self._send_json(404, {"error": f"no route {path}"}, path)
+
+    # -- POST /v1/generate --------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path != "/v1/generate":
+            self._send_json(404, {"error": f"no route {path}"}, path)
+            return
+        route = "/v1/generate"
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = np.asarray(body["prompt"], np.int32).reshape(-1)
+            steps = int(body["steps"])
+            deadline_s = (None if body.get("deadline_s") is None
+                          else float(body["deadline_s"]))
+            stream = bool(body.get("stream", False))
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"}, route)
+            return
+        http_id = self.headers.get("X-Request-Id")
+        try:
+            with self.server.tracer.span("http.request", scope=False,
+                                         route=route,
+                                         http_id=http_id or ""):
+                handle = self.frontend.submit(
+                    prompt, steps, deadline_s=deadline_s, stream=stream)
+        except QueueFull as e:
+            self._send_json(429, {"error": str(e)}, route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+            return
+        except (QueueClosed, FrontendError) as e:
+            self._send_json(503, {"error": str(e)}, route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)}, route)
+            return
+        # The id echo: the caller's X-Request-Id comes back verbatim
+        # when sent; the engine id always travels (it is the key the
+        # runlog events and trace spans carry).
+        id_headers = {"X-Engine-Request-Id": handle.request_id,
+                      "X-Request-Id": http_id or str(handle.request_id)}
+        with self.server.tracer.span("http.respond", scope=False,
+                                     request_id=handle.request_id,
+                                     http_id=http_id or "",
+                                     stream=stream):
+            if stream:
+                self._respond_stream(handle, route, id_headers)
+            else:
+                self._respond_blocking(handle, route, id_headers)
+
+    def _finish_fields(self, req) -> dict:
+        return {"request_id": req.request_id, "status": req.status,
+                "emitted": req.emitted,
+                "prompt_len": req.prompt_len, "steps": req.steps}
+
+    def _respond_blocking(self, handle, route, id_headers) -> None:
+        try:
+            req = handle.result(self.server.request_timeout_s)
+        except (FrontendError, TimeoutError) as e:
+            self._send_json(503, {"error": str(e)}, route,
+                            headers=id_headers)
+            return
+        if req.status != "done":
+            # Queued past its deadline: admission never happened.
+            self._send_json(504, {"error": "deadline exceeded in queue",
+                                  **self._finish_fields(req)},
+                            route, headers=id_headers)
+            return
+        self._send_json(
+            200, {**self._finish_fields(req),
+                  "tokens": np.asarray(req.tokens).tolist()},
+            route, headers=id_headers)
+
+    def _respond_stream(self, handle, route, id_headers) -> None:
+        """SSE over chunked transfer: one ``data:`` event per round's
+        new tokens, then the terminal ``done`` event. The 200 commits
+        before the outcome is known (streaming semantics); a deadline
+        timeout therefore surfaces IN-BAND as the done event's
+        ``status`` instead of a 504."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in id_headers.items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        code = 200
+        try:
+            for chunk in handle.chunks():
+                self._sse({"tokens": np.asarray(chunk).tolist()})
+            req = handle.result(0.0 if handle.done.is_set() else None)
+            self._sse({"done": True, **self._finish_fields(req)})
+            self._chunk(b"")  # terminal zero-length chunk
+        except (FrontendError, TimeoutError) as e:
+            code = 503  # accounting only: the 200 already went out
+            try:
+                self._sse({"done": True, "error": str(e)})
+                self._chunk(b"")
+            except OSError:
+                pass
+        except OSError:
+            code = 499  # client went away mid-stream
+        self._count(route, code)
+
+    def _sse(self, obj: dict) -> None:
+        self._chunk(b"data: " + json.dumps(obj).encode() + b"\n\n")
+
+    def _chunk(self, payload: bytes) -> None:
+        self.wfile.write(f"{len(payload):x}\r\n".encode() + payload
+                         + b"\r\n")
+        self.wfile.flush()
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """The listener + shared state the handlers read.
+
+    ``frontend`` must be a STARTED :class:`EngineFrontend`. The server
+    never touches the engine directly — everything goes through the
+    bridge, which is the whole point of the bridge."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, frontend: EngineFrontend,
+                 request_timeout_s: Optional[float] = 300.0):
+        super().__init__(addr, _Handler)
+        self.frontend = frontend
+        self.registry = frontend.metrics
+        self.tracer = frontend.engine.tracer
+        self.runlog = frontend.engine.runlog
+        self.request_timeout_s = request_timeout_s
+        self._drain_once = threading.Lock()
+        self._drained = False
+        self._serve_thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start_background(self) -> "ServingHTTPServer":
+        """serve_forever on a daemon thread (tests, the bench driver)."""
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="marlin-http-listener",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def begin_drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain, idempotent and thread-safe: stop admissions
+        (new generates 503 immediately), finish in-flight requests via
+        the engine drain path (runlog sealed), then stop the listener.
+        Returns True once the driver exited within ``timeout``."""
+        with self._drain_once:
+            if self._drained:
+                return True
+            self.runlog.emit("http_drain_begin", t_wall=time.time())
+            ok = self.frontend.drain(timeout)
+            self.shutdown()  # returns after serve_forever exits
+            if self._serve_thread is not None:
+                self._serve_thread.join(timeout)
+            self.server_close()
+            self._drained = ok
+            return ok
+
+    def close_now(self) -> None:
+        """Hard teardown for tests: no drain, just stop everything."""
+        self.frontend.stop()
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5.0)
+        self.server_close()
+
+
+def serve(params, cfg, host: str = "127.0.0.1", port: int = 0,
+          request_timeout_s: Optional[float] = 300.0,
+          **engine_kwargs) -> ServingHTTPServer:
+    """Build engine + frontend + listener; returns the (not yet
+    serving) server — call ``serve_forever()`` (blocking) or
+    ``start_background()``. ``port=0`` binds an ephemeral port
+    (``server.port`` reports it)."""
+    from .engine import ServingEngine
+
+    engine = ServingEngine(params, cfg, **engine_kwargs)
+    frontend = EngineFrontend(engine).start()
+    return ServingHTTPServer((host, port), frontend,
+                             request_timeout_s=request_timeout_s)
+
+
+def install_signal_handlers(server: ServingHTTPServer,
+                            drain_timeout: Optional[float] = None):
+    """SIGTERM/SIGINT → graceful drain on a helper thread (a signal
+    handler must not block; ``serve_forever`` keeps running until the
+    drain's ``shutdown()`` stops it). Returns the threading.Event set
+    when the drain completes."""
+    import signal
+
+    drained = threading.Event()
+
+    def _drain(signum, frame):
+        def go():
+            server.begin_drain(drain_timeout)
+            drained.set()
+
+        threading.Thread(target=go, name="marlin-drain",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    return drained
+
+
+def main(argv=None) -> int:
+    """Demo/smoke entry point: serve a tiny randomly initialized model.
+
+    Prints one ``SERVING host=... port=...`` line once the listener is
+    bound (the subprocess smoke reads it to find the ephemeral port),
+    then serves until SIGTERM/SIGINT, drains gracefully, and exits 0.
+    """
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 binds an ephemeral port")
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--n-heads", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--max-len", type=int, default=128)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--round-steps", type=int, default=8)
+    p.add_argument("--max-pending", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--runlog", default=None,
+                   help="stream engine runlog JSONL to this path")
+    p.add_argument("--force-cpu", action="store_true",
+                   help="pin jax to the CPU backend (smoke/demo hosts)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.force_cpu or os.environ.get("MARLIN_SERVE_FORCE_CPU"):
+        # Same dance as bench.py: this image's sitecustomize registers
+        # the axon TPU platform via jax.config, so the override must go
+        # through jax.config too, before first backend use.
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..models import TransformerConfig, init_params
+    from ..obs.runlog import RunLog
+
+    cfg = TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=4 * args.d_model,
+        max_len=args.max_len, dtype="float32")
+    params = init_params(cfg, seed=args.seed)
+    runlog = RunLog(path=args.runlog) if args.runlog else None
+    server = serve(params, cfg, host=args.host, port=args.port,
+                   batch=args.batch, round_steps=args.round_steps,
+                   max_pending=args.max_pending,
+                   temperature=args.temperature, seed=args.seed,
+                   # `is not None`, not truthiness: RunLog has __len__,
+                   # so a fresh (empty) log is falsy.
+                   **({"runlog": runlog} if runlog is not None else {}))
+    drained = install_signal_handlers(server)
+    print(f"SERVING host={args.host} port={server.port}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        # serve_forever exits via the drain's shutdown(); wait for the
+        # drain to finish sealing before reporting success.
+        drained.wait(60.0)
+    print("DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

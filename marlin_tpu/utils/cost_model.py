@@ -745,6 +745,55 @@ def run_gemm_trend_sweep(mesh=None, grid=GEMM_TREND_GRID, reps: int = 3):
              "measured": p["measured"]} for p in pts]
 
 
+# Attention S-sweep (ROADMAP item 2, attention slice): S-doubling grid
+# through OUR flash kernel with the model's S^2 term. NON-causal on
+# purpose: every visited block pair is live, so the grid accounting's
+# FLOPs term is EXACTLY 4*H*D*S^2 at these S (each S here is a multiple
+# of — or clamps the blocks to — the padded sequence, so block tiles
+# cover S^2 with no ragged remainder), i.e. 4x per doubling, the same
+# exact-term contract the GEMM/LU/Cholesky slices hold their exponent
+# to. Causal liveness would bend the term (3/4 * S^2 at two blocks) —
+# a band claim, not an exact one. The smallest point is sized so the
+# kernel's MACs dominate dispatch overhead on the CPU mesh.
+ATTENTION_TREND_GRID = (512, 1024, 2048)
+
+
+def run_attention_trend_sweep(grid=ATTENTION_TREND_GRID, h: int = 2,
+                              d: int = 64, reps: int = 3):
+    """Flash-attention S-sweep (ops/flash_attention): measured
+    wall-clock of the full (S, H, D) x (S, H, D) forward paired with
+    :func:`flash_attention_cost`'s FLOPs at the kernel's own effective
+    blocks — which reduces to the exact 4*H*D*S^2 term on this grid
+    (assertion-pinned in tests/test_trend_sweep.py). Same
+    ``powerlaw_fit`` exponent-band + residual contract as the other
+    ROADMAP-2 slices; reported in the ``--config trend`` bench line."""
+    import jax
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    from ..ops.flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+                                       effective_blocks, flash_attention)
+
+    fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=False))
+    rng = np.random.default_rng(0)
+    out = []
+    for s in grid:
+        q, k, v = (jnp.asarray(rng.standard_normal((s, h, d)),
+                               jnp.float32) for _ in range(3))
+        jax.block_until_ready((q, k, v))
+        bq, bk = effective_blocks(s, s, DEFAULT_BLOCK_Q,
+                                  DEFAULT_BLOCK_K, 0)
+        flops, _ = flash_attention_cost(s, h, d, bq, bk, causal=False)
+        out.append({
+            "s": s,
+            "predicted": flops,
+            "measured": measure_wallclock(
+                lambda q=q, k=k, v=v: fn(q, k, v), reps=reps),
+        })
+    return out
+
+
 # LU / Cholesky n-sweeps (ROADMAP item 2, next slice after the GEMM
 # one): same recipe — n-doubling square grids whose model FLOPs term is
 # exactly n^3 (8x per step), measured through OUR blocked factorizations

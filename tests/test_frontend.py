@@ -1,0 +1,600 @@
+"""HTTP serving frontend tests (marlin_tpu/serving/frontend.py +
+server.py; docs/frontend.md).
+
+The PR-5 acceptance claims, each pinned mechanically:
+
+* CONCURRENCY — the admission queue and ``engine.submit`` survive >= 8
+  producer threads racing the driver with EXACT accounting: no request
+  lost, duplicated, or retired twice, and the ``serving_*_total``
+  counters/queue-depth gauge agree with the ground truth to the unit.
+* EXACTNESS THROUGH THE STACK — a streamed token sequence is
+  byte-identical to the blocking response and to an in-process
+  ``engine.run()`` of the same prompts/seeds: the bridge and the HTTP
+  framing add transport, never reordering.
+* BACKPRESSURE AS STATUS — queue full maps to 429 + Retry-After,
+  draining to 503, malformed to 400, queue-deadline expiry to 504.
+* GRACEFUL DRAIN — SIGTERM (subprocess) / ``begin_drain`` (in-process)
+  completes in-flight requests, 503s new ones, seals the runlog with a
+  terminal ``drain_complete`` + flush (the tail is ON DISK), exits 0.
+
+Everything runs on the tiny CPU-mesh knobs; the bench smoke at the
+bottom runs the real ``bench.py --config http`` subprocess and holds
+its artifact to the committed SLO baseline's HTTP block.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.obs.runlog import RunLog
+from marlin_tpu.serving import (AdmissionQueue, EngineFrontend, QueueClosed,
+                                QueueFull, Request, ServingEngine, serve)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return init_params(cfg, seed=0), cfg
+
+
+def _prompts(cfg, n, length=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab, length).astype(np.int32)
+            for _ in range(n)]
+
+
+def _golden(params, cfg, prompts, steps, **eng_kw):
+    eng = ServingEngine(params, cfg, **eng_kw)
+    for p in prompts:
+        eng.submit(p, steps)
+    return {r.request_id: list(map(int, r.tokens)) for r in eng.run()}
+
+
+# -- queue + engine concurrency (satellite: AdmissionQueue safety) ----
+
+
+class TestQueueConcurrency:
+    def test_producers_vs_consumer_exact_accounting(self):
+        q = AdmissionQueue(max_pending=10_000)
+        n_threads, per = 8, 200
+        accepted = [[] for _ in range(n_threads)]
+
+        def producer(t):
+            for i in range(per):
+                rid = t * per + i
+                q.submit(Request(request_id=rid, steps=1,
+                                 prompt=np.zeros(4, np.int32)))
+                accepted[t].append(rid)
+
+        popped = []
+        stop = threading.Event()
+
+        def consumer():
+            while not stop.is_set() or len(q):
+                req, expired = q.pop_ready(0)
+                assert not expired
+                if req is not None:
+                    popped.append(req.request_id)
+
+        threads = [threading.Thread(target=producer, args=(t,))
+                   for t in range(n_threads)]
+        c = threading.Thread(target=consumer)
+        c.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        c.join()
+        all_accepted = sorted(sum(accepted, []))
+        assert sorted(popped) == all_accepted  # nothing lost
+        assert len(set(popped)) == len(popped)  # nothing duplicated
+        assert len(q) == 0
+
+    def test_backpressure_races_never_overfill(self):
+        q = AdmissionQueue(max_pending=4)
+        outcomes = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(8)
+
+        def producer(t):
+            barrier.wait()  # maximal collision
+            for i in range(25):
+                try:
+                    q.submit(Request(request_id=t * 25 + i, steps=1,
+                                     prompt=np.zeros(4, np.int32)))
+                    ok = True
+                except QueueFull:
+                    ok = False
+                with lock:
+                    outcomes.append(ok)
+                    # The invariant a torn len-check would break:
+                    assert len(q) <= 4
+
+        threads = [threading.Thread(target=producer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        n_ok = sum(outcomes)
+        assert n_ok >= 4  # the queue did accept up to its cap
+        drained = 0
+        while q.pop_ready(0)[0] is not None:
+            drained += 1
+        assert drained == min(n_ok, 4) == 4
+
+    def test_wallclock_deadline_drops_at_pop(self):
+        q = AdmissionQueue()
+        now = time.perf_counter()
+        q.submit(Request(request_id=0, steps=1,
+                         prompt=np.zeros(4, np.int32),
+                         deadline_time=now - 1.0))  # already past
+        q.submit(Request(request_id=1, steps=1,
+                         prompt=np.zeros(4, np.int32),
+                         deadline_time=now + 60.0))
+        got, expired = q.pop_ready(0)
+        assert got.request_id == 1
+        assert [r.request_id for r in expired] == [0]
+        assert expired[0].status == "timeout"
+
+
+class TestEngineConcurrentSubmitters:
+    def test_eight_producers_exact_request_accounting(self, model):
+        """The satellite pin: 8 producer threads race the driver; no
+        request is lost, duplicated, or retired twice, and the metric
+        mirrors stay exact to the unit."""
+        params, cfg = model
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=4, round_steps=4,
+                            max_pending=512, metrics_registry=reg)
+        fe = EngineFrontend(eng).start()
+        n_threads, per = 8, 6
+        handles = [[] for _ in range(n_threads)]
+        prompts = _prompts(cfg, n_threads * per)
+        barrier = threading.Barrier(n_threads)
+
+        def producer(t):
+            barrier.wait()
+            for i in range(per):
+                h = fe.submit(prompts[t * per + i], steps=3)
+                handles[t].append(h)
+
+        threads = [threading.Thread(target=producer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        flat = sum(handles, [])
+        results = [h.result(60.0) for h in flat]
+        assert fe.drain(30.0)
+        n = n_threads * per
+        rids = [r.request_id for r in results]
+        assert len(set(rids)) == n  # no dup ids, none lost
+        assert all(r.status == "done" for r in results)
+        assert all(len(r.tokens) == 3 for r in results)
+        # Retired exactly once: the ledger agrees with ground truth.
+        assert eng.stats.n_completed == n
+        assert eng.stats.n_timeout == 0
+        assert reg.counter("serving_submitted_total").value == n
+        assert reg.counter("serving_completed_total").value == n
+        assert reg.counter("serving_tokens_out_total").value == 3 * n
+        assert reg.gauge("serving_queue_depth").value == 0
+        assert len(eng.requests) == 0  # ownership fully transferred
+        # And exactness survived the stampede: every prompt's tokens
+        # match a solo engine run of the same workload.
+        gold = _golden(params, cfg, prompts, 3, batch=4, round_steps=4)
+        by_prompt = {tuple(map(int, prompts[i])): gold[i]
+                     for i in range(n)}
+        for h, r in zip(flat, results):
+            assert list(map(int, r.tokens)) \
+                == by_prompt[tuple(map(int, r.prompt))]
+
+
+# -- drain semantics (satellite: runlog flush + drain_complete) -------
+
+
+class TestDrainRunlog:
+    def test_drain_flushes_jsonl_and_emits_terminal_ledger(
+            self, model, tmp_path):
+        params, cfg = model
+        path = tmp_path / "runlog.jsonl"
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            runlog=RunLog(maxlen=8, path=path),
+                            metrics_registry=MetricsRegistry())
+        prompts = _prompts(cfg, 5)
+        for p in prompts:
+            eng.submit(p, 6)
+        eng.step()  # mid-flight: rows live, queue non-empty
+        assert eng.slots.n_occupied > 0
+        finished = eng.drain()
+        assert len(finished) == 5
+        # Replay the on-disk JSONL: every line parses, the submit ->
+        # admit -> complete narrative is whole for every request even
+        # though the in-memory deque (maxlen=8) long since dropped the
+        # head, and the terminal event carries the final ledger.
+        lines = [json.loads(l)
+                 for l in path.read_text().strip().splitlines()]
+        assert len(lines) == eng.runlog.n_emitted  # nothing buffered
+        assert lines[-1]["kind"] == "drain_complete"
+        ledger = lines[-1]["ledger"]
+        assert ledger["completed"] == 5
+        assert ledger["admitted"] == 5
+        assert ledger == eng.stats.summary()
+        for kind in ("submit", "admit", "complete"):
+            assert {e["request_id"] for e in lines
+                    if e["kind"] == kind} == set(range(5)), kind
+        assert len(eng.runlog) <= 8  # deque stayed bounded throughout
+
+    def test_drain_complete_is_emitted_exactly_once(self, model):
+        params, cfg = model
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            metrics_registry=MetricsRegistry())
+        eng.submit(_prompts(cfg, 1)[0], 2)
+        eng.drain()
+        eng.run()  # idempotent: a later run() must not re-seal
+        eng.drain()
+        assert len(eng.runlog.events("drain_complete")) == 1
+
+    def test_open_queue_run_does_not_seal(self, model):
+        params, cfg = model
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            metrics_registry=MetricsRegistry())
+        eng.submit(_prompts(cfg, 1)[0], 2)
+        eng.run()  # drains to idle, but the queue is still OPEN
+        assert eng.runlog.events("drain_complete") == []
+        eng.submit(_prompts(cfg, 1)[0], 2)  # still accepts
+        eng.drain()
+        assert len(eng.runlog.events("drain_complete")) == 1
+
+
+# -- the bridge, in-process -------------------------------------------
+
+
+class TestEngineFrontend:
+    def test_blocking_and_streaming_match_engine_run(self, model):
+        params, cfg = model
+        prompts = _prompts(cfg, 6)
+        steps = 5
+        gold = _golden(params, cfg, prompts, steps, batch=2,
+                       round_steps=4)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            metrics_registry=MetricsRegistry())
+        fe = EngineFrontend(eng).start()
+        stream_handles = [fe.submit(p, steps, stream=True)
+                          for p in prompts[:3]]
+        block_handles = [fe.submit(p, steps) for p in prompts[3:]]
+        streamed = []
+        for h in stream_handles:
+            toks = []
+            for chunk in h.chunks():
+                toks.extend(int(t) for t in chunk)
+            streamed.append(toks)
+            assert h.result(10.0).status == "done"
+        for i, h in enumerate(stream_handles):
+            assert streamed[i] == gold[i]
+            # The stream IS the blocking array, chunked.
+            assert streamed[i] == list(map(int, h.result(0.1).tokens))
+        for i, h in enumerate(block_handles):
+            assert list(map(int, h.result(30.0).tokens)) == gold[3 + i]
+        assert fe.drain(30.0)
+        assert not fe.ready  # drained frontends report unready
+
+    def test_deadline_s_times_out_queued_request(self, model):
+        params, cfg = model
+        eng = ServingEngine(params, cfg, batch=1, round_steps=4,
+                            metrics_registry=MetricsRegistry())
+        fe = EngineFrontend(eng).start()
+        long_h = fe.submit(_prompts(cfg, 1)[0], 32)  # hogs the slot
+        short_h = fe.submit(_prompts(cfg, 2)[1], 2, deadline_s=0.002)
+        assert short_h.result(30.0).status == "timeout"
+        assert long_h.result(60.0).status == "done"
+        assert eng.stats.n_timeout == 1
+        fe.drain(10.0)
+
+
+# -- the HTTP layer (tier-1 smoke satellite) --------------------------
+
+
+@pytest.fixture(scope="module")
+def http_server(model):
+    params, cfg = model
+    srv = serve(params, cfg, port=0, batch=2, round_steps=4,
+                max_pending=8, seed=0).start_background()
+    yield srv
+    try:
+        srv.close_now()
+    except OSError:
+        pass
+
+
+@pytest.fixture(scope="module")
+def client_mod():
+    return _load_tool("serving_client")
+
+
+class TestHTTPServer:
+    def test_blocking_request_matches_golden(self, http_server, model,
+                                             client_mod):
+        params, cfg = model
+        prompts = _prompts(cfg, 2, seed=7)
+        gold = _golden(params, cfg, prompts, 4, batch=2, round_steps=4)
+        c = client_mod.ServingClient(port=http_server.port)
+        r = c.generate(prompts[0], 4, request_id="my-id-123")
+        assert r["code"] == 200 and r["status"] == "done"
+        assert r["tokens"] == gold[0]
+        assert r["emitted"] == 4
+        assert r["x_request_id"] == "my-id-123"  # caller id echoed
+        assert r["x_engine_request_id"] is not None
+        # Without a caller id, the engine id is the echo.
+        r2 = c.generate(prompts[1], 4)
+        assert r2["x_request_id"] == r2["x_engine_request_id"]
+
+    def test_streaming_bitexact_with_blocking(self, http_server, model,
+                                              client_mod):
+        params, cfg = model
+        prompt = _prompts(cfg, 1, seed=11)[0]
+        c = client_mod.ServingClient(port=http_server.port)
+        st = c.stream(prompt, 6)
+        bl = c.generate(prompt, 6)
+        assert st["code"] == bl["code"] == 200
+        assert st["tokens"] == bl["tokens"]
+        assert st["status"] == "done" and st["emitted"] == 6
+        assert st["ttft_s"] > 0
+        assert len(st["chunks"]) >= 1
+
+    def test_metrics_healthz_readyz(self, http_server, client_mod):
+        c = client_mod.ServingClient(port=http_server.port)
+        m = c.metrics()
+        assert m["code"] == 200
+        for series in ("serving_http_requests_total",
+                       "serving_http_ttft_seconds",
+                       "serving_ttft_seconds", "serving_queue_depth"):
+            assert series in m["text"], series
+        assert any(k.startswith("serving_http_responses_total")
+                   for k in m["samples"])
+        assert c.healthz()["code"] == 200
+        rz = c.readyz()
+        assert rz["code"] == 200 and rz["ready"] and rz["driver_alive"]
+
+    def test_bad_requests_map_to_400_and_404(self, http_server,
+                                             client_mod):
+        import http.client
+
+        c = client_mod.ServingClient(port=http_server.port)
+        # steps beyond max_len: engine validation -> 400
+        r = c.generate([1, 2, 3], 10_000)
+        assert r["code"] == 400 and "error" in r
+        conn = http.client.HTTPConnection("127.0.0.1", http_server.port,
+                                          timeout=10)
+        try:
+            conn.request("POST", "/v1/generate", b"{not json",
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 400
+        finally:
+            conn.close()
+        code, _, _ = c._get("/nope")
+        assert code == 404
+
+    def test_queue_full_maps_to_429_with_retry_after(self, model,
+                                                     client_mod):
+        params, cfg = model
+        srv = serve(params, cfg, port=0, batch=1, round_steps=4,
+                    max_pending=1, seed=0).start_background()
+        try:
+            c = client_mod.ServingClient(port=srv.port)
+            prompts = _prompts(cfg, 10, seed=3)
+            results = [None] * 10
+
+            def fire(i):
+                results[i] = client_mod.ServingClient(
+                    port=srv.port).generate(prompts[i], 24)
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            codes = [r["code"] for r in results]
+            shed = [r for r in results if r["code"] == 429]
+            assert shed, codes  # 1 slot + 1 pending cannot hold 10
+            assert all(r["retry_after"] is not None for r in shed)
+            served = [r for r in results if r["code"] == 200]
+            assert served  # accepted requests completed under the burst
+            assert all(len(r["tokens"]) == 24 for r in served)
+            assert len(shed) + len(served) == 10
+        finally:
+            srv.begin_drain(30.0)
+
+    def test_drain_completes_in_flight_and_503s_new(self, model,
+                                                    client_mod):
+        """In-process shape of the SIGTERM contract: begin_drain mid-
+        stream -> the in-flight stream finishes byte-complete, new
+        submits 503, readyz flips, the runlog seals."""
+        params, cfg = model
+        srv = serve(params, cfg, port=0, batch=2, round_steps=2,
+                    max_pending=8, seed=0).start_background()
+        c = client_mod.ServingClient(port=srv.port)
+        prompt = _prompts(cfg, 1, seed=5)[0]
+        stream_res = {}
+
+        def streamer():
+            stream_res.update(c.stream(prompt, 24))
+
+        st = threading.Thread(target=streamer)
+        st.start()
+        time.sleep(0.05)  # let the stream get in flight
+        drained = {}
+
+        def drainer():
+            drained["ok"] = srv.begin_drain(60.0)
+
+        dt = threading.Thread(target=drainer)
+        dt.start()
+        time.sleep(0.02)
+        # New work while draining: 503 with Retry-After (the listener
+        # is still up until in-flight work completes) — or, late in the
+        # drain, a torn-down listener. Both are valid shed shapes; a
+        # 200 would mean draining admitted new work.
+        try:
+            r = c.generate(prompt, 2)
+            assert r["code"] == 503, r
+        except (ConnectionError, OSError):
+            pass
+        st.join(60.0)
+        dt.join(60.0)
+        assert drained.get("ok") is True
+        assert stream_res["code"] == 200
+        assert stream_res["status"] == "done"
+        assert stream_res["emitted"] == 24  # in-flight ran to the end
+        assert len(stream_res["tokens"]) == 24
+        kinds = [e["kind"] for e in srv.runlog.events()]
+        assert "drain_complete" in kinds
+
+
+class TestSigtermSubprocess:
+    def test_sigterm_drains_and_exits_zero(self, tmp_path):
+        """The acceptance criterion verbatim, against a real process:
+        SIGTERM mid-stream -> the stream completes, new requests are
+        shed, the runlog (file sink) carries drain_complete, exit 0."""
+        sc = _load_tool("serving_client")
+        runlog = tmp_path / "server_runlog.jsonl"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "marlin_tpu.serving.server",
+             "--port", "0", "--force-cpu", "--d-model", "32",
+             "--n-layers", "2", "--vocab", "64", "--max-len", "64",
+             "--batch", "2", "--round-steps", "2",
+             "--runlog", str(runlog)],
+            cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("SERVING "), line
+            port = int(line.strip().split("port=")[1])
+            c = sc.ServingClient(port=port, timeout=60.0)
+            warm = c.generate(list(range(8)), 2)
+            assert warm["code"] == 200
+            stream_res = {}
+
+            def streamer():
+                stream_res.update(c.stream(list(range(8)), 24))
+
+            st = threading.Thread(target=streamer)
+            st.start()
+            time.sleep(0.1)  # in flight
+            proc.send_signal(signal.SIGTERM)
+            time.sleep(0.02)
+            try:
+                shed = c.generate(list(range(8)), 2)
+                assert shed["code"] == 503, shed
+            except (ConnectionError, OSError):
+                pass  # late in the drain the listener is already down
+            st.join(60.0)
+            assert stream_res.get("code") == 200, stream_res
+            assert stream_res.get("emitted") == 24
+            rc = proc.wait(60.0)
+            assert rc == 0, proc.stderr.read()[-800:]
+            assert "DRAINED" in proc.stdout.read()
+            events = [json.loads(l) for l in
+                      runlog.read_text().strip().splitlines()]
+            assert events[-1]["kind"] == "drain_complete"
+            assert events[-1]["ledger"]["completed"] >= 2
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10.0)
+
+
+# -- the bench artifact + SLO gate ------------------------------------
+
+
+class TestHTTPBenchSmoke:
+    def test_bench_http_line_and_slo_gate(self, tmp_path):
+        """`bench.py --config http` end to end with tiny knobs: the
+        artifact line must carry end-to-end TTFT p50/p99, inter-token
+        latency, completions/s, byte-identical streams, and
+        `recompiles_after_warmup == 0` READ FROM THE SCRAPED /metrics —
+        then pass tools/slo_check.py against the committed baseline's
+        HTTP block (the tier-1 form of the SLO gate)."""
+        env = dict(
+            os.environ, BENCH_FORCE_CPU="1", BENCH_RETRIES="1",
+            BENCH_HTTP_D="32", BENCH_HTTP_L="2", BENCH_HTTP_REQS="6",
+            BENCH_HTTP_STEPS="6", BENCH_HTTP_CONC="3",
+            # round=2 so a 6-step stream spans >= 3 rounds — the
+            # inter-token timeline needs more than one chunk to exist.
+            BENCH_HTTP_ROUND="2",
+            BENCH_HTTP_VOCAB="64", BENCH_HTTP_PEND="4",
+            BENCH_HTTP_BURST="16", BENCH_HTTP_SCRAPES="5")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--config", "http"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=_REPO)
+        assert r.returncode == 0, r.stderr[-800:]
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        (line,) = [d for d in lines
+                   if d["metric"] == "serving_http_frontend"]
+        assert line["streams_bitexact"] is True
+        assert line["recompiles_after_warmup"] == 0
+        assert line["drain_ok"] is True
+        assert line["completions_per_s"] > 0
+        assert 0 < line["ttft_p50_s"] <= line["ttft_p99_s"]
+        assert line["intertoken_mean_s"] > 0
+        assert line["overload_429s"] >= 1  # the burst actually shed
+        assert line["metrics_scrape_p99_s"] > 0
+        # The scraped-exposition path fed the metrics block too.
+        assert line["metrics"]["histograms"][
+            "serving_http_ttft_seconds"]["count"] > 0
+        artifact = tmp_path / "http_artifact.jsonl"
+        artifact.write_text(r.stdout)
+        slo = subprocess.run(
+            [sys.executable, "tools/slo_check.py", str(artifact),
+             "--metrics-key", "metrics_http"],
+            capture_output=True, text=True, timeout=60, cwd=_REPO)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "SLO OK" in slo.stdout
+
+    def test_slo_quantile_bound_helper(self):
+        slo = _load_tool("slo_check")
+        hist = {"count": 10, "sum": 1.0,
+                "buckets": {"0.001": 4, "0.1": 5, "+Inf": 1}}
+        assert slo._quantile_bound(hist, 0.10) == 0.001
+        assert slo._quantile_bound(hist, 0.50) == 0.1
+        assert slo._quantile_bound(hist, 0.99) == float("inf")
+        # End to end through the check: p50 within 0.1 passes, p99
+        # lands in +Inf and violates.
+        line = {"metrics": {"histograms": {"h": hist}}}
+        ok = slo._check_histogram(line, "f", {
+            "histogram": "h", "quantile": 0.5, "max_quantile_s": 0.1})
+        assert ok == []
+        bad = slo._check_histogram(line, "f", {
+            "histogram": "h", "quantile": 0.99, "max_quantile_s": 5.0})
+        assert len(bad) == 1 and "p99" in bad[0]

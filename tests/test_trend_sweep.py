@@ -173,6 +173,48 @@ class TestGemmTrendSweep:
         assert fit["residual_rms"] < 0.75, (fit, sweep)
 
 
+class TestAttentionTrendSweep:
+    """ROADMAP item 2, attention slice: the flash forward measured over
+    an S-doubling grid against the model's S^2 term (NON-causal so the
+    grid accounting's term is EXACT — see cost_model.
+    ATTENTION_TREND_GRID's rationale)."""
+
+    H, D = 2, 64
+
+    @pytest.fixture(scope="class")
+    def sweep(self):
+        return cm.run_attention_trend_sweep(h=self.H, d=self.D)
+
+    def test_model_term_is_exactly_s_squared(self, sweep):
+        # flash_attention_cost at the kernel's effective blocks must
+        # REDUCE to 4*H*D*S^2 on this grid (every visited non-causal
+        # block pair is live and the tiles cover S^2 exactly) — 4x per
+        # S-doubling, the exact-term contract of the other slices.
+        for p in sweep:
+            assert p["predicted"] == pytest.approx(
+                4.0 * self.H * self.D * p["s"] ** 2)
+        preds = [p["predicted"] for p in sweep]
+        for lo, hi in zip(preds[:-1], preds[1:]):
+            assert hi == pytest.approx(4 * lo)
+
+    def test_rank_correlation_meets_bar(self, sweep):
+        assert cm.trend_verdict(sweep)["rho"] >= 0.9, sweep
+
+    def test_measured_exponent_band_and_residual(self, sweep):
+        # Wide band around 2 for the same reason as the n^3 slices: the
+        # small-S end mixes in dispatch overhead (flattening toward
+        # S^1) on a shared CPU host, but an attention whose cost
+        # stopped scaling with its model — S^1 constant-dominated or
+        # S^3 from a materialized logits matrix — still fails loudly.
+        fit = cm.powerlaw_fit([p["s"] for p in sweep],
+                              [p["measured"] for p in sweep])
+        model = cm.powerlaw_fit([p["s"] for p in sweep],
+                                [p["predicted"] for p in sweep])
+        assert model["exponent"] == pytest.approx(2.0, abs=1e-9)
+        assert 1.0 <= fit["exponent"] <= 2.9, (fit, sweep)
+        assert fit["residual_rms"] < 0.5, (fit, sweep)
+
+
 class _FactorSweepContract:
     """Shared contract for the blocked-factorization n-sweeps (ROADMAP
     item 2, LU/Cholesky slice): model FLOPs term exactly n^3 (8x-spaced

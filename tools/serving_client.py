@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Client for the marlin serving frontend (marlin_tpu/serving/server.py)
+plus a small closed-loop / open-loop load generator.
+
+Stdlib-only (http.client), mirroring the server's zero-dependency
+stance: the blocking and SSE-streaming forms of ``POST /v1/generate``,
+``GET /metrics`` scrapes, and health probes, each returning plain dicts
+with wall-clock timings attached — the raw material `bench.py --config
+http` turns into end-to-end TTFT / inter-token-latency / completions-per-
+second artifact fields, and what an operator pokes a live server with.
+
+Usage (manual):
+    python tools/serving_client.py --port 8000 generate 1 2 3 --steps 8
+    python tools/serving_client.py --port 8000 stream 1 2 3 --steps 8
+    python tools/serving_client.py --port 8000 load --requests 16
+    python tools/serving_client.py --port 8000 metrics
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class ServingClient:
+    """One server endpoint; a fresh connection per call (the load
+    generator runs many of these concurrently — connection state is
+    never shared across threads)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 timeout: float = 120.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _conn(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+
+    def _get(self, path: str):
+        conn = self._conn()
+        try:
+            t0 = time.perf_counter()
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            return resp.status, body, time.perf_counter() - t0
+        finally:
+            conn.close()
+
+    # -- probes / metrics --------------------------------------------
+
+    def healthz(self) -> Dict:
+        code, body, dt = self._get("/healthz")
+        return {"code": code, "dt_s": dt, **json.loads(body)}
+
+    def readyz(self) -> Dict:
+        code, body, dt = self._get("/readyz")
+        return {"code": code, "dt_s": dt, **json.loads(body)}
+
+    def metrics(self) -> Dict:
+        """Scrape ``/metrics``; returns the raw exposition text, the
+        scrape latency, and the counter/gauge samples parsed into a
+        ``{series: value}`` dict (histogram bucket lines included) —
+        enough for the bench's recompile-delta check without a real
+        Prometheus in the loop."""
+        code, body, dt = self._get("/metrics")
+        text = body.decode()
+        samples: Dict[str, float] = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            try:
+                series, value = line.rsplit(None, 1)
+                samples[series] = float(value)
+            except ValueError:
+                continue
+        return {"code": code, "scrape_s": dt, "text": text,
+                "samples": samples}
+
+    # -- generate -----------------------------------------------------
+
+    def generate(self, prompt: Sequence[int], steps: int,
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None) -> Dict:
+        """Blocking generate; returns the response JSON plus ``code``,
+        ``dt_s``, and the echoed ``x_request_id``/``x_engine_request_id``
+        headers. Non-200s (429/503/504/400) come back the same way —
+        the caller owns the retry/shed decision."""
+        body = {"prompt": list(map(int, prompt)), "steps": int(steps)}
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        conn = self._conn()
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/generate", json.dumps(body),
+                         headers)
+            resp = conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            return {
+                "code": resp.status,
+                "dt_s": time.perf_counter() - t0,
+                "retry_after": resp.headers.get("Retry-After"),
+                "x_request_id": resp.headers.get("X-Request-Id"),
+                "x_engine_request_id":
+                    resp.headers.get("X-Engine-Request-Id"),
+                **payload,
+            }
+        finally:
+            conn.close()
+
+    def stream(self, prompt: Sequence[int], steps: int,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> Dict:
+        """Streaming generate: consume the SSE stream, recording each
+        event's arrival instant. Returns ``tokens`` (all chunks
+        concatenated), ``chunks`` as ``[(t_arrival_s_from_send,
+        n_tokens), ...]``, ``ttft_s`` (send → first token event), the
+        terminal ``done`` event's fields, and ``code``. The per-chunk
+        timeline is the inter-token-latency raw material: tokens within
+        one chunk share an arrival (round-granular streaming — see
+        docs/frontend.md)."""
+        body = {"prompt": list(map(int, prompt)), "steps": int(steps),
+                "stream": True}
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        conn = self._conn()
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/generate", json.dumps(body),
+                         headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                payload = json.loads(resp.read() or b"{}")
+                return {"code": resp.status, "tokens": [], "chunks": [],
+                        "retry_after": resp.headers.get("Retry-After"),
+                        "dt_s": time.perf_counter() - t0, **payload}
+            tokens: List[int] = []
+            chunks: List = []
+            final: Dict = {}
+            # http.client decodes the chunked framing; readline gives
+            # one SSE line at a time as the server flushes rounds.
+            for raw in resp:
+                line = raw.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[len(b"data: "):])
+                now = time.perf_counter() - t0
+                if ev.get("done"):
+                    final = ev
+                    break
+                tokens.extend(ev["tokens"])
+                chunks.append((now, len(ev["tokens"])))
+            return {
+                "code": resp.status,
+                "dt_s": time.perf_counter() - t0,
+                "ttft_s": chunks[0][0] if chunks else None,
+                "tokens": tokens,
+                "chunks": chunks,
+                "x_request_id": resp.headers.get("X-Request-Id"),
+                "x_engine_request_id":
+                    resp.headers.get("X-Engine-Request-Id"),
+                **{k: v for k, v in final.items() if k != "done"},
+            }
+        finally:
+            conn.close()
+
+
+# -- load generation --------------------------------------------------
+
+
+def run_closed_loop(host: str, port: int, prompts: List[Sequence[int]],
+                    steps: int, concurrency: int = 4,
+                    stream: bool = True,
+                    deadline_s: Optional[float] = None) -> Dict:
+    """Closed-loop load: ``concurrency`` workers, each sending its next
+    request the moment the previous one finishes, until every prompt is
+    served exactly once (work-stealing over one shared index). The
+    classic throughput-under-fixed-parallelism harness — offered load
+    tracks service rate, so nothing sheds and every timing is an
+    end-to-end completion. Returns per-request results plus the
+    wall-clock of the whole run."""
+    results: List[Optional[Dict]] = [None] * len(prompts)
+    cursor = [0]
+    lock = threading.Lock()
+
+    def worker():
+        client = ServingClient(host, port)
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(prompts):
+                    return
+                cursor[0] += 1
+            fn = client.stream if stream else client.generate
+            results[i] = fn(prompts[i], steps, deadline_s=deadline_s)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {"results": results, "wall_s": time.perf_counter() - t0,
+            "n": len(prompts), "concurrency": concurrency}
+
+
+def run_open_loop(host: str, port: int, prompts: List[Sequence[int]],
+                  steps: int, rate_per_s: float,
+                  deadline_s: Optional[float] = None,
+                  stream: bool = False) -> Dict:
+    """Open-loop load: fire one request per ``1/rate`` seconds from a
+    metronome regardless of completions (arrival process independent of
+    service process — the regime where backpressure shows up as real
+    429s instead of a slowed closed loop). Every response, shed or
+    served, lands in ``results``."""
+    results: List[Optional[Dict]] = [None] * len(prompts)
+    threads = []
+
+    def fire(i):
+        client = ServingClient(host, port)
+        fn = client.stream if stream else client.generate
+        results[i] = fn(prompts[i], steps, deadline_s=deadline_s)
+
+    t0 = time.perf_counter()
+    for i in range(len(prompts)):
+        target = t0 + i / max(rate_per_s, 1e-9)
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    return {"results": results, "wall_s": time.perf_counter() - t0,
+            "n": len(prompts), "rate_per_s": rate_per_s}
+
+
+def quantile(xs: List[float], q: float) -> float:
+    """Nearest-rank quantile of a non-empty list (no numpy: this file
+    must run anywhere the stdlib does)."""
+    ys = sorted(xs)
+    i = min(len(ys) - 1, max(0, int(round(q * (len(ys) - 1)))))
+    return ys[i]
+
+
+def summarize(results: List[Dict]) -> Dict:
+    """Latency digest of load-run results: TTFT p50/p99 (streaming runs
+    only), per-token inter-arrival mean/p99 from the chunk timelines,
+    completion/shed counts."""
+    ok = [r for r in results if r and r.get("code") == 200
+          and r.get("status", "done") == "done"]
+    out: Dict = {
+        "n_results": len(results),
+        "n_ok": len(ok),
+        "codes": {},
+    }
+    for r in results:
+        if r:
+            c = str(r.get("code"))
+            out["codes"][c] = out["codes"].get(c, 0) + 1
+    ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+    if ttfts:
+        out["ttft_p50_s"] = quantile(ttfts, 0.50)
+        out["ttft_p99_s"] = quantile(ttfts, 0.99)
+    gaps: List[float] = []
+    for r in ok:
+        chunks = r.get("chunks") or []
+        # Spread each chunk gap over the tokens it delivered: with
+        # round-granular streaming a chunk of k tokens arriving dt
+        # after the previous one contributes k gaps of dt/k.
+        for (t_prev, _), (t_cur, k) in zip(chunks, chunks[1:]):
+            if k > 0:
+                gaps.extend([(t_cur - t_prev) / k] * k)
+    if gaps:
+        out["intertoken_mean_s"] = sum(gaps) / len(gaps)
+        out["intertoken_p99_s"] = quantile(gaps, 0.99)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("generate", "stream"):
+        g = sub.add_parser(name)
+        g.add_argument("prompt", nargs="+", type=int)
+        g.add_argument("--steps", type=int, default=8)
+        g.add_argument("--deadline-s", type=float, default=None)
+    lo = sub.add_parser("load")
+    lo.add_argument("--requests", type=int, default=16)
+    lo.add_argument("--steps", type=int, default=8)
+    lo.add_argument("--concurrency", type=int, default=4)
+    lo.add_argument("--prompt-len", type=int, default=16)
+    lo.add_argument("--vocab", type=int, default=256)
+    lo.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrivals/s (default: closed loop)")
+    sub.add_parser("metrics")
+    sub.add_parser("readyz")
+    args = p.parse_args(argv)
+
+    client = ServingClient(args.host, args.port)
+    if args.cmd == "generate":
+        print(json.dumps(client.generate(args.prompt, args.steps,
+                                         args.deadline_s), indent=2))
+    elif args.cmd == "stream":
+        print(json.dumps(client.stream(args.prompt, args.steps,
+                                       args.deadline_s), indent=2))
+    elif args.cmd == "load":
+        import random
+
+        rng = random.Random(0)
+        prompts = [[rng.randrange(args.vocab)
+                    for _ in range(args.prompt_len)]
+                   for _ in range(args.requests)]
+        if args.rate:
+            run = run_open_loop(args.host, args.port, prompts,
+                                args.steps, rate_per_s=args.rate)
+        else:
+            run = run_closed_loop(args.host, args.port, prompts,
+                                  args.steps,
+                                  concurrency=args.concurrency)
+        digest = summarize(run["results"])
+        digest["wall_s"] = run["wall_s"]
+        digest["completions_per_s"] = digest["n_ok"] / run["wall_s"]
+        print(json.dumps(digest, indent=2))
+    elif args.cmd == "metrics":
+        print(client.metrics()["text"], end="")
+    elif args.cmd == "readyz":
+        print(json.dumps(client.readyz(), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

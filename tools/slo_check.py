@@ -24,9 +24,14 @@ Baseline schema (see tools/serving_slo_baseline.json):
 where <check> is one of
     {"min": x} / {"max": x}      bound on a numeric field of the line
     {..., "optional": true}      field may be absent (skip, not fail)
-    {"histogram": <name>, "min_count": n, "max_mean_s": s}
-                                 bound on an attached obs histogram's
-                                 sample count and mean (sum / count)
+    {"histogram": <name>, "min_count": n, "max_mean_s": s,
+     "quantile": q, "max_quantile_s": s}
+                                 bounds on an attached obs histogram:
+                                 sample count, mean (sum / count), and
+                                 the q-quantile's bucket UPPER BOUND
+                                 (conservative: the real quantile is <=
+                                 the bound that trips; a mass landing
+                                 in +Inf always violates)
 Bounds are exact; encode tolerance IN the committed bound (wall-clock
 fields get generous bounds — CI hosts are weather; the sharp teeth are
 the ratio / hit-rate / recompile checks, which are schedule-determined).
@@ -70,6 +75,23 @@ def find_metric(lines: List[dict], name: str) -> Optional[dict]:
     return found
 
 
+def _quantile_bound(hist: dict, q: float) -> float:
+    """Upper bound of the bucket containing the q-quantile, from the
+    snapshot's NON-cumulative bucket counts ({bound_repr: n, "+Inf": n},
+    obs/metrics.py Histogram.summary). Returns inf when the quantile
+    mass sits in the +Inf overflow."""
+    count = hist.get("count", 0)
+    buckets = hist.get("buckets", {})
+    bounds = sorted(float(b) for b in buckets if b != "+Inf")
+    target = q * count
+    cum = 0
+    for b in bounds:
+        cum += buckets[repr(b)]
+        if cum >= target:
+            return b
+    return float("inf")
+
+
 def _check_histogram(line: dict, field: str, spec: dict) -> List[str]:
     name = spec["histogram"]
     hist = (line.get("metrics") or {}).get("histograms", {}).get(name)
@@ -86,6 +108,13 @@ def _check_histogram(line: dict, field: str, spec: dict) -> List[str]:
         if mean > spec["max_mean_s"]:
             out.append(f"{field}: {name} mean {mean:.4f}s > "
                        f"max_mean_s {spec['max_mean_s']}")
+    if count and "max_quantile_s" in spec:
+        q = spec.get("quantile", 0.99)
+        bound = _quantile_bound(hist, q)
+        if bound > spec["max_quantile_s"]:
+            out.append(f"{field}: {name} p{int(q * 100)} bucket bound "
+                       f"{bound}s > max_quantile_s "
+                       f"{spec['max_quantile_s']}")
     return out
 
 
@@ -109,10 +138,18 @@ def check_line(line: dict, checks: Dict[str, dict]) -> List[str]:
     return out
 
 
-def run_checks(lines: List[dict], baseline: dict):
-    """(violations, hard_errors) over every baseline metric block."""
+def run_checks(lines: List[dict], baseline: dict,
+               metrics_key: str = "metrics"):
+    """(violations, hard_errors) over every baseline metric block under
+    ``baseline[metrics_key]`` — one committed baseline file carries one
+    block per bench surface (``metrics`` for ``--config serving``,
+    ``metrics_http`` for ``--config http``), each smoke checking its
+    own artifact against its own block."""
     violations, errors = [], []
-    for name, checks in baseline.get("metrics", {}).items():
+    blocks = baseline.get(metrics_key)
+    if blocks is None:
+        return [], [f"baseline has no {metrics_key!r} block"]
+    for name, checks in blocks.items():
         line = find_metric(lines, name)
         if line is None:
             errors.append(f"metric {name!r} not found in the artifact")
@@ -130,6 +167,9 @@ def main(argv=None) -> int:
     p.add_argument("artifact", help="bench artifact (JSON lines)")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help=f"baseline JSON (default {DEFAULT_BASELINE})")
+    p.add_argument("--metrics-key", default="metrics",
+                   help="baseline block to check (metrics | "
+                        "metrics_http)")
     args = p.parse_args(argv)
     try:
         with open(args.baseline) as f:
@@ -140,7 +180,8 @@ def main(argv=None) -> int:
         # diagnostic), not a silent violation-class traceback.
         print(f"ERROR: {e}", file=sys.stderr)
         return 2
-    violations, errors = run_checks(lines, baseline)
+    violations, errors = run_checks(lines, baseline,
+                                    metrics_key=args.metrics_key)
     for e in errors:
         print(f"ERROR: {e}")
     for v in violations:
@@ -149,7 +190,7 @@ def main(argv=None) -> int:
         return 2
     if violations:
         return 1
-    n = len(baseline.get("metrics", {}))
+    n = len(baseline.get(args.metrics_key, {}))
     print(f"SLO OK: {n} metric(s) within baseline {args.baseline}")
     return 0
 
