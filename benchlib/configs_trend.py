@@ -35,10 +35,17 @@ def config_trend_cpu():
     lu = cm.run_lu_trend_sweep()
     chol = cm.run_cholesky_trend_sweep()
     attn = cm.run_attention_trend_sweep()
+    spmm = cm.run_spmm_trend_sweep()
+    # ELL-vs-dense crossover (ROADMAP item 2 remainder / VERDICT #4):
+    # the measured density where the row-gather stops beating the
+    # densified MXU ring on THIS host — the data-backed form of
+    # MarlinConfig.sparse_ell_density_max's dispatch constant.
+    crossover = cm.run_spmm_crossover_sweep()
+    ell_density_max = cm.derive_ell_density_max(crossover)
     dv, sv = cm.trend_verdict(decode), cm.trend_verdict(summa)
     rv, gv = cm.trend_verdict(serving), cm.trend_verdict(gemm)
     lv, cv = cm.trend_verdict(lu), cm.trend_verdict(chol)
-    av = cm.trend_verdict(attn)
+    av, pv = cm.trend_verdict(attn), cm.trend_verdict(spmm)
     # Early-exit cliff: the all-finished decode point against its
     # same-shape all-live twin (skew-proofing made the while_loop exit
     # before the first body; < 0.5 means the exit is real, not noise).
@@ -57,14 +64,24 @@ def config_trend_cpu():
     lu_exp, lu_res = fit(lu)
     ch_exp, ch_res = fit(chol)
     attn_exp, attn_res = fit(attn, key="s")
+    spmm_exp, spmm_res = fit(spmm)
     rho_min = min(dv["rho"], sv["rho"], rv["rho"], gv["rho"], lv["rho"],
-                  cv["rho"], av["rho"])
+                  cv["rho"], av["rho"], pv["rho"])
     return {"metric": "trend_rank_correlation_min", "value": rho_min,
             "unit": "rho", "vs_baseline": round(rho_min / 0.9, 3),
             "decode_rho": dv["rho"], "summa_rho": sv["rho"],
             "serving_rho": rv["rho"], "gemm_rho": gv["rho"],
             "lu_rho": lv["rho"], "cholesky_rho": cv["rho"],
             "attention_rho": av["rho"],
+            "spmm_rho": pv["rho"],
+            "spmm_exponent": spmm_exp,
+            "spmm_model_exponent": 2.0,
+            "spmm_fit_residual_rms": spmm_res,
+            "sparse_ell_density_max_measured": round(ell_density_max, 6),
+            "spmm_crossover_points": [
+                [p["r_slots"], round(p["density"], 6),
+                 round(p["ell_s"], 5), round(p["dense_s"], 5)]
+                for p in crossover],
             "attention_exponent": attn_exp,
             "attention_model_exponent": 2.0,
             "attention_fit_residual_rms": attn_res,
@@ -90,7 +107,9 @@ def config_trend_cpu():
             "cholesky_points": [[p["n"], round(p["measured"], 5)]
                                 for p in chol],
             "attention_points": [[p["s"], round(p["measured"], 5)]
-                                 for p in attn]}
+                                 for p in attn],
+            "spmm_points": [[p["n"], round(p["measured"], 5)]
+                            for p in spmm]}
 
 
 def config_serving():
@@ -200,6 +219,21 @@ def config_serving():
     # schedule complete within the budget continuous used? sim_iters =
     # decode iterations + one per admission prefill (conservative
     # toward static — see EngineStats.sim_iters).
+    # Latency attribution (PR 6): every completed request's contiguous
+    # phases must sum to its measured end-to-end latency — the 5%
+    # acceptance identity — and the decode drift ratio must sit in its
+    # band (the calibration ledger's "model still priced right" check
+    # that gates ROADMAP-17 cost-model scheduling).
+    phase_errs = []
+    for c in eng.stats.completed:
+        ph = c.get("phases", {})
+        if all(k in ph for k in ("queue_wait", "admit", "decode",
+                                 "total")):
+            s = ph["queue_wait"] + ph["admit"] + ph["decode"]
+            phase_errs.append(abs(s - ph["total"])
+                              / max(ph["total"], 1e-9))
+    drift = eng.stats.calibration.summary()
+
     budget = eng.stats.sim_iters
     completed_static = static_completed_at_budget(steps_list, batch,
                                                   budget)
@@ -227,6 +261,11 @@ def config_serving():
         "continuous_tok_s": round(tokens / dt_cont, 1),
         "static_tok_s": round(tokens / dt_static, 1),
         "mean_ttft_s": eng.stats.summary().get("mean_ttft_s", 0.0),
+        "phase_sum_checked": len(phase_errs),
+        "phase_sum_max_rel_err": round(max(phase_errs), 6)
+        if phase_errs else None,
+        "cost_model_drift": drift,
+        "drift_decode": drift.get("decode", {}).get("drift_ratio"),
         "batch": batch, "n_requests": n_req, "round_steps": round_steps,
         "steps_short": short, "steps_long": long_, "d_model": d,
         "recompiles_after_warmup": recompiles,

@@ -13,8 +13,16 @@ timings). Two exporters:
   every bench artifact line (``benchlib/harness.attach_metrics``) so a
   perf number never travels without the counters that contextualize it;
 * :meth:`MetricsRegistry.prometheus` — the Prometheus text exposition
-  format (``# TYPE`` headers, cumulative ``_bucket{le=...}`` lines), so
-  a serving frontend can expose ``/metrics`` with zero extra deps.
+  format (``# HELP``/``# TYPE`` headers, cumulative ``_bucket{le=...}``
+  lines), so a serving frontend can expose ``/metrics`` with zero extra
+  deps.
+
+Histograms optionally carry EXEMPLARS — one request id per bucket, the
+last observation that landed there (``observe(v, exemplar=...)``) — so
+a tail bucket of ``serving_ttft_seconds`` names a concrete request whose
+full trace the Tracer's slowest-k reservoir retains
+(docs/observability.md §7). Exemplars travel in :meth:`snapshot` (the
+JSON view); the text exposition stays plain 0.0.4 format.
 
 ``utils/timing.py``'s ``Metrics``/``timed``/``timeit`` are thin shims
 over the default registry here, so every existing call site keeps
@@ -112,10 +120,15 @@ class Histogram:
     catches the overflow. Per-bucket counts are stored NON-cumulative
     (the snapshot view); :meth:`MetricsRegistry.prometheus` accumulates
     them into the exposition format's cumulative ``le`` convention.
+
+    ``observe(v, exemplar=id)`` additionally remembers ``id`` as the
+    bucket's exemplar (last-writer-wins per bucket — one id per bucket,
+    O(len(buckets)) state): the breadcrumb from a histogram bucket to a
+    concrete request whose trace the exemplar reservoir retains.
     """
 
     __slots__ = ("buckets", "bucket_counts", "count", "sum", "min", "max",
-                 "_lock")
+                 "exemplars", "_lock")
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS,
                  lock=None):
@@ -129,16 +142,24 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self.exemplars: Dict[int, str] = {}  # bucket index -> last id
         self._lock = lock or threading.RLock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(value)
         with self._lock:  # five coupled writes: see Counter on the lock
-            self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+            i = bisect.bisect_left(self.buckets, v)
+            self.bucket_counts[i] += 1
             self.count += 1
             self.sum += v
             self.min = min(self.min, v)
             self.max = max(self.max, v)
+            if exemplar is not None:
+                self.exemplars[i] = str(exemplar)
+
+    def _bucket_repr(self, i: int) -> str:
+        return repr(self.buckets[i]) if i < len(self.buckets) else "+Inf"
 
     def summary(self) -> Dict[str, object]:
         with self._lock:
@@ -154,22 +175,29 @@ class Histogram:
                     "+Inf": self.bucket_counts[-1],
                 },
             }
+            if self.exemplars:
+                out["exemplars"] = {self._bucket_repr(i): x
+                                    for i, x in self.exemplars.items()}
         return out
 
 
 class _Family:
     """One metric name: kind + labeled children (sharing the registry
-    lock, see Counter)."""
+    lock, see Counter). ``help`` is the one-line ``# HELP`` text of the
+    exposition format — set on first non-empty offer, a property of the
+    family like the bucket layout."""
 
-    __slots__ = ("kind", "name", "buckets", "children", "lock")
+    __slots__ = ("kind", "name", "buckets", "children", "lock", "help")
 
     def __init__(self, kind: str, name: str,
-                 buckets: Optional[Tuple[float, ...]] = None, lock=None):
+                 buckets: Optional[Tuple[float, ...]] = None, lock=None,
+                 help: str = ""):
         self.kind = kind
         self.name = name
         self.buckets = buckets
         self.children: Dict[LabelKey, object] = {}
         self.lock = lock
+        self.help = str(help)
 
     def child(self, key: LabelKey):
         c = self.children.get(key)
@@ -193,6 +221,11 @@ class MetricsRegistry:
     histogram would corrupt both exporters); re-using a histogram name
     with different buckets keeps the family's original buckets — bucket
     layout is a property of the series, not of one call site.
+
+    ``help`` (keyword) attaches the family's ``# HELP`` exposition text
+    — first non-empty offer wins, later calls may omit it. The keyword
+    is claimed by the API, so a LABEL literally named ``help`` is not
+    expressible; no series in the repo wants one.
     """
 
     def __init__(self):
@@ -200,33 +233,36 @@ class MetricsRegistry:
         self._families: Dict[str, _Family] = {}
 
     def _family(self, kind: str, name: str,
-                buckets: Optional[Sequence[float]] = None) -> _Family:
+                buckets: Optional[Sequence[float]] = None,
+                help: str = "") -> _Family:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
                 fam = _Family(kind, name,
                               tuple(buckets) if buckets else None,
-                              lock=self._lock)
+                              lock=self._lock, help=help)
                 self._families[name] = fam
             elif fam.kind != kind:
                 raise ValueError(
                     f"metric {name!r} is a {fam.kind}, not a {kind}")
+            elif help and not fam.help:
+                fam.help = str(help)
             return fam
 
-    def counter(self, name: str, **labels) -> Counter:
-        fam = self._family("counter", name)
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        fam = self._family("counter", name, help=help)
         with self._lock:
             return fam.child(_label_key(labels))
 
-    def gauge(self, name: str, **labels) -> Gauge:
-        fam = self._family("gauge", name)
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        fam = self._family("gauge", name, help=help)
         with self._lock:
             return fam.child(_label_key(labels))
 
     def histogram(self, name: str,
                   buckets: Sequence[float] = DEFAULT_BUCKETS,
-                  **labels) -> Histogram:
-        fam = self._family("histogram", name, buckets=buckets)
+                  help: str = "", **labels) -> Histogram:
+        fam = self._family("histogram", name, buckets=buckets, help=help)
         with self._lock:
             return fam.child(_label_key(labels))
 
@@ -261,14 +297,20 @@ class MetricsRegistry:
         return json.dumps(self.snapshot(), indent=2, sort_keys=True)
 
     def prometheus(self) -> str:
-        """Prometheus text exposition (version 0.0.4): ``# TYPE`` per
-        family, cumulative ``_bucket{le=...}`` + ``_sum``/``_count`` for
-        histograms. Names are sanitized to the Prometheus charset."""
+        """Prometheus text exposition (version 0.0.4): ``# HELP`` (when
+        the family carries one) + ``# TYPE`` per family, cumulative
+        ``_bucket{le=...}`` + ``_sum``/``_count`` for histograms. Names
+        are sanitized to the Prometheus charset; help text is escaped
+        per the format (backslash and newline)."""
         lines = []
         with self._lock:
             for name in sorted(self._families):
                 fam = self._families[name]
                 pname = _prom_name(name)
+                if fam.help:
+                    esc = fam.help.replace("\\", "\\\\") \
+                                  .replace("\n", "\\n")
+                    lines.append(f"# HELP {pname} {esc}")
                 lines.append(f"# TYPE {pname} {fam.kind}")
                 for key in sorted(fam.children):
                     child = fam.children[key]

@@ -14,19 +14,38 @@ is a bare generator yield (no clock reads, no profiler call, no event),
 so instrumented hot paths — the serving round, ``generate`` — cost
 nothing until someone turns tracing on. ``tests/test_obs.py`` pins the
 instrumented serving round within 5% of the disabled-tracer path.
+
+TAIL EXEMPLARS (``exemplar_k > 0``): the Dapper doctrine for explaining
+tail latency — keep FULL traces for the outliers while everything else
+stays cheaply sampled. Spans that carry a ``request_id`` attr are staged
+per request (independently of the ``sample_rate`` draw — a sampling-
+dropped trace's spans must still exist if the request turns out to be an
+outlier); when the owner calls :meth:`finish_request` with the request's
+end-to-end latency, the staged spans either enter the slowest-k
+reservoir (a min-heap keyed on total latency) or are dropped whole.
+``serving_ttft_seconds``'s bucket exemplars (obs/metrics.py) carry the
+matching request ids, so a bad histogram bucket points at a retained
+trace. docs/observability.md §7 documents the retention policy.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import heapq
 import json
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 import jax
+
+# Staging cap for exemplar candidates: requests whose owner never calls
+# finish_request (crashed drivers, abandoned handles) must not leak —
+# beyond this many in-flight staged requests the OLDEST staging entry is
+# dropped (its request can no longer become an exemplar).
+_EXEMPLAR_STAGING_CAP = 2048
 
 
 class Tracer:
@@ -48,10 +67,12 @@ class Tracer:
     at sampled rates too (tests/test_obs.py)."""
 
     def __init__(self, enabled: bool = False, max_events: int = 100_000,
-                 sample_rate: float = 1.0):
+                 sample_rate: float = 1.0, exemplar_k: int = 0):
         if not 0.0 < sample_rate <= 1.0:
             raise ValueError(
                 f"sample_rate must be in (0, 1], got {sample_rate}")
+        if exemplar_k < 0:
+            raise ValueError(f"exemplar_k must be >= 0, got {exemplar_k}")
         self._enabled = bool(enabled)
         self._events: deque = deque(maxlen=max_events)
         self._lock = threading.Lock()
@@ -59,6 +80,14 @@ class Tracer:
         self._epoch_ns = time.perf_counter_ns()
         self.sample_rate = float(sample_rate)
         self._roots_seen = 0  # deterministic root-sampling counter
+        # Tail-exemplar reservoir (module docstring): slowest-k finished
+        # requests' complete span lists, plus the per-request staging
+        # area request_id-attributed spans land in until finish_request
+        # decides their fate.
+        self.exemplar_k = int(exemplar_k)
+        self._exemplar_heap: List[tuple] = []  # (total_s, seq, id, spans)
+        self._exemplar_seq = 0  # heap tiebreak: spans never compare
+        self._staged: "OrderedDict[str, List[dict]]" = OrderedDict()
 
     # -- switches -----------------------------------------------------
 
@@ -76,6 +105,9 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._roots_seen = 0
+            self._exemplar_heap.clear()
+            self._exemplar_seq = 0
+            self._staged.clear()
         self._epoch_ns = time.perf_counter_ns()
 
     # -- recording ----------------------------------------------------
@@ -120,16 +152,29 @@ class Tracer:
         else:
             parent, kept = None, self._sample_root()
         stack.append((name, kept))
-        if not kept:  # dropped trace: bookkeeping only, no recording
+        # Exemplar candidates bypass the sampling decision: a request-id-
+        # attributed span must exist even in a sampling-dropped trace,
+        # because finish_request may promote that request to the
+        # slowest-k reservoir (module docstring). Per-request spans are
+        # low-rate (submit/admit/chunks, never per-iteration), so the
+        # extra clock reads stay inside the <=5% overhead pin.
+        stage = bool(self.exemplar_k) and "request_id" in attrs
+        if not kept and not stage:  # dropped trace: bookkeeping only
             try:
                 yield
             finally:
                 stack.pop()
             return
-        ns = jax.named_scope(name) if scope else contextlib.nullcontext()
+        if kept:
+            ns = jax.named_scope(name) if scope \
+                else contextlib.nullcontext()
+            ann = jax.profiler.TraceAnnotation(name)
+        else:  # staged-only span: no profiler mirrors for a dropped trace
+            ns = contextlib.nullcontext()
+            ann = contextlib.nullcontext()
         t0 = time.perf_counter_ns()
         try:
-            with jax.profiler.TraceAnnotation(name), ns:
+            with ann, ns:
                 yield
         finally:
             dur = time.perf_counter_ns() - t0
@@ -148,7 +193,10 @@ class Tracer:
                 "args": args,
             }
             with self._lock:
-                self._events.append(ev)
+                if kept:
+                    self._events.append(ev)
+                if stage:
+                    self._stage_locked(str(attrs["request_id"]), ev)
 
     def trace(self, fn=None, *, name: Optional[str] = None):
         """Decorator form of :meth:`span`."""
@@ -164,6 +212,73 @@ class Tracer:
             return inner
 
         return wrap(fn) if fn is not None else wrap
+
+    # -- tail exemplars -----------------------------------------------
+
+    def _stage_locked(self, request_id: str, ev: dict) -> None:
+        lst = self._staged.get(request_id)
+        if lst is None:
+            while len(self._staged) >= _EXEMPLAR_STAGING_CAP:
+                self._staged.popitem(last=False)  # oldest orphan out
+            lst = self._staged[request_id] = []
+        lst.append(ev)
+
+    def span_from_stamps(self, name: str, t0_s: float, t1_s: float,
+                         **attrs) -> dict:
+        """Build (without recording) one complete-span event from two
+        ``time.perf_counter()`` stamps — how the engine converts a
+        request's phase timeline (queue_wait/admit/decode stamps it
+        already holds) into trace events for the exemplar reservoir
+        without having wrapped each phase in a live ``span``."""
+        return {
+            "name": name,
+            "ph": "X",
+            "ts": (t0_s * 1e9 - self._epoch_ns) / 1e3,
+            "dur": max(0.0, (t1_s - t0_s) * 1e6),
+            "pid": 0,
+            "tid": threading.get_ident() % (1 << 31),
+            "args": dict(attrs),
+        }
+
+    def finish_request(self, request_id, total_s: float,
+                       extra_spans: Optional[List[dict]] = None) -> bool:
+        """Close a request's exemplar candidacy: its staged spans (plus
+        ``extra_spans``, e.g. synthesized phase segments) enter the
+        slowest-k reservoir if ``total_s`` ranks among the k slowest
+        requests seen, else are dropped whole. Returns True when
+        retained. No-op (False) with ``exemplar_k == 0``; cost per
+        request is one dict pop and at most one heap op."""
+        rid = str(request_id)
+        with self._lock:
+            spans = self._staged.pop(rid, [])
+            if not self.exemplar_k:
+                return False
+            spans = spans + list(extra_spans or [])
+            entry = (float(total_s), self._exemplar_seq, rid, spans)
+            self._exemplar_seq += 1
+            if len(self._exemplar_heap) < self.exemplar_k:
+                heapq.heappush(self._exemplar_heap, entry)
+                return True
+            if entry[0] > self._exemplar_heap[0][0]:
+                heapq.heapreplace(self._exemplar_heap, entry)
+                return True
+            return False
+
+    def exemplars(self) -> List[dict]:
+        """Retained tail exemplars, slowest first:
+        ``[{request_id, total_s, spans}, ...]`` (at most ``exemplar_k``)."""
+        with self._lock:
+            entries = sorted(self._exemplar_heap, reverse=True)
+        return [{"request_id": rid, "total_s": total, "spans": spans}
+                for total, _, rid, spans in entries]
+
+    def exemplar_trace(self) -> Dict[str, Any]:
+        """Chrome/Perfetto trace-event doc of ONLY the retained
+        exemplars' spans (``GET /debug/trace?exemplars=1``)."""
+        evs: List[dict] = []
+        for ex in self.exemplars():
+            evs.extend(ex["spans"])
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
     # -- export -------------------------------------------------------
 

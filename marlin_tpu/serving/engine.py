@@ -61,6 +61,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..obs.runlog import RunLog
 from ..obs.watch import CompileWatchdog
+from ..utils import cost_model as cm
 from .prefix import PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, Request
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
@@ -289,6 +290,11 @@ class ServingEngine:
         self._base_key = jax.random.PRNGKey(seed)
         self._next_id = 0
         self.round_idx = 0
+        # Cost-model calibration (stats.calibration, docs/observability
+        # .md §7): the per-round-iteration decode FLOPs the drift ledger
+        # prices measured rounds against, computed once — decode shapes
+        # are static, so the per-iteration prediction is a constant.
+        self._decode_flops, _ = cm.decode_step_cost(cfg, batch)
         # Pending + active requests ONLY: finished/timed-out requests
         # are returned from step()/run() and dropped here, so a
         # long-running engine holds O(batch + max_pending) requests.
@@ -318,6 +324,15 @@ class ServingEngine:
         # owning request's key at admission, advanced (live iterations
         # only) inside _decode_round. Host-side like filled/target.
         self._keys = np.zeros((batch, 2), np.uint32)
+        # One config event so an offline runlog analysis knows the
+        # engine's shape (tools/runlog_report.py reads ``batch`` for its
+        # occupancy/stall accounting instead of inferring it).
+        self.runlog.emit("engine_start", batch=batch,
+                         round_steps=round_steps,
+                         prefill_chunk=prefill_chunk,
+                         max_pending=max_pending,
+                         max_len=cfg.max_len,
+                         prefix_cache=prefix_cache is not None)
 
     # -- submission ---------------------------------------------------
 
@@ -413,7 +428,9 @@ class ServingEngine:
             self.stats.record_timeout(req)
             self.runlog.emit("timeout", request_id=req.request_id,
                              round=self.round_idx,
-                             deadline_rounds=req.deadline_rounds)
+                             deadline_rounds=req.deadline_rounds,
+                             wait_s=req.finish_time - req.submit_time)
+            self._finish_exemplar(req)
             # Same ownership transfer as retirement: timed-out requests
             # go back to the caller, not into an ever-growing dict (the
             # lock pairs the delete with submit()'s insert).
@@ -436,11 +453,13 @@ class ServingEngine:
             expired.extend(dropped)
             if req is None:
                 break
+            req.admit_start_time = time.perf_counter()  # queue_wait ends
             row = self.slots.acquire(req.request_id)
             s = req.prompt_len
             padded = np.zeros((pad_prompt_len(s),), np.int32)
             padded[:s] = req.prompt
             k_first, k_decode = self._request_keys(req)
+            t0 = time.perf_counter()
             with self.tracer.span("serving.admit", scope=False,
                                   request_id=req.request_id, row=row,
                                   prompt_len=s):
@@ -449,10 +468,14 @@ class ServingEngine:
                     jnp.asarray(padded), jnp.int32(s),
                     jnp.asarray(k_first), cfg=self.cfg,
                     temperature=self.temperature)
+            req.prefill_s += time.perf_counter() - t0
+            self.stats.calibration.record(
+                "prefill", cm.admission_cost(self.cfg, s)[0],
+                req.prefill_s)
             self._activate_row(req, row, k_decode)
             self.runlog.emit(
                 "admit", request_id=req.request_id, row=row,
-                round=self.round_idx,
+                round=self.round_idx, prompt_len=s,
                 wait_rounds=self.round_idx - req.submit_round,
                 queue_depth=len(self.queue))
         self._drop_expired(expired)
@@ -480,12 +503,16 @@ class ServingEngine:
                 if job.done:
                     break
             if job.done:
-                del self._prefilling[row]
+                # Delete under _submit_lock: debug_snapshot iterates
+                # _prefilling from handler threads under the same lock.
+                with self._submit_lock:
+                    del self._prefilling[row]
                 self._finish_admission(job)
         self._drop_expired(expired)
         return expired
 
     def _start_prefill(self, req: Request) -> None:
+        req.admit_start_time = time.perf_counter()  # queue_wait ends
         row = self.slots.acquire(req.request_id)
         hit_row, hit = (None, 0)
         if self.prefix_cache is not None:
@@ -495,11 +522,19 @@ class ServingEngine:
                 # copy — the reuse that replaces recomputing them; the
                 # engine cache is donated through, so its buffer
                 # pointers stay stable across prefix-hit admissions.
+                t0 = time.perf_counter()
                 with self.tracer.span("serving.prefix_copy", scope=False,
                                       request_id=req.request_id, row=row,
                                       hit_len=hit):
                     self._cache = self.prefix_cache.load_into(
                         self._cache, row, hit_row, hit)
+                req.prefix_copy_s = time.perf_counter() - t0
+                # Copy cost is byte-priced: admission_cost at tail=0
+                # reduces to exactly the copy's read+write traffic.
+                self.stats.calibration.record(
+                    "copy", cm.admission_cost(self.cfg, hit,
+                                              hit_len=hit)[1],
+                    req.prefix_copy_s)
             self.stats.record_prefix_lookup(hit, req.prompt_len)
         k_first, k_decode = self._request_keys(req)
         # Mid-prefill rows ride through decode rounds FROZEN, and a
@@ -512,9 +547,13 @@ class ServingEngine:
         # only step that can attend it), so interleaved rounds cannot
         # clobber a partially prefilled prompt.
         self._filled[row] = self.cfg.max_len
-        self._prefilling[row] = _PrefillJob(
-            req=req, row=row, pos=hit, hit_len=hit, k_first=k_first,
-            k_decode=k_decode, start_round=self.round_idx)
+        # Insert under _submit_lock: pairs with debug_snapshot's
+        # handler-thread iteration (the delete in _admit_chunked takes
+        # the same lock).
+        with self._submit_lock:
+            self._prefilling[row] = _PrefillJob(
+                req=req, row=row, pos=hit, hit_len=hit, k_first=k_first,
+                k_decode=k_decode, start_round=self.round_idx)
         self.runlog.emit("prefill_start", request_id=req.request_id,
                          row=row, round=self.round_idx,
                          prompt_len=req.prompt_len, prefix_hit_len=hit)
@@ -528,6 +567,7 @@ class ServingEngine:
         seg = np.zeros((pad_prompt_len(clen),), np.int32)
         seg[:clen] = req.prompt[c0:c1]
         final = c1 == s
+        t0 = time.perf_counter()
         with self.tracer.span("serving.admit_chunk", scope=False,
                               request_id=req.request_id, row=job.row,
                               start=c0, chunk_len=clen, final=final):
@@ -550,6 +590,12 @@ class ServingEngine:
                     jnp.int32(clen), jnp.asarray(seg), jnp.int32(s),
                     jnp.asarray(job.k_first), cfg=self.cfg,
                     temperature=self.temperature, final=False)
+        dt = time.perf_counter() - t0
+        req.prefill_s += dt
+        # Incremental prediction for the [c0, c1) tail wedge: the
+        # admission model's flops at prompt=c1 with a hit of c0.
+        self.stats.calibration.record(
+            "prefill", cm.admission_cost(self.cfg, c1, hit_len=c0)[0], dt)
         job.pos = c1
         job.chunks += 1
 
@@ -563,7 +609,7 @@ class ServingEngine:
             self.prefix_cache.store_from(self._cache, job.row, req.prompt)
         self.runlog.emit(
             "admit", request_id=req.request_id, row=job.row,
-            round=self.round_idx,
+            round=self.round_idx, prompt_len=req.prompt_len,
             wait_rounds=self.round_idx - req.submit_round,
             prefill_rounds=self.round_idx - job.start_round + 1,
             chunks=job.chunks, prefix_hit_len=job.hit_len,
@@ -605,7 +651,10 @@ class ServingEngine:
                 emitted=req.emitted, live_iters=req.live_iters,
                 submit_t=req.submit_time, admit_t=req.admit_time,
                 finish_t=req.finish_time,
-                rounds=req.finish_round - req.admit_round + 1)
+                rounds=req.finish_round - req.admit_round + 1,
+                phases={k: round(v, 6)
+                        for k, v in req.phases().items()})
+            self._finish_exemplar(req)
             # Ownership of a finished request transfers to the caller
             # (step()/run() return it); holding it here would grow host
             # memory without bound on a long-running server — the queue
@@ -615,11 +664,45 @@ class ServingEngine:
             finished.append(req)
         return finished
 
+    def _finish_exemplar(self, req: Request) -> None:
+        """Close a retired/timed-out request's tail-exemplar candidacy:
+        synthesize its contiguous phase segments as trace events and let
+        the tracer's slowest-k reservoir decide (obs/trace.py). A
+        tracer without exemplar retention makes this one attribute
+        read."""
+        tr_ = self.tracer
+        if not (tr_.enabled and tr_.exemplar_k):
+            return
+        spans = []
+        rid = req.request_id
+        if req.admit_start_time:
+            spans.append(tr_.span_from_stamps(
+                "serving.phase.queue_wait", req.submit_time,
+                req.admit_start_time, request_id=rid))
+            if req.admit_time:
+                spans.append(tr_.span_from_stamps(
+                    "serving.phase.admit", req.admit_start_time,
+                    req.admit_time, request_id=rid,
+                    prefill_s=round(req.prefill_s, 6),
+                    prefix_copy_s=round(req.prefix_copy_s, 6)))
+                if req.finish_time:
+                    spans.append(tr_.span_from_stamps(
+                        "serving.phase.decode", req.admit_time,
+                        req.finish_time, request_id=rid,
+                        emitted=req.emitted))
+        elif req.finish_time:  # expired in the queue
+            spans.append(tr_.span_from_stamps(
+                "serving.phase.queue_wait", req.submit_time,
+                req.finish_time, request_id=rid, status="timeout"))
+        total = max(0.0, req.finish_time - req.submit_time)
+        tr_.finish_request(rid, total, extra_spans=spans)
+
     def step(self) -> List[Request]:
         """One scheduling round: admit into free rows, decode one
         bounded round, retire finished rows. Returns the requests that
         finished (or timed out) this round."""
         admitted0 = self.stats.n_admitted
+        t_round0 = time.perf_counter()
         with self.tracer.span("serving.round", scope=False,
                               round=self.round_idx):
             expired = self._admit()
@@ -630,6 +713,7 @@ class ServingEngine:
             # such rows at body entry; marking them here saves the
             # all-done round a no-op trip.
             done0 = ~self._active | (self._filled >= self._target)
+            t_dec0 = time.perf_counter()
             with self.tracer.span("serving.decode_round", scope=False,
                                   occupied=self.slots.n_occupied):
                 self._buf, filled_d, done_d, self._cache, iters_d, \
@@ -643,6 +727,14 @@ class ServingEngine:
                         temperature=self.temperature, eos_id=self.eos_id)
                 filled, done, iters, live, keys = jax.device_get(
                     (filled_d, done_d, iters_d, live_d, keys_d))
+            # The device_get above fences the round, so this host delta
+            # covers dispatch + execution — the measured side the drift
+            # ledger confronts the decode cost model with. All-idle
+            # rounds (iters == 0) carry no model work and are skipped.
+            decode_s = time.perf_counter() - t_dec0
+            if int(iters):
+                self.stats.calibration.record(
+                    "decode", int(iters) * self._decode_flops, decode_s)
             self._filled = np.array(filled, np.int32)  # writable copy
             self._keys = np.array(keys, np.uint32)
             for row in self.slots.occupied_rows():
@@ -670,7 +762,10 @@ class ServingEngine:
             retired=len(finished), expired=len(expired),
             prefilling=len(self._prefilling),
             queue_depth=len(self.queue),
-            wasted_row_iters=int(iters) * self.batch - live_sum)
+            wasted_row_iters=int(iters) * self.batch - live_sum,
+            round_s=round(time.perf_counter() - t_round0, 6),
+            decode_s=round(decode_s, 6),
+            drift_decode=round(self.stats.calibration.drift("decode"), 4))
         self.round_idx += 1
         return expired + finished
 
@@ -733,3 +828,72 @@ class ServingEngine:
         embedding caller share this."""
         self.close()
         return self.run(max_rounds=max_rounds)
+
+    # -- debug introspection (any thread) -----------------------------
+
+    def debug_snapshot(self) -> dict:
+        """Point-in-time engine state for ``GET /debug/engine``
+        (docs/frontend.md): occupancy, queue depth, in-flight prefill
+        jobs, the stats/calibration ledgers, and the prefix pool
+        summary. Safe from any thread: the shared request/prefill dicts
+        are read under ``_submit_lock`` (paired with the driver's
+        mutations); the scalar reads outside it are racy by a round at
+        most — this is a debug view, not an accounting surface."""
+        with self._submit_lock:
+            requests = {
+                int(rid): {"status": req.status, "row": req.row,
+                           "prompt_len": req.prompt_len,
+                           "steps": req.steps}
+                for rid, req in self.requests.items()}
+            prefilling = [
+                {"request_id": job.req.request_id, "row": row,
+                 "pos": job.pos, "prompt_len": job.req.prompt_len,
+                 "hit_len": job.hit_len, "chunks": job.chunks,
+                 "start_round": job.start_round}
+                for row, job in self._prefilling.items()]
+        out = {
+            "round": self.round_idx,
+            "batch": self.batch,
+            "round_steps": self.round_steps,
+            "occupied": self.slots.n_occupied,
+            "queue_depth": len(self.queue),
+            "queue_closed": self.queue.closed,
+            "requests": requests,
+            "prefilling": prefilling,
+            "stats": self.stats.summary(),
+            "cost_model_drift": self.stats.calibration.summary(),
+        }
+        if self.prefix_cache is not None:
+            out["prefix_pool"] = self.prefix_cache.summary()
+        return out
+
+    def debug_request(self, request_id: int) -> Optional[dict]:
+        """One request's timeline view for ``GET /debug/requests/<id>``:
+        a LIVE request reports its phases so far (queue_wait/admit
+        closed as reached, the clock still running on the open one); a
+        COMPLETED one is served from the stats ledger's bounded
+        completion window; retained tail exemplars attach their span
+        trees. None when the id is unknown (fell out of the window)."""
+        with self._submit_lock:
+            req = self.requests.get(request_id)
+            if req is not None:
+                out = {"request_id": req.request_id,
+                       "status": req.status, "row": req.row,
+                       "prompt_len": req.prompt_len, "steps": req.steps,
+                       "live_iters": req.live_iters,
+                       "phases": req.phases(),
+                       "age_s": time.perf_counter() - req.submit_time}
+            else:
+                out = None
+        if out is None:
+            for rec in reversed(self.stats.completed_snapshot()):
+                if rec["request_id"] == request_id:
+                    out = dict(rec)
+                    break
+        if out is None:
+            return None
+        for ex in self.tracer.exemplars():
+            if ex["request_id"] == str(request_id):
+                out["exemplar"] = ex
+                break
+        return out

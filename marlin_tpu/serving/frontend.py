@@ -234,6 +234,23 @@ class EngineFrontend:
         self._wake.set()
         return handle
 
+    # -- debug introspection (handler threads) ------------------------
+
+    def debug_engine(self) -> dict:
+        """Engine state for ``GET /debug/engine`` — the server goes
+        through the bridge, never the engine (bridge contract), plus
+        the bridge's own gauge: live handle count and driver health."""
+        out = self.engine.debug_snapshot()
+        with self._lock:
+            out["frontend"] = {"handles": len(self._handles),
+                               "alive": self.alive,
+                               "draining": self.draining}
+        return out
+
+    def debug_request(self, request_id: int):
+        """Per-request timeline for ``GET /debug/requests/<id>``."""
+        return self.engine.debug_request(request_id)
+
     # -- the driver loop ----------------------------------------------
 
     def _has_work(self) -> bool:
@@ -304,6 +321,16 @@ class EngineFrontend:
                 # concatenated stream equals the blocking array exactly.
                 h._push(np.asarray(req.tokens[h._streamed:], np.int32),
                         now)
+            # stream_delivery: engine finish -> fanout handoff, the
+            # bridge's own slice of the phase timeline (same
+            # perf_counter clock as the engine's stamps).
+            req.delivered_time = now
+            if req.finish_time:
+                self.metrics.histogram(
+                    "serving_phase_seconds", phase="stream_delivery",
+                    help="per-request phase durations, seconds",
+                ).observe(max(0.0, now - req.finish_time),
+                          exemplar=str(req.request_id))
             if h.first_token_time is not None:
                 self.metrics.histogram(
                     "serving_http_ttft_seconds").observe(

@@ -76,9 +76,23 @@ class Request:
     # of batch composition (engine docstring, sampled-path contract).
     row: int = -1
     admit_round: int = -1
+    # Phase-timeline stamps (docs/observability.md §7), all
+    # ``time.perf_counter()`` instants on ONE monotonic clock so phase
+    # durations are contiguous differences that sum EXACTLY to the
+    # end-to-end latency: submit_time -> admit_start_time (popped from
+    # the queue, admission work begins) -> admit_time (row armed, first
+    # token exists) -> finish_time. prefill_s / prefix_copy_s are
+    # SUB-attributions inside the admit phase (host wall-clock of the
+    # dispatches), informational rather than part of the contiguous sum
+    # — a chunked admission's admit phase also contains the decode
+    # rounds it rode through frozen.
+    admit_start_time: float = 0.0
     admit_time: float = 0.0
     finish_round: int = -1
     finish_time: float = 0.0
+    prefill_s: float = 0.0      # prefill dispatch wall (sum over chunks)
+    prefix_copy_s: float = 0.0  # prefix-cache donor-row copy wall
+    delivered_time: float = 0.0  # frontend fanout done (0: engine-only)
     live_iters: int = 0  # decode iterations this request was live for
     emitted: int = 0  # tokens actually generated (< steps if eos fired)
     status: str = "pending"  # pending -> active -> done | timeout
@@ -87,6 +101,42 @@ class Request:
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+    def phases(self) -> dict:
+        """Per-phase durations (seconds) of this request's life so far.
+
+        The contiguous phases — ``queue_wait`` (submit -> popped),
+        ``admit`` (popped -> row armed; prefix copy + prefill chunks +
+        any rounds ridden through frozen), ``decode`` (armed -> finish)
+        — sum exactly to ``total`` (finish - submit) by construction:
+        they are differences of consecutive stamps on one clock, which
+        is what makes the runlog analyzer's phase-sum-vs-wall-clock
+        check a 5%-tolerance identity rather than a reconciliation.
+        Sub-attributions (``prefill_dispatch``, ``prefix_copy``) and the
+        frontend's ``stream_delivery`` (finish -> handle delivered) ride
+        along outside the sum. A timed-out request has only
+        ``queue_wait``/``total``; an in-flight one reports the phases
+        completed so far."""
+        out = {}
+        if not self.submit_time:
+            return out
+        if self.admit_start_time:
+            out["queue_wait"] = self.admit_start_time - self.submit_time
+            if self.admit_time:
+                out["admit"] = self.admit_time - self.admit_start_time
+                if self.finish_time:
+                    out["decode"] = self.finish_time - self.admit_time
+        elif self.finish_time:  # timed out while queued
+            out["queue_wait"] = self.finish_time - self.submit_time
+        if self.finish_time:
+            out["total"] = self.finish_time - self.submit_time
+        if self.prefill_s:
+            out["prefill_dispatch"] = self.prefill_s
+        if self.prefix_copy_s:
+            out["prefix_copy"] = self.prefix_copy_s
+        if self.delivered_time and self.finish_time:
+            out["stream_delivery"] = self.delivered_time - self.finish_time
+        return out
 
 
 @dataclass
@@ -144,6 +194,7 @@ class AdmissionQueue:
                             and now > req.deadline_time)):
                     req.status = "timeout"
                     req.finish_round = round_idx
+                    req.finish_time = now  # closes the queue_wait phase
                     expired.append(req)
                     continue
                 return req, expired

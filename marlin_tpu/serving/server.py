@@ -25,6 +25,21 @@ dependencies beyond the stdlib. Endpoints (docs/frontend.md):
 * ``GET /healthz`` — 200 while the listener accepts (liveness).
 * ``GET /readyz`` — 200 only while the driver thread is alive and NOT
   draining; 503 otherwise (readiness — what a load balancer keys on).
+* ``GET /debug/engine`` — point-in-time engine state: occupancy, queue
+  depth, in-flight prefill jobs, the stats + cost-model-drift ledgers,
+  prefix pool summary (docs/frontend.md §debug).
+* ``GET /debug/requests/<id>`` — one request's phase timeline (live:
+  phases so far; completed: the ledger record), with its tail-exemplar
+  span tree attached when the tracer retained one.
+* ``GET /debug/trace`` — Chrome/Perfetto trace-event JSON of the
+  process tracer's buffer (``?exemplars=1``: only the slowest-k
+  exemplar traces).
+
+Every generate response carries a ``timing`` block — the request's
+per-phase latency attribution (queue_wait/admit/decode summing exactly
+to total, plus prefill/copy sub-attributions and the HTTP-side
+end-to-end) — in the blocking JSON and in the SSE terminal ``done``
+event alike.
 
 Backpressure maps to status codes instead of silent buffering:
 ``QueueFull`` → 429 with ``Retry-After``; draining (``QueueClosed``) →
@@ -45,6 +60,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -83,7 +99,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_json(self, code: int, obj: dict, route: str,
                    headers: Optional[dict] = None) -> None:
-        body = json.dumps(obj).encode()
+        body = json.dumps(obj, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -96,7 +112,7 @@ class _Handler(BaseHTTPRequestHandler):
     # -- GET ----------------------------------------------------------
 
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             body = self.server.registry.prometheus().encode()
             self.send_response(200)
@@ -116,6 +132,31 @@ class _Handler(BaseHTTPRequestHandler):
                  "driver_alive": self.frontend.alive},
                 "/readyz",
                 headers=None if ready else {"Retry-After": RETRY_AFTER_S})
+        elif path == "/debug/engine":
+            self._send_json(200, self.frontend.debug_engine(),
+                            "/debug/engine")
+        elif path.startswith("/debug/requests/"):
+            route = "/debug/requests"
+            try:
+                rid = int(path[len("/debug/requests/"):])
+            except ValueError:
+                self._send_json(400, {"error": "request id must be the "
+                                      "integer engine id"}, route)
+                return
+            info = self.frontend.debug_request(rid)
+            if info is None:
+                self._send_json(
+                    404, {"error": f"request {rid} unknown (never "
+                          "submitted, or fell out of the completion "
+                          "window)"}, route)
+            else:
+                self._send_json(200, info, route)
+        elif path == "/debug/trace":
+            params = urllib.parse.parse_qs(query)
+            doc = (self.server.tracer.exemplar_trace()
+                   if params.get("exemplars", ["0"])[-1] == "1"
+                   else self.server.tracer.to_chrome_trace())
+            self._send_json(200, doc, "/debug/trace")
         else:
             self._send_json(404, {"error": f"no route {path}"}, path)
 
@@ -171,10 +212,25 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._respond_blocking(handle, route, id_headers)
 
-    def _finish_fields(self, req) -> dict:
-        return {"request_id": req.request_id, "status": req.status,
-                "emitted": req.emitted,
-                "prompt_len": req.prompt_len, "steps": req.steps}
+    def _finish_fields(self, req, handle=None) -> dict:
+        out = {"request_id": req.request_id, "status": req.status,
+               "emitted": req.emitted,
+               "prompt_len": req.prompt_len, "steps": req.steps,
+               # The latency-attribution block (docs/frontend.md): the
+               # engine's contiguous phase durations (queue_wait +
+               # admit + decode == total exactly — one monotonic clock)
+               # plus the dispatch sub-attributions.
+               "timing": {f"{k}_s": round(v, 6)
+                          for k, v in req.phases().items()}}
+        if handle is not None:
+            # The HTTP side of the same timeline, on the handle's own
+            # stamps: submit-at-bridge -> first streamed token -> now.
+            if handle.first_token_time is not None:
+                out["timing"]["http_ttft_s"] = round(
+                    handle.first_token_time - handle.submit_time, 6)
+            out["timing"]["http_total_s"] = round(
+                time.perf_counter() - handle.submit_time, 6)
+        return out
 
     def _respond_blocking(self, handle, route, id_headers) -> None:
         try:
@@ -186,11 +242,11 @@ class _Handler(BaseHTTPRequestHandler):
         if req.status != "done":
             # Queued past its deadline: admission never happened.
             self._send_json(504, {"error": "deadline exceeded in queue",
-                                  **self._finish_fields(req)},
+                                  **self._finish_fields(req, handle)},
                             route, headers=id_headers)
             return
         self._send_json(
-            200, {**self._finish_fields(req),
+            200, {**self._finish_fields(req, handle),
                   "tokens": np.asarray(req.tokens).tolist()},
             route, headers=id_headers)
 
@@ -212,7 +268,7 @@ class _Handler(BaseHTTPRequestHandler):
             for chunk in handle.chunks():
                 self._sse({"tokens": np.asarray(chunk).tolist()})
             req = handle.result(0.0 if handle.done.is_set() else None)
-            self._sse({"done": True, **self._finish_fields(req)})
+            self._sse({"done": True, **self._finish_fields(req, handle)})
             self._chunk(b"")  # terminal zero-length chunk
         except (FrontendError, TimeoutError) as e:
             code = 503  # accounting only: the 200 already went out

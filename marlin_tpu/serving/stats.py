@@ -25,6 +25,7 @@ priced by ``utils.cost_model.decode_step_cost``.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -46,7 +47,10 @@ def request_stats(req) -> dict:
     sampled inside the admission prefill); decode throughput counts the
     request's generated tokens over its admit -> finish wall-clock.
     Round-indexed twins of each figure are the noise-free CI/simulation
-    view (wall-clock on a shared CPU host is weather)."""
+    view (wall-clock on a shared CPU host is weather). ``phases`` is the
+    request's per-phase timeline (``Request.phases``): contiguous
+    queue_wait/admit/decode durations summing exactly to ``total``, plus
+    the prefill/copy sub-attributions."""
     wait_s = max(0.0, req.admit_time - req.submit_time) \
         if req.admit_round >= 0 else None
     out = {
@@ -60,6 +64,7 @@ def request_stats(req) -> dict:
         "queue_wait_s": wait_s,
         "ttft_s": wait_s,  # first token lands with the admission prefill
         "live_iters": req.live_iters,
+        "phases": req.phases(),
     }
     if req.status == "done":
         dt = max(req.finish_time - req.admit_time, 1e-9)
@@ -112,11 +117,20 @@ class EngineStats:
     ``serving_token_latency_seconds``) — so one ``metrics.snapshot()``
     covers the engine next to the op timings, instead of the two
     parallel accounting surfaces PR 2 left behind.
+
+    Two PR-6 surfaces live here too: the per-phase latency mirror
+    (``serving_phase_seconds{phase=queue_wait|admit|decode|...}``, fed
+    from each completed request's contiguous phase timeline) and the
+    cost-model CALIBRATION ledger (``calibration``,
+    utils/cost_model.CostCalibration) the engine feeds measured-vs-
+    predicted samples per op class — its drift ratios export as
+    ``cost_model_drift_ratio{op=...}`` gauges and ride the summary.
     """
 
     batch: int
     cfg: object = None
     registry: Optional[obs_metrics.MetricsRegistry] = None
+    calibration: Optional[cm.CostCalibration] = None
     n_admitted: int = 0
     n_completed: int = 0
     n_timeout: int = 0
@@ -133,18 +147,38 @@ class EngineStats:
     reclaimed_prefill_flops: float = 0.0
     rounds: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
     completed: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
+    # Guards DEQUE ITERATION against driver-thread appends: the debug
+    # endpoints (engine.debug_snapshot/debug_request) read ``completed``
+    # from HTTP handler threads while the driver retires requests, and
+    # CPython raises on a deque mutated mid-iteration. Appends and the
+    # iterating readers take it; the scalar counters stay lock-free
+    # (single-writer, and a racy scalar read is at most a round stale).
+    _lock: object = field(default_factory=threading.Lock, repr=False)
+
+    def __post_init__(self):
+        if self.calibration is None:
+            self.calibration = cm.CostCalibration(registry=self.registry)
 
     # -- engine callbacks --------------------------------------------
 
     def record_admission(self, req) -> None:
         self.n_admitted += 1
         if self.registry is not None:
-            self.registry.counter("serving_admitted_total").inc()
+            self.registry.counter(
+                "serving_admitted_total",
+                help="requests admitted into a batch row").inc()
             if req.submit_time:
                 # First token lands with the admission prefill: TTFT is
-                # the submit -> admission-dispatch wall-clock.
-                self.registry.histogram("serving_ttft_seconds").observe(
-                    max(0.0, req.admit_time - req.submit_time))
+                # the submit -> admission-dispatch wall-clock. The
+                # request id rides as the bucket's EXEMPLAR — the
+                # breadcrumb from a slow bucket to the tail-exemplar
+                # trace the Tracer retains for that id.
+                self.registry.histogram(
+                    "serving_ttft_seconds",
+                    help="submit -> first token (admission prefill) "
+                         "seconds; bucket exemplars carry request ids",
+                ).observe(max(0.0, req.admit_time - req.submit_time),
+                          exemplar=str(req.request_id))
 
     def record_prefix_lookup(self, hit_len: int, prompt_len: int) -> None:
         """One admission's prefix-cache outcome: ``hit_len`` prompt
@@ -184,27 +218,49 @@ class EngineStats:
         self.n_rounds += 1
         self.total_iters += iters
         self.useful_row_iters += live_iters
-        self.rounds.append({"round": round_idx, "iters": iters,
-                            "occupied": occupied,
-                            "live_iters": live_iters})
+        with self._lock:
+            self.rounds.append({"round": round_idx, "iters": iters,
+                                "occupied": occupied,
+                                "live_iters": live_iters})
         if self.registry is not None:
             self.registry.counter("serving_decode_iters_total").inc(iters)
             self.registry.gauge("serving_occupancy").set(occupied)
             self.registry.gauge("serving_utilization").set(
                 self.utilization())
 
+    # The contiguous phases mirrored into serving_phase_seconds; the
+    # sub-attributions (prefill_dispatch, prefix_copy) and the
+    # frontend's stream_delivery share the family but are observed at
+    # their own sites.
+    PHASE_KEYS = ("queue_wait", "admit", "decode", "total")
+    PHASE_HELP = ("per-request phase durations, seconds; phases "
+                  "queue_wait+admit+decode sum exactly to total "
+                  "(docs/observability.md section 7)")
+
     def record_completion(self, req) -> None:
         self.n_completed += 1
         self.tokens_out += req.emitted  # eos-padded tail is not output
-        self.completed.append(request_stats(req))
+        with self._lock:
+            self.completed.append(request_stats(req))
         if self.registry is not None:
-            self.registry.counter("serving_completed_total").inc()
+            self.registry.counter(
+                "serving_completed_total",
+                help="requests finished with output").inc()
             self.registry.counter("serving_tokens_out_total").inc(
                 req.emitted)
             dt = max(req.finish_time - req.admit_time, 0.0)
             self.registry.histogram(
                 "serving_token_latency_seconds").observe(
                     dt / max(req.emitted, 1))
+            phases = req.phases()
+            rid = str(req.request_id)
+            for key in self.PHASE_KEYS + ("prefill_dispatch",
+                                          "prefix_copy"):
+                if key in phases:
+                    self.registry.histogram(
+                        "serving_phase_seconds", phase=key,
+                        help=self.PHASE_HELP,
+                    ).observe(max(0.0, phases[key]), exemplar=rid)
 
     # -- the ledger ---------------------------------------------------
 
@@ -265,8 +321,16 @@ class EngineStats:
         return (static_waste - self.wasted_row_iters) \
             * self.flops_per_row_iter()
 
+    def completed_snapshot(self) -> List[dict]:
+        """Point-in-time copy of the completion window, safe to iterate
+        from any thread (the debug endpoints' read side of ``_lock``)."""
+        with self._lock:
+            return list(self.completed)
+
     def summary(self) -> Dict[str, object]:
-        """One observability dict — the bench line's raw material."""
+        """One observability dict — the bench line's raw material.
+        Callable from any thread (debug_snapshot): the completion-window
+        scan copies under the deque lock."""
         out = {
             "admitted": self.n_admitted,
             "completed": self.n_completed,
@@ -290,7 +354,8 @@ class EngineStats:
                 "prefix_reclaimed_prefill_gflops": round(
                     self.reclaimed_prefill_flops / 1e9, 4),
             })
-        done = [c for c in self.completed if c["status"] == "done"]
+        done = [c for c in self.completed_snapshot()
+                if c["status"] == "done"]
         if done:
             waits = [c["queue_wait_rounds"] for c in done]
             out["mean_queue_wait_rounds"] = sum(waits) / len(waits)
@@ -298,4 +363,15 @@ class EngineStats:
             ttfts = [c["ttft_s"] for c in done if c["ttft_s"] is not None]
             if ttfts:
                 out["mean_ttft_s"] = round(sum(ttfts) / len(ttfts), 5)
+            # Phase means over the retained completion window — the
+            # ledger's own view of where request time went.
+            for key in self.PHASE_KEYS:
+                vals = [c["phases"][key] for c in done
+                        if key in c.get("phases", {})]
+                if vals:
+                    out[f"mean_phase_{key}_s"] = round(
+                        sum(vals) / len(vals), 5)
+        drift = self.calibration.summary() if self.calibration else {}
+        if drift:
+            out["cost_model_drift"] = drift
         return out

@@ -27,6 +27,7 @@ Conventions:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -45,6 +46,10 @@ __all__ = [
     "admission_cost",
     "run_lu_trend_sweep", "LU_TREND_GRID",
     "run_cholesky_trend_sweep", "CHOLESKY_TREND_GRID",
+    "run_spmm_trend_sweep", "SPMM_TREND_GRID",
+    "run_spmm_crossover_sweep", "SPMM_CROSSOVER_SLOTS",
+    "derive_ell_density_max",
+    "CostCalibration",
 ]
 
 
@@ -870,6 +875,246 @@ def run_cholesky_trend_sweep(grid=CHOLESKY_TREND_GRID, reps: int = 3,
         return cholesky_factor_array(a, mode="dist", base_size=base_size)
 
     return _factor_trend_sweep(grid, make, factor, 1.0 / 3.0, reps)
+
+
+# Spmm n-sweep (ROADMAP item 2, final slice): square (n x n) ELL spmm
+# against a dense (n, n) B at a FIXED slot count R per row, so
+# ell_product_cost's FLOPs term 2 * (n/nd) * R * n reduces to an exact
+# n^2 — 4x per doubling, the attention slice's exact-term contract
+# (density R/n varies along the grid; the model prices slots, not
+# density, so the term stays exact). The smallest point is sized so the
+# gather work dominates the CPU mesh's per-dispatch overhead.
+SPMM_TREND_GRID = (512, 1024, 2048)
+_SPMM_TREND_SLOTS = 4
+
+
+def _spmm_operand(n: int, r_slots: int, mesh):
+    """Deterministic (n, n) DistSparseVecMatrix with EXACTLY ``r_slots``
+    nonzeros per row (columns strided so no row collides), the shape the
+    ELL layout packs with zero padding waste — the sweep measures the
+    engine, not layout skew."""
+    import numpy as np
+
+    from ..matrix.dist_sparse import DistSparseVecMatrix
+
+    rows = np.repeat(np.arange(n, dtype=np.int64), r_slots)
+    cols = (rows * 7 + np.tile(np.arange(r_slots, dtype=np.int64), n)
+            * max(n // max(r_slots, 1), 1) + 3) % n
+    vals = (1.0 + (rows * r_slots + cols) % 5).astype(np.float32)
+    return DistSparseVecMatrix.from_coo(rows, cols, vals, (n, n),
+                                        mesh=mesh)
+
+
+def run_spmm_trend_sweep(mesh=None, grid=SPMM_TREND_GRID,
+                         r_slots: int = _SPMM_TREND_SLOTS, reps: int = 3):
+    """ELL spmm n-sweep: measured wall-clock of the row-gather engine
+    (matrix/dist_sparse._ell_product via ``mode="ell"``) on square
+    (n, n) x (n, n) products with ``r_slots`` entries per row, paired
+    with :func:`ell_product_cost`'s FLOPs term (exactly
+    ``2 * n/nd * r_slots * n`` — n^2 along the grid). Same
+    ``powerlaw_fit`` exponent-band + residual contract as the other
+    ROADMAP-2 slices; reported in the ``--config trend`` bench line."""
+    import jax.numpy as jnp
+
+    from ..matrix.dist_sparse import _n_dev, _spmm_array
+    from ..mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    nd = _n_dev(mesh)
+    out = []
+    for n in grid:
+        a = _spmm_operand(n, r_slots, mesh)
+        b = jnp.ones((n, n), jnp.float32)
+        a.ell_stripes()  # layout conversion outside the timed region
+        flops, _ = ell_product_cost(n, n, n, r_slots, nd)
+        out.append({
+            "n": n, "r_slots": r_slots, "predicted": flops,
+            "measured": measure_wallclock(
+                lambda a=a, b=b: _spmm_array(a, b, mode="ell"),
+                reps=reps),
+        })
+    return out
+
+
+# ELL-vs-dense crossover (ROADMAP item 2 / VERDICT #4): at a fixed n,
+# sweep the per-row slot count — density = r/n — timing BOTH engines at
+# each point. The densities where the row-gather still beats the
+# densified MXU ring bound the dispatch constant
+# MarlinConfig.sparse_ell_density_max guards; the bench line reports the
+# measured crossover so the constant is data-backed, not folklore.
+SPMM_CROSSOVER_SLOTS = (1, 8, 32, 128)
+
+
+def run_spmm_crossover_sweep(mesh=None, n: int = 1024,
+                             slots=SPMM_CROSSOVER_SLOTS, reps: int = 3):
+    """Measure ELL vs dense spmm wall-clock over a per-row-slot grid at
+    fixed ``n``; returns per-point ``{density, ell_s, dense_s,
+    ell_over_dense}``. Feed the points to
+    :func:`derive_ell_density_max` for the crossover density."""
+    import jax.numpy as jnp
+
+    from ..matrix.dist_sparse import _spmm_array
+    from ..mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    b = jnp.ones((n, n), jnp.float32)
+    out = []
+    for r in slots:
+        a = _spmm_operand(n, r, mesh)
+        a.ell_stripes()      # both format conversions outside the
+        a.densify_stripes()  # timed region: the engines race, not I/O
+        ell_s = measure_wallclock(
+            lambda a=a, b=b: _spmm_array(a, b, mode="ell"), reps=reps)
+        dense_s = measure_wallclock(
+            lambda a=a, b=b: _spmm_array(a, b, mode="dense"), reps=reps)
+        out.append({"n": n, "r_slots": r, "density": r / n,
+                    "ell_s": ell_s, "dense_s": dense_s,
+                    "ell_over_dense": ell_s / max(dense_s, 1e-12)})
+    return out
+
+
+def derive_ell_density_max(points) -> float:
+    """Data-backed ``sparse_ell_density_max`` from a crossover sweep:
+    the density where ``ell_over_dense`` crosses 1.0, log-interpolated
+    between the last ELL-winning point and the first dense-winning one.
+    Clamps to the grid: ELL winning everywhere returns the highest
+    measured density (the crossover is above the sweep), dense winning
+    everywhere returns half the lowest (below it). Points need not be
+    sorted; ratios <= 0 are rejected."""
+    import math as _math
+
+    pts = sorted(points, key=lambda p: p["density"])
+    if not pts:
+        raise ValueError("empty crossover sweep")
+    if any(p["ell_over_dense"] <= 0 for p in pts):
+        raise ValueError("ell_over_dense must be positive")
+    if pts[0]["ell_over_dense"] >= 1.0:  # dense wins even at the floor
+        return pts[0]["density"] / 2.0
+    last_win = pts[0]
+    for p in pts[1:]:
+        if p["ell_over_dense"] < 1.0:
+            last_win = p
+            continue
+        # log-log interpolation of the ratio=1 crossing in density.
+        d0, r0 = last_win["density"], last_win["ell_over_dense"]
+        d1, r1 = p["density"], p["ell_over_dense"]
+        t = (0.0 - _math.log(r0)) / (_math.log(r1) - _math.log(r0))
+        return float(_math.exp(
+            _math.log(d0) + t * (_math.log(d1) - _math.log(d0))))
+    return pts[-1]["density"]  # ELL wins across the whole sweep
+
+
+# ---------------------------------------------------------------------------
+# Cost-model calibration: confronting predictions with production wall-clock
+# ---------------------------------------------------------------------------
+
+
+class CostCalibration:
+    """EWMA drift ledger: measured wall-clock vs model-predicted cost,
+    per op class (docs/observability.md §7).
+
+    The trend sweeps above validate the models OFFLINE; this ledger is
+    the in-production counterpart the serving engine feeds every round:
+    ``record(op, predicted_units, measured_s)`` tracks the seconds-per-
+    model-unit ratio per op class (``decode``/``prefill``/``copy``),
+    CALIBRATES a baseline from the first ``warmup`` samples (median —
+    one GC hiccup in the window must not skew the reference), then
+    maintains an EWMA of the ratio. ``drift(op)`` = EWMA / baseline —
+    1.0 means the model still prices this op the way it did when the
+    engine warmed up; sustained drift means the model (or the machine)
+    moved, which is exactly the signal a cost-model-driven scheduler
+    (ROADMAP items 16/17) must watch before trusting its admission
+    prices. Mirrored as ``cost_model_drift_ratio{op=...}`` gauges when
+    a metrics registry is attached (duck-typed: anything with
+    ``.gauge(name, **labels).set``).
+
+    Model units are whatever the caller's predictor returns (FLOPs for
+    decode/prefill, bytes for the prefix copy) — drift is unit-free.
+    The single driver thread records; an internal lock covers the op
+    table so readers on other threads (``engine.debug_snapshot`` serving
+    ``GET /debug/engine`` from HTTP handlers) get consistent views while
+    ``record`` inserts new op classes."""
+
+    def __init__(self, alpha: float = 0.2, warmup: int = 5,
+                 registry=None):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if warmup < 1:
+            raise ValueError(f"warmup must be >= 1, got {warmup}")
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.registry = registry
+        self._ops: dict = {}
+        # RLock: record() reads drift() for the registry mirror while
+        # holding it.
+        self._lock = threading.RLock()
+
+    def record(self, op: str, predicted_units: float,
+               measured_s: float) -> None:
+        """One sample: the model said ``predicted_units``, the wall
+        clock said ``measured_s``. Non-positive samples are dropped (an
+        all-idle round predicts zero work — there is no ratio in it)."""
+        if predicted_units <= 0 or measured_s <= 0:
+            return
+        r = measured_s / predicted_units
+        with self._lock:
+            st = self._ops.get(op)
+            if st is None:
+                st = self._ops[op] = {"n": 0, "window": [],
+                                      "baseline": None, "ewma": None,
+                                      "last": r}
+            st["n"] += 1
+            st["last"] = r
+            if st["baseline"] is None:
+                st["window"].append(r)
+                w = sorted(st["window"])
+                med = w[len(w) // 2]  # running median, warmup window
+                st["ewma"] = med
+                if len(st["window"]) >= self.warmup:
+                    st["baseline"] = med
+                    st["window"] = []
+            else:
+                st["ewma"] = self.alpha * r \
+                    + (1 - self.alpha) * st["ewma"]
+            if self.registry is not None:
+                self.registry.gauge(
+                    "cost_model_drift_ratio", op=op,
+                    help="EWMA(measured s per model unit) / warmup "
+                         "baseline per op class; 1.0 = model still "
+                         "calibrated",
+                ).set(self.drift(op))
+
+    def drift(self, op: str) -> float:
+        """EWMA-over-baseline ratio for ``op``; 1.0 while uncalibrated
+        (unknown op, or still inside the warmup window — the baseline IS
+        the running estimate there, drift is definitionally 1)."""
+        with self._lock:
+            st = self._ops.get(op)
+            if st is None or st["baseline"] is None or not st["baseline"]:
+                return 1.0
+            return st["ewma"] / st["baseline"]
+
+    def sec_per_unit(self, op: str) -> Optional[float]:
+        """Current EWMA seconds-per-model-unit — the absolute
+        calibration a scheduler multiplies a predicted cost by to get a
+        round-budget estimate (ROADMAP item 17's pricing input)."""
+        with self._lock:
+            st = self._ops.get(op)
+            return None if st is None else st["ewma"]
+
+    def summary(self) -> dict:
+        """JSON-able ledger: per op class, sample count, current and
+        baseline sec/unit, and the drift ratio. Safe from any thread."""
+        with self._lock:
+            return {
+                op: {
+                    "samples": st["n"],
+                    "sec_per_unit_ewma": st["ewma"],
+                    "sec_per_unit_baseline": st["baseline"],
+                    "drift_ratio": round(self.drift(op), 4),
+                }
+                for op, st in self._ops.items()
+            }
 
 
 def trend_verdict(points) -> dict:

@@ -391,3 +391,91 @@ class TestFactorTrendPrograms:
             assert len(sweep) == 3
             for p in sweep:
                 assert p["measured"] > 0 and p["predicted"] > 0
+
+
+class TestCostCalibration:
+    """The in-production drift ledger (cost_model.CostCalibration): the
+    trend sweeps validate the models offline, this confronts them with
+    measured wall-clock per op class and reports EWMA drift vs a
+    warmup-calibrated baseline (docs/observability.md §7)."""
+
+    def test_steady_samples_pin_drift_at_one(self):
+        cal = cm.CostCalibration(warmup=3)
+        for _ in range(20):
+            cal.record("decode", 1e6, 0.002)
+        assert cal.drift("decode") == pytest.approx(1.0)
+        assert cal.sec_per_unit("decode") == pytest.approx(2e-9)
+        s = cal.summary()["decode"]
+        assert s["samples"] == 20 and s["drift_ratio"] == 1.0
+
+    def test_sustained_slowdown_moves_drift(self):
+        cal = cm.CostCalibration(alpha=0.5, warmup=2)
+        for _ in range(4):
+            cal.record("decode", 1e6, 0.001)
+        for _ in range(10):
+            cal.record("decode", 1e6, 0.003)  # model now 3x off
+        assert cal.drift("decode") == pytest.approx(3.0, rel=0.05)
+
+    def test_baseline_is_median_of_warmup_window(self):
+        # One GC hiccup inside the warmup window must not become the
+        # reference: the median keeps the baseline at the normal rate.
+        cal = cm.CostCalibration(warmup=5)
+        for m in (0.001, 0.001, 0.050, 0.001, 0.001):
+            cal.record("decode", 1e6, m)
+        for _ in range(10):
+            cal.record("decode", 1e6, 0.001)
+        assert cal.drift("decode") == pytest.approx(1.0, rel=0.1)
+
+    def test_nonpositive_samples_dropped_and_unknown_op_is_one(self):
+        cal = cm.CostCalibration()
+        cal.record("decode", 0.0, 0.01)   # all-idle round: no ratio
+        cal.record("decode", 1e6, 0.0)
+        assert cal.summary() == {}
+        assert cal.drift("nope") == 1.0
+        assert cal.sec_per_unit("nope") is None
+
+    def test_registry_mirror_exports_drift_gauge(self):
+        from marlin_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        cal = cm.CostCalibration(warmup=1, registry=reg)
+        cal.record("copy", 1e3, 0.001)
+        cal.record("copy", 1e3, 0.002)
+        snap = reg.snapshot()
+        assert snap["gauges"]['cost_model_drift_ratio{op="copy"}'] \
+            == pytest.approx(cal.drift("copy"))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="alpha"):
+            cm.CostCalibration(alpha=0.0)
+        with pytest.raises(ValueError, match="warmup"):
+            cm.CostCalibration(warmup=0)
+
+
+class TestEllDensityDerivation:
+    """derive_ell_density_max: the data-backed form of
+    MarlinConfig.sparse_ell_density_max (ROADMAP item 2 remainder)."""
+
+    def test_interpolates_the_ratio_one_crossing(self):
+        pts = [{"density": 1e-3, "ell_over_dense": 0.25},
+               {"density": 1e-2, "ell_over_dense": 0.5},
+               {"density": 1e-1, "ell_over_dense": 4.0}]
+        d = cm.derive_ell_density_max(pts)
+        assert 1e-2 < d < 1e-1
+        # log-log interpolation: ratio 0.5 -> 4.0 crosses 1 a third of
+        # the way through the log-density span (log2: -1 -> 2).
+        assert d == pytest.approx(10 ** (-2 + 1 / 3), rel=1e-6)
+
+    def test_clamps_when_one_arm_wins_everywhere(self):
+        ell = [{"density": 1e-3, "ell_over_dense": 0.2},
+               {"density": 1e-2, "ell_over_dense": 0.8}]
+        assert cm.derive_ell_density_max(ell) == 1e-2
+        dense = [{"density": 1e-3, "ell_over_dense": 1.5}]
+        assert cm.derive_ell_density_max(dense) == 5e-4
+
+    def test_rejects_degenerate_input(self):
+        with pytest.raises(ValueError, match="empty"):
+            cm.derive_ell_density_max([])
+        with pytest.raises(ValueError, match="positive"):
+            cm.derive_ell_density_max(
+                [{"density": 1e-3, "ell_over_dense": 0.0}])

@@ -385,6 +385,68 @@ class TestHTTPServer:
         rz = c.readyz()
         assert rz["code"] == 200 and rz["ready"] and rz["driver_alive"]
 
+    def test_timing_block_attributes_latency(self, http_server,
+                                             client_mod):
+        """The PR-6 tentpole at the HTTP surface: every generate
+        response carries the `timing` block, whose contiguous phases
+        sum exactly to the engine-side total (one monotonic clock), and
+        the stream's terminal done event carries the same block."""
+        c = client_mod.ServingClient(port=http_server.port)
+        prompt = _prompts(_cfg(), 1, seed=21)[0]
+        r = c.generate(prompt, 4)
+        assert r["code"] == 200
+        t = r["timing"]
+        for k in ("queue_wait_s", "admit_s", "decode_s", "total_s",
+                  "http_total_s"):
+            assert k in t, t
+        contiguous = t["queue_wait_s"] + t["admit_s"] + t["decode_s"]
+        # Fields are rounded to 1 us server-side; the identity holds to
+        # rounding, far inside the 5% acceptance tolerance.
+        assert contiguous == pytest.approx(t["total_s"], abs=5e-6)
+        assert t["http_total_s"] >= t["total_s"] - 5e-3  # same clock
+        st = c.stream(prompt, 4)
+        assert st["code"] == 200
+        ts = st["timing"]
+        assert ts["queue_wait_s"] + ts["admit_s"] + ts["decode_s"] \
+            == pytest.approx(ts["total_s"], abs=5e-6)
+        assert "http_ttft_s" in ts and "stream_delivery_s" in ts
+        # The phase histograms are scrapeable, labeled, with HELP.
+        m = c.metrics()
+        assert 'serving_phase_seconds_bucket{phase="decode"' in m["text"]
+        assert "# HELP serving_phase_seconds" in m["text"]
+        assert "# HELP cost_model_drift_ratio" in m["text"]
+
+    def test_debug_endpoints(self, http_server, client_mod):
+        """GET /debug/engine, /debug/requests/<id>, /debug/trace: the
+        point-in-time introspection surface (docs/frontend.md)."""
+        c = client_mod.ServingClient(port=http_server.port)
+        r = c.generate(_prompts(_cfg(), 1, seed=23)[0], 3)
+        assert r["code"] == 200
+        code, body, _ = c._get("/debug/engine")
+        assert code == 200
+        dbg = json.loads(body)
+        assert dbg["batch"] == 2 and dbg["round"] > 0
+        assert dbg["frontend"]["alive"] is True
+        assert "cost_model_drift" in dbg and "stats" in dbg
+        assert dbg["stats"]["completed"] >= 1
+        code, body, _ = c._get(f"/debug/requests/{r['request_id']}")
+        assert code == 200
+        info = json.loads(body)
+        assert info["status"] == "done"
+        ph = info["phases"]
+        assert ph["queue_wait"] + ph["admit"] + ph["decode"] \
+            == pytest.approx(ph["total"], rel=1e-6, abs=1e-9)
+        code, body, _ = c._get("/debug/requests/987654")
+        assert code == 404
+        code, body, _ = c._get("/debug/requests/not-an-id")
+        assert code == 400
+        code, body, _ = c._get("/debug/trace")
+        assert code == 200
+        doc = json.loads(body)  # valid Chrome-trace JSON by round-trip
+        assert "traceEvents" in doc
+        code, body, _ = c._get("/debug/trace?exemplars=1")
+        assert code == 200 and "traceEvents" in json.loads(body)
+
     def test_bad_requests_map_to_400_and_404(self, http_server,
                                              client_mod):
         import http.client
@@ -481,6 +543,52 @@ class TestHTTPServer:
         assert "drain_complete" in kinds
 
 
+class TestBaselineMetricConsistency:
+    def test_every_baseline_metric_name_exists_in_live_registry(
+            self, model):
+        """The staleness guard: every registry metric the committed SLO
+        baseline references (histogram/gauge specs, full labeled series
+        names) must exist in a live registry snapshot after a smoke
+        workload — rename a metric without updating the baseline and
+        this fails, instead of the gate silently checking nothing.
+        (slo_check already treats a missing series as a violation at
+        gate time; this pins the contract at unit-test speed, for BOTH
+        baseline blocks at once.)"""
+        params, cfg = model
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            metrics_registry=reg)
+        fe = EngineFrontend(eng).start()
+        # Streamed requests exercise the full phase surface, including
+        # the frontend's stream_delivery slice.
+        handles = [fe.submit(p, 4, stream=True)
+                   for p in _prompts(cfg, 4, seed=31)]
+        for h in handles:
+            list(h.chunks())
+            assert h.result(30.0).status == "done"
+        assert fe.drain(30.0)
+        snap = reg.snapshot()
+        with open(os.path.join(_REPO, "tools",
+                               "serving_slo_baseline.json")) as f:
+            baseline = json.load(f)
+        referenced = []
+        for key, blocks in baseline.items():
+            if key.startswith("_") or not isinstance(blocks, dict):
+                continue
+            for checks in blocks.values():
+                for spec in checks.values():
+                    if isinstance(spec, dict) and "histogram" in spec:
+                        referenced.append(("histograms",
+                                           spec["histogram"]))
+                    if isinstance(spec, dict) and "gauge" in spec:
+                        referenced.append(("gauges", spec["gauge"]))
+        assert referenced  # the baseline does reference registry series
+        missing = [f"{kind}:{name}" for kind, name in referenced
+                   if name not in snap[kind]]
+        assert not missing, (missing, sorted(snap["histograms"]),
+                             sorted(snap["gauges"]))
+
+
 class TestSigtermSubprocess:
     def test_sigterm_drains_and_exits_zero(self, tmp_path):
         """The acceptance criterion verbatim, against a real process:
@@ -528,6 +636,26 @@ class TestSigtermSubprocess:
                       runlog.read_text().strip().splitlines()]
             assert events[-1]["kind"] == "drain_complete"
             assert events[-1]["ledger"]["completed"] >= 2
+            # The offline loop closes here (tier-1 smoke of the PR-6
+            # analyzer): tools/runlog_report.py replays the sealed
+            # on-disk runlog this real server produced and must find a
+            # clean run — report parses, zero post-warmup compiles,
+            # zero anomalies, and every request's contiguous phase sum
+            # within tolerance of its measured end-to-end latency.
+            rep_proc = subprocess.run(
+                [sys.executable, "tools/runlog_report.py", str(runlog),
+                 "--json", "-"],
+                capture_output=True, text=True, timeout=60, cwd=_REPO)
+            assert rep_proc.returncode == 0, \
+                rep_proc.stdout + rep_proc.stderr
+            report = json.loads(rep_proc.stdout)
+            assert report["ok"] is True
+            assert report["anomalies"] == []
+            assert report["sealed"] is True
+            assert report["post_warmup_compiles"] == 0
+            assert report["n_completed"] >= 2
+            assert report["phase_sum_checked"] == report["n_completed"]
+            assert report["phase_sum_max_rel_err"] <= 0.05
         finally:
             if proc.poll() is None:
                 proc.kill()

@@ -146,6 +146,59 @@ class TestTracer:
         with pytest.raises(ValueError, match="sample_rate"):
             Tracer(sample_rate=1.5)
 
+    def test_exemplar_reservoir_keeps_slowest_k(self):
+        # Tail exemplars (docs/observability.md §7): request-attributed
+        # spans stage per request; finish_request ranks the request by
+        # its end-to-end latency and keeps the SLOWEST k complete span
+        # lists — cheap for everyone else, fully explained outliers.
+        tr = Tracer(enabled=True, exemplar_k=2)
+        for i in range(6):
+            with tr.span(f"serving.submit", scope=False, request_id=i):
+                pass
+            extra = [tr.span_from_stamps("serving.phase.total", 0.0,
+                                         i * 1e-3, request_id=i)]
+            tr.finish_request(i, total_s=i * 1e-3, extra_spans=extra)
+        exs = tr.exemplars()
+        assert [e["request_id"] for e in exs] == ["5", "4"]  # slowest 2
+        assert exs[0]["total_s"] == pytest.approx(5e-3)
+        for e in exs:
+            names = {s["name"] for s in e["spans"]}
+            assert names == {"serving.submit", "serving.phase.total"}
+            # ... and staged spans carry the id that keyed them.
+            for s in e["spans"]:
+                assert str(s["args"]["request_id"]) == e["request_id"]
+        doc = tr.exemplar_trace()
+        assert len(doc["traceEvents"]) == 4  # 2 exemplars x 2 spans
+
+    def test_exemplars_survive_sampling_drop(self):
+        # "Sampled requests stay cheap, outliers stay fully explained":
+        # exemplar staging bypasses the root-sampling draw, so a trace
+        # the sampler dropped whole can still be retained as an
+        # exemplar — while the main event buffer stays sampled.
+        tr = Tracer(enabled=True, sample_rate=0.25, exemplar_k=8)
+        for i in range(8):
+            with tr.span("root", request_id=i):
+                pass
+            tr.finish_request(i, total_s=1.0 + i)
+        assert len(tr.events()) == 2  # sampling still governs the buffer
+        assert len(tr.exemplars()) == 8  # every request fully staged
+        assert all(len(e["spans"]) == 1 for e in tr.exemplars())
+
+    def test_exemplar_disabled_and_reset(self):
+        tr = Tracer(enabled=True)  # exemplar_k=0: reservoir off
+        with tr.span("s", request_id=1):
+            pass
+        assert tr.finish_request(1, 9.9) is False
+        assert tr.exemplars() == []
+        tr2 = Tracer(enabled=True, exemplar_k=2)
+        with tr2.span("s", request_id=1):
+            pass
+        tr2.finish_request(1, 1.0)
+        tr2.reset()
+        assert tr2.exemplars() == []
+        with pytest.raises(ValueError, match="exemplar_k"):
+            Tracer(exemplar_k=-1)
+
     def test_thread_safety_and_per_thread_nesting(self):
         tr = Tracer(enabled=True)
 
@@ -228,6 +281,44 @@ class TestMetrics:
         assert 'lat_bucket{le="+Inf"} 3' in lines
         assert "lat_sum 5.55" in lines
         assert "lat_count 3" in lines
+
+    def test_help_lines_in_exposition(self):
+        # The exposition-format satellite: families constructed with
+        # help= get a `# HELP` line immediately before their `# TYPE`
+        # line, with format escaping; helpless families emit TYPE only.
+        reg = om.MetricsRegistry()
+        reg.counter("req_total", help="requests\nover two lines",
+                    route="a").inc()
+        reg.gauge("depth").set(1)  # no help: no HELP line
+        reg.histogram("lat", help="latency s").observe(0.2)
+        reg.counter("req_total").inc()  # later helpless call keeps it
+        lines = reg.prometheus().splitlines()
+        i = lines.index("# HELP req_total requests\\nover two lines")
+        assert lines[i + 1] == "# TYPE req_total counter"
+        assert "# HELP lat latency s" in lines
+        assert "# TYPE depth gauge" in lines
+        assert not any(l.startswith("# HELP depth") for l in lines)
+        # First non-empty help wins; a later offer does not overwrite.
+        reg.counter("req_total", help="other text")
+        assert "# HELP req_total requests\\nover two lines" \
+            in reg.prometheus().splitlines()
+
+    def test_histogram_bucket_exemplars(self):
+        # Exemplars: one request id per bucket, last writer wins — the
+        # breadcrumb from a slow TTFT bucket to its retained trace. They
+        # travel in the JSON snapshot; the text exposition stays plain.
+        reg = om.MetricsRegistry()
+        h = reg.histogram("ttft", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="7")
+        h.observe(0.06, exemplar="9")
+        h.observe(5.0, exemplar="13")
+        h.observe(0.5)  # no exemplar offered: bucket stays unattributed
+        s = reg.snapshot()["histograms"]["ttft"]
+        assert s["exemplars"] == {"0.1": "9", "+Inf": "13"}
+        assert "exemplar" not in reg.prometheus()
+        h2 = reg.histogram("plain", buckets=(1.0,))
+        h2.observe(0.5)
+        assert "exemplars" not in reg.snapshot()["histograms"]["plain"]
 
     def test_one_snapshot_covers_timing_shim_and_engine_series(self):
         # The dedup satellite: utils/timing writes into the SAME default
@@ -382,6 +473,110 @@ class TestServingObservability:
                       if e["name"] == "serving.decode_round")
         assert decode["args"]["parent"] == "serving.round"
 
+    def test_phase_timeline_attributes_every_request(self):
+        # The PR-6 tentpole contract: every completed request carries a
+        # contiguous phase timeline — queue_wait + admit + decode ==
+        # total EXACTLY (differences of consecutive stamps on one
+        # monotonic clock; the bench's 5% acceptance bound is an
+        # identity here) — mirrored into the labeled
+        # serving_phase_seconds histograms and the runlog's complete
+        # events, with the drift ledger calibrated alongside.
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        eng = ServingEngine(params, cfg, batch=3, round_steps=4)
+        workload = _workload(cfg)
+        _submit_all(eng, workload)
+        done = eng.run()
+        assert len(done) == len(workload)
+        for req in done:
+            ph = req.phases()
+            assert ph["queue_wait"] >= 0 and ph["admit"] > 0 \
+                and ph["decode"] >= 0
+            assert ph["queue_wait"] + ph["admit"] + ph["decode"] \
+                == pytest.approx(ph["total"], rel=1e-9, abs=1e-12)
+            assert ph["total"] == pytest.approx(
+                req.finish_time - req.submit_time)
+            assert 0 < ph["prefill_dispatch"] <= ph["admit"] * (1 + 1e-9)
+        snap = om.registry.snapshot()
+        hists = snap["histograms"]
+        for phase in ("queue_wait", "admit", "decode", "total"):
+            series = f'serving_phase_seconds{{phase="{phase}"}}'
+            assert hists[series]["count"] == len(workload), series
+            # Bucket exemplars carry request ids (strings of ints).
+            assert all(int(x) >= 0 for x in
+                       hists[series]["exemplars"].values())
+        # The runlog's per-request events carry the same attribution.
+        for ev in eng.runlog.events("complete"):
+            ph = ev["phases"]
+            assert set(ph) >= {"queue_wait", "admit", "decode", "total"}
+            assert ph["queue_wait"] + ph["admit"] + ph["decode"] \
+                == pytest.approx(ph["total"], abs=5e-6)  # runlog rounds
+        # Round events gained the measured-side fields the drift ledger
+        # and the runlog analyzer consume.
+        rnd = eng.runlog.events("round")[0]
+        assert rnd["round_s"] >= rnd["decode_s"] > 0
+        assert rnd["drift_decode"] > 0
+        # Per-phase means ride the ledger summary.
+        assert eng.stats.summary()["mean_phase_total_s"] > 0
+
+    def test_drift_ledger_calibrates_decode_and_prefill(self):
+        # The calibration ledger (stats.calibration): same shapes every
+        # round on one host, so the EWMA-vs-baseline drift ratio must
+        # sit well inside the [0.5, 2.0] acceptance band, with samples
+        # for both op classes and gauges in the registry.
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4)
+        workload = _workload(cfg, n=10)
+        _submit_all(eng, workload)  # warmup: compiles land here
+        eng.run()
+        eng2 = ServingEngine(params, cfg, batch=2, round_steps=4)
+        _submit_all(eng2, workload)
+        eng2.run()
+        summ = eng2.stats.calibration.summary()
+        assert summ["decode"]["samples"] >= 5
+        assert summ["prefill"]["samples"] == len(workload)
+        assert 0.5 <= summ["decode"]["drift_ratio"] <= 2.0, summ
+        assert summ["decode"]["sec_per_unit_ewma"] > 0
+        snap = om.registry.snapshot()
+        assert 'cost_model_drift_ratio{op="decode"}' in snap["gauges"]
+        assert 'cost_model_drift_ratio{op="prefill"}' in snap["gauges"]
+        # ... and the drain seal carries the drift block in its ledger.
+        eng2.drain()
+        seal = eng2.runlog.events("drain_complete")[-1]
+        assert "cost_model_drift" in seal["ledger"]
+
+    def test_engine_retains_tail_exemplars(self):
+        # Slowest-k retention through the engine: completed requests'
+        # phase timelines become exemplar span trees; the TTFT
+        # histogram's bucket exemplars name ids whose traces exist.
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        tr = Tracer(enabled=True, exemplar_k=3)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            tracer=tr)
+        workload = _workload(cfg, n=8)
+        _submit_all(eng, workload)
+        eng.run()
+        exs = tr.exemplars()
+        assert len(exs) == 3
+        totals = [e["total_s"] for e in exs]
+        assert totals == sorted(totals, reverse=True)
+        for e in exs:
+            names = {s["name"] for s in e["spans"]}
+            # Synthesized phase segments plus the staged real spans.
+            assert {"serving.phase.queue_wait", "serving.phase.admit",
+                    "serving.phase.decode"} <= names
+            assert "serving.admit" in names
+        # Every id the TTFT buckets point at resolves to a request that
+        # ran (exemplar ids are last-per-bucket, not necessarily
+        # slowest-k — the histogram side holds ids, the tracer side
+        # holds traces for the k slowest).
+        snap = om.registry.snapshot()
+        ex_ids = snap["histograms"]["serving_ttft_seconds"]["exemplars"]
+        assert ex_ids and all(0 <= int(x) < len(workload)
+                              for x in ex_ids.values())
+
     def test_steady_state_logs_zero_compiles(self):
         # Warmup engine pays (and LOGS) the round + admission compiles;
         # a second engine on the same shapes must log none — the
@@ -425,10 +620,16 @@ class TestServingObservability:
         workload = [(rng.integers(0, cfg.vocab, int(s)), int(st))
                     for s, st in zip(rng.integers(4, 12, 12),
                                      rng.integers(24, 40, 12))]
+        # The "on" and "sampled" arms run with exemplar retention
+        # ENABLED (exemplar_k=8): the PR-6 acceptance criterion says the
+        # PR-3 pin must still hold with the slowest-k reservoir active —
+        # staging is per-request-span (low rate) plus one heap op per
+        # completion, which must disappear into the same 5%.
         tracers = {
             "off": Tracer(enabled=False),
-            "on": Tracer(enabled=True),
-            "sampled": Tracer(enabled=True, sample_rate=0.1),
+            "on": Tracer(enabled=True, exemplar_k=8),
+            "sampled": Tracer(enabled=True, sample_rate=0.1,
+                              exemplar_k=8),
         }
 
         def trial(tracer):
@@ -442,7 +643,11 @@ class TestServingObservability:
 
         trial(tracers["off"])  # warmup: compiles out of the measurement
         times = {name: [] for name in tracers}
-        for _ in range(4):
+        # 6 interleaved trials: ~0.1 s each, and the min-of-trials
+        # estimator needs enough draws to find the noise floor on a
+        # shared host — 4 was observed to flake at a 7.8% "overhead"
+        # that three clean re-runs put under 2%.
+        for _ in range(6):
             for name, tracer in tracers.items():
                 times[name].append(trial(tracer))
         assert len(tracers["sampled"].events()) \

@@ -628,6 +628,27 @@ class TestSloCheck:
         lines = [{"metric": "m", "value": 1}, {"metric": "m", "value": 2}]
         assert slo.find_metric(lines, "m")["value"] == 2
 
+    def test_gauge_band_check(self, slo):
+        # The drift-band check (PR 6): a gauge read by its full labeled
+        # series name from the attached metrics block, held to a
+        # [min, max] band; missing gauge = violation, not skip.
+        series = 'cost_model_drift_ratio{op="decode"}'
+        line = {"metrics": {"gauges": {series: 1.2}}}
+        spec = {"gauge": series, "min": 0.5, "max": 2.0}
+        assert slo._check_gauge(line, "drift", spec) == []
+        line["metrics"]["gauges"][series] = 3.0
+        (v,) = slo._check_gauge(line, "drift", spec)
+        assert "> max 2.0" in v
+        line["metrics"]["gauges"][series] = 0.1
+        (v,) = slo._check_gauge(line, "drift", spec)
+        assert "< min 0.5" in v
+        (v,) = slo._check_gauge({"metrics": {}}, "drift", spec)
+        assert "missing" in v
+        # ... and check_line dispatches on the spec shape.
+        assert slo.check_line(
+            {"metrics": {"gauges": {series: 1.0}}},
+            {"drift": spec}) == []
+
     def test_committed_baseline_is_well_formed(self, slo):
         with open("tools/serving_slo_baseline.json") as f:
             baseline = json.load(f)
@@ -636,3 +657,10 @@ class TestSloCheck:
         assert "serving_continuous_vs_static_completed" in metrics
         assert metrics["serving_prefix_reuse_speedup"]["value"]["min"] \
             == 1.3
+        srv = metrics["serving_continuous_vs_static_completed"]
+        assert srv["phase_sum_max_rel_err"]["max"] == 0.05
+        assert srv["decode_drift_band"]["gauge"] \
+            == 'cost_model_drift_ratio{op="decode"}'
+        http = baseline["metrics_http"]["serving_http_frontend"]
+        assert http["phase_sum_ok"]["min"] == 1
+        assert "phase_stream_delivery" in http

@@ -1,0 +1,298 @@
+"""tools/runlog_report.py — the offline latency-attribution analyzer
+(docs/observability.md §7).
+
+Two layers, each pinned:
+
+* SYNTHETIC runlogs: the anomaly detectors fire on exactly the injected
+  defect — a steady-state compile (and NOT a warmup or novel-bucket
+  one), a round that sat on ready work, a deadline expiry, a phase sum
+  that disagrees with the measured wall-clock, an unresolved request in
+  a sealed log — and stay silent on a clean narrative.
+* A REAL engine runlog (in-process drain to a file sink): the report
+  parses, joins every request's timeline, finds zero anomalies, and the
+  phase-sum identity holds. The tier-1 subprocess form of this smoke
+  (a SIGTERM'd real server) lives in tests/test_frontend.py.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.obs.runlog import RunLog
+from marlin_tpu.serving import ServingEngine
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def rr():
+    spec = importlib.util.spec_from_file_location(
+        "runlog_report", os.path.join(_REPO, "tools",
+                                      "runlog_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, events, name="runlog.jsonl"):
+    path = tmp_path / name
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def _clean_events():
+    """A minimal clean narrative: one engine, two requests, two rounds,
+    warmup compiles at the admission round, sealed drain."""
+    return [
+        {"kind": "engine_start", "t": 0.0, "batch": 2, "round_steps": 4,
+         "prefill_chunk": None, "max_pending": 8, "max_len": 64,
+         "prefix_cache": False},
+        {"kind": "submit", "t": 0.01, "request_id": 0, "prompt_len": 8,
+         "steps": 4, "round": 0, "queue_depth": 1},
+        {"kind": "submit", "t": 0.011, "request_id": 1, "prompt_len": 24,
+         "steps": 4, "round": 0, "queue_depth": 2},
+        {"kind": "admit", "t": 0.02, "request_id": 0, "row": 0,
+         "round": 0, "prompt_len": 8, "wait_rounds": 0, "queue_depth": 1},
+        {"kind": "admit", "t": 0.03, "request_id": 1, "row": 1,
+         "round": 0, "prompt_len": 24, "wait_rounds": 0,
+         "queue_depth": 0},
+        {"kind": "compile", "t": 0.04, "round": 0,
+         "entry": "serving.decode_round", "new_compiles": 1},
+        {"kind": "compile", "t": 0.04, "round": 0,
+         "entry": "serving.prefill_into_row", "new_compiles": 2},
+        {"kind": "round", "t": 0.05, "round": 0, "iters": 4,
+         "occupied": 2, "live_iters": 8, "admitted": 2, "retired": 0,
+         "expired": 0, "prefilling": 0, "queue_depth": 0,
+         "wasted_row_iters": 0, "round_s": 0.04, "decode_s": 0.03,
+         "drift_decode": 1.0},
+        {"kind": "complete", "t": 0.09, "request_id": 0, "row": 0,
+         "emitted": 4, "live_iters": 4, "submit_t": 1.00,
+         "admit_t": 1.01, "finish_t": 1.09, "rounds": 2,
+         "phases": {"queue_wait": 0.005, "admit": 0.005,
+                    "decode": 0.08, "total": 0.09}},
+        {"kind": "complete", "t": 0.095, "request_id": 1, "row": 1,
+         "emitted": 4, "live_iters": 4, "submit_t": 1.001,
+         "admit_t": 1.02, "finish_t": 1.095, "rounds": 2,
+         "phases": {"queue_wait": 0.009, "admit": 0.01,
+                    "decode": 0.075, "total": 0.094}},
+        {"kind": "round", "t": 0.1, "round": 1, "iters": 4,
+         "occupied": 2, "live_iters": 4, "admitted": 0, "retired": 2,
+         "expired": 0, "prefilling": 0, "queue_depth": 0,
+         "wasted_row_iters": 4, "round_s": 0.05, "decode_s": 0.045,
+         "drift_decode": 1.02},
+        {"kind": "drain_complete", "t": 0.11, "round": 2,
+         "ledger": {"completed": 2, "admitted": 2}},
+    ]
+
+
+class TestSyntheticRunlogs:
+    def test_clean_log_reports_ok(self, rr, tmp_path):
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, _clean_events())))
+        assert report["ok"] is True and report["anomalies"] == []
+        assert report["sealed"] is True
+        assert report["n_submitted"] == report["n_completed"] == 2
+        assert report["post_warmup_compiles"] == 0
+        assert report["phase_sum_checked"] == 2
+        assert report["phase_sum_max_rel_err"] <= 0.05
+        assert report["ledger"]["completed"] == 2
+        # Per-request timelines joined across event kinds.
+        r0 = next(r for r in report["requests"]
+                  if r["request_id"] == 0)
+        assert r0["status"] == "done" and r0["prompt_len"] == 8
+        assert r0["e2e_s"] == pytest.approx(0.09)
+        # Per-round series summarized (batch from engine_start).
+        assert report["rounds"]["n_rounds"] == 2
+        assert report["rounds"]["batch"] == 2
+        assert report["rounds"]["utilization"] == pytest.approx(
+            12 / 16)
+        assert report["rounds"]["drift_decode_last"] == 1.02
+
+    def test_steady_state_compile_is_flagged(self, rr, tmp_path):
+        events = _clean_events()
+        events.insert(-1, {"kind": "compile", "t": 0.10, "round": 1,
+                           "entry": "serving.decode_round",
+                           "new_compiles": 1})
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is False
+        (a,) = report["anomalies"]
+        assert a["kind"] == "post_warmup_compile"
+        assert a["entry"] == "serving.decode_round" and a["round"] == 1
+        assert report["post_warmup_compiles"] == 1
+
+    def test_novel_bucket_compile_is_warmup_not_anomaly(self, rr,
+                                                        tmp_path):
+        # A SECOND prefill compile is fine when that round admitted a
+        # never-seen 16-bucket (one compile per distinct bucket is the
+        # contract); the same compile without a novel bucket is not.
+        events = _clean_events()
+        tail = [
+            {"kind": "submit", "t": 0.12, "request_id": 2,
+             "prompt_len": 40, "steps": 2, "round": 2,
+             "queue_depth": 1},
+            {"kind": "admit", "t": 0.13, "request_id": 2, "row": 0,
+             "round": 2, "prompt_len": 40, "wait_rounds": 2,
+             "queue_depth": 0},
+            {"kind": "compile", "t": 0.14, "round": 2,
+             "entry": "serving.prefill_into_row", "new_compiles": 1},
+            {"kind": "round", "t": 0.15, "round": 2, "iters": 2,
+             "occupied": 1, "live_iters": 2, "admitted": 1,
+             "retired": 1, "expired": 0, "prefilling": 0,
+             "queue_depth": 0, "wasted_row_iters": 2},
+            {"kind": "complete", "t": 0.16, "request_id": 2, "row": 0,
+             "emitted": 2, "live_iters": 2, "submit_t": 1.2,
+             "admit_t": 1.3, "finish_t": 1.4, "rounds": 1,
+             "phases": {"queue_wait": 0.09, "admit": 0.01,
+                        "decode": 0.1, "total": 0.2}},
+        ]
+        events[-1:-1] = tail  # before the drain seal
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+        # Same events, but request 2 re-uses a seen bucket (8 -> 16,
+        # same as request 0): now the compile has no excuse.
+        for ev in tail:
+            if "prompt_len" in ev:
+                ev["prompt_len"] = 8
+        report2 = rr.build_report(
+            rr.load_runlog(_write(tmp_path, events, "r2.jsonl")))
+        assert report2["ok"] is False
+        assert report2["anomalies"][0]["kind"] == "post_warmup_compile"
+
+    def test_queue_stall_deadline_and_phase_mismatch(self, rr,
+                                                     tmp_path):
+        events = _clean_events()
+        extra = [
+            # Stall PAIR: round 2 ends with work queued and a free row
+            # (alone, that's a legal mid-round submission), then round 3
+            # neither admits, prefills, nor expires — the scheduler sat
+            # on ready work for a full round, and round 3 is flagged.
+            {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4},
+            {"kind": "round", "t": 0.107, "round": 3, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4},
+            {"kind": "timeout", "t": 0.108, "request_id": 7,
+             "round": 3, "deadline_rounds": 0, "wait_s": 0.5},
+            {"kind": "submit", "t": 0.1055, "request_id": 7,
+             "prompt_len": 8, "steps": 2, "round": 2, "queue_depth": 4},
+        ]
+        events[-1:-1] = extra
+        # ... and corrupt one phase block.
+        events[8]["phases"]["decode"] = 0.5  # sum no longer == total
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        kinds = sorted(a["kind"] for a in report["anomalies"])
+        assert kinds == ["deadline_expiry", "phase_sum_mismatch",
+                         "queue_stall"]
+        assert report["ok"] is False
+        mism = next(a for a in report["anomalies"]
+                    if a["kind"] == "phase_sum_mismatch")
+        assert mism["request_id"] == 0 and mism["rel_err"] > 0.05
+
+    def test_mid_round_submission_is_not_a_stall(self, rr, tmp_path):
+        # A round that ENDS with queued work and a free row is normal
+        # when the submission landed mid-round (round events stamp
+        # queue depth at round end); the next round admits it. Only a
+        # following round that does nothing makes it a stall.
+        events = _clean_events()
+        extra = [
+            {"kind": "submit", "t": 0.104, "request_id": 3,
+             "prompt_len": 8, "steps": 2, "round": 2, "queue_depth": 1},
+            {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 1, "wasted_row_iters": 4},
+            {"kind": "admit", "t": 0.106, "request_id": 3, "row": 0,
+             "round": 3, "prompt_len": 8, "wait_rounds": 1,
+             "queue_depth": 0},
+            {"kind": "round", "t": 0.107, "round": 3, "iters": 2,
+             "occupied": 2, "live_iters": 4, "admitted": 1,
+             "retired": 1, "expired": 0, "prefilling": 0,
+             "queue_depth": 0, "wasted_row_iters": 0},
+            {"kind": "complete", "t": 0.108, "request_id": 3, "row": 0,
+             "emitted": 2, "live_iters": 2, "submit_t": 1.104,
+             "admit_t": 1.106, "finish_t": 1.108, "rounds": 1,
+             "phases": {"queue_wait": 0.001, "admit": 0.001,
+                        "decode": 0.002, "total": 0.004}},
+        ]
+        events[-1:-1] = extra  # before the drain seal
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+
+    def test_unresolved_request_only_in_sealed_logs(self, rr, tmp_path):
+        events = _clean_events()
+        orphan = {"kind": "submit", "t": 0.012, "request_id": 9,
+                  "prompt_len": 8, "steps": 4, "round": 0,
+                  "queue_depth": 3}
+        events.insert(3, orphan)
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert [a["kind"] for a in report["anomalies"]] \
+            == ["unresolved_request"]
+        # The same orphan in an UNSEALED log (mid-flight snapshot) is
+        # not an anomaly — the request may simply still be running.
+        unsealed = [e for e in events if e["kind"] != "drain_complete"]
+        report2 = rr.build_report(
+            rr.load_runlog(_write(tmp_path, unsealed, "u.jsonl")))
+        assert report2["ok"] is True
+
+    def test_cli_exit_codes(self, rr, tmp_path, capsys):
+        clean = _write(tmp_path, _clean_events())
+        assert rr.main([clean]) == 0
+        out = capsys.readouterr().out
+        assert "no anomalies" in out and "phase sums: 2 checked" in out
+        bad = _clean_events()
+        bad.insert(-1, {"kind": "compile", "t": 0.1, "round": 1,
+                        "entry": "serving.decode_round",
+                        "new_compiles": 1})
+        assert rr.main([_write(tmp_path, bad, "bad.jsonl")]) == 1
+        capsys.readouterr()
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert rr.main([str(empty)]) == 2
+        assert rr.main([str(tmp_path / "missing.jsonl")]) == 2
+        # --json - emits ONLY the JSON report.
+        assert rr.main([clean, "--json", "-"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ok"] is True
+
+    def test_series_flag_inlines_rounds(self, rr, tmp_path):
+        report = rr.build_report(
+            rr.load_runlog(_write(tmp_path, _clean_events())),
+            series=True)
+        assert len(report["round_series"]) == 2
+        assert report["round_series"][0]["iters"] == 4
+
+
+class TestRealEngineRunlog:
+    def test_engine_drain_runlog_is_clean(self, rr, tmp_path):
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=64)
+        params = init_params(cfg, seed=0)
+        path = tmp_path / "engine_runlog.jsonl"
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            runlog=RunLog(maxlen=8, path=path))
+        rng = np.random.default_rng(3)
+        for i in range(5):
+            eng.submit(rng.integers(0, cfg.vocab, 8), int(2 + i))
+        done = eng.drain()
+        assert len(done) == 5
+        report = rr.build_report(rr.load_runlog(str(path)))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["sealed"] is True
+        assert report["n_completed"] == 5
+        assert report["post_warmup_compiles"] == 0
+        assert report["phase_sum_checked"] == 5
+        # The identity: contiguous stamps on one clock; 6-decimal
+        # runlog rounding is the only slack the analyzer needs.
+        assert report["phase_sum_max_rel_err"] <= 0.01
+        assert report["rounds"]["n_rounds"] == eng.stats.n_rounds
+        assert report["rounds"]["batch"] == 2
+        assert report["ledger"] == eng.stats.summary()

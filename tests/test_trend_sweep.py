@@ -215,6 +215,63 @@ class TestAttentionTrendSweep:
         assert fit["residual_rms"] < 0.5, (fit, sweep)
 
 
+class TestSpmmTrendSweep:
+    """ROADMAP item 2, final slice: the ELL row-gather spmm measured
+    over an n-doubling square grid at a FIXED per-row slot count, so
+    ell_product_cost's FLOPs term reduces to an exact n^2 (4x per
+    doubling — the attention slice's exact-term contract; density
+    varies as R/n but the model prices slots, not density)."""
+
+    @pytest.fixture(scope="class")
+    def sweep(self, mesh):
+        return cm.run_spmm_trend_sweep(mesh=mesh)
+
+    def test_model_term_is_exactly_n_squared(self, sweep, mesh):
+        from marlin_tpu.matrix.dist_sparse import _n_dev
+
+        nd = _n_dev(mesh)
+        for p in sweep:
+            assert p["predicted"] == pytest.approx(
+                2.0 * (p["n"] / nd) * p["r_slots"] * p["n"])
+        preds = [p["predicted"] for p in sweep]
+        for lo, hi in zip(preds[:-1], preds[1:]):
+            assert hi == pytest.approx(4 * lo)
+
+    def test_rank_correlation_meets_bar(self, sweep):
+        assert cm.trend_verdict(sweep)["rho"] >= 0.9, sweep
+
+    def test_measured_exponent_band_and_residual(self, sweep):
+        # Wide band around 2 for the same reason as the other slices:
+        # the small-n end mixes the replicated-B placement and dispatch
+        # overhead into the measurement on a shared CPU host, but a
+        # gather that stopped scaling with its model — n^1 constant-
+        # dominated, or n^3 from an accidental densify — still fails.
+        fit = cm.powerlaw_fit([p["n"] for p in sweep],
+                              [p["measured"] for p in sweep])
+        model = cm.powerlaw_fit([p["n"] for p in sweep],
+                                [p["predicted"] for p in sweep])
+        assert model["exponent"] == pytest.approx(2.0, abs=1e-9)
+        assert 1.0 <= fit["exponent"] <= 3.2, (fit, sweep)
+        assert fit["residual_rms"] < 0.6, (fit, sweep)
+
+    def test_crossover_sweep_produces_derivable_points(self, mesh):
+        # Small-shape smoke of the ELL-vs-dense crossover recipe: both
+        # arms measured, ratios positive, and the derived density lands
+        # inside (or clamps to) the swept band. The full-size crossover
+        # — the data-backed sparse_ell_density_max — is the bench
+        # line's job (`--config trend`), where the wall-clock budget
+        # lives; which arm wins at which density is a HOST property,
+        # so no winner is pinned here.
+        pts = cm.run_spmm_crossover_sweep(mesh=mesh, n=256,
+                                          slots=(1, 32), reps=1)
+        assert [p["r_slots"] for p in pts] == [1, 32]
+        for p in pts:
+            assert p["ell_s"] > 0 and p["dense_s"] > 0
+            assert p["density"] == pytest.approx(p["r_slots"] / 256)
+        d = cm.derive_ell_density_max(pts)
+        assert 0 < d <= 32 / 256
+
+
 class _FactorSweepContract:
     """Shared contract for the blocked-factorization n-sweeps (ROADMAP
     item 2, LU/Cholesky slice): model FLOPs term exactly n^3 (8x-spaced

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Offline runlog analyzer: replay a serving-engine JSONL runlog into
+per-request phase timelines and per-round occupancy/utilization series,
+flag anomalies, and emit a machine-readable report.
+
+The closing piece of the latency-attribution loop (docs/observability.md
+§7): the engine streams its runtime narrative to JSONL
+(marlin_tpu/obs/runlog.py — ``RunLog(path=...)``, sealed by the drain
+path); this tool turns a sealed log back into answers — where did each
+request's time go, what did each round execute, and did anything happen
+that should never happen in steady state:
+
+* **post-warmup compiles** — a ``compile`` event is warmup only when it
+  is the first for its entry OR a novel 16-bucket prompt length was
+  admitted that same round (chunk/prefill entries legitimately compile
+  once per distinct bucket); anything else is the silent-retrace signal
+  the watchdog exists for.
+* **queue stalls** — a round that ended with work queued and free rows,
+  followed by a round that neither admitted, prefilled, nor expired
+  anything: the scheduler sat on ready work for a full round. (One
+  round's worth of queued-but-unadmitted work is normal — submissions
+  land mid-round, and round events stamp queue depth at round end.)
+* **deadline expiries** — ``timeout`` events (admission never happened).
+* **phase-sum mismatches** — a completed request whose contiguous phase
+  durations (queue_wait + admit + decode) disagree with its measured
+  end-to-end wall-clock beyond ``--phase-tol`` (they are differences of
+  consecutive stamps on one clock, so a mismatch means clock or
+  instrumentation breakage, not workload behavior).
+* **unresolved requests** — submitted but neither completed nor timed
+  out in a SEALED log (``drain_complete`` present): the drain contract
+  says that cannot happen.
+
+Usage:
+    python tools/runlog_report.py RUNLOG.jsonl [--json OUT|-]
+        [--phase-tol 0.05] [--series]
+
+Exit 0 = report clean (no anomalies), 1 = anomalies found, 2 = unusable
+input. ``--json -`` prints the JSON report to stdout (nothing else);
+``--series`` inlines the full per-round series instead of summaries.
+Stdlib-only, like every tool here — runs anywhere the log lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+PHASE_TOL_DEFAULT = 0.05
+_CONTIGUOUS = ("queue_wait", "admit", "decode")
+
+
+def load_runlog(path: str) -> List[dict]:
+    """Parse one-JSON-object-per-line; non-JSON lines are skipped (a log
+    interleaved with stderr noise must still replay)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict) and "kind" in ev:
+                events.append(ev)
+    return events
+
+
+def _bucket(prompt_len: int) -> int:
+    """The admission 16-bucket (serving/slots.pad_prompt_len)."""
+    return -(-max(int(prompt_len), 1) // 16) * 16
+
+
+def build_requests(events: List[dict]) -> Dict[int, dict]:
+    """Join submit/admit/prefill_start/complete/timeout by request id
+    into per-request timeline records."""
+    reqs: Dict[int, dict] = {}
+
+    def rec(rid) -> dict:
+        return reqs.setdefault(int(rid), {"request_id": int(rid)})
+
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "submit":
+            r = rec(ev["request_id"])
+            r.update(submit_round=ev.get("round"),
+                     prompt_len=ev.get("prompt_len"),
+                     steps=ev.get("steps"))
+        elif kind == "prefill_start":
+            r = rec(ev["request_id"])
+            r.update(prefill_start_round=ev.get("round"),
+                     prefix_hit_len=ev.get("prefix_hit_len"))
+        elif kind == "admit":
+            r = rec(ev["request_id"])
+            r.update(admit_round=ev.get("round"),
+                     wait_rounds=ev.get("wait_rounds"),
+                     chunks=ev.get("chunks"),
+                     prompt_len=r.get("prompt_len") or ev.get("prompt_len"))
+        elif kind == "complete":
+            r = rec(ev["request_id"])
+            e2e = (ev["finish_t"] - ev["submit_t"]
+                   if ev.get("finish_t") is not None
+                   and ev.get("submit_t") is not None else None)
+            r.update(status="done", finish_round=ev.get("round"),
+                     emitted=ev.get("emitted"),
+                     live_iters=ev.get("live_iters"),
+                     rounds=ev.get("rounds"),
+                     phases=ev.get("phases") or {},
+                     e2e_s=e2e)
+            ph = r["phases"]
+            if e2e and all(k in ph for k in _CONTIGUOUS):
+                s = sum(ph[k] for k in _CONTIGUOUS)
+                r["phase_sum_s"] = round(s, 6)
+                r["phase_sum_rel_err"] = abs(s - e2e) / max(e2e, 1e-9)
+        elif kind == "timeout":
+            r = rec(ev["request_id"])
+            r.update(status="timeout", finish_round=ev.get("round"),
+                     wait_s=ev.get("wait_s"))
+    return reqs
+
+
+def round_series(events: List[dict], batch: Optional[int]) -> dict:
+    """Per-round occupancy/utilization series + summary figures."""
+    rounds = [ev for ev in events if ev["kind"] == "round"]
+    if not rounds:
+        return {"n_rounds": 0}
+    occ = [ev.get("occupied", 0) for ev in rounds]
+    iters = [ev.get("iters", 0) for ev in rounds]
+    live = [ev.get("live_iters", 0) for ev in rounds]
+    b = batch or max(occ) or 1
+    total_row_iters = sum(iters) * b
+    out = {
+        "n_rounds": len(rounds),
+        "batch": b,
+        "iters_total": sum(iters),
+        "occupancy_mean": round(sum(occ) / len(occ), 4),
+        "occupancy_max": max(occ),
+        "utilization": round(sum(live) / total_row_iters, 4)
+        if total_row_iters else 0.0,
+        "queue_depth_max": max(ev.get("queue_depth", 0) for ev in rounds),
+        "wasted_row_iters": total_row_iters - sum(live),
+    }
+    times = [ev["round_s"] for ev in rounds if "round_s" in ev]
+    if times:
+        out["round_s_mean"] = round(sum(times) / len(times), 6)
+        out["round_s_max"] = round(max(times), 6)
+    drifts = [ev["drift_decode"] for ev in rounds if "drift_decode" in ev]
+    if drifts:
+        out["drift_decode_last"] = drifts[-1]
+        out["drift_decode_range"] = [min(drifts), max(drifts)]
+    return out
+
+
+def find_anomalies(events: List[dict], reqs: Dict[int, dict],
+                   phase_tol: float) -> List[dict]:
+    anomalies: List[dict] = []
+
+    # Post-warmup compiles. A compile event is WARMUP when (a) it is the
+    # first ever for its entry, or (b) it lands inside the admission
+    # window of a request with a novel shape signature — the 16-bucket
+    # of its prompt length, or a first-seen prefix-hit length (the
+    # copy/chunk entries legitimately compile once per distinct bucket,
+    # and a chunked admission's compiles surface across the rounds its
+    # prefill spans). Everything else is a silent retrace.
+    warmup_rounds = set()
+    seen_sigs = set()
+    for r in sorted(reqs.values(),
+                    key=lambda r: (r.get("prefill_start_round",
+                                         r.get("admit_round", 0)) or 0,
+                                   r["request_id"])):
+        start = r.get("prefill_start_round", r.get("admit_round"))
+        end = r.get("admit_round", start)
+        if start is None:
+            continue
+        sigs = set()
+        if r.get("prompt_len") is not None:
+            sigs.add(("bucket", _bucket(r["prompt_len"])))
+        if r.get("prefix_hit_len"):
+            sigs.add(("hit", int(r["prefix_hit_len"])))
+        if sigs - seen_sigs:
+            seen_sigs |= sigs
+            warmup_rounds.update(
+                range(int(start), int(end if end is not None else start)
+                      + 1))
+    seen_entries = set()
+    for ev in events:
+        if ev["kind"] != "compile":
+            continue
+        entry = ev.get("entry")
+        first = entry not in seen_entries
+        seen_entries.add(entry)
+        if first or ev.get("round") in warmup_rounds:
+            continue
+        anomalies.append({
+            "kind": "post_warmup_compile", "round": ev.get("round"),
+            "entry": entry, "new_compiles": ev.get("new_compiles")})
+
+    # Queue stalls. Round events stamp queue_depth at round END — after
+    # that round's admissions already ran — so a request submitted
+    # MID-round legitimately shows (queue_depth > 0, admitted == 0) on
+    # the round it arrived during; it gets its chance at the NEXT
+    # round's admit. The stall signature therefore spans a consecutive
+    # pair: round N ends with ready work and free rows, and round N+1
+    # still neither admits, starts a prefill, nor expires anything —
+    # the scheduler provably sat on ready work for a full round.
+    batch = next((ev.get("batch") for ev in events
+                  if ev["kind"] == "engine_start"), None)
+    if batch:
+        rounds = [ev for ev in events if ev["kind"] == "round"]
+        for prev, cur in zip(rounds, rounds[1:]):
+            if (prev.get("queue_depth", 0) > 0
+                    and prev.get("occupied", 0) < batch
+                    and cur.get("admitted", 0) == 0
+                    and cur.get("prefilling", 0) == 0
+                    and cur.get("expired", 0) == 0):
+                anomalies.append({
+                    "kind": "queue_stall", "round": cur.get("round"),
+                    "queue_depth": prev.get("queue_depth"),
+                    "occupied": prev.get("occupied"), "batch": batch})
+
+    for r in reqs.values():
+        if r.get("status") == "timeout":
+            anomalies.append({
+                "kind": "deadline_expiry",
+                "request_id": r["request_id"],
+                "round": r.get("finish_round"),
+                "wait_s": r.get("wait_s")})
+        err = r.get("phase_sum_rel_err")
+        if err is not None and err > phase_tol:
+            anomalies.append({
+                "kind": "phase_sum_mismatch",
+                "request_id": r["request_id"],
+                "phase_sum_s": r.get("phase_sum_s"),
+                "e2e_s": r.get("e2e_s"),
+                "rel_err": round(err, 4), "tol": phase_tol})
+
+    # Unresolved requests — only judged against a SEALED log (the file
+    # sink is unbounded, so every event of a sealed run is present).
+    if any(ev["kind"] == "drain_complete" for ev in events):
+        for r in reqs.values():
+            if "submit_round" in r and r.get("status") is None:
+                anomalies.append({"kind": "unresolved_request",
+                                  "request_id": r["request_id"]})
+    return anomalies
+
+
+def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
+                 series: bool = False) -> dict:
+    reqs = build_requests(events)
+    batch = next((ev.get("batch") for ev in events
+                  if ev["kind"] == "engine_start"), None)
+    anomalies = find_anomalies(events, reqs, phase_tol)
+    done = [r for r in reqs.values() if r.get("status") == "done"]
+    errs = [r["phase_sum_rel_err"] for r in done
+            if "phase_sum_rel_err" in r]
+    report = {
+        "n_events": len(events),
+        "sealed": any(ev["kind"] == "drain_complete" for ev in events),
+        "n_submitted": sum(1 for r in reqs.values() if "submit_round" in r),
+        "n_completed": len(done),
+        "n_timeout": sum(1 for r in reqs.values()
+                         if r.get("status") == "timeout"),
+        "rounds": round_series(events, batch),
+        "requests": sorted(reqs.values(),
+                           key=lambda r: r["request_id"]),
+        "phase_tol": phase_tol,
+        "phase_sum_checked": len(errs),
+        "phase_sum_max_rel_err": round(max(errs), 6) if errs else None,
+        "post_warmup_compiles": sum(
+            1 for a in anomalies if a["kind"] == "post_warmup_compile"),
+        "anomalies": anomalies,
+        "ok": not anomalies,
+    }
+    if series:
+        report["round_series"] = [
+            {k: ev.get(k) for k in ("round", "iters", "occupied",
+                                    "live_iters", "queue_depth",
+                                    "round_s", "decode_s")}
+            for ev in events if ev["kind"] == "round"]
+    # Ledger echo: the drain seal carries the engine's final summary.
+    for ev in reversed(events):
+        if ev["kind"] == "drain_complete":
+            report["ledger"] = ev.get("ledger")
+            break
+    return report
+
+
+def _human(report: dict) -> str:
+    lines = [
+        f"runlog: {report['n_events']} events, "
+        f"sealed={report['sealed']}",
+        f"requests: {report['n_submitted']} submitted, "
+        f"{report['n_completed']} completed, "
+        f"{report['n_timeout']} timed out",
+    ]
+    r = report["rounds"]
+    if r.get("n_rounds"):
+        lines.append(
+            f"rounds: {r['n_rounds']} (occupancy mean "
+            f"{r['occupancy_mean']}, utilization {r['utilization']}, "
+            f"max queue depth {r['queue_depth_max']})")
+        if "drift_decode_last" in r:
+            lines.append(f"decode drift: {r['drift_decode_last']} "
+                         f"(range {r['drift_decode_range']})")
+    if report["phase_sum_checked"]:
+        lines.append(
+            f"phase sums: {report['phase_sum_checked']} checked, max "
+            f"rel err {report['phase_sum_max_rel_err']} "
+            f"(tol {report['phase_tol']})")
+    if report["anomalies"]:
+        lines.append(f"ANOMALIES ({len(report['anomalies'])}):")
+        lines.extend(f"  {json.dumps(a, sort_keys=True)}"
+                     for a in report["anomalies"])
+    else:
+        lines.append("no anomalies")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("runlog", help="engine runlog (JSON lines)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="write the JSON report here ('-' = stdout, "
+                        "suppressing the human summary)")
+    p.add_argument("--phase-tol", type=float, default=PHASE_TOL_DEFAULT,
+                   help="max |phase sum - e2e| / e2e before a completed "
+                        "request is flagged (default 0.05)")
+    p.add_argument("--series", action="store_true",
+                   help="inline the full per-round series")
+    args = p.parse_args(argv)
+    try:
+        events = load_runlog(args.runlog)
+    except OSError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    if not events:
+        print(f"ERROR: no runlog events in {args.runlog}",
+              file=sys.stderr)
+        return 2
+    report = build_report(events, phase_tol=args.phase_tol,
+                          series=args.series)
+    if args.json_out == "-":
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True,
+                          default=str)
+        print(_human(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

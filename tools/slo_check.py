@@ -32,6 +32,15 @@ where <check> is one of
                                  (conservative: the real quantile is <=
                                  the bound that trips; a mass landing
                                  in +Inf always violates)
+    {"gauge": <series>, "min": x, "max": x}
+                                 band on an attached obs gauge by its
+                                 full labeled series name (e.g.
+                                 'cost_model_drift_ratio{op="decode"}');
+                                 a missing gauge is a violation — the
+                                 consistency teeth that keep baselines
+                                 from outliving renamed metrics
+                                 (histogram names get the same
+                                 missing-is-failure treatment)
 Bounds are exact; encode tolerance IN the committed bound (wall-clock
 fields get generous bounds — CI hosts are weather; the sharp teeth are
 the ratio / hit-rate / recompile checks, which are schedule-determined).
@@ -118,6 +127,21 @@ def _check_histogram(line: dict, field: str, spec: dict) -> List[str]:
     return out
 
 
+def _check_gauge(line: dict, field: str, spec: dict) -> List[str]:
+    name = spec["gauge"]
+    gauges = (line.get("metrics") or {}).get("gauges", {})
+    if name not in gauges:
+        return [f"{field}: gauge {name!r} missing from the metrics "
+                "block"]
+    out = []
+    val = gauges[name]
+    if "min" in spec and val < spec["min"]:
+        out.append(f"{field}: {name} = {val} < min {spec['min']}")
+    if "max" in spec and val > spec["max"]:
+        out.append(f"{field}: {name} = {val} > max {spec['max']}")
+    return out
+
+
 def check_line(line: dict, checks: Dict[str, dict]) -> List[str]:
     """Violations of ``checks`` (baseline block for one metric) in one
     artifact line; empty list = pass."""
@@ -125,6 +149,9 @@ def check_line(line: dict, checks: Dict[str, dict]) -> List[str]:
     for field, spec in checks.items():
         if "histogram" in spec:
             out.extend(_check_histogram(line, field, spec))
+            continue
+        if "gauge" in spec:
+            out.extend(_check_gauge(line, field, spec))
             continue
         val = line.get(field)
         if val is None:
