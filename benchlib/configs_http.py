@@ -45,9 +45,14 @@ _TOOLS = os.path.join(
 def _load_client():
     """tools/ is not a package; load serving_client.py by path (the
     capture_summary idiom from tests/test_bench_harness.py)."""
+    import sys
+
     spec = importlib.util.spec_from_file_location(
         "serving_client", os.path.join(_TOOLS, "serving_client.py"))
     mod = importlib.util.module_from_spec(spec)
+    # Register BEFORE exec (the importlib contract): the client's
+    # RetryPolicy dataclass resolves string annotations via sys.modules.
+    sys.modules["serving_client"] = mod
     spec.loader.exec_module(mod)
     return mod
 
@@ -182,8 +187,17 @@ def config_http():
         n_429 = over_digest["codes"].get("429", 0)
 
         recompiles = scraped_recompiles() - recompiles_before
-        drift_post_burst = client.metrics()["samples"].get(
+        final_samples = client.metrics()["samples"]
+        drift_post_burst = final_samples.get(
             'cost_model_drift_ratio{op="decode"}')
+        # Robustness gate fields (docs/robustness.md): this is a
+        # NON-chaos run — any supervised engine restart or abandoned
+        # stream here means something crashed or broke organically, and
+        # the SLO baseline pins both to zero.
+        engine_restarts = int(final_samples.get(
+            "serving_engine_restarts_total", 0))
+        streams_abandoned = int(final_samples.get(
+            "serving_streams_abandoned_total", 0))
     finally:
         t_drain = time.perf_counter()
         drain_ok = server.begin_drain(120.0)
@@ -214,6 +228,8 @@ def config_http():
         "drift_decode_post_burst": drift_post_burst,
         "drift_samples": drift_samples,
         "recompiles_after_warmup": int(recompiles),
+        "engine_restarts": engine_restarts,
+        "streams_abandoned": streams_abandoned,
         "overload_requests": burst,
         "overload_429s": n_429,
         "overload_429_rate": round(n_429 / burst, 4),

@@ -144,6 +144,7 @@ def config_serving():
     import numpy as np
 
     from marlin_tpu.models import TransformerConfig, generate, init_params
+    from marlin_tpu.obs import metrics as obs_metrics
     from marlin_tpu.obs import trace as obs_trace
     from marlin_tpu.obs.watch import CompileWatchdog
     from marlin_tpu.serving import (ServingEngine,
@@ -269,6 +270,16 @@ def config_serving():
         "batch": batch, "n_requests": n_req, "round_steps": round_steps,
         "steps_short": short, "steps_long": long_, "d_model": d,
         "recompiles_after_warmup": recompiles,
+        # Non-chaos robustness echo (docs/robustness.md): supervised
+        # restarts observed process-wide. This config drives the engine
+        # DIRECTLY (no frontend), so a crash here would kill the bench,
+        # not restart — the field is trivially 0 today and exists so
+        # the baseline's restarts==0 check covers any frontend-driven
+        # config sharing this process (the HTTP line is where the check
+        # has teeth now; a ROADMAP-14 fleet config is where this one
+        # will).
+        "engine_restarts": int(obs_metrics.registry.counter(
+            "serving_engine_restarts_total").value),
         "trace_path": trace_path, "trace_events": n_trace_events,
     }
 

@@ -8,8 +8,12 @@ that converts PR 1's "skew-proof" into reclaimed throughput
 (docs/serving.md).
 """
 
+from . import faults
 from .engine import ServingEngine, _decode_round
-from .frontend import EngineFrontend, FrontendError, FrontendRequest
+from .faults import (EngineStateCorrupt, FaultInjected, FaultPlan,
+                     FaultSpec)
+from .frontend import (EngineFailed, EngineFrontend, FrontendError,
+                       FrontendRequest, PoisonedRequest)
 from .prefix import PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
 from .server import ServingHTTPServer, install_signal_handlers, serve
@@ -20,11 +24,18 @@ from .stats import (EngineStats, request_stats, static_completed_at_budget,
 
 __all__ = [
     "AdmissionQueue",
+    "EngineFailed",
     "EngineFrontend",
+    "EngineStateCorrupt",
     "EngineStats",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
     "FrontendError",
     "FrontendRequest",
+    "PoisonedRequest",
     "PrefixCache",
+    "faults",
     "QueueClosed",
     "QueueFull",
     "Request",

@@ -62,6 +62,7 @@ from ..obs import trace as obs_trace
 from ..obs.runlog import RunLog
 from ..obs.watch import CompileWatchdog
 from ..utils import cost_model as cm
+from . import faults
 from .prefix import PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, Request
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
@@ -199,7 +200,8 @@ class ServingEngine:
                  metrics_registry=None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: Optional[PrefixCache] = None,
-                 prefill_chunks_per_round: int = 2):
+                 prefill_chunks_per_round: int = 2,
+                 stats: Optional[EngineStats] = None):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -272,8 +274,12 @@ class ServingEngine:
         self.runlog = runlog if runlog is not None else RunLog()
         self.metrics = metrics_registry if metrics_registry is not None \
             else obs_metrics.registry
-        self.stats = EngineStats(batch=batch, cfg=cfg,
-                                 registry=self.metrics)
+        # ``stats`` may be inherited from a crashed predecessor
+        # (spawn_successor): one serving lifetime's ledger spans N
+        # engine incarnations, so restarts don't zero the totals the
+        # SLO gates and the quarantine ledger live in.
+        self.stats = stats if stats is not None else EngineStats(
+            batch=batch, cfg=cfg, registry=self.metrics)
         self.watchdog = CompileWatchdog(registry=self.metrics)
         self.watchdog.register("serving.decode_round", _decode_round)
         self.watchdog.register("serving.prefill_into_row",
@@ -287,6 +293,7 @@ class ServingEngine:
         # request_id), so its sampled tokens are a pure function of
         # (prompt, steps, engine seed, request_id) — independent of
         # batch composition, slot, or arrival pattern.
+        self._seed = int(seed)  # spawn_successor re-derives _base_key
         self._base_key = jax.random.PRNGKey(seed)
         self._next_id = 0
         self.round_idx = 0
@@ -312,6 +319,20 @@ class ServingEngine:
         # In-flight chunked admissions (row -> job); empty in the
         # default one-shot mode.
         self._prefilling: Dict[int, _PrefillJob] = {}
+        # Crash-consistency ledger for the supervisor (frontend.py):
+        # requests RESOLVED this step (retired with output, or expired)
+        # whose ownership has not yet transferred out through step()'s
+        # return. A crash between resolution and return would otherwise
+        # lose finished work — the supervisor delivers these before
+        # rebuilding. Cleared at every successful step() exit.
+        self._retired_pending: List[Request] = []
+        # Crash-blame attribution: the request whose PER-REQUEST
+        # dispatch (admission prefill / prefix copy) is executing right
+        # now, or None during batch-wide work (the decode round). A
+        # crash with a blamed id implicates only that request; a
+        # batch-wide crash implicates every in-flight request
+        # (docs/robustness.md §quarantine).
+        self._admitting_rid: Optional[int] = None
         # Device state. Free rows sit at filled=1 over a zero buffer so
         # the frozen feed (buf[row, 0] at position 0) is well-defined
         # dead state; target=0 keeps them done from round one.
@@ -436,6 +457,7 @@ class ServingEngine:
             # lock pairs the delete with submit()'s insert).
             with self._submit_lock:
                 self.requests.pop(req.request_id, None)
+            self._retired_pending.append(req)  # crash-safe until return
 
     def _admit(self) -> List[Request]:
         """Fill free slots from the queue (FIFO); returns timed-out
@@ -449,6 +471,7 @@ class ServingEngine:
     def _admit_oneshot(self) -> List[Request]:
         expired: List[Request] = []
         while self.slots.n_free:
+            faults.check("admission_pop", round_idx=self.round_idx)
             req, dropped = self.queue.pop_ready(self.round_idx)
             expired.extend(dropped)
             if req is None:
@@ -460,14 +483,22 @@ class ServingEngine:
             padded[:s] = req.prompt
             k_first, k_decode = self._request_keys(req)
             t0 = time.perf_counter()
+            # Blame scope: set before, cleared only on SUCCESS — a
+            # crash must leave the id readable by the supervisor, which
+            # is the whole point of the attribution.
+            self._admitting_rid = req.request_id
+            faults.check("prefill_chunk", round_idx=self.round_idx,
+                         request_id=req.request_id)
             with self.tracer.span("serving.admit", scope=False,
                                   request_id=req.request_id, row=row,
                                   prompt_len=s):
                 self._cache, self._buf, _, _ = prefill_into_row(
-                    self.params, self._cache, self._buf, jnp.int32(row),
+                    self.params, self._cache, self._buf,
+                    jnp.int32(row),
                     jnp.asarray(padded), jnp.int32(s),
                     jnp.asarray(k_first), cfg=self.cfg,
                     temperature=self.temperature)
+            self._admitting_rid = None
             req.prefill_s += time.perf_counter() - t0
             self.stats.calibration.record(
                 "prefill", cm.admission_cost(self.cfg, s)[0],
@@ -491,6 +522,7 @@ class ServingEngine:
         prefill across rounds instead of stalling the live batch."""
         expired: List[Request] = []
         while self.slots.n_free:
+            faults.check("admission_pop", round_idx=self.round_idx)
             req, dropped = self.queue.pop_ready(self.round_idx)
             expired.extend(dropped)
             if req is None:
@@ -523,11 +555,18 @@ class ServingEngine:
                 # engine cache is donated through, so its buffer
                 # pointers stay stable across prefix-hit admissions.
                 t0 = time.perf_counter()
-                with self.tracer.span("serving.prefix_copy", scope=False,
-                                      request_id=req.request_id, row=row,
-                                      hit_len=hit):
+                # Blame scope: cleared only on success (see
+                # _admit_oneshot) so a crash stays attributed.
+                self._admitting_rid = req.request_id
+                faults.check("prefix_copy", round_idx=self.round_idx,
+                             request_id=req.request_id)
+                with self.tracer.span("serving.prefix_copy",
+                                      scope=False,
+                                      request_id=req.request_id,
+                                      row=row, hit_len=hit):
                     self._cache = self.prefix_cache.load_into(
                         self._cache, row, hit_row, hit)
+                self._admitting_rid = None
                 req.prefix_copy_s = time.perf_counter() - t0
                 # Copy cost is byte-priced: admission_cost at tail=0
                 # reduces to exactly the copy's read+write traffic.
@@ -568,6 +607,9 @@ class ServingEngine:
         seg[:clen] = req.prompt[c0:c1]
         final = c1 == s
         t0 = time.perf_counter()
+        self._admitting_rid = req.request_id  # crash blame scope
+        faults.check("prefill_chunk", round_idx=self.round_idx,
+                     request_id=req.request_id)
         with self.tracer.span("serving.admit_chunk", scope=False,
                               request_id=req.request_id, row=job.row,
                               start=c0, chunk_len=clen, final=final):
@@ -590,6 +632,7 @@ class ServingEngine:
                     jnp.int32(clen), jnp.asarray(seg), jnp.int32(s),
                     jnp.asarray(job.k_first), cfg=self.cfg,
                     temperature=self.temperature, final=False)
+        self._admitting_rid = None
         dt = time.perf_counter() - t0
         req.prefill_s += dt
         # Incremental prediction for the [c0, c1) tail wedge: the
@@ -661,6 +704,7 @@ class ServingEngine:
             # bounds PENDING work, this bounds FINISHED work.
             with self._submit_lock:
                 del self.requests[req.request_id]
+            self._retired_pending.append(req)  # crash-safe until return
             finished.append(req)
         return finished
 
@@ -714,6 +758,7 @@ class ServingEngine:
             # all-done round a no-op trip.
             done0 = ~self._active | (self._filled >= self._target)
             t_dec0 = time.perf_counter()
+            faults.check("decode_round", round_idx=self.round_idx)
             with self.tracer.span("serving.decode_round", scope=False,
                                   occupied=self.slots.n_occupied):
                 self._buf, filled_d, done_d, self._cache, iters_d, \
@@ -727,6 +772,8 @@ class ServingEngine:
                         temperature=self.temperature, eos_id=self.eos_id)
                 filled, done, iters, live, keys = jax.device_get(
                     (filled_d, done_d, iters_d, live_d, keys_d))
+            filled = faults.corrupt("decode_round", filled,
+                                    round_idx=self.round_idx)
             # The device_get above fences the round, so this host delta
             # covers dispatch + execution — the measured side the drift
             # ledger confronts the decode cost model with. All-idle
@@ -736,6 +783,19 @@ class ServingEngine:
                 self.stats.calibration.record(
                     "decode", int(iters) * self._decode_flops, decode_s)
             self._filled = np.array(filled, np.int32)  # writable copy
+            # Fetch sanity: every legal row sits in [1, max_len]
+            # (free rows park at 1, chunked prefills at max_len, live
+            # rows at most target <= max_len). Anything outside means
+            # the round-trip itself is untrustworthy — scheduling on it
+            # would serve corrupt output; raising hands the round to
+            # the supervisor, whose rebuilt engine replays the affected
+            # requests bit-exactly (docs/robustness.md §failure model).
+            if ((self._filled < 1)
+                    | (self._filled > self.cfg.max_len)).any():
+                raise faults.EngineStateCorrupt(
+                    f"round {self.round_idx}: fetched filled counters "
+                    f"outside [1, {self.cfg.max_len}]: "
+                    f"{self._filled.tolist()}")
             self._keys = np.array(keys, np.uint32)
             for row in self.slots.occupied_rows():
                 self.requests[self.slots.owner_of(row)].live_iters += \
@@ -755,6 +815,7 @@ class ServingEngine:
                              new_compiles=rec.new_compiles)
         self.metrics.gauge("serving_queue_depth").set(len(self.queue))
         live_sum = int(live.sum())
+        faults.check("runlog_emit", round_idx=self.round_idx)
         self.runlog.emit(
             "round", round=self.round_idx, iters=int(iters),
             occupied=occupied, live_iters=live_sum,
@@ -767,6 +828,10 @@ class ServingEngine:
             decode_s=round(decode_s, 6),
             drift_decode=round(self.stats.calibration.drift("decode"), 4))
         self.round_idx += 1
+        # Ownership transfers through the return below; the crash-
+        # consistency copy is only needed while a raise could still
+        # strand resolved requests inside this engine.
+        self._retired_pending = []
         return expired + finished
 
     def run(self, max_rounds: int = 10_000) -> List[Request]:
@@ -828,6 +893,74 @@ class ServingEngine:
         embedding caller share this."""
         self.close()
         return self.run(max_rounds=max_rounds)
+
+    # -- supervised restart (serving/frontend.py, docs/robustness.md) -
+
+    def spawn_successor(self) -> "ServingEngine":
+        """A fresh engine that CONTINUES this one's serving lifetime
+        after a crash: same params/config/knobs/seed (so every
+        request's PRNG stream — ``fold_in(seed key, request_id)`` —
+        replays bit-exactly), same tracer/runlog/registry, and the SAME
+        ``EngineStats`` ledger (totals and the quarantine record span
+        incarnations). Device state is rebuilt from scratch — the jit
+        caches of the module-level entry points stay warm, so the
+        successor recompiles nothing for shapes this process has
+        already served. Id allocation and the round index carry over:
+        recovered requests keep their ids (no collision with new
+        submissions) and both deadline currencies stay monotone. A
+        closed (draining) queue stays closed — a drain interrupted by a
+        crash still owes its accepted work but admits nothing new."""
+        pc = self.prefix_cache
+        new_pc = None
+        if pc is not None:
+            # A fresh pool, not the crashed one: the old pool's
+            # refcounts/LRU state may be torn mid-copy, and the cache
+            # is a pure performance layer — bit-exactness never
+            # depended on it (tests/test_prefix_cache.py).
+            new_pc = PrefixCache(self.cfg, pool_rows=pc.pool_rows,
+                                 registry=pc._registry)
+        eng = ServingEngine(
+            self.params, self.cfg, batch=self.batch,
+            round_steps=self.round_steps,
+            max_pending=self.queue.max_pending,
+            temperature=self.temperature, eos_id=self.eos_id,
+            seed=self._seed, tracer=self.tracer, runlog=self.runlog,
+            metrics_registry=self.metrics,
+            prefill_chunk=self.prefill_chunk,
+            prefix_cache=new_pc,
+            prefill_chunks_per_round=self.prefill_chunks_per_round,
+            stats=self.stats)
+        eng._next_id = self._next_id
+        eng.round_idx = self.round_idx + 1
+        if self.queue.closed:
+            eng.queue.close()
+        return eng
+
+    def requeue(self, reqs: List[Request],
+                crash_time: Optional[float] = None) -> int:
+        """Restore captured requests from a crashed predecessor, in
+        arrival (request-id) order, each reset to pristine pending
+        state (``Request.reset_for_requeue``) with its id, deadlines,
+        and submit stamp intact — the recovery half of the supervised
+        restart. Bypasses the backpressure cap (``queue.restore``):
+        recovered work was already accepted once. Returns the count."""
+        now = crash_time if crash_time is not None else \
+            time.perf_counter()
+        ordered = sorted(reqs, key=lambda r: r.request_id)
+        with self._submit_lock:
+            for req in ordered:
+                req.reset_for_requeue(now)
+                self.queue.restore(req)
+                self.requests[req.request_id] = req
+        for req in ordered:
+            self.stats.record_recovery(req)
+            self.runlog.emit("recover", request_id=req.request_id,
+                             round=self.round_idx,
+                             crash_count=req.crash_count,
+                             requeues=req.requeues,
+                             recovery_s=round(req.recovery_s, 6))
+        self.metrics.gauge("serving_queue_depth").set(len(self.queue))
+        return len(ordered)
 
     # -- debug introspection (any thread) -----------------------------
 

@@ -1,0 +1,260 @@
+"""Deterministic fault injection for the serving stack.
+
+Chaos testing only works if the chaos REPLAYS: a fault that fires on a
+coin flip produces unreproducible failures and therefore unprovable
+recoveries. Every injection here is keyed on the engine's own
+deterministic coordinates — the round index and the request id — so a
+crash scenario is a pure function of the plan and the workload, and the
+bit-exact-recovery pin (tests/test_faults.py) can compare a faulted run
+against an uninterrupted one token for token.
+
+The hot path carries named INJECTION SITES (docs/robustness.md has the
+full table):
+
+========================  ============================================
+site                      fires inside
+========================  ============================================
+``decode_round``          ``ServingEngine.step`` — before the round's
+                          decode dispatch (``raise``/``delay``), or on
+                          the round's device fetch (``corrupt``)
+``prefill_chunk``         the admission prefill dispatch — one-shot
+                          (``_admit_oneshot``) and chunked
+                          (``_advance_chunk``) alike
+``prefix_copy``           the prefix-cache donor-row copy
+                          (``_start_prefill``)
+``admission_pop``         the queue pop loop (``_admit*``)
+``stream_fanout``         the frontend driver's post-round delivery
+                          (``EngineFrontend._fanout``)
+``runlog_emit``           the engine's per-round runlog emission
+========================  ============================================
+
+Each site calls :func:`check` (raise or sleep) or :func:`corrupt`
+(scribble a sentinel into a fetched host array — the engine's
+fetch-sanity bounds then detect it and raise
+:class:`EngineStateCorrupt`, modeling a real corrupted device
+round-trip rather than a polite exception). With no plan installed the
+module-global fast path is one ``None`` test per site per round —
+measurably free.
+
+Plans install process-globally (:func:`install`) or from the
+``MARLIN_FAULT_PLAN`` environment variable as JSON
+(:func:`install_from_env`; the chaos form of the tier-1 subprocess
+smoke), e.g.::
+
+    MARLIN_FAULT_PLAN='{"specs": [{"site": "decode_round",
+                                   "round": 4, "action": "raise"}]}'
+
+Every fired spec bumps ``serving_faults_injected_total{site=...}`` so a
+chaos run's metrics distinguish injected crashes from organic ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+
+SITES = ("decode_round", "prefill_chunk", "prefix_copy",
+         "admission_pop", "stream_fanout", "runlog_emit")
+ACTIONS = ("raise", "delay", "corrupt")
+ENV_VAR = "MARLIN_FAULT_PLAN"
+
+
+class FaultInjected(RuntimeError):
+    """The exception an ``action="raise"`` spec throws — the canonical
+    chaos crash the supervisor (serving/frontend.py) must recover
+    from."""
+
+
+class EngineStateCorrupt(RuntimeError):
+    """A device fetch failed the engine's sanity bounds. Whether the
+    cause is an injected ``corrupt`` spec or a real bad round-trip, the
+    host-side scheduling state can no longer be trusted mid-round; the
+    engine raises instead of scheduling on garbage, and the supervisor
+    rebuilds from the last round boundary."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One injection rule: WHERE (``site``), WHAT (``action``), and the
+    deterministic WHEN — an exact ``round`` index, a ``round_every``
+    modulus, and/or a ``request_id``, each ``None`` meaning "any".
+    ``max_fires`` bounds total firings (default one-shot), so a
+    round-keyed crash does not re-fire after the supervisor restarts
+    past it."""
+
+    site: str
+    action: str = "raise"
+    round: Optional[int] = None        # exact engine round index
+    round_every: Optional[int] = None  # fire when round % round_every == 0
+    request_id: Optional[int] = None
+    max_fires: int = 1
+    delay_s: float = 0.05
+    message: str = ""
+    fires: int = 0  # mutable firing count (plan lock guards it)
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"sites: {SITES}")
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}; "
+                             f"actions: {ACTIONS}")
+        if self.max_fires < 1:
+            raise ValueError(f"max_fires must be >= 1, got "
+                             f"{self.max_fires}")
+        if self.round_every is not None and self.round_every < 1:
+            # Reject at install time: a zero modulus would otherwise
+            # ZeroDivisionError on every site check — a deterministic
+            # crash loop born from a config typo.
+            raise ValueError(f"round_every must be >= 1, got "
+                             f"{self.round_every}")
+
+    def matches(self, site: str, round_idx: Optional[int],
+                request_id: Optional[int]) -> bool:
+        if self.site != site or self.fires >= self.max_fires:
+            return False
+        if self.round is not None and round_idx != self.round:
+            return False
+        if self.round_every is not None and (
+                round_idx is None or round_idx % self.round_every):
+            return False
+        if self.request_id is not None and request_id != self.request_id:
+            return False
+        return True
+
+
+class FaultPlan:
+    """An ordered set of :class:`FaultSpec` rules sharing one firing
+    lock (sites are hit from the driver thread AND handler threads).
+    Build programmatically (``plan.add(site=..., round=...)``) or from
+    JSON (:meth:`from_json`); activate with :func:`install`."""
+
+    def __init__(self, specs: Optional[List[FaultSpec]] = None):
+        self.specs: List[FaultSpec] = list(specs or [])
+        self._lock = threading.Lock()
+
+    def add(self, **kw) -> FaultSpec:
+        spec = FaultSpec(**kw)
+        with self._lock:
+            self.specs.append(spec)
+        return spec
+
+    def _fire(self, site: str, actions, round_idx, request_id):
+        """First matching spec of the wanted action class, its firing
+        counted — or None. The count and the match are one atomic
+        decision (two threads cannot both consume a max_fires=1 spec)."""
+        with self._lock:
+            for spec in self.specs:
+                if spec.action in actions and spec.matches(
+                        site, round_idx, request_id):
+                    spec.fires += 1
+                    obs_metrics.registry.counter(
+                        "serving_faults_injected_total", site=site,
+                        help="chaos faults fired, by injection site",
+                    ).inc()
+                    return spec
+        return None
+
+    def check(self, site: str, round_idx: Optional[int] = None,
+              request_id: Optional[int] = None) -> None:
+        spec = self._fire(site, ("raise", "delay"), round_idx, request_id)
+        if spec is None:
+            return
+        if spec.action == "delay":
+            time.sleep(spec.delay_s)
+            return
+        raise FaultInjected(
+            spec.message or f"injected fault at {site} "
+            f"(round={round_idx}, request_id={request_id})")
+
+    def corrupt(self, site: str, arr, round_idx: Optional[int] = None,
+                request_id: Optional[int] = None):
+        """Scribble a sentinel into a copy of ``arr`` when a
+        ``corrupt`` spec matches; otherwise return ``arr`` untouched.
+        The sentinel (-1) sits outside every legal range the engine's
+        fetch-sanity check accepts, so corruption is DETECTED, not
+        silently served."""
+        spec = self._fire(site, ("corrupt",), round_idx, request_id)
+        if spec is None:
+            return arr
+        out = np.array(arr)
+        out.flat[:1] = -1
+        return out
+
+    def summary(self) -> List[dict]:
+        with self._lock:
+            return [dataclasses.asdict(s) for s in self.specs]
+
+    def total_fires(self) -> int:
+        with self._lock:
+            return sum(s.fires for s in self.specs)
+
+    # -- (de)serialization (the env-selected chaos smoke) -------------
+
+    def to_json(self) -> str:
+        return json.dumps({"specs": self.summary()})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        """Accepts ``{"specs": [...]}`` or a bare spec list."""
+        doc = json.loads(text)
+        specs = doc if isinstance(doc, list) else doc.get("specs", [])
+        return cls([FaultSpec(**{k: v for k, v in s.items()
+                                 if k != "fires"}) for s in specs])
+
+
+# -- the process-global plan (None = injection disabled) --------------
+
+_plan: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Activate ``plan`` process-wide; returns it. Chaos tests pair
+    this with :func:`reset` in teardown."""
+    global _plan
+    _plan = plan
+    return plan
+
+
+def reset() -> None:
+    global _plan
+    _plan = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _plan
+
+
+def install_from_env(environ=None) -> Optional[FaultPlan]:
+    """Install a plan from ``MARLIN_FAULT_PLAN`` (JSON) when set —
+    how the subprocess chaos smoke arms a real server without code
+    changes. Returns the installed plan or None."""
+    text = (environ if environ is not None else os.environ).get(ENV_VAR)
+    if not text:
+        return None
+    return install(FaultPlan.from_json(text))
+
+
+def check(site: str, round_idx: Optional[int] = None,
+          request_id: Optional[int] = None) -> None:
+    """Hot-path site hook: no-op unless a plan is installed."""
+    if _plan is None:
+        return
+    _plan.check(site, round_idx=round_idx, request_id=request_id)
+
+
+def corrupt(site: str, arr, round_idx: Optional[int] = None,
+            request_id: Optional[int] = None):
+    """Hot-path fetch hook: identity unless a plan is installed."""
+    if _plan is None:
+        return arr
+    return _plan.corrupt(site, arr, round_idx=round_idx,
+                         request_id=request_id)

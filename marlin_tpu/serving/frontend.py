@@ -36,6 +36,22 @@ submits raise ``QueueClosed``), let the driver finish every in-flight
 and queued request, seal the runlog (``drain_complete`` + flush via
 ``engine._seal_drain``), then join the driver thread. The HTTP layer
 maps this onto SIGTERM (docs/frontend.md §drain).
+
+Supervision (docs/robustness.md): the driver loop runs inside a CRASH
+BOUNDARY. An engine exception no longer kills the service — the
+supervisor captures the in-flight ledger, rebuilds a fresh
+``ServingEngine`` (same params/config/seed; module-level jit caches
+stay warm, so the successor recompiles nothing), REQUEUES every
+non-completed request with its id, deadlines, and arrival order intact,
+and resumes. Replay is bit-exact by construction (per-request PRNG
+streams: output is a pure function of ``(prompt, steps, seed,
+request_id)``), so streaming handles just keep delivering past their
+cursor and an SSE consumer sees a byte-identical continuation. Bounded
+restarts (``max_restarts`` within ``restart_window_s``) then FAIL
+CLOSED: waiters get :class:`EngineFailed`, ``/readyz`` goes false. A
+request implicated in ``poison_after`` consecutive crashes is
+QUARANTINED — failed with :class:`PoisonedRequest` instead of requeued,
+so one poison request cannot consume the restart budget.
 """
 
 from __future__ import annotations
@@ -43,11 +59,13 @@ from __future__ import annotations
 import queue as _queue
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from . import faults
 
 # Sentinel closing a streaming handle's chunk queue. A unique object —
 # never equal to a token chunk.
@@ -56,6 +74,29 @@ _EOS = object()
 
 class FrontendError(RuntimeError):
     """The driver thread died; carried by every handle it abandoned."""
+
+
+class EngineFailed(FrontendError):
+    """The supervisor exhausted its restart budget and failed closed:
+    the engine is not coming back without operator action. New
+    submissions are refused (HTTP 503) and ``/readyz`` reports false."""
+
+
+class PoisonedRequest(FrontendError):
+    """This request was in flight across ``poison_after`` consecutive
+    engine crashes and is quarantined instead of requeued again
+    (HTTP 500 with a structured body). Carries ``request_id`` and
+    ``crash_count``."""
+
+    def __init__(self, request_id: int, crash_count: int,
+                 last_error: BaseException):
+        super().__init__(
+            f"request {request_id} quarantined: in flight across "
+            f"{crash_count} consecutive engine crashes "
+            f"(last: {type(last_error).__name__}: {last_error})")
+        self.request_id = request_id
+        self.crash_count = crash_count
+        self.last_error = last_error
 
 
 class FrontendRequest:
@@ -76,6 +117,9 @@ class FrontendRequest:
         self.done = threading.Event()
         self.request = None  # engine Request, set at completion
         self.error: Optional[BaseException] = None
+        # Client hung up mid-SSE (frontend.abandon_stream): fanout stops
+        # feeding the chunk queue; the request itself still completes.
+        self.abandoned = False
         # Streamed-token cursor, driver-thread-only: how many of the
         # request's generated tokens have been pushed already.
         self._streamed = 0
@@ -87,12 +131,16 @@ class FrontendRequest:
     def result(self, timeout: Optional[float] = None):
         """Block until the request finishes; returns the engine's
         finished ``Request`` (status ``done`` or ``timeout``). Raises
-        :class:`FrontendError` if the driver died, ``TimeoutError`` on
-        ``timeout``."""
+        the TYPED failure when there is one — :class:`PoisonedRequest`
+        (quarantined), :class:`EngineFailed` (supervisor failed closed)
+        — :class:`FrontendError` for any other driver death, and
+        ``TimeoutError`` on ``timeout``."""
         if not self.done.wait(timeout):
             raise TimeoutError(
                 f"request {self.request_id} not done after {timeout}s")
         if self.error is not None:
+            if isinstance(self.error, FrontendError):
+                raise self.error
             raise FrontendError(
                 f"driver thread failed serving request "
                 f"{self.request_id}") from self.error
@@ -110,6 +158,8 @@ class FrontendRequest:
             item = self._chunks.get()
             if item is _EOS:
                 if self.error is not None:
+                    if isinstance(self.error, FrontendError):
+                        raise self.error
                     raise FrontendError(
                         f"driver thread failed serving request "
                         f"{self.request_id}") from self.error
@@ -146,12 +196,33 @@ class EngineFrontend:
     ``idle_wait`` bounds how long the parked driver sleeps between
     wake checks — the worst-case submit-to-first-round latency added
     by an idle engine (a submission's wake event usually cuts it to
-    ~0)."""
+    ~0).
 
-    def __init__(self, engine, idle_wait: float = 0.05):
+    Supervision knobs (module docstring, docs/robustness.md):
+    ``max_restarts`` engine rebuilds within the sliding
+    ``restart_window_s`` before the frontend fails closed with
+    :class:`EngineFailed`; a request in flight across ``poison_after``
+    consecutive crashes is quarantined with :class:`PoisonedRequest`
+    instead of requeued."""
+
+    def __init__(self, engine, idle_wait: float = 0.05,
+                 max_restarts: int = 3, restart_window_s: float = 60.0,
+                 poison_after: int = 2):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got "
+                             f"{max_restarts}")
+        if poison_after < 1:
+            raise ValueError(f"poison_after must be >= 1, got "
+                             f"{poison_after}")
         self.engine = engine
         self.idle_wait = float(idle_wait)
+        self.max_restarts = int(max_restarts)
+        self.restart_window_s = float(restart_window_s)
+        self.poison_after = int(poison_after)
         self.metrics = engine.metrics
+        self.restarts = 0  # lifetime successful engine rebuilds
+        self._crash_times: deque = deque()  # sliding restart window
+        self._undelivered: List = []  # last step's un-fanned-out work
         self._handles: Dict[int, FrontendRequest] = {}
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -193,8 +264,12 @@ class EngineFrontend:
         # via engine._seal_drain(), which is a no-op while the queue is
         # open — flag-first would let an idle driver wake in the gap,
         # see draining with an open queue, and exit unsealed (no
-        # drain_complete, no flush).
-        self.engine.close()  # new submits now raise QueueClosed
+        # drain_complete, no flush). Under the frontend lock so the
+        # close cannot land on an engine the supervisor is about to
+        # discard (capture-and-swap holds the same lock; a successor
+        # inherits a closed queue via spawn_successor).
+        with self._lock:
+            self.engine.close()  # new submits now raise QueueClosed
         self._draining.set()
         self._wake.set()
         if self._thread is None:
@@ -212,6 +287,12 @@ class EngineFrontend:
 
     # -- submission (handler threads) --------------------------------
 
+    def _raise_if_fatal(self) -> None:
+        if self._fatal is not None:
+            if isinstance(self._fatal, EngineFailed):
+                raise EngineFailed(str(self._fatal))
+            raise FrontendError("driver thread died") from self._fatal
+
     def submit(self, prompt, steps: int,
                deadline_s: Optional[float] = None,
                stream: bool = False) -> FrontendRequest:
@@ -224,15 +305,39 @@ class EngineFrontend:
         and retired within the very round that is executing during this
         call. ``QueueFull``/``QueueClosed``/``ValueError`` propagate to
         the caller (the HTTP 429/503/400 mapping)."""
-        if self._fatal is not None:
-            raise FrontendError("driver thread died") from self._fatal
+        self._raise_if_fatal()
+        # One lock hold also makes submission atomic vs the
+        # supervisor's capture-and-swap: a request lands wholly in the
+        # crashed engine (and is captured + requeued) or wholly in its
+        # successor — never between the two.
         with self._lock:
+            # Re-checked UNDER the lock: a submission racing the
+            # fail-closed transition must not register a handle after
+            # _abandon already failed every waiter — nothing would
+            # ever complete it.
+            self._raise_if_fatal()
             rid = self.engine.submit(prompt, steps, deadline_s=deadline_s)
             handle = FrontendRequest(rid, stream=stream,
                                      submit_time=time.perf_counter())
             self._handles[rid] = handle
         self._wake.set()
         return handle
+
+    def abandon_stream(self, handle: FrontendRequest) -> None:
+        """The SSE client hung up mid-stream (serving/server.py caught
+        the broken pipe): stop feeding this handle's chunk queue. The
+        request itself still runs to completion — its compute is
+        already scheduled and its output may be fetched via the debug
+        surface — the tokens are just not delivered. Idempotent."""
+        if handle.abandoned:
+            return
+        handle.abandoned = True
+        self.metrics.counter(
+            "serving_streams_abandoned_total",
+            help="SSE streams whose client disconnected before "
+                 "completion (request still completes)").inc()
+        self.engine.runlog.emit("stream_abandoned",
+                                request_id=handle.request_id)
 
     # -- debug introspection (handler threads) ------------------------
 
@@ -244,7 +349,12 @@ class EngineFrontend:
         with self._lock:
             out["frontend"] = {"handles": len(self._handles),
                                "alive": self.alive,
-                               "draining": self.draining}
+                               "draining": self.draining,
+                               "restarts": self.restarts,
+                               "crashes_in_window":
+                                   len(self._crash_times),
+                               "max_restarts": self.max_restarts,
+                               "failed": self._fatal is not None}
         return out
 
     def debug_request(self, request_id: int):
@@ -258,25 +368,179 @@ class EngineFrontend:
         return bool(len(eng.queue) or eng.slots.n_occupied)
 
     def _drive(self) -> None:
+        """The supervised driver: run the loop inside a crash boundary;
+        on an engine exception, recover (capture + rebuild + requeue)
+        and resume — until the restart budget is spent, at which point
+        fail closed and let the thread die loudly."""
+        while True:
+            try:
+                self._drive_loop()
+                return  # clean exit: drain sealed, or hard stop
+            except BaseException as e:  # noqa: BLE001 - supervised
+                if self._stopped.is_set():
+                    self._fatal = e
+                    self._abandon(e)
+                    raise
+                try:
+                    recovered = self._recover(e)
+                except BaseException as rec_err:  # noqa: BLE001
+                    # The RECOVERY itself failed (successor allocation,
+                    # requeue, a full disk under runlog.emit...). Fail
+                    # closed explicitly — _fatal must be set and every
+                    # waiter failed, or the service is exactly the
+                    # zombie-behind-a-live-listener this layer exists
+                    # to eliminate.
+                    err = EngineFailed(
+                        f"recovery failed after engine crash "
+                        f"({type(e).__name__}: {e}): "
+                        f"{type(rec_err).__name__}: {rec_err}")
+                    err.__cause__ = rec_err
+                    self._fatal = err
+                    self._abandon(err)
+                    raise err from rec_err
+                if not recovered:
+                    # Fail-closed: die loudly with the typed verdict
+                    # (the last crash rides along as __cause__).
+                    raise self._fatal
+
+    def _drive_loop(self) -> None:
+        while not self._stopped.is_set():
+            eng = self.engine  # re-read: _recover swaps it
+            if not self._has_work():
+                if self._draining.is_set():
+                    eng._seal_drain()
+                    return
+                self._wake.wait(self.idle_wait)
+                self._wake.clear()
+                continue
+            round_idx = eng.round_idx  # step() increments before return
+            finished = eng.step()
+            # Crash-consistency: hold the round's finished work where
+            # _recover can re-deliver it if fanout dies mid-way
+            # (delivery is idempotent — the handle pop hands each
+            # request out exactly once).
+            self._undelivered = list(finished)
+            self._fanout(eng, finished, round_idx)
+            self._undelivered = []
+        # Hard stop: anything still in flight will never finish —
+        # fail the waiters instead of hanging them.
+        self._abandon(FrontendError("frontend stopped mid-flight"))
+
+    def _recover(self, exc: BaseException) -> bool:
+        """The crash boundary (docs/robustness.md §recovery): deliver
+        work that resolved before the crash, capture the in-flight
+        ledger, quarantine poison requests, rebuild the engine, requeue
+        the rest bit-exactly. Returns False when the restart budget is
+        exhausted — the frontend has failed closed."""
+        now = time.perf_counter()
         eng = self.engine
-        try:
-            while not self._stopped.is_set():
-                if not self._has_work():
-                    if self._draining.is_set():
-                        eng._seal_drain()
-                        return
-                    self._wake.wait(self.idle_wait)
-                    self._wake.clear()
-                    continue
-                finished = eng.step()
-                self._fanout(finished)
-            # Hard stop: anything still in flight will never finish —
-            # fail the waiters instead of hanging them.
-            self._abandon(FrontendError("frontend stopped mid-flight"))
-        except BaseException as e:  # noqa: BLE001 - handed to waiters
-            self._fatal = e
-            self._abandon(e)
-            raise
+        # 1. Requests that RESOLVED before the crash (retired/expired
+        #    but not yet handed out) complete normally — their outputs
+        #    are real; losing them would violate exact accounting.
+        leftovers, eng._retired_pending = eng._retired_pending, []
+        for req in list(self._undelivered) + leftovers:
+            self._deliver(req, now)
+        self._undelivered = []
+        # 2. Restart budget: a sliding window, so one crash a day never
+        #    accumulates into a fail-closed verdict.
+        self._crash_times.append(now)
+        horizon = now - self.restart_window_s
+        while self._crash_times and self._crash_times[0] < horizon:
+            self._crash_times.popleft()
+        fail_closed = len(self._crash_times) > self.max_restarts
+        poisoned: List = []
+        poisoned_handles: List = []
+        err: Optional[EngineFailed] = None
+        # 3. Capture + swap, atomic vs submit() (same lock): a
+        #    concurrent submission lands wholly in the captured set or
+        #    wholly in the successor.
+        with self._lock:
+            with eng._submit_lock:
+                captured = sorted(eng.requests.values(),
+                                  key=lambda r: r.request_id)
+            blamed = eng._admitting_rid
+            inflight = [r for r in captured if r.admit_start_time]
+            eng.runlog.emit(
+                "engine_crash", round=eng.round_idx,
+                error=f"{type(exc).__name__}: {exc}",
+                error_type=type(exc).__name__,
+                blamed_request_id=blamed,
+                inflight=[r.request_id for r in inflight],
+                queued=[r.request_id for r in captured
+                        if not r.admit_start_time],
+                crashes_in_window=len(self._crash_times))
+            if fail_closed:
+                err = EngineFailed(
+                    f"engine crashed {len(self._crash_times)} times "
+                    f"within {self.restart_window_s:.0f}s "
+                    f"(max_restarts={self.max_restarts}); failing "
+                    f"closed (last: {type(exc).__name__}: {exc})")
+                err.__cause__ = exc
+                self._fatal = err
+                eng.runlog.emit(
+                    "engine_failed", round=eng.round_idx,
+                    restarts=self.restarts,
+                    abandoned=[r.request_id for r in captured],
+                    error=f"{type(exc).__name__}: {exc}")
+                eng.runlog.flush()
+            else:
+                # Blame: a crash inside one request's own admission
+                # dispatch implicates only that request; a batch-wide
+                # crash (decode round, fanout, ...) implicates every
+                # in-flight request.
+                implicated = ([r for r in inflight
+                               if r.request_id == blamed]
+                              if blamed is not None else inflight)
+                implicated_ids = {r.request_id for r in implicated}
+                # "poison_after CONSECUTIVE crashes", literally: an
+                # implication older than the restart window is stale
+                # (unrelated crashes far apart must not accumulate into
+                # a 500), and an in-flight request the blame pinned
+                # ELSEWHERE was attempted and exonerated — its streak
+                # resets.
+                for r in inflight:
+                    if r.request_id not in implicated_ids:
+                        r.crash_count = 0
+                for r in implicated:
+                    stale = (r.last_crash_time
+                             and now - r.last_crash_time
+                             > self.restart_window_s)
+                    r.crash_count = 1 if stale else r.crash_count + 1
+                    r.last_crash_time = now
+                poisoned = [r for r in implicated
+                            if r.crash_count >= self.poison_after]
+                poison_ids = {r.request_id for r in poisoned}
+                survivors = [r for r in captured
+                             if r.request_id not in poison_ids]
+                new_eng = eng.spawn_successor()
+                new_eng.requeue(survivors, crash_time=now)
+                self.engine = new_eng
+                self.restarts += 1
+                self.metrics.counter(
+                    "serving_engine_restarts_total",
+                    help="supervised engine rebuilds after a crash"
+                ).inc()
+                poisoned_handles = [
+                    self._handles.pop(r.request_id, None)
+                    for r in poisoned]
+        if fail_closed:
+            self._abandon(err)
+            return False
+        # 4. Quarantine verdicts, outside the lock (event sets + queue
+        #    puts only).
+        for req, h in zip(poisoned, poisoned_handles):
+            req.status = "poisoned"
+            req.finish_time = now
+            perr = PoisonedRequest(req.request_id, req.crash_count, exc)
+            self.engine.stats.record_quarantine(req, exc)
+            self.engine.runlog.emit(
+                "quarantine", request_id=req.request_id,
+                crash_count=req.crash_count,
+                error=f"{type(exc).__name__}: {exc}")
+            if h is not None:
+                h._fail(perr)
+        self._wake.set()  # recovered work is ready to schedule
+        return True
 
     def _abandon(self, err: BaseException) -> None:
         with self._lock:
@@ -285,18 +549,20 @@ class EngineFrontend:
         for h in orphans:
             h._fail(err)
 
-    def _fanout(self, finished: List) -> None:
+    def _fanout(self, eng, finished: List, round_idx: int) -> None:
         """Post-round delivery: push newly visible tokens to live
-        streaming handles, complete finished/timed-out ones."""
-        eng = self.engine
+        streaming handles, complete finished/timed-out ones.
+        ``round_idx`` is the round just executed (step() increments its
+        counter before returning) — the fault site shares the same
+        round coordinate as every engine-side site."""
+        faults.check("stream_fanout", round_idx=round_idx)
         now = time.perf_counter()
         with self._lock:
             live_streams = [
                 h for h in self._handles.values()
-                if h.stream and h.request_id in eng.requests
+                if h.stream and not h.abandoned
+                and h.request_id in eng.requests
                 and eng.requests[h.request_id].status == "active"]
-            done_handles = [(req, self._handles.pop(req.request_id, None))
-                            for req in finished]
         if live_streams:
             # One host copy of the token buffer per round serves every
             # active streamer; np.array (explicit copy) keeps the
@@ -312,30 +578,39 @@ class EngineFrontend:
                     h._push(buf[req.row, s + h._streamed:s + n_vis]
                             .astype(np.int32), now)
                     h._streamed = n_vis
-        for req, h in done_handles:
-            if h is None:
-                continue  # submitted directly on the engine, no handle
-            if req.status == "done" and req.tokens is not None:
-                # The tail: tokens past the streamed cursor, including
-                # the eos padding `generate`'s contract fills — the
-                # concatenated stream equals the blocking array exactly.
-                h._push(np.asarray(req.tokens[h._streamed:], np.int32),
-                        now)
-            # stream_delivery: engine finish -> fanout handoff, the
-            # bridge's own slice of the phase timeline (same
-            # perf_counter clock as the engine's stamps).
-            req.delivered_time = now
-            if req.finish_time:
-                self.metrics.histogram(
-                    "serving_phase_seconds", phase="stream_delivery",
-                    help="per-request phase durations, seconds",
-                ).observe(max(0.0, now - req.finish_time),
-                          exemplar=str(req.request_id))
-            if h.first_token_time is not None:
-                self.metrics.histogram(
-                    "serving_http_ttft_seconds").observe(
-                        h.first_token_time - h.submit_time)
+        for req in finished:
+            self._deliver(req, now)
+
+    def _deliver(self, req, now: float) -> None:
+        """Hand one resolved request to its handle — exactly once (the
+        handle pop is the claim, so the recovery path can re-run this
+        over the same list without double delivery)."""
+        with self._lock:
+            h = self._handles.pop(req.request_id, None)
+        if h is None:
+            return  # engine-direct submit, or already delivered
+        if req.status == "done" and req.tokens is not None \
+                and not h.abandoned:
+            # The tail: tokens past the streamed cursor, including
+            # the eos padding `generate`'s contract fills — the
+            # concatenated stream equals the blocking array exactly.
+            h._push(np.asarray(req.tokens[h._streamed:], np.int32),
+                    now)
+        # stream_delivery: engine finish -> fanout handoff, the
+        # bridge's own slice of the phase timeline (same
+        # perf_counter clock as the engine's stamps).
+        req.delivered_time = now
+        if req.finish_time:
             self.metrics.histogram(
-                "serving_http_request_seconds").observe(
-                    now - h.submit_time)
-            h._complete(req, now)
+                "serving_phase_seconds", phase="stream_delivery",
+                help="per-request phase durations, seconds",
+            ).observe(max(0.0, now - req.finish_time),
+                      exemplar=str(req.request_id))
+        if h.first_token_time is not None:
+            self.metrics.histogram(
+                "serving_http_ttft_seconds").observe(
+                    h.first_token_time - h.submit_time)
+        self.metrics.histogram(
+            "serving_http_request_seconds").observe(
+                now - h.submit_time)
+        h._complete(req, now)

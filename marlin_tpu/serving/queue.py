@@ -95,8 +95,23 @@ class Request:
     delivered_time: float = 0.0  # frontend fanout done (0: engine-only)
     live_iters: int = 0  # decode iterations this request was live for
     emitted: int = 0  # tokens actually generated (< steps if eos fired)
-    status: str = "pending"  # pending -> active -> done | timeout
+    # pending -> active -> done | timeout; "poisoned" is the supervisor's
+    # terminal quarantine verdict (serving/frontend.py, docs/robustness
+    # .md): implicated in ``poison_after`` consecutive engine crashes,
+    # never requeued again.
+    status: str = "pending"
     tokens: Optional[np.ndarray] = None
+    # Crash-recovery ledger (supervised restart, serving/frontend.py):
+    # how many engine crashes this request was implicated in, how many
+    # times it was requeued, and the wall-clock sunk into attempts that
+    # died with a crashed engine (``recovery_s`` — a sub-attribution
+    # OUTSIDE the contiguous phase sum: the final attempt's queue_wait
+    # absorbs the crashed windows, so queue_wait + admit + decode still
+    # equals total exactly).
+    crash_count: int = 0
+    last_crash_time: float = 0.0  # consecutiveness stamp (supervisor)
+    requeues: int = 0
+    recovery_s: float = 0.0
 
     @property
     def prompt_len(self) -> int:
@@ -136,7 +151,40 @@ class Request:
             out["prefix_copy"] = self.prefix_copy_s
         if self.delivered_time and self.finish_time:
             out["stream_delivery"] = self.delivered_time - self.finish_time
+        if self.recovery_s:
+            out["recovery"] = self.recovery_s
         return out
+
+    def reset_for_requeue(self, now: float) -> None:
+        """Return this request to pristine PENDING state for supervised
+        re-execution after an engine crash (serving/frontend.py).
+
+        Identity and arrival fields survive untouched — ``request_id``
+        (the PRNG-stream root: replay is bit-exact by construction),
+        ``prompt``/``steps``, both deadlines (an original wall-clock
+        deadline that expired during the crash window resolves as a
+        normal timeout, not a recovery retry), ``submit_time`` (the
+        phase timeline keeps measuring from the caller's real submit),
+        and the crash ledger. Everything the crashed engine wrote —
+        row, keys, stamps, partial output — is wiped; wall-clock sunk
+        into the dead attempt is banked in ``recovery_s``."""
+        if self.admit_start_time:  # was popped: the attempt died
+            self.recovery_s += max(0.0, now - self.admit_start_time)
+        self.requeues += 1
+        self.key = None
+        self.row = -1
+        self.admit_round = -1
+        self.admit_start_time = 0.0
+        self.admit_time = 0.0
+        self.finish_round = -1
+        self.finish_time = 0.0
+        self.prefill_s = 0.0
+        self.prefix_copy_s = 0.0
+        self.delivered_time = 0.0
+        self.live_iters = 0
+        self.emitted = 0
+        self.status = "pending"
+        self.tokens = None
 
 
 @dataclass
@@ -199,6 +247,18 @@ class AdmissionQueue:
                     continue
                 return req, expired
         return None, expired
+
+    def restore(self, req: Request) -> None:
+        """Supervised-restart recovery path (serving/frontend.py):
+        re-append a captured request, bypassing BOTH the ``max_pending``
+        cap and the closed check. Recovered work was already admitted
+        once — shedding it to its own backpressure would turn one crash
+        into dropped requests, and a draining engine still owes its
+        accepted work. Callers restore in arrival (request-id) order so
+        FIFO fairness survives the restart. Never use this for new
+        submissions; ``submit`` owns the backpressure contract."""
+        with self._lock:
+            self._q.append(req)
 
     def close(self) -> None:
         """Stop accepting new work; queued requests still drain."""

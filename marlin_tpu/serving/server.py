@@ -45,6 +45,18 @@ Backpressure maps to status codes instead of silent buffering:
 ``QueueFull`` → 429 with ``Retry-After``; draining (``QueueClosed``) →
 503 with ``Retry-After``; malformed request → 400.
 
+Failure handling (docs/robustness.md): an engine crash is invisible to
+callers — the frontend's supervisor rebuilds the engine and replays
+every non-completed request bit-exactly, so blocking responses and SSE
+streams just continue. A quarantined POISON request returns 500 with a
+structured body (``status: "poisoned"``, ``crash_count``); a
+fail-closed frontend (restart budget spent) returns 503 and flips
+``/readyz`` false. An SSE client that disconnects mid-stream is
+detected at the broken pipe, its fanout stops
+(``serving_streams_abandoned_total``), and the request still completes.
+Chaos smoke: the ``MARLIN_FAULT_PLAN`` env var (JSON, serving/faults
+.py) arms a deterministic fault plan in ``main()``.
+
 Graceful drain: SIGTERM (``install_signal_handlers``) or
 :meth:`ServingHTTPServer.begin_drain` stops admissions (new generates
 get 503), lets the driver finish every in-flight row through the
@@ -66,7 +78,8 @@ from typing import Optional
 
 import numpy as np
 
-from .frontend import EngineFrontend, FrontendError
+from . import faults
+from .frontend import (EngineFrontend, FrontendError, PoisonedRequest)
 from .queue import QueueClosed, QueueFull
 
 RETRY_AFTER_S = 1  # hint on 429/503: one engine round is usually enough
@@ -235,6 +248,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _respond_blocking(self, handle, route, id_headers) -> None:
         try:
             req = handle.result(self.server.request_timeout_s)
+        except PoisonedRequest as e:
+            # Quarantined: the request was in flight across repeated
+            # engine crashes — a terminal per-request verdict (500),
+            # not a service-health one (the engine is back up).
+            self._send_json(500, {"error": str(e), "status": "poisoned",
+                                  "request_id": e.request_id,
+                                  "crash_count": e.crash_count},
+                            route, headers=id_headers)
+            return
         except (FrontendError, TimeoutError) as e:
             self._send_json(503, {"error": str(e)}, route,
                             headers=id_headers)
@@ -270,6 +292,16 @@ class _Handler(BaseHTTPRequestHandler):
             req = handle.result(0.0 if handle.done.is_set() else None)
             self._sse({"done": True, **self._finish_fields(req, handle)})
             self._chunk(b"")  # terminal zero-length chunk
+        except PoisonedRequest as e:
+            code = 500  # accounting only: the 200 already went out
+            try:
+                self._sse({"done": True, "status": "poisoned",
+                           "error": str(e),
+                           "request_id": e.request_id,
+                           "crash_count": e.crash_count})
+                self._chunk(b"")
+            except OSError:
+                pass
         except (FrontendError, TimeoutError) as e:
             code = 503  # accounting only: the 200 already went out
             try:
@@ -278,7 +310,12 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
         except OSError:
-            code = 499  # client went away mid-stream
+            # Client went away mid-stream (broken pipe on a chunk
+            # write): stop the fanout feeding a queue nobody reads —
+            # the request still completes, its tokens just aren't
+            # delivered (serving_streams_abandoned_total).
+            code = 499
+            self.frontend.abandon_stream(handle)
         self._count(route, code)
 
     def _sse(self, obj: dict) -> None:
@@ -352,15 +389,22 @@ class ServingHTTPServer(ThreadingHTTPServer):
 
 def serve(params, cfg, host: str = "127.0.0.1", port: int = 0,
           request_timeout_s: Optional[float] = 300.0,
+          max_restarts: int = 3, restart_window_s: float = 60.0,
+          poison_after: int = 2,
           **engine_kwargs) -> ServingHTTPServer:
     """Build engine + frontend + listener; returns the (not yet
     serving) server — call ``serve_forever()`` (blocking) or
     ``start_background()``. ``port=0`` binds an ephemeral port
-    (``server.port`` reports it)."""
+    (``server.port`` reports it). The ``max_restarts`` /
+    ``restart_window_s`` / ``poison_after`` knobs parameterize the
+    frontend's crash supervisor (docs/robustness.md)."""
     from .engine import ServingEngine
 
     engine = ServingEngine(params, cfg, **engine_kwargs)
-    frontend = EngineFrontend(engine).start()
+    frontend = EngineFrontend(
+        engine, max_restarts=max_restarts,
+        restart_window_s=restart_window_s,
+        poison_after=poison_after).start()
     return ServingHTTPServer((host, port), frontend,
                              request_timeout_s=request_timeout_s)
 
@@ -435,6 +479,12 @@ def main(argv=None) -> int:
         max_len=args.max_len, dtype="float32")
     params = init_params(cfg, seed=args.seed)
     runlog = RunLog(path=args.runlog) if args.runlog else None
+    # Chaos arming (tier-1 fault smoke, tests/test_faults.py): a JSON
+    # fault plan in MARLIN_FAULT_PLAN injects deterministic crashes the
+    # supervisor must recover from; absent, this is a no-op.
+    plan = faults.install_from_env()
+    if plan is not None and runlog is not None:
+        runlog.emit("fault_plan", specs=plan.summary())
     server = serve(params, cfg, host=args.host, port=args.port,
                    batch=args.batch, round_steps=args.round_steps,
                    max_pending=args.max_pending,

@@ -145,8 +145,17 @@ class EngineStats:
     n_prefix_misses: int = 0
     reclaimed_prefill_tokens: int = 0
     reclaimed_prefill_flops: float = 0.0
+    # Crash-recovery ledger (supervised restart, serving/frontend.py;
+    # docs/robustness.md). The stats object is CARRIED ACROSS engine
+    # incarnations by ``ServingEngine.spawn_successor`` — one serving
+    # lifetime, N engines — so these totals, like everything above,
+    # span restarts.
+    n_recovered: int = 0    # requests requeued into a successor engine
+    n_quarantined: int = 0  # requests failed closed as poisoned
     rounds: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
     completed: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
+    quarantined: deque = field(
+        default_factory=lambda: deque(maxlen=HISTORY))
     # Guards DEQUE ITERATION against driver-thread appends: the debug
     # endpoints (engine.debug_snapshot/debug_request) read ``completed``
     # from HTTP handler threads while the driver retires requests, and
@@ -213,6 +222,40 @@ class EngineStats:
         if self.registry is not None:
             self.registry.counter("serving_timeout_total").inc()
 
+    def record_recovery(self, req) -> None:
+        """One request requeued into a successor engine after a crash
+        (engine.requeue) — recovered work, not new work."""
+        self.n_recovered += 1
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_requests_recovered_total",
+                help="requests requeued bit-exactly after an engine "
+                     "crash (supervised restart)").inc()
+
+    def record_quarantine(self, req, error) -> None:
+        """One request failed closed as POISONED: implicated in
+        ``poison_after`` consecutive engine crashes and excluded from
+        requeue so the crash loop stops consuming restarts
+        (docs/robustness.md §quarantine)."""
+        self.n_quarantined += 1
+        with self._lock:
+            self.quarantined.append({
+                "request_id": req.request_id,
+                "crash_count": req.crash_count,
+                "prompt_len": req.prompt_len,
+                "steps": req.steps,
+                "error": repr(error)})
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_requests_quarantined_total",
+                help="poison requests excluded from crash recovery "
+                     "after repeated implication").inc()
+
+    def quarantine_snapshot(self) -> List[dict]:
+        """Point-in-time copy of the quarantine ledger, any thread."""
+        with self._lock:
+            return list(self.quarantined)
+
     def record_round(self, round_idx: int, iters: int, occupied: int,
                      live_iters: int) -> None:
         self.n_rounds += 1
@@ -255,7 +298,7 @@ class EngineStats:
             phases = req.phases()
             rid = str(req.request_id)
             for key in self.PHASE_KEYS + ("prefill_dispatch",
-                                          "prefix_copy"):
+                                          "prefix_copy", "recovery"):
                 if key in phases:
                     self.registry.histogram(
                         "serving_phase_seconds", phase=key,
@@ -344,6 +387,12 @@ class EngineStats:
             "wasted_row_iters": self.wasted_row_iters,
             "utilization": round(self.utilization(), 4),
         }
+        if self.n_recovered or self.n_quarantined:
+            out.update({
+                "recovered": self.n_recovered,
+                "quarantined": self.n_quarantined,
+                "quarantine": self.quarantine_snapshot(),
+            })
         if self.n_prefix_hits or self.n_prefix_misses:
             out.update({
                 "prefix_hits": self.n_prefix_hits,

@@ -1,0 +1,871 @@
+"""Chaos suite for the fault-tolerant serving layer (PR 7,
+docs/robustness.md): serving/faults.py + the frontend supervisor.
+
+The acceptance claims, each pinned mechanically:
+
+* BIT-EXACT RECOVERY — with a fault injected at EVERY site
+  (decode_round / prefill_chunk one-shot + chunked / prefix_copy /
+  admission_pop / stream_fanout / runlog_emit), every in-flight and
+  queued request's recovered output is bit-identical to an
+  uninterrupted solo run, greedy AND sampled (per-request PRNG streams
+  make output a pure function of ``(prompt, steps, seed, request_id)``)
+  — and streamed SSE chunk sequences concatenate byte-identically
+  across the restart (the cursor deduplicates delivered tokens).
+* EXACT ACCOUNTING — none lost, none duplicated: completed + timed out
+  + quarantined == submitted, handles all resolved, counters to the
+  unit.
+* WARM RESTART — zero compile events after the crash round (the
+  successor reuses the module-level jit caches).
+* POISON QUARANTINE — a request implicated in 2 consecutive crashes is
+  failed with a typed ``PoisonedRequest`` (HTTP 500, structured body)
+  instead of requeued; the engine keeps serving everyone else.
+* FAIL CLOSED — past ``max_restarts`` in the window, waiters get
+  ``EngineFailed``, new submits are refused, ``/readyz`` goes false.
+* DEADLINES SURVIVE — a requeued request keeps its ORIGINAL
+  ``deadline_time``; one that expired during the crash window resolves
+  as a normal timeout, not a recovery retry.
+* CLIENT RETRY — deterministic backoff schedule, Retry-After honored,
+  budget enforced, idempotent-only by default.
+
+The subprocess smoke at the bottom is the CI form: a real server armed
+via ``MARLIN_FAULT_PLAN``, crashed mid-stream, recovered byte-exactly,
+``/metrics`` showing exactly one restart, and the sealed runlog passing
+tools/runlog_report.py's crash-cycle detector.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.serving import (EngineFailed, EngineFrontend,
+                                PoisonedRequest, PrefixCache,
+                                ServingEngine, faults, serve)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclass annotations resolve via here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return init_params(cfg, seed=0), cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    """No chaos plan leaks across tests — injection is opt-in per
+    test."""
+    yield
+    faults.reset()
+
+
+def _prompts(cfg, n, length=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab, length).astype(np.int32)
+            for _ in range(n)]
+
+
+def _golden(params, cfg, prompts, steps, **eng_kw):
+    """Uninterrupted solo run of the same workload (ids 0..n-1 in
+    submission order) — the bit-exactness reference."""
+    eng_kw.setdefault("metrics_registry", MetricsRegistry())
+    eng = ServingEngine(params, cfg, **eng_kw)
+    for p in prompts:
+        eng.submit(p, steps)
+    return {r.request_id: list(map(int, r.tokens)) for r in eng.run()}
+
+
+def _run_chaos(params, cfg, specs, n=6, steps=6, temperature=0.0,
+               stream_mod=2, **eng_kw):
+    """Install ``specs``, run ``n`` requests (every ``stream_mod``-th
+    one streaming) through a supervised frontend; returns
+    ``(frontend, registry, streamed-by-id, results-by-id)``. The fault
+    plan is active only during this run."""
+    plan = faults.install(faults.FaultPlan())
+    for s in specs:
+        plan.add(**s)
+    reg = MetricsRegistry()
+    eng_kw.setdefault("batch", 2)
+    eng_kw.setdefault("round_steps", 2)
+    eng = ServingEngine(params, cfg, temperature=temperature,
+                        metrics_registry=reg, **eng_kw)
+    fe = EngineFrontend(eng).start()
+    handles = [fe.submit(p, steps, stream=(i % stream_mod == 0))
+               for i, p in enumerate(_prompts(cfg, n))]
+    streamed = {}
+    for h in handles:
+        if h.stream:
+            toks = []
+            for chunk in h.chunks():
+                toks.extend(int(t) for t in chunk)
+            streamed[h.request_id] = toks
+    results = {h.request_id: h.result(60.0) for h in handles}
+    faults.reset()
+    return fe, reg, streamed, results
+
+
+def _assert_exact_accounting(fe, reg, n, quarantined=0, timeout=0):
+    st = fe.engine.stats
+    assert st.n_completed + st.n_timeout + st.n_quarantined == n
+    assert st.n_quarantined == quarantined
+    assert st.n_timeout == timeout
+    assert reg.counter("serving_submitted_total").value == n
+    assert reg.counter("serving_completed_total").value == st.n_completed
+    assert len(fe.engine.requests) == 0  # ownership fully transferred
+    assert len(fe._handles) == 0
+
+
+# -- the fault plan itself (unit) -------------------------------------
+
+
+class TestFaultPlan:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            faults.FaultSpec(site="nope")
+        with pytest.raises(ValueError):
+            faults.FaultSpec(site="decode_round", action="explode")
+        with pytest.raises(ValueError):
+            faults.FaultSpec(site="decode_round", max_fires=0)
+        with pytest.raises(ValueError):
+            # A zero modulus would ZeroDivisionError on every check —
+            # a config typo must fail at install, not as a crash loop.
+            faults.FaultSpec(site="decode_round", round_every=0)
+
+    def test_deterministic_matching_and_max_fires(self):
+        plan = faults.FaultPlan()
+        plan.add(site="decode_round", round=3, max_fires=1)
+        plan.check("decode_round", round_idx=2)  # no match
+        plan.check("prefill_chunk", round_idx=3)  # wrong site
+        with pytest.raises(faults.FaultInjected):
+            plan.check("decode_round", round_idx=3)
+        plan.check("decode_round", round_idx=3)  # consumed: max_fires=1
+        assert plan.total_fires() == 1
+
+    def test_round_every_and_request_predicates(self):
+        plan = faults.FaultPlan()
+        plan.add(site="prefill_chunk", round_every=2, request_id=5,
+                 max_fires=10)
+        plan.check("prefill_chunk", round_idx=1, request_id=5)  # odd
+        plan.check("prefill_chunk", round_idx=2, request_id=4)  # wrong id
+        with pytest.raises(faults.FaultInjected):
+            plan.check("prefill_chunk", round_idx=2, request_id=5)
+
+    def test_delay_and_corrupt_actions(self):
+        plan = faults.FaultPlan()
+        plan.add(site="decode_round", action="delay", round=0,
+                 delay_s=0.01)
+        t0 = time.perf_counter()
+        plan.check("decode_round", round_idx=0)  # sleeps, no raise
+        assert time.perf_counter() - t0 >= 0.009
+        plan.add(site="decode_round", action="corrupt", round=1)
+        arr = np.arange(4, dtype=np.int32) + 1
+        out = plan.corrupt("decode_round", arr, round_idx=1)
+        assert out[0] == -1 and arr[0] == 1  # scribbled COPY
+        same = plan.corrupt("decode_round", arr, round_idx=1)
+        assert same is arr  # spec consumed
+
+    def test_json_roundtrip_and_env_install(self):
+        plan = faults.FaultPlan()
+        plan.add(site="decode_round", round=4)
+        plan2 = faults.FaultPlan.from_json(plan.to_json())
+        assert plan2.specs[0].site == "decode_round"
+        assert plan2.specs[0].round == 4
+        assert plan2.specs[0].fires == 0  # firing state not inherited
+        installed = faults.install_from_env(
+            {faults.ENV_VAR: plan.to_json()})
+        assert faults.active() is installed
+        # The bare-list form is accepted too.
+        bare = faults.FaultPlan.from_json(
+            '[{"site": "decode_round", "round": 4}]')
+        assert bare.specs[0].round == 4
+        assert faults.install_from_env({}) is None  # unset: no-op
+
+    def test_no_plan_fast_path(self):
+        faults.reset()
+        faults.check("decode_round", round_idx=0)
+        arr = np.ones(2)
+        assert faults.corrupt("decode_round", arr) is arr
+
+
+# -- supervised restart: bit-exact recovery ---------------------------
+
+
+class TestBitExactRecovery:
+    @pytest.mark.parametrize("temperature", [0.0, 0.7],
+                             ids=["greedy", "sampled"])
+    def test_decode_round_crash_recovers_bitexact(self, model,
+                                                  temperature):
+        """The tentpole pin: crash mid-serving at a decode round; every
+        request (streamed and blocking) completes bit-identical to an
+        uninterrupted run — greedy and sampled alike — with exactly one
+        restart and zero post-restart compiles."""
+        params, cfg = model
+        prompts = _prompts(cfg, 6)
+        gold = _golden(params, cfg, prompts, 6, batch=2, round_steps=2,
+                       temperature=temperature)
+        fe, reg, streamed, results = _run_chaos(
+            params, cfg, [dict(site="decode_round", round=2)],
+            n=6, steps=6, temperature=temperature)
+        assert fe.restarts == 1
+        assert all(r.status == "done" for r in results.values())
+        for rid, r in results.items():
+            assert list(map(int, r.tokens)) == gold[rid], rid
+        # Streamed chunk sequences concatenate byte-identically across
+        # the restart: the cursor deduplicated pre-crash deliveries.
+        for rid, toks in streamed.items():
+            assert toks == gold[rid], rid
+        _assert_exact_accounting(fe, reg, 6)
+        assert reg.counter("serving_engine_restarts_total").value == 1
+        assert reg.counter(
+            "serving_requests_recovered_total").value >= 1
+        # Fired faults are visible process-wide (faults.py bumps the
+        # global registry — chaos runs distinguish injected crashes
+        # from organic ones even when engines pin their own registry).
+        from marlin_tpu.obs import metrics as obs_metrics
+        assert obs_metrics.registry.counter(
+            "serving_faults_injected_total",
+            site="decode_round").value >= 1
+        # Warm restart: no compile events after the crash round.
+        late = [e for e in fe.engine.runlog.events("compile")
+                if e["round"] > 2]
+        assert late == [], late
+        # The crash narrative is in the runlog.
+        kinds = [e["kind"] for e in fe.engine.runlog.events()]
+        assert "engine_crash" in kinds and "recover" in kinds
+        # Requests IN FLIGHT at the crash carry the recovery
+        # sub-attribution (time sunk into the dead attempt), and the
+        # contiguous phase sum still equals total exactly.
+        rec = [r for r in results.values() if r.crash_count]
+        assert rec  # the crash did interrupt someone mid-flight
+        for r in rec:
+            ph = r.phases()
+            assert ph["recovery"] > 0
+            assert ph["queue_wait"] + ph["admit"] + ph["decode"] \
+                == pytest.approx(ph["total"], rel=1e-9, abs=1e-12)
+        assert fe.drain(30.0)
+
+    @pytest.mark.parametrize("site,specs,eng_kw", [
+        # admission_pop only runs while a slot is FREE: with 6 equal
+        # requests on batch=2, the first retirement frees rows at the
+        # round-2 boundary, so round 3's pop is the first mid-flight one.
+        ("admission_pop",
+         [dict(site="admission_pop", round=3)], {}),
+        ("runlog_emit",
+         [dict(site="runlog_emit", round=2)], {}),
+        ("stream_fanout",
+         [dict(site="stream_fanout", round=2)], {}),
+        ("prefill_oneshot",
+         [dict(site="prefill_chunk", request_id=3)], {}),
+        ("prefill_chunked",
+         [dict(site="prefill_chunk", request_id=3)],
+         {"prefill_chunk": 32}),
+    ])
+    def test_every_site_recovers_bitexact(self, model, site, specs,
+                                          eng_kw):
+        params, cfg = model
+        prompts = _prompts(cfg, 6)
+        gold = _golden(params, cfg, prompts, 6, batch=2, round_steps=2,
+                       **eng_kw)
+        fe, reg, streamed, results = _run_chaos(
+            params, cfg, specs, n=6, steps=6, **eng_kw)
+        assert fe.restarts == 1, site
+        assert all(r.status == "done" for r in results.values())
+        for rid, r in results.items():
+            assert list(map(int, r.tokens)) == gold[rid], (site, rid)
+        for rid, toks in streamed.items():
+            assert toks == gold[rid], (site, rid)
+        _assert_exact_accounting(fe, reg, 6)
+        assert fe.drain(30.0)
+
+    def test_prefix_copy_crash_recovers_bitexact(self, model):
+        """Crash inside the prefix-cache donor copy: the successor gets
+        a FRESH pool (torn refcounts discarded) and replays bit-exactly
+        — cache state is a pure perf layer, never a correctness one."""
+        params, cfg = model
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, cfg.vocab, 32).astype(np.int32)
+        prompts = [np.concatenate([shared, rng.integers(
+            0, cfg.vocab, 8).astype(np.int32)]) for _ in range(5)]
+        kw = dict(batch=2, round_steps=2, prefill_chunk=16)
+        eng_gold = ServingEngine(params, cfg,
+                                 metrics_registry=MetricsRegistry(),
+                                 **kw)
+        for p in prompts:
+            eng_gold.submit(p, 4)
+        gold = {r.request_id: list(map(int, r.tokens))
+                for r in eng_gold.run()}
+        plan = faults.install(faults.FaultPlan())
+        # Request 2 shares request 0's stored prefix -> its admission
+        # starts with a pool copy, which crashes.
+        plan.add(site="prefix_copy", request_id=2)
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, metrics_registry=reg,
+                            prefix_cache=PrefixCache(cfg, pool_rows=4),
+                            **kw)
+        fe = EngineFrontend(eng).start()
+        handles = [fe.submit(p, 4) for p in prompts]
+        results = {h.request_id: h.result(60.0) for h in handles}
+        faults.reset()
+        assert plan.total_fires() == 1  # the copy path really ran
+        assert fe.restarts == 1
+        for rid, r in results.items():
+            assert list(map(int, r.tokens)) == gold[rid], rid
+        _assert_exact_accounting(fe, reg, 5)
+        assert fe.drain(30.0)
+
+    def test_corrupted_fetch_is_detected_and_recovered(self, model):
+        """A corrupted device fetch is not served: the engine's sanity
+        bounds raise EngineStateCorrupt, the supervisor rebuilds, and
+        the replay is bit-exact."""
+        params, cfg = model
+        prompts = _prompts(cfg, 4)
+        gold = _golden(params, cfg, prompts, 6, batch=2, round_steps=2)
+        fe, reg, _, results = _run_chaos(
+            params, cfg,
+            [dict(site="decode_round", action="corrupt", round=2)],
+            n=4, steps=6, stream_mod=10)
+        assert fe.restarts == 1
+        for rid, r in results.items():
+            assert list(map(int, r.tokens)) == gold[rid], rid
+        crash = fe.engine.runlog.events("engine_crash")[0]
+        assert crash["error_type"] == "EngineStateCorrupt"
+        _assert_exact_accounting(fe, reg, 4)
+        assert fe.drain(30.0)
+
+
+# -- poison quarantine + fail closed ----------------------------------
+
+
+class TestQuarantineAndFailClosed:
+    def test_poison_request_quarantined_after_two_crashes(self, model):
+        """A request whose OWN admission dispatch kills the engine
+        twice is quarantined — typed PoisonedRequest, recorded in the
+        ledger — and everyone else completes bit-exactly; the engine
+        stays up and ready."""
+        params, cfg = model
+        prompts = _prompts(cfg, 4)
+        gold = _golden(params, cfg, prompts, 6, batch=2, round_steps=2)
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="prefill_chunk", request_id=1, max_fires=2)
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=2, round_steps=2,
+                            metrics_registry=reg)
+        fe = EngineFrontend(eng).start()  # poison_after=2 default
+        handles = [fe.submit(p, 6) for p in prompts]
+        outcomes = {}
+        for h in handles:
+            try:
+                outcomes[h.request_id] = h.result(60.0)
+            except PoisonedRequest as e:
+                outcomes[h.request_id] = e
+        faults.reset()
+        poisoned = outcomes[1]
+        assert isinstance(poisoned, PoisonedRequest)
+        assert poisoned.request_id == 1 and poisoned.crash_count == 2
+        for rid in (0, 2, 3):
+            assert outcomes[rid].status == "done"
+            assert list(map(int, outcomes[rid].tokens)) == gold[rid]
+        assert fe.restarts == 2
+        assert fe.ready  # quarantine stopped the crash loop
+        st = fe.engine.stats
+        assert st.n_quarantined == 1
+        (qrec,) = st.quarantine_snapshot()
+        assert qrec["request_id"] == 1 and qrec["crash_count"] == 2
+        assert reg.counter(
+            "serving_requests_quarantined_total").value == 1
+        _assert_exact_accounting(fe, reg, 4, quarantined=1)
+        q_events = fe.engine.runlog.events("quarantine")
+        assert [e["request_id"] for e in q_events] == [1]
+        # Blame attribution: the admission crash implicated ONLY the
+        # poison request — its neighbors carry no crash count.
+        for rid in (0, 2, 3):
+            assert outcomes[rid].crash_count == 0, rid
+        assert fe.drain(30.0)
+
+    def test_unrelated_crashes_far_apart_do_not_poison(self, model):
+        """The CONSECUTIVE in 'poison_after consecutive crashes' is
+        literal: an implication older than restart_window_s is stale —
+        the streak restarts at 1 — so two unrelated batch-wide crashes
+        far apart never 500 a long-running request."""
+        params, cfg = model
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="decode_round", round=2)
+        # Stretch wall-clock past the (tiny) window between the two
+        # crashes. round_every=1 also fires on rounds 0-1 (before the
+        # first crash), so budget 7 fires: the 5 POST-crash delays on
+        # rounds 3-7 put 0.4 s > restart_window_s between the crashes.
+        plan.add(site="decode_round", action="delay", round_every=1,
+                 max_fires=7, delay_s=0.08)
+        plan.add(site="decode_round", round=8)
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=2, round_steps=2,
+                            metrics_registry=reg)
+        fe = EngineFrontend(eng, restart_window_s=0.2).start()
+        handles = [fe.submit(p, 24) for p in _prompts(cfg, 2)]
+        results = [h.result(120.0) for h in handles]
+        faults.reset()
+        assert fe.restarts == 2
+        assert all(r.status == "done" for r in results)
+        assert all(r.crash_count <= 1 for r in results)  # streak reset
+        assert fe.engine.stats.n_quarantined == 0
+        _assert_exact_accounting(fe, reg, 2)
+        assert fe.drain(30.0)
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_recovery_failure_fails_closed_not_silent(self, model):
+        """If RECOVERY ITSELF dies (successor can't be built), the
+        frontend still fails closed — _fatal set, waiters failed,
+        submits refused — never a silent zombie driver."""
+        params, cfg = model
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="decode_round", round=1)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=2,
+                            metrics_registry=MetricsRegistry())
+
+        def broken_successor():
+            raise RuntimeError("no device memory for a successor")
+
+        eng.spawn_successor = broken_successor
+        fe = EngineFrontend(eng).start()
+        handles = [fe.submit(p, 6) for p in _prompts(cfg, 2)]
+        for h in handles:
+            with pytest.raises(EngineFailed, match="recovery failed"):
+                h.result(60.0)
+        faults.reset()
+        assert not fe.ready
+        with pytest.raises(EngineFailed):
+            fe.submit(_prompts(cfg, 1)[0], 2)
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_restart_cap_fails_closed(self, model):
+        """Past max_restarts in the window: waiters get EngineFailed,
+        new submits are refused, ready goes false — fail closed, not
+        crash-loop forever. (The driver thread dying LOUDLY with the
+        typed verdict is part of the contract — hence the filtered
+        unhandled-thread warning.)"""
+        params, cfg = model
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="decode_round", round_every=1, max_fires=50)
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=2, round_steps=2,
+                            metrics_registry=reg)
+        # poison_after out of reach: this pins the CAP, not quarantine.
+        fe = EngineFrontend(eng, max_restarts=2,
+                            poison_after=10).start()
+        handles = [fe.submit(p, 6) for p in _prompts(cfg, 4)]
+        for h in handles:
+            with pytest.raises(EngineFailed):
+                h.result(60.0)
+        faults.reset()
+        assert not fe.ready
+        deadline = time.perf_counter() + 10.0
+        while fe.alive and time.perf_counter() < deadline:
+            time.sleep(0.01)  # the driver thread dies loudly
+        assert not fe.alive
+        with pytest.raises(EngineFailed):
+            fe.submit(_prompts(cfg, 1)[0], 2)
+        assert reg.counter("serving_engine_restarts_total").value == 2
+        kinds = [e["kind"] for e in fe.engine.runlog.events()]
+        assert "engine_failed" in kinds
+        assert len(fe._handles) == 0  # every waiter was failed
+
+
+# -- deadlines across recovery (satellite) ----------------------------
+
+
+class TestDeadlinesAcrossRecovery:
+    def test_requeued_keeps_deadline_and_expiry_is_timeout(self, model):
+        """A requeued request keeps its ORIGINAL wall-clock deadline;
+        one whose deadline passed during the crash window resolves as a
+        normal timeout (504 semantics), not a recovery retry."""
+        params, cfg = model
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="decode_round", round=1)
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=1, round_steps=2,
+                            metrics_registry=reg)
+        fe = EngineFrontend(eng).start()
+        prompts = _prompts(cfg, 3)
+        h0 = fe.submit(prompts[0], 12)  # occupies the only slot
+        h1 = fe.submit(prompts[1], 4, deadline_s=30.0)   # generous
+        h2 = fe.submit(prompts[2], 4, deadline_s=0.001)  # hopeless
+        # The engine-side Request objects survive the requeue by
+        # identity — capture their deadlines now.
+        req1 = fe.engine.requests[h1.request_id]
+        req2 = fe.engine.requests[h2.request_id]
+        d1, d2 = req1.deadline_time, req2.deadline_time
+        r0 = h0.result(60.0)
+        r1 = h1.result(60.0)
+        r2 = h2.result(60.0)
+        faults.reset()
+        assert fe.restarts == 1
+        assert r0.status == "done"
+        assert r1.status == "done"
+        assert r1 is req1 and r1.deadline_time == d1  # kept, not reset
+        assert r1.requeues == 1
+        assert r2.status == "timeout"  # expiry, not a recovery retry
+        assert r2 is req2 and r2.deadline_time == d2
+        assert r2.admit_round == -1  # never admitted post-recovery
+        _assert_exact_accounting(fe, reg, 3, timeout=1)
+        assert fe.drain(30.0)
+
+
+# -- HTTP surface: 500 poison body, restart transparency --------------
+
+
+class TestHTTPFailureSurface:
+    def test_poison_maps_to_500_and_server_stays_ready(self, model):
+        params, cfg = model
+        sc = _load_tool("serving_client")
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="prefill_chunk", request_id=1, max_fires=2)
+        srv = serve(params, cfg, port=0, batch=2, round_steps=2,
+                    max_pending=8, seed=0).start_background()
+        try:
+            c = sc.ServingClient(port=srv.port)
+            prompts = _prompts(cfg, 3, seed=9)
+            # serve() shares the PROCESS registry: deltas, not
+            # absolutes.
+            base = c.metrics()["samples"]
+            base_restarts = base.get("serving_engine_restarts_total", 0)
+            base_quarantined = base.get(
+                "serving_requests_quarantined_total", 0)
+            warm = c.generate(prompts[0], 4)  # id 0
+            assert warm["code"] == 200
+            poisoned = c.generate(prompts[1], 4)  # id 1: crashes twice
+            faults.reset()
+            assert poisoned["code"] == 500
+            assert poisoned["status"] == "poisoned"
+            assert poisoned["request_id"] == 1
+            assert poisoned["crash_count"] == 2
+            # The engine recovered: service ready, next request serves.
+            rz = c.readyz()
+            assert rz["code"] == 200 and rz["ready"]
+            after = c.generate(prompts[2], 4)
+            assert after["code"] == 200 and after["status"] == "done"
+            # The restart/quarantine counters are scrapeable.
+            samples = c.metrics()["samples"]
+            assert samples.get("serving_engine_restarts_total", 0) \
+                - base_restarts == 2
+            assert samples.get(
+                "serving_requests_quarantined_total", 0) \
+                - base_quarantined == 1
+            # /debug/engine narrates the supervisor state.
+            code, body, _ = c._get("/debug/engine")
+            assert code == 200
+            dbg = json.loads(body)
+            assert dbg["frontend"]["restarts"] == 2
+            assert dbg["frontend"]["failed"] is False
+            assert dbg["stats"]["quarantined"] == 1
+        finally:
+            faults.reset()
+            srv.begin_drain(60.0)
+
+
+# -- SSE disconnect mid-stream (satellite) ----------------------------
+
+
+class TestStreamAbandon:
+    def test_client_disconnect_abandons_stream_request_completes(
+            self, model):
+        import http.client
+
+        params, cfg = model
+        sc = _load_tool("serving_client")
+        srv = serve(params, cfg, port=0, batch=2, round_steps=2,
+                    max_pending=8, seed=0).start_background()
+        try:
+            c = sc.ServingClient(port=srv.port)
+            # serve() shares the PROCESS registry — measure deltas, not
+            # absolutes, so earlier tests' traffic doesn't interfere.
+            base = c.metrics()["samples"]
+            base_abandoned = base.get(
+                "serving_streams_abandoned_total", 0)
+            base_completed = base.get("serving_completed_total", 0)
+            # Raw streaming request we will abandon after one chunk.
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            body = json.dumps({"prompt": [1, 2, 3, 4], "steps": 40,
+                               "stream": True})
+            conn.request("POST", "/v1/generate", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            got = b""
+            while b"data: " not in got:  # first chunk arrived
+                got += resp.read1(256)
+            conn.close()  # hang up mid-stream
+            # The server detects the broken pipe on a later write,
+            # stops fanout, and the request STILL completes.
+            deadline = time.perf_counter() + 30.0
+            abandoned = completed = 0
+            while time.perf_counter() < deadline:
+                samples = c.metrics()["samples"]
+                abandoned = samples.get(
+                    "serving_streams_abandoned_total", 0) \
+                    - base_abandoned
+                completed = samples.get("serving_completed_total", 0) \
+                    - base_completed
+                if abandoned >= 1 and completed >= 1:
+                    break
+                time.sleep(0.1)
+            assert abandoned == 1
+            assert completed == 1  # the abandoned request finished
+            kinds = [e["kind"] for e in srv.runlog.events()]
+            assert "stream_abandoned" in kinds
+            # The service is unaffected: a fresh request round-trips.
+            r = c.generate([1, 2, 3, 4], 4)
+            assert r["code"] == 200 and r["status"] == "done"
+        finally:
+            srv.begin_drain(60.0)
+
+
+# -- client retry/backoff (tentpole part 4) ---------------------------
+
+
+class TestClientRetry:
+    def _policy(self, **kw):
+        sc = _load_tool("serving_client")
+        return sc, sc.RetryPolicy(**kw)
+
+    def test_delay_is_deterministic_and_bounded(self):
+        sc, p = self._policy()
+        assert p.delay(0, "key-a") == p.delay(0, "key-a")  # replayable
+        assert p.delay(0, "key-a") != p.delay(0, "key-b")  # decorrelated
+        for attempt in range(8):
+            d = p.delay(attempt, "k")
+            base = min(p.max_delay_s,
+                       p.base_delay_s * p.multiplier ** attempt)
+            assert 0.5 * base <= d <= base
+        assert p.delay(10, "k") <= p.max_delay_s
+        # Retry-After is a floor, not a suggestion.
+        assert p.delay(0, "k", retry_after="3") >= 3.0
+        assert p.delay(0, "k", retry_after="junk") == p.delay(0, "k")
+
+    def test_retries_shed_codes_until_success(self):
+        sc, p = self._policy(max_attempts=4, budget_s=60.0)
+        seq = iter([{"code": 429, "retry_after": None, "tokens": []},
+                    {"code": 503, "tokens": []},
+                    {"code": 200, "tokens": [7], "status": "done"}])
+        sleeps = []
+        res = sc.call_with_retry(lambda: next(seq), p, "k",
+                                 sleep=sleeps.append)
+        assert res["code"] == 200 and res["attempts"] == 3
+        assert res["retried_codes"] == [429, 503]
+        assert len(sleeps) == 2 and all(s > 0 for s in sleeps)
+
+    def test_non_retryable_and_budget(self):
+        sc, p = self._policy(max_attempts=5)
+        res = sc.call_with_retry(
+            lambda: {"code": 400, "tokens": []}, p, "k",
+            sleep=lambda s: None)
+        assert res["attempts"] == 1  # 400 is not retryable
+        sc2, tight = self._policy(max_attempts=5, budget_s=0.01,
+                                  base_delay_s=1.0)
+        res2 = sc2.call_with_retry(
+            lambda: {"code": 429, "tokens": []}, tight, "k",
+            sleep=lambda s: None)
+        assert res2["attempts"] == 1  # first backoff busts the budget
+        assert res2["code"] == 429
+
+    def test_connect_errors_retry_but_partial_streams_do_not(self):
+        sc, p = self._policy(max_attempts=3)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise ConnectionResetError("boom")
+            return {"code": 200, "tokens": [1], "status": "done"}
+
+        res = sc.call_with_retry(flaky, p, "k", sleep=lambda s: None)
+        assert res["code"] == 200 and res["attempts"] == 2
+        # A stream that already delivered tokens is NOT idempotent:
+        # no silent retry without opt-in.
+        partial = {"code": 200, "tokens": [1, 2],
+                   "stream_error": "ConnectionResetError: mid-flight"}
+        res2 = sc.call_with_retry(lambda: dict(partial), p, "k",
+                                  sleep=lambda s: None)
+        assert res2["attempts"] == 1
+        # ... unless the caller opts in.
+        sc3, optin = self._policy(max_attempts=3,
+                                  retry_streamed_partial=True)
+        seq = iter([dict(partial),
+                    {"code": 200, "tokens": [1, 2, 3],
+                     "status": "done"}])
+        res3 = sc3.call_with_retry(lambda: next(seq), optin, "k",
+                                   sleep=lambda s: None)
+        assert res3["attempts"] == 2 and res3["tokens"] == [1, 2, 3]
+
+    def test_retry_rides_a_real_429(self, model):
+        """End to end: a burst past max_pending sheds 429s; a retrying
+        client wins on a later attempt instead of surfacing the shed."""
+        params, cfg = model
+        sc = _load_tool("serving_client")
+        srv = serve(params, cfg, port=0, batch=1, round_steps=4,
+                    max_pending=1, seed=0).start_background()
+        try:
+            prompts = _prompts(cfg, 8, seed=13)
+            policy = sc.RetryPolicy(max_attempts=8, base_delay_s=0.2,
+                                    budget_s=120.0)
+            results = [None] * 8
+
+            def fire(i):
+                results[i] = sc.ServingClient(
+                    port=srv.port, timeout=120.0).generate(
+                        prompts[i], 8, retry=policy)
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(r["code"] == 200 for r in results), \
+                [(r["code"], r.get("attempts")) for r in results]
+            assert any(r["attempts"] > 1 for r in results)  # shed+won
+        finally:
+            srv.begin_drain(60.0)
+
+
+# -- the CI form: env-armed subprocess chaos smoke --------------------
+
+
+class TestChaosSubprocessSmoke:
+    def test_fault_injected_server_recovers_and_runlog_is_clean(
+            self, tmp_path):
+        """The acceptance criterion against a REAL process: a server
+        armed via MARLIN_FAULT_PLAN crashes mid-stream, recovers, every
+        stream completes byte-identical to an in-process golden,
+        /metrics shows exactly one restart, SIGTERM drains clean, and
+        the sealed runlog passes the crash-cycle detector."""
+        sc = _load_tool("serving_client")
+        runlog = tmp_path / "chaos_runlog.jsonl"
+        plan = {"specs": [{"site": "decode_round", "round": 4,
+                           "action": "raise"}]}
+        # The in-process golden below runs under conftest's jax config
+        # (x64 + partitionable threefry); the subprocess must match or
+        # init_params diverges and the byte-exactness check is vacuous.
+        env = dict(os.environ, MARLIN_FAULT_PLAN=json.dumps(plan),
+                   JAX_ENABLE_X64="True",
+                   JAX_THREEFRY_PARTITIONABLE="true")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "marlin_tpu.serving.server",
+             "--port", "0", "--force-cpu", "--d-model", "32",
+             "--n-layers", "2", "--vocab", "64", "--max-len", "64",
+             "--batch", "2", "--round-steps", "2",
+             "--runlog", str(runlog)],
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("SERVING "), line
+            port = int(line.strip().split("port=")[1])
+            c = sc.ServingClient(port=port, timeout=120.0)
+            warm_prompt = list(range(8))
+            warm = c.generate(warm_prompt, 2)
+            assert warm["code"] == 200
+            # Three concurrent streams long enough to straddle the
+            # round-4 crash.
+            prompts = _prompts(_cfg(), 3, seed=17)
+            results = [None] * 3
+
+            def fire(i):
+                results[i] = sc.ServingClient(
+                    port=port, timeout=120.0).stream(prompts[i], 24)
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # Byte-exact across the crash: greedy output is a pure
+            # function of the prompt (arrival-order invariant), so an
+            # in-process golden of the same model settles it. The demo
+            # entry builds d_ff = 4*d_model — mirror it exactly.
+            cfg = _cfg(d_ff=128)
+            params = init_params(cfg, seed=0)
+            gold_by_prompt = {}
+            geng = ServingEngine(params, cfg, batch=2, round_steps=2,
+                                 metrics_registry=MetricsRegistry())
+            for p in [warm_prompt] + [list(map(int, p))
+                                      for p in prompts]:
+                geng.submit(np.asarray(p, np.int32),
+                            2 if p == warm_prompt else 24)
+            for r in geng.run():
+                gold_by_prompt[tuple(map(int, r.prompt))] = \
+                    list(map(int, r.tokens))
+            assert warm["tokens"] == gold_by_prompt[tuple(warm_prompt)]
+            for i, res in enumerate(results):
+                assert res["code"] == 200, res
+                assert res["status"] == "done" and res["emitted"] == 24
+                assert res["tokens"] == \
+                    gold_by_prompt[tuple(map(int, prompts[i]))], i
+            # Exactly one supervised restart, visible to a scraper.
+            samples = c.metrics()["samples"]
+            assert samples.get("serving_engine_restarts_total") == 1
+            assert samples.get(
+                'serving_faults_injected_total{site="decode_round"}'
+            ) == 1
+            rz = c.readyz()
+            assert rz["code"] == 200 and rz["ready"]
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(60.0)
+            assert rc == 0, proc.stderr.read()[-800:]
+            assert "DRAINED" in proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10.0)
+        # The sealed runlog passes the crash-cycle detector: the crash
+        # is narrated, every interrupted request resolved, zero
+        # post-warmup compiles (warm caches across the restart), and
+        # the phase-sum identity held for every completion.
+        rep = subprocess.run(
+            [sys.executable, "tools/runlog_report.py", str(runlog),
+             "--json", "-"],
+            capture_output=True, text=True, timeout=60, cwd=_REPO)
+        assert rep.returncode == 0, rep.stdout + rep.stderr
+        report = json.loads(rep.stdout)
+        assert report["ok"] is True, report["anomalies"]
+        assert report["sealed"] is True
+        assert report["n_crashes"] == 1
+        assert report["n_recovered"] >= 1
+        assert report["n_quarantined"] == 0
+        assert report["engine_failed"] is False
+        assert report["post_warmup_compiles"] == 0
+        assert report["n_completed"] == 4
+        assert report["phase_sum_max_rel_err"] <= 0.05
+        events = [json.loads(l)
+                  for l in runlog.read_text().strip().splitlines()]
+        kinds = [e["kind"] for e in events]
+        assert "fault_plan" in kinds  # the env arming is on record
+        assert kinds[-1] == "drain_complete"

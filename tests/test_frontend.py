@@ -47,6 +47,9 @@ def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
         name, os.path.join(_REPO, "tools", f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
+    # Register BEFORE exec (the importlib contract): dataclasses in the
+    # tool resolve their string annotations via sys.modules.
+    sys.modules[name] = mod
     spec.loader.exec_module(mod)
     return mod
 

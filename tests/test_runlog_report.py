@@ -271,6 +271,134 @@ class TestSyntheticRunlogs:
         assert report["round_series"][0]["iters"] == 4
 
 
+def _crash_cycle_events():
+    """A clean crash/recovery narrative grafted onto the clean log:
+    round 1 crashes with request 0 in flight and request 1 queued, both
+    recover into the successor engine (its own engine_start), and both
+    complete post-restart."""
+    events = _clean_events()
+    # Drop the pre-crash completes; the requests resolve after recovery.
+    events = [e for e in events if e["kind"] != "complete"]
+    seal = events.pop()  # drain_complete goes back at the end
+    events += [
+        {"kind": "engine_crash", "t": 0.06, "round": 1,
+         "error": "FaultInjected: injected", "error_type": "FaultInjected",
+         "blamed_request_id": None, "inflight": [0], "queued": [1],
+         "crashes_in_window": 1},
+        {"kind": "recover", "t": 0.061, "request_id": 0, "round": 2,
+         "crash_count": 1, "requeues": 1, "recovery_s": 0.01},
+        {"kind": "recover", "t": 0.062, "request_id": 1, "round": 2,
+         "crash_count": 0, "requeues": 1, "recovery_s": 0.0},
+        {"kind": "engine_start", "t": 0.063, "batch": 2,
+         "round_steps": 4, "prefill_chunk": None, "max_pending": 8,
+         "max_len": 64, "prefix_cache": False},
+        {"kind": "admit", "t": 0.07, "request_id": 0, "row": 0,
+         "round": 2, "prompt_len": 8, "wait_rounds": 2,
+         "queue_depth": 1},
+        {"kind": "admit", "t": 0.071, "request_id": 1, "row": 1,
+         "round": 2, "prompt_len": 24, "wait_rounds": 2,
+         "queue_depth": 0},
+        {"kind": "round", "t": 0.08, "round": 2, "iters": 4,
+         "occupied": 2, "live_iters": 8, "admitted": 2, "retired": 2,
+         "expired": 0, "prefilling": 0, "queue_depth": 0,
+         "wasted_row_iters": 0, "round_s": 0.02, "decode_s": 0.018,
+         "drift_decode": 1.0},
+        {"kind": "complete", "t": 0.09, "request_id": 0, "row": 0,
+         "emitted": 4, "live_iters": 4, "submit_t": 1.00,
+         "admit_t": 1.07, "finish_t": 1.09, "rounds": 1,
+         "phases": {"queue_wait": 0.06, "admit": 0.01,
+                    "decode": 0.02, "total": 0.09, "recovery": 0.05}},
+        {"kind": "complete", "t": 0.095, "request_id": 1, "row": 1,
+         "emitted": 4, "live_iters": 4, "submit_t": 1.001,
+         "admit_t": 1.072, "finish_t": 1.094, "rounds": 1,
+         "phases": {"queue_wait": 0.069, "admit": 0.004,
+                    "decode": 0.02, "total": 0.093}},
+        seal,
+    ]
+    return events
+
+
+class TestCrashCycleDetector:
+    """PR-7 (docs/robustness.md): every request a crash interrupts must
+    resolve — recovered or quarantined, never silently lost — and the
+    report narrates the cycle without treating a RESOLVED chaos run as
+    an anomaly."""
+
+    def test_resolved_crash_cycle_is_clean_and_reported(self, rr,
+                                                        tmp_path):
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, _crash_cycle_events())))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["n_crashes"] == 1
+        assert report["n_recovered"] == 2
+        assert report["n_quarantined"] == 0
+        assert report["engine_failed"] is False
+        (cycle,) = report["crashes"]
+        assert cycle["interrupted"] == [0, 1]
+        assert sorted(cycle["recovered"]) == [0, 1]
+        # The recovery sub-attribution rides OUTSIDE the contiguous
+        # sum: phase checks still pass on the recovered request.
+        r0 = next(r for r in report["requests"] if r["request_id"] == 0)
+        assert r0["recoveries"] == 1
+        assert r0["phase_sum_rel_err"] <= 0.05
+
+    def test_crashed_request_vanishing_is_flagged(self, rr, tmp_path):
+        events = [e for e in _crash_cycle_events()
+                  if not (e["kind"] == "recover"
+                          and e["request_id"] == 1)
+                  and not (e["kind"] == "complete"
+                           and e["request_id"] == 1)
+                  and not (e["kind"] == "admit"
+                           and e["request_id"] == 1)]
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is False
+        kinds = sorted(a["kind"] for a in report["anomalies"])
+        assert "crash_unresolved_request" in kinds
+        a = next(a for a in report["anomalies"]
+                 if a["kind"] == "crash_unresolved_request")
+        assert a["request_id"] == 1
+        # ... and the sealed log also flags it as unresolved overall.
+        assert "unresolved_request" in kinds
+
+    def test_quarantine_resolves_the_cycle(self, rr, tmp_path):
+        events = _crash_cycle_events()
+        # Request 1 is quarantined instead of recovered.
+        for i, e in enumerate(events):
+            if e["kind"] == "recover" and e["request_id"] == 1:
+                events[i] = {"kind": "quarantine", "t": e["t"],
+                             "request_id": 1, "crash_count": 2,
+                             "error": "FaultInjected: injected"}
+        events = [e for e in events
+                  if not (e["kind"] in ("admit", "complete")
+                          and e.get("request_id") == 1)]
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["n_quarantined"] == 1
+        (cycle,) = report["crashes"]
+        assert cycle["quarantined"] == [1]
+        r1 = next(r for r in report["requests"] if r["request_id"] == 1)
+        assert r1["status"] == "poisoned"
+
+    def test_engine_failed_resolves_named_abandoned(self, rr, tmp_path):
+        events = _crash_cycle_events()
+        # Second crash whose requests are abandoned by fail-closed;
+        # the log is NOT sealed (a failed engine never drains).
+        events = [e for e in events if e["kind"] != "drain_complete"]
+        events += [
+            {"kind": "engine_crash", "t": 0.12, "round": 3,
+             "error": "FaultInjected: injected",
+             "error_type": "FaultInjected", "blamed_request_id": None,
+             "inflight": [2], "queued": [], "crashes_in_window": 2},
+            {"kind": "engine_failed", "t": 0.121, "round": 3,
+             "restarts": 1, "abandoned": [2],
+             "error": "FaultInjected: injected"},
+        ]
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["engine_failed"] is True
+        assert not any(a["kind"] == "crash_unresolved_request"
+                       for a in report["anomalies"]), report["anomalies"]
+
+
 class TestRealEngineRunlog:
     def test_engine_drain_runlog_is_clean(self, rr, tmp_path):
         cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,

@@ -26,9 +26,20 @@ that should never happen in steady state:
   end-to-end wall-clock beyond ``--phase-tol`` (they are differences of
   consecutive stamps on one clock, so a mismatch means clock or
   instrumentation breakage, not workload behavior).
-* **unresolved requests** — submitted but neither completed nor timed
-  out in a SEALED log (``drain_complete`` present): the drain contract
-  says that cannot happen.
+* **unresolved requests** — submitted but neither completed, timed
+  out, nor quarantined in a SEALED log (``drain_complete`` present):
+  the drain contract says that cannot happen.
+* **crash/recovery cycles** (docs/robustness.md) — every
+  ``engine_crash`` event names the requests it interrupted; each one
+  must be accounted for by a following ``recover`` (requeued into the
+  successor engine) or ``quarantine`` (poisoned) event, and recovered
+  requests must still resolve terminally. A crashed request that
+  simply vanishes is the silent-loss bug the supervisor exists to
+  prevent (``crash_unresolved_request``). Resolved crash cycles are
+  reported (``n_crashes``/``n_recovered``/``n_quarantined`` and a
+  per-crash summary) but are NOT anomalies — chaos runs are
+  legitimate; the non-chaos gate is the SLO baseline's
+  ``engine_restarts == 0`` check (tools/slo_check.py).
 
 Usage:
     python tools/runlog_report.py RUNLOG.jsonl [--json OUT|-]
@@ -119,7 +130,63 @@ def build_requests(events: List[dict]) -> Dict[int, dict]:
             r = rec(ev["request_id"])
             r.update(status="timeout", finish_round=ev.get("round"),
                      wait_s=ev.get("wait_s"))
+        elif kind == "recover":
+            r = rec(ev["request_id"])
+            r["recoveries"] = r.get("recoveries", 0) + 1
+            r["crash_count"] = ev.get("crash_count")
+        elif kind == "quarantine":
+            r = rec(ev["request_id"])
+            r.update(status="poisoned",
+                     crash_count=ev.get("crash_count"),
+                     quarantine_error=ev.get("error"))
     return reqs
+
+
+def crash_cycles(events: List[dict]):
+    """Replay the crash/recovery narrative: per ``engine_crash``, the
+    interrupted requests and how each resolved (``recover`` /
+    ``quarantine``). Returns ``(cycles, anomalies)`` — an interrupted
+    request with neither verdict before the log ends (or the next
+    crash) is a ``crash_unresolved_request`` anomaly. An
+    ``engine_failed`` event is terminal fail-closed: it names its
+    abandoned requests explicitly, which resolves its open cycle."""
+    cycles: List[dict] = []
+    anomalies: List[dict] = []
+    open_set: set = set()
+
+    def close_open(reason):
+        for rid in sorted(open_set):
+            anomalies.append({"kind": "crash_unresolved_request",
+                              "request_id": rid, "reason": reason})
+        open_set.clear()
+
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "engine_crash":
+            close_open("next crash arrived first")
+            open_set.update(ev.get("inflight", []))
+            open_set.update(ev.get("queued", []))
+            cycles.append({"round": ev.get("round"),
+                           "error_type": ev.get("error_type"),
+                           "blamed_request_id":
+                               ev.get("blamed_request_id"),
+                           "interrupted": sorted(open_set),
+                           "recovered": [], "quarantined": []})
+        elif kind == "recover" and cycles:
+            rid = ev.get("request_id")
+            open_set.discard(rid)
+            cycles[-1]["recovered"].append(rid)
+        elif kind == "quarantine" and cycles:
+            rid = ev.get("request_id")
+            open_set.discard(rid)
+            cycles[-1]["quarantined"].append(rid)
+        elif kind == "engine_failed":
+            # Fail-closed abandons everything still open, by name.
+            open_set.difference_update(ev.get("abandoned", []))
+            close_open("open at engine_failed but not listed as "
+                       "abandoned")
+    close_open("log ended with the crash cycle open")
+    return cycles, anomalies
 
 
 def round_series(events: List[dict], batch: Optional[int]) -> dict:
@@ -155,7 +222,12 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
 
 
 def find_anomalies(events: List[dict], reqs: Dict[int, dict],
-                   phase_tol: float) -> List[dict]:
+                   phase_tol: float,
+                   crash_anomalies: Optional[List[dict]] = None
+                   ) -> List[dict]:
+    """``crash_anomalies``: pass :func:`crash_cycles`' anomaly half
+    when already computed (build_report does) to avoid replaying the
+    log twice; None recomputes."""
     anomalies: List[dict] = []
 
     # Post-warmup compiles. A compile event is WARMUP when (a) it is the
@@ -239,11 +311,19 @@ def find_anomalies(events: List[dict], reqs: Dict[int, dict],
 
     # Unresolved requests — only judged against a SEALED log (the file
     # sink is unbounded, so every event of a sealed run is present).
+    # "Poisoned" is a terminal resolution: the quarantine verdict
+    # reached the caller as a typed failure.
     if any(ev["kind"] == "drain_complete" for ev in events):
         for r in reqs.values():
             if "submit_round" in r and r.get("status") is None:
                 anomalies.append({"kind": "unresolved_request",
                                   "request_id": r["request_id"]})
+
+    # Crash/recovery cycles: every interrupted request must carry a
+    # recover or quarantine verdict (docs/robustness.md).
+    if crash_anomalies is None:
+        _, crash_anomalies = crash_cycles(events)
+    anomalies.extend(crash_anomalies)
     return anomalies
 
 
@@ -252,7 +332,9 @@ def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
     reqs = build_requests(events)
     batch = next((ev.get("batch") for ev in events
                   if ev["kind"] == "engine_start"), None)
-    anomalies = find_anomalies(events, reqs, phase_tol)
+    cycles, crash_anomalies = crash_cycles(events)
+    anomalies = find_anomalies(events, reqs, phase_tol,
+                               crash_anomalies=crash_anomalies)
     done = [r for r in reqs.values() if r.get("status") == "done"]
     errs = [r["phase_sum_rel_err"] for r in done
             if "phase_sum_rel_err" in r]
@@ -263,6 +345,13 @@ def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
         "n_completed": len(done),
         "n_timeout": sum(1 for r in reqs.values()
                          if r.get("status") == "timeout"),
+        "n_crashes": len(cycles),
+        "n_recovered": sum(1 for ev in events if ev["kind"] == "recover"),
+        "n_quarantined": sum(1 for r in reqs.values()
+                             if r.get("status") == "poisoned"),
+        "engine_failed": any(ev["kind"] == "engine_failed"
+                             for ev in events),
+        "crashes": cycles,
         "rounds": round_series(events, batch),
         "requests": sorted(reqs.values(),
                            key=lambda r: r["request_id"]),
@@ -296,6 +385,13 @@ def _human(report: dict) -> str:
         f"{report['n_completed']} completed, "
         f"{report['n_timeout']} timed out",
     ]
+    if report["n_crashes"]:
+        lines.append(
+            f"crashes: {report['n_crashes']} engine crash(es), "
+            f"{report['n_recovered']} recovery requeue(s), "
+            f"{report['n_quarantined']} quarantined"
+            + (", ENGINE FAILED CLOSED" if report["engine_failed"]
+               else ""))
     r = report["rounds"]
     if r.get("n_rounds"):
         lines.append(

@@ -9,6 +9,16 @@ with wall-clock timings attached — the raw material `bench.py --config
 http` turns into end-to-end TTFT / inter-token-latency / completions-per-
 second artifact fields, and what an operator pokes a live server with.
 
+Retry/backoff (docs/robustness.md §client): pass a
+:class:`RetryPolicy` to ``generate``/``stream`` to retry shed responses
+(429/503, honoring ``Retry-After``) and connection failures with
+exponential backoff and DETERMINISTIC jitter (keyed on the request, not
+a process RNG — chaos tests replay exactly), under a wall-clock retry
+budget. Retries are IDEMPOTENT-ONLY by default: a stream that already
+delivered tokens is never silently re-sent (re-sending would duplicate
+delivered output at the consumer) unless the caller opts in with
+``retry_streamed_partial=True``.
+
 Usage (manual):
     python tools/serving_client.py --port 8000 generate 1 2 3 --steps 8
     python tools/serving_client.py --port 8000 stream 1 2 3 --steps 8
@@ -18,11 +28,99 @@ Usage (manual):
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
 import json
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Opt-in client retry: exponential backoff with deterministic
+    jitter, a retry budget, and idempotent-only defaults.
+
+    ``delay(attempt, key, retry_after)`` is a pure function — backoff
+    for attempt ``i`` is ``base * multiplier**i`` capped at
+    ``max_delay_s``, scaled into ``[0.5, 1.0]`` by a crc32 hash of
+    ``(key, attempt)`` (decorrelates a thundering herd WITHOUT
+    randomness, so a replayed chaos run retries on the same schedule),
+    and floored by the server's ``Retry-After`` hint when
+    ``honor_retry_after``. Retries stop at ``max_attempts``, when the
+    cumulative sleep would exceed ``budget_s``, on any non-retryable
+    code, or — unless ``retry_streamed_partial`` — the moment a stream
+    has delivered partial output (re-sending is no longer idempotent
+    from the consumer's point of view)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.1
+    multiplier: float = 2.0
+    max_delay_s: float = 5.0
+    budget_s: float = 30.0
+    retry_codes: Tuple[int, ...] = (429, 503)
+    retry_connect_errors: bool = True
+    honor_retry_after: bool = True
+    retry_streamed_partial: bool = False
+
+    def delay(self, attempt: int, key: str,
+              retry_after: Optional[str] = None) -> float:
+        base = min(self.max_delay_s,
+                   self.base_delay_s * self.multiplier ** attempt)
+        frac = (zlib.crc32(f"{key}:{attempt}".encode()) % 1000) / 999.0
+        d = base * (0.5 + 0.5 * frac)
+        if retry_after is not None and self.honor_retry_after:
+            try:
+                d = max(d, float(retry_after))
+            except (TypeError, ValueError):
+                pass
+        return d
+
+
+def call_with_retry(attempt_fn, policy: RetryPolicy, key: str,
+                    sleep=time.sleep) -> Dict:
+    """Drive ``attempt_fn`` (one request attempt returning a result
+    dict with ``code``/``retry_after``/``tokens``) under ``policy``.
+    Connection-level failures become ``{"code": None,
+    "connect_error": ...}`` results. The returned dict carries the
+    retry ledger: ``attempts``, ``retry_wait_s``, ``retried_codes``."""
+    waited = 0.0
+    history: List = []
+    res: Dict = {}
+    for attempt in range(max(1, policy.max_attempts)):
+        try:
+            res = attempt_fn()
+        except (ConnectionError, OSError) as e:
+            res = {"code": None, "tokens": [], "chunks": [],
+                   "connect_error": f"{type(e).__name__}: {e}"}
+            retryable = policy.retry_connect_errors
+        else:
+            if res.get("code") in policy.retry_codes:
+                retryable = True
+            elif res.get("stream_error") is not None:
+                # The stream died mid-flight (stream() returns the
+                # partial take instead of raising).
+                retryable = policy.retry_connect_errors
+            else:
+                retryable = False
+        # Idempotency guard: partial streamed output means a retry
+        # would duplicate bytes the consumer already has.
+        partial = retryable and bool(res.get("tokens"))
+        if (attempt + 1 >= policy.max_attempts or not retryable
+                or (partial and not policy.retry_streamed_partial)):
+            break
+        d = policy.delay(attempt, key, res.get("retry_after"))
+        if waited + d > policy.budget_s:
+            break
+        history.append(res.get("code"))
+        sleep(d)
+        waited += d
+    res["attempts"] = attempt + 1
+    res["retry_wait_s"] = round(waited, 6)
+    if history:
+        res["retried_codes"] = history
+    return res
 
 
 class ServingClient:
@@ -85,11 +183,21 @@ class ServingClient:
 
     def generate(self, prompt: Sequence[int], steps: int,
                  deadline_s: Optional[float] = None,
-                 request_id: Optional[str] = None) -> Dict:
+                 request_id: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None) -> Dict:
         """Blocking generate; returns the response JSON plus ``code``,
         ``dt_s``, and the echoed ``x_request_id``/``x_engine_request_id``
         headers. Non-200s (429/503/504/400) come back the same way —
-        the caller owns the retry/shed decision."""
+        the caller owns the retry/shed decision, or delegates it by
+        passing a :class:`RetryPolicy` (blocking requests are
+        idempotent until delivery, so shed AND connection-failed
+        attempts both retry under the policy)."""
+        if retry is not None:
+            return call_with_retry(
+                lambda: self.generate(prompt, steps,
+                                      deadline_s=deadline_s,
+                                      request_id=request_id),
+                retry, key=request_id or repr(list(map(int, prompt))))
         body = {"prompt": list(map(int, prompt)), "steps": int(steps)}
         if deadline_s is not None:
             body["deadline_s"] = float(deadline_s)
@@ -117,7 +225,8 @@ class ServingClient:
 
     def stream(self, prompt: Sequence[int], steps: int,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> Dict:
+               request_id: Optional[str] = None,
+               retry: Optional[RetryPolicy] = None) -> Dict:
         """Streaming generate: consume the SSE stream, recording each
         event's arrival instant. Returns ``tokens`` (all chunks
         concatenated), ``chunks`` as ``[(t_arrival_s_from_send,
@@ -125,7 +234,16 @@ class ServingClient:
         terminal ``done`` event's fields, and ``code``. The per-chunk
         timeline is the inter-token-latency raw material: tokens within
         one chunk share an arrival (round-granular streaming — see
-        docs/frontend.md)."""
+        docs/frontend.md). A connection lost MID-stream returns the
+        partial take with ``stream_error`` set rather than raising —
+        what the :class:`RetryPolicy` idempotency guard inspects (a
+        partial stream is only retried when the caller opted in)."""
+        if retry is not None:
+            return call_with_retry(
+                lambda: self.stream(prompt, steps,
+                                    deadline_s=deadline_s,
+                                    request_id=request_id),
+                retry, key=request_id or repr(list(map(int, prompt))))
         body = {"prompt": list(map(int, prompt)), "steps": int(steps),
                 "stream": True}
         if deadline_s is not None:
@@ -147,20 +265,28 @@ class ServingClient:
             tokens: List[int] = []
             chunks: List = []
             final: Dict = {}
-            # http.client decodes the chunked framing; readline gives
-            # one SSE line at a time as the server flushes rounds.
-            for raw in resp:
-                line = raw.strip()
-                if not line.startswith(b"data: "):
-                    continue
-                ev = json.loads(line[len(b"data: "):])
-                now = time.perf_counter() - t0
-                if ev.get("done"):
-                    final = ev
-                    break
-                tokens.extend(ev["tokens"])
-                chunks.append((now, len(ev["tokens"])))
+            stream_error = None
+            try:
+                # http.client decodes the chunked framing; readline
+                # gives one SSE line at a time as the server flushes
+                # rounds.
+                for raw in resp:
+                    line = raw.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    ev = json.loads(line[len(b"data: "):])
+                    now = time.perf_counter() - t0
+                    if ev.get("done"):
+                        final = ev
+                        break
+                    tokens.extend(ev["tokens"])
+                    chunks.append((now, len(ev["tokens"])))
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                stream_error = f"{type(e).__name__}: {e}"
             return {
+                **({"stream_error": stream_error} if stream_error
+                   else {}),
                 "code": resp.status,
                 "dt_s": time.perf_counter() - t0,
                 "ttft_s": chunks[0][0] if chunks else None,
@@ -301,6 +427,9 @@ def main(argv=None) -> int:
         g.add_argument("prompt", nargs="+", type=int)
         g.add_argument("--steps", type=int, default=8)
         g.add_argument("--deadline-s", type=float, default=None)
+        g.add_argument("--retries", type=int, default=0,
+                       help="max retry attempts on 429/503/connect "
+                            "errors (default 0 = no retry)")
     lo = sub.add_parser("load")
     lo.add_argument("--requests", type=int, default=16)
     lo.add_argument("--steps", type=int, default=8)
@@ -315,11 +444,17 @@ def main(argv=None) -> int:
 
     client = ServingClient(args.host, args.port)
     if args.cmd == "generate":
+        policy = RetryPolicy(max_attempts=args.retries + 1) \
+            if args.retries else None
         print(json.dumps(client.generate(args.prompt, args.steps,
-                                         args.deadline_s), indent=2))
+                                         args.deadline_s,
+                                         retry=policy), indent=2))
     elif args.cmd == "stream":
+        policy = RetryPolicy(max_attempts=args.retries + 1) \
+            if args.retries else None
         print(json.dumps(client.stream(args.prompt, args.steps,
-                                       args.deadline_s), indent=2))
+                                       args.deadline_s,
+                                       retry=policy), indent=2))
     elif args.cmd == "load":
         import random
 

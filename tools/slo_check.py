@@ -4,10 +4,13 @@
 
 Compares the metrics of `bench.py --config serving` artifact lines —
 the continuous-vs-static ratio, the prefix-reuse speedup, utilization,
-`recompiles_after_warmup`, prefix hit rate, and the TTFT histogram from
-the attached obs metrics block — against a COMMITTED baseline JSON with
-explicit tolerances, so an SLO regression fails fast in the tier-1
-serving smoke instead of surfacing rounds later in a bench diff.
+`recompiles_after_warmup`, prefix hit rate, `engine_restarts` (the
+non-chaos lines must report ZERO supervised restarts — an organic crash
+in a normal bench run is a gate failure, docs/robustness.md), and the
+TTFT histogram from the attached obs metrics block — against a
+COMMITTED baseline JSON with explicit tolerances, so an SLO regression
+fails fast in the tier-1 serving smoke instead of surfacing rounds
+later in a bench diff.
 
 Usage:
     python tools/slo_check.py ARTIFACT.jsonl \
