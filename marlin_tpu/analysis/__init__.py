@@ -1,0 +1,35 @@
+"""marlint — the repo-native invariant-aware static-analysis pass.
+
+Mechanizes the stack's hard-won correctness rules as an ``ast``-based
+checker that runs in tier-1 (``python -m marlin_tpu.analysis``,
+``make lint`` in tools/): donation-safe device fetches, lock-annotated
+shared state, the deterministic-replay contract, jit retrace hazards,
+``sys.modules``-before-exec loaders, and export integrity. Each rule is
+grounded in a bug a real PR shipped or nearly shipped — see
+docs/static_analysis.md for the catalog, annotation grammar,
+suppression policy, and baseline workflow; PAPERS.md for the lineage
+(Tricorder, Clang Thread Safety Analysis).
+
+Dependency-free by design (stdlib only, no jax import): the pass must
+run — fast — anywhere the repo checks out.
+"""
+
+from .cli import main
+from .core import (AnalysisContext, Finding, Report, Rule, SourceFile,
+                   analyze, load_baseline, render_text, write_baseline)
+from .rules import ALL_RULES, rules_by_name
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisContext",
+    "Finding",
+    "Report",
+    "Rule",
+    "SourceFile",
+    "analyze",
+    "load_baseline",
+    "main",
+    "render_text",
+    "rules_by_name",
+    "write_baseline",
+]

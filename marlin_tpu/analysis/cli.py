@@ -1,0 +1,104 @@
+"""marlint CLI: ``python -m marlin_tpu.analysis`` / ``make lint``.
+
+Exit codes (the contract ``tools/Makefile`` and the tier-1 test share):
+
+* 0 — clean: zero non-baselined findings, zero stale baseline entries
+* 1 — findings (or stale baseline entries, or parse failures)
+* 2 — internal error (the analyzer itself crashed)
+
+Default targets are ``marlin_tpu/ benchlib/ tools/`` relative to the
+repo root (derived from this package's location, so the entry point
+works from any cwd); the default baseline is
+``tools/marlint_baseline.json`` when present. The tier-1 test
+(tests/test_analysis.py) invokes :func:`main` directly — the suite and
+a local ``make lint`` cannot diverge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from . import core
+from .rules import ALL_RULES, rules_by_name
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = "tools/marlint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m marlin_tpu.analysis",
+        description=("marlint: the repo-native invariant checker "
+                     "(docs/static_analysis.md)"))
+    p.add_argument("targets", nargs="*",
+                   default=list(core.DEFAULT_TARGETS),
+                   help="files/directories to scan (default: "
+                        "marlin_tpu benchlib tools)")
+    p.add_argument("--root", default=str(REPO_ROOT),
+                   help="repo root targets are relative to")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit 0")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                        f"under --root when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline (every finding is new)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings as the baseline "
+                        "and exit 0 (policy: keep it empty — fix or "
+                        "suppress-with-reason first)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="findings only, no summary line")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _main(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - exit-code contract
+        print(f"marlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+
+def _main(argv: Optional[List[str]]) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in ALL_RULES:
+            scope = ", ".join(r.paths) if r.paths else "all files"
+            print(f"{r.name:22s} {r.description}  [scope: {scope}]")
+        return 0
+    rules = rules_by_name(
+        [r.strip() for r in args.rules.split(",") if r.strip()] or None)
+    root = Path(args.root).resolve()
+    baseline_path = Path(args.baseline) if args.baseline \
+        else root / DEFAULT_BASELINE
+    baseline = None
+    if not args.no_baseline and not args.write_baseline \
+            and baseline_path.is_file():
+        baseline = core.load_baseline(baseline_path)
+    report = core.analyze(root, args.targets, rules, baseline=baseline)
+    if args.write_baseline:
+        core.write_baseline(baseline_path, report.findings)
+        print(f"marlint: wrote {len(report.findings)} key(s) to "
+              f"{baseline_path}")
+        return 0
+    if args.as_json:
+        print(json.dumps(report.as_dict(), indent=2))
+    else:
+        text = core.render_text(report)
+        if args.quiet:
+            text = "\n".join(text.splitlines()[:-1])
+        if text:
+            print(text)
+    return 0 if report.clean else 1

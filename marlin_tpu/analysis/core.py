@@ -1,0 +1,462 @@
+"""marlint core: source model, annotation grammar, rule registry,
+baseline, reporters.
+
+The Tricorder doctrine (PAPERS.md): project-specific analyzers wired
+into the workflow beat generic ones, because they mechanize the rules
+THIS codebase learned the hard way. Every rule in ``rules.py`` encodes
+an invariant a real PR bug established (the rule docstrings cite them);
+this module is the dependency-free machinery those rules share — pure
+``ast`` + ``tokenize``, no third-party imports, so the pass runs
+anywhere the package imports.
+
+Annotation grammar (docs/static_analysis.md has the full catalog):
+
+``# guarded-by: <lock>``
+    Trailing comment on an attribute's declaration (the ``self.x = ...``
+    in ``__init__``/``__post_init__``, or a class-level field). Declares
+    that methods of the class may only touch ``self.x`` inside a
+    ``with self.<lock>:`` block — the Clang Thread Safety Analysis
+    ``GUARDED_BY`` analogue, lexically checked.
+
+``# marlint: holds=<lock>``
+    Trailing comment on a ``def`` line: the caller is contractually
+    holding ``<lock>`` (TSA's ``REQUIRES``). The body is checked as if
+    inside the ``with`` block; call sites are NOT verified — name the
+    function ``*_locked`` so reviewers see the contract.
+
+``# donated-buffer``
+    Trailing comment on an attribute's declaration: the attribute holds
+    a DONATED device buffer (re-threaded through jitted donation-aliased
+    calls). ``jax.device_get``/``np.asarray`` on expressions mentioning
+    it are flagged repo-wide — on the CPU backend both return zero-copy
+    views that permanently disable the donation aliasing; ``np.array``
+    (an explicit copy) is the sanctioned fetch.
+
+``# timestamp-only``
+    Trailing comment on a line calling ``time.time()`` inside the
+    serving scope: the value is emitted as a wall-clock timestamp, never
+    used as a control input, so the deterministic-serving rule allows
+    it.
+
+``# marlint: disable=<rule>[,<rule>...]``
+    Per-line suppression. Policy (docs/static_analysis.md): a
+    suppression must ride with a human-readable reason in the same
+    comment block; prefer fixing. ``disable=all`` suppresses every rule
+    on the line.
+
+Baseline workflow: ``tools/marlint_baseline.json`` holds the keys of
+findings the repo has accepted (ideally none). ``analyze`` splits
+findings into new vs baselined and reports baseline entries whose
+finding no longer exists (STALE — the bug was fixed, drop the entry).
+Keys are semantic (rule/file/scope/symbol + occurrence index), not line
+numbers, so unrelated edits don't churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# -- annotation grammar ------------------------------------------------
+
+_DISABLE_RE = re.compile(r"marlint:\s*disable\s*=\s*([\w,\- ]+)")
+_HOLDS_RE = re.compile(r"marlint:\s*holds\s*=\s*(\w+)")
+_GUARDED_RE = re.compile(r"guarded-by:\s*(\w+)")
+_DONATED_RE = re.compile(r"\bdonated-buffer\b")
+_TIMESTAMP_RE = re.compile(r"\btimestamp-only\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``key`` is the stable baseline identity:
+    semantic anchor (scope + symbol), NOT the line number — unrelated
+    edits must not churn the baseline. ``line`` is for humans."""
+
+    rule: str
+    path: str       # repo-relative posix path
+    line: int
+    message: str
+    key: str
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed source file plus its marlint annotations, built once
+    and shared by every rule (the pass is parse-bound; rules are walks).
+    """
+
+    def __init__(self, path: Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        # line -> full comment text (tokenize, not a '#' scan: string
+        # literals containing '#' must not read as comments).
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            pass
+        self.suppressed: Dict[int, Set[str]] = {}
+        self.holds: Dict[int, str] = {}
+        self.guarded: Dict[int, str] = {}
+        # line -> comment text, annotation_on-compatible tables.
+        self.donated: Dict[int, str] = {}
+        self.timestamp_only: Dict[int, str] = {}
+        for ln, c in self.comments.items():
+            m = _DISABLE_RE.search(c)
+            if m:
+                self.suppressed[ln] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+            m = _HOLDS_RE.search(c)
+            if m:
+                self.holds[ln] = m.group(1)
+            m = _GUARDED_RE.search(c)
+            if m:
+                self.guarded[ln] = m.group(1)
+            if _DONATED_RE.search(c):
+                self.donated[ln] = c
+            if _TIMESTAMP_RE.search(c):
+                self.timestamp_only[ln] = c
+        self._expand_suppressions()
+
+    # Simple (non-compound) statements: a disable comment at the
+    # natural trailing position of a WRAPPED statement must cover the
+    # whole statement — findings anchor at the call's first line, the
+    # comment often lands on the last. Compound statements (def/if/
+    # with/...) are excluded: a comment inside a body must not
+    # suppress the body wholesale.
+    _SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AnnAssign, ast.AugAssign,
+                     ast.Return, ast.Raise, ast.Assert, ast.Delete)
+
+    def _expand_suppressions(self) -> None:
+        if not (self.suppressed or self.timestamp_only or self.donated):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, self._SIMPLE_STMTS):
+                continue
+            end = getattr(node, "end_lineno", None) or node.lineno
+            if end == node.lineno:
+                continue
+            span = range(node.lineno, end + 1)
+            sup: Set[str] = set()
+            for ln in span:
+                sup |= self.suppressed.get(ln, set())
+            if sup:
+                for ln in span:
+                    self.suppressed[ln] = \
+                        self.suppressed.get(ln, set()) | sup
+            # Annotation marks expand the same way: the comment's
+            # natural position is the wrapped statement's LAST line,
+            # the flagged/declared node's anchor is usually the first.
+            for table in (self.timestamp_only, self.donated):
+                mark = next((table[ln] for ln in span if ln in table),
+                            None)
+                if mark is not None:
+                    for ln in span:
+                        table.setdefault(ln, mark)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        sup = self.suppressed.get(line)
+        return bool(sup) and (rule in sup or "all" in sup)
+
+    def annotation_on(self, node: ast.AST, table: Dict[int, str]
+                      ) -> Optional[str]:
+        """Annotation attached to ``node``: a trailing comment on any
+        line the node's source spans (a declaration statement is almost
+        always one line; multi-line targets take the first hit)."""
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for ln in range(node.lineno, end + 1):
+            if ln in table:
+                return table[ln]
+        return None
+
+    def header_annotation(self, node, table: Dict[int, str]
+                          ) -> Optional[str]:
+        """Annotation on a ``def``'s HEADER lines only (the ``def`` line
+        through the line before the first body statement) — a
+        ``holds=`` comment buried in the body must not read as the
+        function's own contract."""
+        body = getattr(node, "body", None)
+        end = max(node.lineno,
+                  body[0].lineno - 1) if body else node.lineno
+        for ln in range(node.lineno, end + 1):
+            if ln in table:
+                return table[ln]
+        return None
+
+
+class AnalysisContext:
+    """Cross-file state shared by the two-phase run: rules ``collect``
+    over every file first (donated attribute names, the module index the
+    export rule resolves against), then ``check``."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        # attr name -> declaring rel path (donation-fetch collection)
+        self.donated_attrs: Dict[str, str] = {}
+        self._module_cache: Dict[Path, Optional[Set[str]]] = {}
+
+    def module_bindings(self, path: Path) -> Optional[Set[str]]:
+        """Top-level bound names of the module at ``path`` (defs,
+        classes, assigns, imports) — what ``from .mod import X`` can
+        legally name. None when the file is missing/unparseable."""
+        path = path.resolve()
+        if path not in self._module_cache:
+            self._module_cache[path] = self._bindings_of(path)
+        return self._module_cache[path]
+
+    @staticmethod
+    def _bindings_of(path: Path) -> Optional[Set[str]]:
+        if not path.is_file():
+            return None
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            return None
+        names: Set[str] = set()
+        AnalysisContext._collect_bindings(tree.body, names)
+        return names
+
+    @staticmethod
+    def _collect_bindings(stmts, names: Set[str]) -> None:
+        """Module-level bindings from a statement list, descending ONLY
+        through conditional/guarded containers (version shims:
+        ``if``/``try`` bodies still bind at module level) — never into
+        function/class bodies, whose names are locals/attributes."""
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            elif isinstance(node, ast.AnnAssign):
+                if isinstance(node.target, ast.Name):
+                    names.add(node.target.id)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    names.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    names.add(a.asname or a.name)
+            elif isinstance(node, ast.If):
+                AnalysisContext._collect_bindings(node.body, names)
+                AnalysisContext._collect_bindings(node.orelse, names)
+            elif isinstance(node, ast.Try):
+                AnalysisContext._collect_bindings(node.body, names)
+                for h in node.handlers:
+                    AnalysisContext._collect_bindings(h.body, names)
+                AnalysisContext._collect_bindings(node.orelse, names)
+                AnalysisContext._collect_bindings(node.finalbody, names)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                AnalysisContext._collect_bindings(node.body, names)
+
+
+class Rule:
+    """One invariant checker. Subclasses set ``name``/``description``
+    (and optionally ``paths``, fnmatch patterns against the repo-relative
+    posix path — empty means every scanned file) and implement
+    ``check``; ``collect`` is the optional cross-file first phase."""
+
+    name: str = ""
+    description: str = ""
+    paths: Tuple[str, ...] = ()
+
+    def applies(self, sf: SourceFile) -> bool:
+        if not self.paths:
+            return True
+        import fnmatch
+
+        return any(fnmatch.fnmatch(sf.rel, p) for p in self.paths)
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        pass
+
+    def check(self, sf: SourceFile,
+              ctx: AnalysisContext) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class KeyMaker:
+    """Stable baseline keys: ``rule::path::anchor[#n]`` with ``#n``
+    disambiguating repeated anchors in declaration order."""
+
+    def __init__(self):
+        self._seen: Dict[str, int] = {}
+
+    def key(self, rule: str, rel: str, anchor: str) -> str:
+        base = f"{rule}::{rel}::{anchor}"
+        n = self._seen.get(base, 0)
+        self._seen[base] = n + 1
+        return base if n == 0 else f"{base}#{n}"
+
+
+# -- AST helpers shared by rules --------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is exactly ``self.x``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# -- the run -----------------------------------------------------------
+
+DEFAULT_TARGETS = ("marlin_tpu", "benchlib", "tools")
+SKIP_PARTS = {"__pycache__", ".git", "node_modules"}
+
+
+def iter_py_files(root: Path, targets: Sequence[str]) -> List[Path]:
+    out: List[Path] = []
+    seen = set()  # overlapping targets must not analyze a file twice
+    for t in targets:
+        p = (root / t) if not Path(t).is_absolute() else Path(t)
+        cands: List[Path] = []
+        if p.is_file() and p.suffix == ".py":
+            cands = [p]
+        elif p.is_dir():
+            cands = sorted(f for f in p.rglob("*.py")
+                           if not (set(f.parts) & SKIP_PARTS))
+        for f in cands:
+            r = f.resolve()
+            if r not in seen:
+                seen.add(r)
+                out.append(f)
+    return out
+
+
+@dataclasses.dataclass
+class Report:
+    """One analysis run's outcome: every unsuppressed finding, split
+    against the baseline, plus parse failures (reported, never fatal —
+    a syntax error in one file must not hide findings in the rest)."""
+
+    findings: List[Finding]
+    new: List[Finding]
+    baselined: List[Finding]
+    stale: List[str]          # baseline keys with no matching finding
+    parse_errors: List[str]
+    n_files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale and not self.parse_errors
+
+    def as_dict(self) -> dict:
+        return {
+            "files": self.n_files,
+            "findings": [f.as_dict() for f in self.findings],
+            "new": [f.key for f in self.new],
+            "baselined": [f.key for f in self.baselined],
+            "stale_baseline_keys": list(self.stale),
+            "parse_errors": list(self.parse_errors),
+            "clean": self.clean,
+        }
+
+
+def load_baseline(path: Path) -> Set[str]:
+    doc = json.loads(Path(path).read_text())
+    keys = doc.get("keys", doc) if isinstance(doc, dict) else doc
+    if not isinstance(keys, list):
+        raise ValueError(f"baseline {path}: expected a key list")
+    return set(str(k) for k in keys)
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    doc = {
+        "comment": "marlint accepted-findings baseline; keys are "
+                   "semantic (rule::path::anchor), see "
+                   "docs/static_analysis.md. Keep this empty: fix or "
+                   "suppress-with-reason instead of baselining.",
+        "keys": sorted(f.key for f in findings),
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def analyze(root: Path, targets: Sequence[str], rules: Sequence[Rule],
+            baseline: Optional[Set[str]] = None) -> Report:
+    """Run ``rules`` over every .py file under ``targets``: parse once,
+    one cross-file ``collect`` phase, then per-file checks, suppression,
+    and the baseline split."""
+    root = Path(root).resolve()
+    files = iter_py_files(root, targets)
+    sources: List[SourceFile] = []
+    parse_errors: List[str] = []
+    for f in files:
+        rel = f.resolve().relative_to(root).as_posix() \
+            if f.resolve().is_relative_to(root) else f.as_posix()
+        try:
+            sources.append(SourceFile(f, rel, f.read_text()))
+        except SyntaxError as e:
+            parse_errors.append(f"{rel}: {e.msg} (line {e.lineno})")
+    ctx = AnalysisContext(root)
+    for rule in rules:
+        for sf in sources:
+            if rule.applies(sf):
+                rule.collect(sf, ctx)
+    findings: List[Finding] = []
+    for sf in sources:
+        for rule in rules:
+            if not rule.applies(sf):
+                continue
+            for fd in rule.check(sf, ctx):
+                if not sf.is_suppressed(fd.rule, fd.line):
+                    findings.append(fd)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    base = baseline or set()
+    new = [f for f in findings if f.key not in base]
+    old = [f for f in findings if f.key in base]
+    stale = sorted(base - {f.key for f in findings})
+    return Report(findings=findings, new=new, baselined=old, stale=stale,
+                  parse_errors=parse_errors, n_files=len(sources))
+
+
+def render_text(report: Report) -> str:
+    lines: List[str] = []
+    for f in report.new:
+        lines.append(f.text())
+    for f in report.baselined:
+        lines.append(f"{f.text()}  (baselined)")
+    for k in report.stale:
+        lines.append(f"STALE baseline entry (finding no longer exists; "
+                     f"remove it): {k}")
+    for e in report.parse_errors:
+        lines.append(f"PARSE ERROR: {e}")
+    lines.append(
+        f"marlint: {report.n_files} files, "
+        f"{len(report.new)} new / {len(report.baselined)} baselined "
+        f"finding(s), {len(report.stale)} stale baseline entr(y/ies)")
+    return "\n".join(lines)

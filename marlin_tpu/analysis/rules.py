@@ -1,0 +1,673 @@
+"""marlint rules: each mechanizes an invariant a real prior bug
+established (the Tricorder doctrine — project-specific checks earn
+their keep; PAPERS.md). Rule docstrings cite the originating bug; the
+fixture tests in tests/test_analysis.py re-introduce each bug and pin
+that the rule names it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (AnalysisContext, Finding, KeyMaker, Rule, SourceFile,
+                   dotted_name, self_attr)
+
+
+def _walk_scopes(tree: ast.AST):
+    """Yield (node, scope_stack) for every node, tracking the enclosing
+    class/function chain."""
+    stack: List[ast.AST] = []
+
+    def rec(node):
+        yield node, tuple(stack)
+        push = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))
+        if push:
+            stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child)
+        if push:
+            stack.pop()
+
+    yield from rec(tree)
+
+
+def _scope_name(stack) -> str:
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(names) or "<module>"
+
+
+def _scope_walk(body):
+    """Walk the nodes belonging to ONE scope: descend through plain
+    statements/expressions but never into nested function/class bodies
+    (those are their own scopes)."""
+    todo = list(body)
+    while todo:
+        n = todo.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        todo.extend(ast.iter_child_nodes(n))
+
+
+class DonationFetchRule(Rule):
+    """PR 2's zero-copy-view bug: on the CPU backend ``jax.device_get``
+    (and ``np.asarray``) return a ZERO-COPY view of the fetched buffer,
+    which marks it externally referenced and permanently disables the
+    donation aliasing every later round/admission relies on — the
+    engine silently reallocates per step. ``np.array`` (an explicit
+    copy) is the sanctioned fetch. Buffers are declared with a
+    ``# donated-buffer`` annotation on their assignment; this rule
+    flags ``jax.device_get``/``np.asarray`` whose argument mentions a
+    declared attribute name — in any file, so a frontend touching
+    ``eng._buf`` is covered by the engine's declaration."""
+
+    name = "donation-fetch"
+    description = ("jax.device_get/np.asarray on a # donated-buffer "
+                   "attribute (zero-copy view kills donation aliasing); "
+                   "fetch with np.array")
+
+    _FETCHERS = {"jax.device_get", "device_get", "np.asarray",
+                 "numpy.asarray"}
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        if not sf.donated:
+            return
+        for node in ast.walk(sf.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            if not targets:
+                continue
+            if sf.annotation_on(node, sf.donated) is None:
+                continue
+            for t in targets:
+                attr = self_attr(t)
+                if attr is None and isinstance(t, ast.Name):
+                    attr = t.id
+                if attr:
+                    ctx.donated_attrs.setdefault(attr, sf.rel)
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        if not ctx.donated_attrs:
+            return []
+        km = KeyMaker()
+        out: List[Finding] = []
+        for node, stack in _walk_scopes(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if fn not in self._FETCHERS:
+                continue
+            hit: Optional[str] = None
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr in ctx.donated_attrs):
+                        hit = sub.attr
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            scope = _scope_name(stack)
+            out.append(Finding(
+                rule=self.name, path=sf.rel, line=node.lineno,
+                message=(
+                    f"{fn}() on donated buffer `.{hit}` (declared "
+                    f"donated-buffer in {ctx.donated_attrs[hit]}): a "
+                    f"CPU zero-copy view permanently disables donation "
+                    f"aliasing — fetch with np.array(...) instead"),
+                key=km.key(self.name, sf.rel, f"{scope}:{hit}")))
+        return out
+
+
+class GuardedByRule(Rule):
+    """The race class PR 5/6/7 review-hardening fixed three separate
+    times: shared engine/frontend state touched off the documented
+    lock. Attributes declared ``# guarded-by: <lock>`` may only be
+    read or written inside a ``with self.<lock>:`` block in methods of
+    the declaring class (``__init__``/``__post_init__`` are
+    construction — exempt). ``# marlint: holds=<lock>`` on a ``def``
+    asserts the caller holds the lock (Clang TSA's REQUIRES); call
+    sites are not verified — name such helpers ``*_locked``. Accesses
+    through other objects (``eng.requests`` from the frontend) are out
+    of scope: the declaring class owns the discipline."""
+
+    name = "guarded-by"
+    description = ("# guarded-by: <lock> attribute touched outside "
+                   "`with self.<lock>:` in the declaring class")
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        km = KeyMaker()
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(sf, node, km))
+        return out
+
+    def _class_decls(self, sf: SourceFile,
+                     cls: ast.ClassDef) -> Dict[str, str]:
+        guard_table = sf.guarded
+        decls: Dict[str, str] = {}
+
+        def scan_stmt(stmt):
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            if not targets:
+                return
+            lock = sf.annotation_on(stmt, guard_table)
+            if lock is None:
+                return
+            for t in targets:
+                attr = self_attr(t)
+                if attr is None and isinstance(t, ast.Name):
+                    attr = t.id  # class-level / dataclass field
+                if attr:
+                    decls[attr] = lock
+
+        for stmt in cls.body:
+            scan_stmt(stmt)
+            if (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name in ("__init__", "__post_init__")):
+                for sub in ast.walk(stmt):
+                    scan_stmt(sub)
+        return decls
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
+                     km: KeyMaker) -> List[Finding]:
+        decls = self._class_decls(sf, cls)
+        if not decls:
+            return []
+        out: List[Finding] = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if stmt.name in ("__init__", "__post_init__"):
+                continue
+            held: Set[str] = set()
+            # HEADER lines only: a holds= comment buried in the body
+            # (e.g. on a nested def) must not exempt the whole method.
+            h = sf.header_annotation(stmt, sf.holds)
+            if h:
+                held.add(h)
+            self._check_body(sf, cls, stmt, stmt.body, decls, held, km,
+                             out)
+        return out
+
+    def _with_locks(self, node) -> Set[str]:
+        locks: Set[str] = set()
+        for item in node.items:
+            attr = self_attr(item.context_expr)
+            if attr:
+                locks.add(attr)
+        return locks
+
+    def _check_body(self, sf, cls, func, body, decls, held, km, out):
+        for stmt in body:
+            self._check_node(sf, cls, func, stmt, decls, held, km, out)
+
+    def _check_node(self, sf, cls, func, node, decls, held, km, out):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._check_node(sf, cls, func, item.context_expr,
+                                 decls, held, km, out)
+                if item.optional_vars is not None:
+                    self._check_node(sf, cls, func, item.optional_vars,
+                                     decls, held, km, out)
+            inner = held | self._with_locks(node)
+            self._check_body(sf, cls, func, node.body, decls, inner, km,
+                             out)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested def may escape the lock scope (run on another
+            # thread, after release): only its own holds= annotation
+            # (header lines) counts. Lambdas stay in the enclosing held
+            # set — they are overwhelmingly immediate (sort keys,
+            # comprehension args).
+            inner: Set[str] = set()
+            h = sf.header_annotation(node, sf.holds)
+            if h:
+                inner.add(h)
+            self._check_body(sf, cls, func, node.body, decls, inner, km,
+                             out)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = self_attr(node)
+            if attr in decls and decls[attr] not in held:
+                lock = decls[attr]
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=(
+                        f"self.{attr} (guarded-by {lock}) touched "
+                        f"outside `with self.{lock}:` in "
+                        f"{cls.name}.{func.name}"),
+                    key=km.key(self.name, sf.rel,
+                               f"{cls.name}.{func.name}:{attr}")))
+            # still recurse: self.a.b chains
+        for child in ast.iter_child_nodes(node):
+            self._check_node(sf, cls, func, child, decls, held, km, out)
+
+
+class DeterministicServingRule(Rule):
+    """The replay/bit-exactness contract (docs/robustness.md): every
+    output and every fault is a pure function of (workload, seed,
+    plan) — which is what makes crash recovery provable and chaos runs
+    replayable. Nondeterminism as a CONTROL input breaks it silently:
+    ``random.*``/``np.random.*`` draws and ``time.time()`` consulted
+    for decisions. Per-request randomness must come from the
+    ``fold_in(seed, request_id)`` PRNG streams; backoff jitter from
+    deterministic hashes (tools/serving_client.RetryPolicy's crc32);
+    wall-clock emitted as a log field is fine — annotate the line
+    ``# timestamp-only``. ``time.perf_counter`` (measurement and the
+    wall-clock deadline currency) stays allowed: deadlines are part of
+    the workload, not hidden state."""
+
+    name = "deterministic-serving"
+    description = ("random.*/np.random.* or bare time.time() in the "
+                   "serving/replay scope (bit-exact-replay contract)")
+    paths = ("marlin_tpu/serving/*", "tools/serving_client.py")
+
+    _CLOCKS = {"time.time", "time.time_ns"}
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        km = KeyMaker()
+        out: List[Finding] = []
+        for node, stack in _walk_scopes(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if fn is None:
+                continue
+            scope = _scope_name(stack)
+            if fn in ("random.Random", "np.random.default_rng",
+                      "numpy.random.default_rng") and node.args and \
+                    isinstance(node.args[0], ast.Constant):
+                # A SEEDED generator is deterministic — the sanctioned
+                # way to build synthetic workloads (serving_client's
+                # load CLI). Only ambient draws break replay.
+                continue
+            if fn.startswith("random.") or fn.startswith("np.random.") \
+                    or fn.startswith("numpy.random."):
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=(
+                        f"{fn}() in the serving scope: replay "
+                        f"bit-exactness requires per-request PRNG "
+                        f"streams (fold_in(seed, request_id)) or "
+                        f"deterministic hashes, never ambient RNG"),
+                    key=km.key(self.name, sf.rel, f"{scope}:{fn}")))
+            elif fn in self._CLOCKS:
+                if sf.annotation_on(node, sf.timestamp_only):
+                    continue
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=(
+                        f"{fn}() in the serving scope: wall-clock as a "
+                        f"control input breaks replay; use "
+                        f"time.perf_counter() for durations/deadlines, "
+                        f"or annotate a pure log-field emit with "
+                        f"`# timestamp-only`"),
+                    key=km.key(self.name, sf.rel, f"{scope}:{fn}")))
+        return out
+
+
+class RetraceHazardRule(Rule):
+    """Host conversions inside a ``jax.jit`` body either fail under
+    tracing or — worse — silently bake a traced value into a Python
+    constant at trace time and go stale thereafter; clock reads inside
+    a jit body execute once at trace time, not per call (the compile
+    watchdog's dynamic cousin, obs/watch.py). Flags ``.item()``,
+    ``float()/int()/bool()`` on traced expressions, and ``time.*``
+    calls inside jit-decorated functions (including inner cond/body
+    defs, which are traced too). Arguments named in
+    ``static_argnames`` are concrete Python values — conversions of
+    those (and of ``.shape``/``len()`` expressions, static under
+    tracing) are exempt."""
+
+    name = "retrace-hazard"
+    description = (".item()/float()/int()/bool() on traced values or "
+                   "time.* inside a jax.jit body")
+
+    _CONVERTERS = {"float", "int", "bool", "complex"}
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        jitted = self._jitted_functions(sf.tree)
+        km = KeyMaker()
+        out: List[Finding] = []
+        for fn, static in jitted:
+            label = getattr(fn, "name", "<lambda>")
+            statics = set(static)
+            if isinstance(fn, ast.Lambda):
+                body_iter = ast.walk(fn.body)
+            else:
+                body_iter = (n for st in fn.body for n in ast.walk(st))
+            for node in body_iter:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not node.args):
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=node.lineno,
+                        message=(
+                            f".item() inside jit body `{label}`: host "
+                            f"sync under tracing (ConcretizationError "
+                            f"or a trace-time constant)"),
+                        key=km.key(self.name, sf.rel, f"{label}:item")))
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in self._CONVERTERS
+                      and len(node.args) == 1
+                      and not self._is_static_expr(node.args[0], statics)):
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=node.lineno,
+                        message=(
+                            f"{node.func.id}() on a (possibly traced) "
+                            f"value inside jit body `{label}`: bakes a "
+                            f"trace-time constant or raises under "
+                            f"tracing; keep it an array op or hoist to "
+                            f"the host"),
+                        key=km.key(self.name, sf.rel,
+                                   f"{label}:{node.func.id}")))
+                elif name and name.startswith("time."):
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=node.lineno,
+                        message=(
+                            f"{name}() inside jit body `{label}`: "
+                            f"executes ONCE at trace time, not per "
+                            f"call — time on the host around the "
+                            f"dispatch instead"),
+                        key=km.key(self.name, sf.rel, f"{label}:{name}")))
+        return out
+
+    @staticmethod
+    def _is_static_expr(node: ast.AST, statics: Set[str]) -> bool:
+        """Conservatively static under tracing: every Name reached
+        OUTSIDE a shape/len subtree must be a static_argnames binding
+        (shape/len expressions are concrete during tracing; a traced
+        value MIXED into the arithmetic still makes the whole
+        conversion a hazard)."""
+        traced_names: List[str] = []
+
+        def visit(n: ast.AST, in_static: bool) -> None:
+            if isinstance(n, ast.Attribute) and n.attr in (
+                    "shape", "ndim", "size", "dtype"):
+                in_static = True
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Name) and n.func.id == "len":
+                in_static = True
+            elif isinstance(n, ast.Name) and not in_static:
+                traced_names.append(n.id)
+            for c in ast.iter_child_nodes(n):
+                visit(c, in_static)
+
+        visit(node, False)
+        return all(n in statics for n in traced_names)
+
+    def _jitted_functions(self, tree: ast.AST
+                          ) -> List[Tuple[ast.AST, Tuple[str, ...]]]:
+        """(function node, static_argnames) for every function the file
+        jits: decorator forms (``@jax.jit``, ``@functools.partial(
+        jax.jit, ...)``), call forms (``jax.jit(f)``, ``functools.
+        partial(jax.jit, ...)(f)`` with local ``f``), and jitted
+        lambdas."""
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        out: List[Tuple[ast.AST, Tuple[str, ...]]] = []
+        seen: Set[int] = set()
+
+        def add(fn, static):
+            if fn is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((fn, tuple(static)))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    st = self._jit_decorator_statics(dec)
+                    if st is not None:
+                        add(node, st)
+            elif isinstance(node, ast.Call):
+                st = self._jit_call_statics(node)
+                if st is None:
+                    continue
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id in defs:
+                        # Nearest PRECEDING def of that name: the
+                        # `def f(): ...; return jax.jit(f)` closure
+                        # idiom repeats `f` per enclosing factory.
+                        cands = [d for d in defs[arg.id]
+                                 if d.lineno <= node.lineno]
+                        add(max(cands, key=lambda d: d.lineno)
+                            if cands else defs[arg.id][-1], st)
+                    elif isinstance(arg, ast.Lambda):
+                        add(arg, st)
+        return out
+
+    @staticmethod
+    def _static_names(call: ast.Call) -> List[str]:
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                vals = []
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, str):
+                        vals.append(n.value)
+                return vals
+        return []
+
+    def _jit_decorator_statics(self, dec) -> Optional[List[str]]:
+        """static_argnames when ``dec`` is a jit decorator, else None."""
+        if dotted_name(dec) in ("jax.jit", "jit"):
+            return []
+        if isinstance(dec, ast.Call):
+            return self._jit_call_statics(dec)
+        return None
+
+    def _jit_call_statics(self, call: ast.Call) -> Optional[List[str]]:
+        """static_argnames when ``call`` applies jit — ``jax.jit(...)``
+        or ``functools.partial(jax.jit, ...)(...)`` — else None."""
+        fn = dotted_name(call.func)
+        if fn in ("jax.jit", "jit"):
+            return self._static_names(call)
+        if fn in ("functools.partial", "partial") and call.args and \
+                dotted_name(call.args[0]) in ("jax.jit", "jit"):
+            return self._static_names(call)
+        # functools.partial(jax.jit, ...)(f): func is itself that Call
+        if isinstance(call.func, ast.Call):
+            inner = self._jit_call_statics(call.func)
+            if inner is not None:
+                return inner
+        return None
+
+
+class ExecLoaderRule(Rule):
+    """PR 7's dataclass-annotation crash: a by-path module loader
+    (``importlib.util.module_from_spec`` + ``spec.loader.exec_module``,
+    or ``exec(compile(...))``) that does not register the module in
+    ``sys.modules`` BEFORE executing it. Dataclasses resolve string
+    annotations via ``sys.modules[cls.__module__]`` at class-creation
+    time — a by-path module with any dataclass crashes with a KeyError
+    unless the registration precedes the exec (the importlib
+    contract)."""
+
+    name = "exec-loader"
+    description = ("exec_module()/exec(compile()) without a prior "
+                   "sys.modules[...] registration in the same scope")
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        km = KeyMaker()
+        out: List[Finding] = []
+        # A bare ``modules[...] = mod`` only counts as a registration
+        # when the file actually does ``from sys import modules`` — an
+        # unrelated local dict named "modules" must not vouch.
+        reg_names = {"sys.modules"}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "sys":
+                for a in node.names:
+                    if a.name == "modules":
+                        reg_names.add(a.asname or "modules")
+        scopes: List[Tuple[str, List[ast.stmt]]] = [
+            ("<module>", sf.tree.body)]
+        for node, stack in _walk_scopes(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((_scope_name(stack + (node,)), node.body))
+        for scope, body in scopes:
+            regs: List[int] = []   # lines assigning sys.modules[...]
+            execs: List[Tuple[int, str]] = []
+            for sub in _scope_walk(body):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Subscript)
+                                and dotted_name(t.value) in reg_names):
+                            regs.append(sub.lineno)
+                if isinstance(sub, ast.Call):
+                    fn = dotted_name(sub.func)
+                    if (isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "exec_module"):
+                        execs.append((sub.lineno, "exec_module"))
+                    elif fn == "exec" and sub.args and \
+                            isinstance(sub.args[0], ast.Call) and \
+                            dotted_name(sub.args[0].func) == "compile":
+                        execs.append((sub.lineno, "exec(compile)"))
+            for line, kind in execs:
+                if any(r < line for r in regs):
+                    continue
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=line,
+                    message=(
+                        f"{kind} without a prior `sys.modules[name] = "
+                        f"mod` in {scope}: dataclasses in the loaded "
+                        f"module resolve string annotations via "
+                        f"sys.modules[cls.__module__] — register "
+                        f"BEFORE exec (the importlib contract)"),
+                    key=km.key(self.name, sf.rel, f"{scope}:{kind}")))
+        return out
+
+
+class ExportIntegrityRule(Rule):
+    """Dead-export sweep: every name in an ``__init__.py``'s
+    ``__all__`` must be bound in that module, and every
+    ``from .mod import X`` re-export must name something ``mod``
+    actually binds at top level. A stale export is a latent ImportError
+    that only fires on the (rare) path that touches it — or worse, on
+    ``from pkg import *``."""
+
+    name = "export-integrity"
+    description = ("__all__ entry or relative re-export that does not "
+                   "resolve (stale export)")
+    paths = ("*__init__.py",)
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        km = KeyMaker()
+        out: List[Finding] = []
+        bound = ctx.module_bindings(sf.path) or set()
+        pkg_dir = sf.path.parent
+        # -- __all__ entries resolve locally
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets):
+                for elt in getattr(node.value, "elts", []):
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str) and \
+                            elt.value not in bound:
+                        out.append(Finding(
+                            rule=self.name, path=sf.rel,
+                            line=elt.lineno,
+                            message=(f"__all__ names {elt.value!r} but "
+                                     f"the module never binds it "
+                                     f"(stale export)"),
+                            key=km.key(self.name, sf.rel,
+                                       f"__all__:{elt.value}")))
+        # -- relative re-exports resolve in the sibling module
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ImportFrom) or not node.level:
+                continue
+            base = pkg_dir
+            for _ in range(node.level - 1):
+                base = base.parent
+            mod_parts = (node.module or "").split(".") if node.module \
+                else []
+            target = base.joinpath(*mod_parts) if mod_parts else base
+            if node.module is None:
+                # from . import x — x must be a real submodule (the
+                # import statement itself binds x, so the local binding
+                # set cannot vouch for it).
+                for a in node.names:
+                    if ((target / f"{a.name}.py").is_file()
+                            or (target / a.name / "__init__.py").is_file()):
+                        continue
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=node.lineno,
+                        message=(f"`from . import {a.name}`: no "
+                                 f"submodule {a.name!r} in "
+                                 f"{base.name}/ (stale export)"),
+                        key=km.key(self.name, sf.rel,
+                                   f"import:{a.name}")))
+                continue
+            mod_file = target.with_suffix(".py")
+            if not mod_file.is_file():
+                mod_file = target / "__init__.py"
+            names = ctx.module_bindings(mod_file)
+            if names is None:
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=(f"relative import target "
+                             f"{node.module!r} not found next to "
+                             f"{sf.rel}"),
+                    key=km.key(self.name, sf.rel,
+                               f"module:{node.module}")))
+                continue
+            for a in node.names:
+                if a.name == "*" or a.name in names:
+                    continue
+                if mod_file.name == "__init__.py" and (
+                        (target / f"{a.name}.py").is_file()
+                        or (target / a.name / "__init__.py").is_file()):
+                    # `from .pkg import submod`: a package target may
+                    # legitimately export a SUBMODULE rather than a
+                    # binding of its __init__.
+                    continue
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=(f"`from .{node.module} import {a.name}`: "
+                             f"{node.module} never binds {a.name!r} at "
+                             f"top level (stale export)"),
+                    key=km.key(self.name, sf.rel,
+                               f"{node.module}:{a.name}")))
+        return out
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    DonationFetchRule(),
+    GuardedByRule(),
+    DeterministicServingRule(),
+    RetraceHazardRule(),
+    ExecLoaderRule(),
+    ExportIntegrityRule(),
+)
+
+
+def rules_by_name(names=None) -> List[Rule]:
+    if not names:
+        return list(ALL_RULES)
+    table = {r.name: r for r in ALL_RULES}
+    missing = [n for n in names if n not in table]
+    if missing:
+        raise ValueError(
+            f"unknown rule(s) {missing}; known: {sorted(table)}")
+    return [table[n] for n in names]

@@ -230,7 +230,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
 
     def _family(self, kind: str, name: str,
                 buckets: Optional[Sequence[float]] = None,

@@ -74,20 +74,23 @@ class Tracer:
         if exemplar_k < 0:
             raise ValueError(f"exemplar_k must be >= 0, got {exemplar_k}")
         self._enabled = bool(enabled)
-        self._events: deque = deque(maxlen=max_events)
+        self._events: deque = deque(maxlen=max_events)  # guarded-by: _lock
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._epoch_ns = time.perf_counter_ns()
         self.sample_rate = float(sample_rate)
-        self._roots_seen = 0  # deterministic root-sampling counter
+        # Deterministic root-sampling counter.
+        self._roots_seen = 0  # guarded-by: _lock
         # Tail-exemplar reservoir (module docstring): slowest-k finished
         # requests' complete span lists, plus the per-request staging
         # area request_id-attributed spans land in until finish_request
         # decides their fate.
         self.exemplar_k = int(exemplar_k)
-        self._exemplar_heap: List[tuple] = []  # (total_s, seq, id, spans)
-        self._exemplar_seq = 0  # heap tiebreak: spans never compare
-        self._staged: "OrderedDict[str, List[dict]]" = OrderedDict()
+        # (total_s, seq, id, spans); seq tiebreak — spans never compare.
+        self._exemplar_heap: List[tuple] = []  # guarded-by: _lock
+        self._exemplar_seq = 0  # guarded-by: _lock
+        self._staged: "OrderedDict[str, List[dict]]" = \
+            OrderedDict()  # guarded-by: _lock
 
     # -- switches -----------------------------------------------------
 
@@ -215,7 +218,7 @@ class Tracer:
 
     # -- tail exemplars -----------------------------------------------
 
-    def _stage_locked(self, request_id: str, ev: dict) -> None:
+    def _stage_locked(self, request_id: str, ev: dict) -> None:  # marlint: holds=_lock
         lst = self._staged.get(request_id)
         if lst is None:
             while len(self._staged) >= _EXEMPLAR_STAGING_CAP:

@@ -305,7 +305,7 @@ class ServingEngine:
         # Pending + active requests ONLY: finished/timed-out requests
         # are returned from step()/run() and dropped here, so a
         # long-running engine holds O(batch + max_pending) requests.
-        self.requests: Dict[int, Request] = {}
+        self.requests: Dict[int, Request] = {}  # guarded-by: _submit_lock
         # Concurrent-submitter safety (the HTTP frontend's handler
         # threads call submit() while the driver thread steps): this
         # lock makes id allocation + queue submit + requests-dict insert
@@ -318,7 +318,7 @@ class ServingEngine:
         self._drain_reported = False
         # In-flight chunked admissions (row -> job); empty in the
         # default one-shot mode.
-        self._prefilling: Dict[int, _PrefillJob] = {}
+        self._prefilling: Dict[int, _PrefillJob] = {}  # guarded-by: _submit_lock
         # Crash-consistency ledger for the supervisor (frontend.py):
         # requests RESOLVED this step (retired with output, or expired)
         # whose ownership has not yet transferred out through step()'s
@@ -335,9 +335,13 @@ class ServingEngine:
         self._admitting_rid: Optional[int] = None
         # Device state. Free rows sit at filled=1 over a zero buffer so
         # the frozen feed (buf[row, 0] at position 0) is well-defined
-        # dead state; target=0 keeps them done from round one.
-        self._cache = init_kv_cache(cfg, batch, dtype=cfg.compute_dtype)
-        self._buf = jnp.zeros((batch, cfg.max_len), jnp.int32)
+        # dead state; target=0 keeps them done from round one. Both are
+        # re-threaded through the donation-aliased jitted entry points
+        # every round/admission — host fetches MUST be np.array copies
+        # (marlint donation-fetch, docs/static_analysis.md).
+        self._cache = init_kv_cache(cfg, batch,
+                                    dtype=cfg.compute_dtype)  # donated-buffer
+        self._buf = jnp.zeros((batch, cfg.max_len), jnp.int32)  # donated-buffer
         self._filled = np.ones((batch,), np.int32)
         self._target = np.zeros((batch,), np.int32)
         self._active = np.zeros((batch,), bool)
@@ -424,11 +428,19 @@ class ServingEngine:
         already determined there): submit stays a pure-host path with no
         device dispatch, and requests that time out in the queue never
         pay one. Derived from the id via fold_in, not from a shared
-        mutable key, so no other submission can shift it."""
-        req.key = np.asarray(
-            jax.random.fold_in(self._base_key, req.request_id))
-        k_first, k_decode = jax.random.split(jnp.asarray(req.key))
-        return np.asarray(k_first), np.asarray(k_decode)
+        mutable key, so no other submission can shift it.
+
+        The ``transfer_guard("allow")`` scope SANCTIONS this site's
+        implicit transfer (fold_in pushes the id scalar host->device,
+        once per admission — bounded, not a hot-loop leak), so serving
+        smoke tests can run the whole engine loop under
+        ``obs.watch.no_transfers()`` and still catch an accidental
+        implicit transfer anywhere else in the round path."""
+        with jax.transfer_guard("allow"):
+            req.key = np.asarray(
+                jax.random.fold_in(self._base_key, req.request_id))
+            k_first, k_decode = jax.random.split(jnp.asarray(req.key))
+            return np.asarray(k_first), np.asarray(k_decode)
 
     def _activate_row(self, req: Request, row: int, k_decode) -> None:
         """Shared admission epilogue: the row's prompt K/V and first
@@ -492,12 +504,17 @@ class ServingEngine:
             with self.tracer.span("serving.admit", scope=False,
                                   request_id=req.request_id, row=row,
                                   prompt_len=s):
-                self._cache, self._buf, _, _ = prefill_into_row(
-                    self.params, self._cache, self._buf,
-                    jnp.int32(row),
-                    jnp.asarray(padded), jnp.int32(s),
-                    jnp.asarray(k_first), cfg=self.cfg,
-                    temperature=self.temperature)
+                # transfer_guard("allow"): the admission dispatch IS a
+                # sanctioned host->device site (prompt + row scalars up,
+                # once per admission) — scoping it keeps the decode
+                # round guardable by obs.watch.no_transfers().
+                with jax.transfer_guard("allow"):
+                    self._cache, self._buf, _, _ = prefill_into_row(
+                        self.params, self._cache, self._buf,
+                        jnp.int32(row),
+                        jnp.asarray(padded), jnp.int32(s),
+                        jnp.asarray(k_first), cfg=self.cfg,
+                        temperature=self.temperature)
             self._admitting_rid = None
             req.prefill_s += time.perf_counter() - t0
             self.stats.calibration.record(
@@ -528,8 +545,12 @@ class ServingEngine:
             if req is None:
                 break
             self._start_prefill(req)
-        for row in sorted(self._prefilling):  # deterministic order
-            job = self._prefilling[row]
+        # Snapshot under the lock (handler threads iterate _prefilling
+        # in debug_snapshot); the driver is the only mutator, so the
+        # snapshot stays exact for the loop below.
+        with self._submit_lock:
+            jobs = sorted(self._prefilling.items())  # deterministic order
+        for row, job in jobs:
             for _ in range(self.prefill_chunks_per_round):
                 self._advance_chunk(job)
                 if job.done:
@@ -563,7 +584,10 @@ class ServingEngine:
                 with self.tracer.span("serving.prefix_copy",
                                       scope=False,
                                       request_id=req.request_id,
-                                      row=row, hit_len=hit):
+                                      row=row, hit_len=hit), \
+                        jax.transfer_guard("allow"):
+                    # Sanctioned admission-site pushes (row scalars);
+                    # see _admit_oneshot.
                     self._cache = self.prefix_cache.load_into(
                         self._cache, row, hit_row, hit)
                 self._admitting_rid = None
@@ -612,7 +636,10 @@ class ServingEngine:
                      request_id=req.request_id)
         with self.tracer.span("serving.admit_chunk", scope=False,
                               request_id=req.request_id, row=job.row,
-                              start=c0, chunk_len=clen, final=final):
+                              start=c0, chunk_len=clen, final=final), \
+                jax.transfer_guard("allow"):
+            # transfer_guard("allow"): sanctioned admission-site
+            # host->device pushes (see _admit_oneshot).
             if final:
                 padded = np.zeros((pad_prompt_len(s),), np.int32)
                 padded[:s] = req.prompt
@@ -648,8 +675,11 @@ class ServingEngine:
         if self.prefix_cache is not None:
             # The row now holds canonical-path K/V for the whole prompt
             # — store its 16-aligned prefix so later admissions of the
-            # same system prompt copy instead of recompute.
-            self.prefix_cache.store_from(self._cache, job.row, req.prompt)
+            # same system prompt copy instead of recompute. Sanctioned
+            # admission-site pushes (row scalars); see _admit_oneshot.
+            with jax.transfer_guard("allow"):
+                self.prefix_cache.store_from(self._cache, job.row,
+                                             req.prompt)
         self.runlog.emit(
             "admit", request_id=req.request_id, row=job.row,
             round=self.round_idx, prompt_len=req.prompt_len,
@@ -673,8 +703,14 @@ class ServingEngine:
         # pointer-pin test catches this).
         with self.tracer.span("serving.retire", scope=False, rows=len(rows)):
             buf_host = np.array(self._buf)
+        # One locked snapshot of the owners (handler threads insert into
+        # ``requests`` concurrently via submit); the rows being retired
+        # are driver-owned, so their entries cannot change under us.
+        with self._submit_lock:
+            owners = {row: self.requests[self.slots.owner_of(row)]
+                      for row in rows}
         for row in rows:
-            req = self.requests[self.slots.owner_of(row)]
+            req = owners[row]
             s = req.prompt_len
             out = buf_host[row, s:s + req.steps].copy()
             emitted = min(int(filled[row]) - s, req.steps)
@@ -797,9 +833,10 @@ class ServingEngine:
                     f"outside [1, {self.cfg.max_len}]: "
                     f"{self._filled.tolist()}")
             self._keys = np.array(keys, np.uint32)
-            for row in self.slots.occupied_rows():
-                self.requests[self.slots.owner_of(row)].live_iters += \
-                    int(live[row])
+            with self._submit_lock:  # concurrent submit() inserts
+                for row in self.slots.occupied_rows():
+                    self.requests[self.slots.owner_of(row)].live_iters \
+                        += int(live[row])
             occupied = self.slots.n_occupied  # pre-retire, as decoded
             self.stats.record_round(
                 self.round_idx, int(iters), occupied=occupied,
@@ -815,13 +852,15 @@ class ServingEngine:
                              new_compiles=rec.new_compiles)
         self.metrics.gauge("serving_queue_depth").set(len(self.queue))
         live_sum = int(live.sum())
+        with self._submit_lock:
+            n_prefilling = len(self._prefilling)
         faults.check("runlog_emit", round_idx=self.round_idx)
         self.runlog.emit(
             "round", round=self.round_idx, iters=int(iters),
             occupied=occupied, live_iters=live_sum,
             admitted=self.stats.n_admitted - admitted0,
             retired=len(finished), expired=len(expired),
-            prefilling=len(self._prefilling),
+            prefilling=n_prefilling,
             queue_depth=len(self.queue),
             wasted_row_iters=int(iters) * self.batch - live_sum,
             round_s=round(time.perf_counter() - t_round0, 6),

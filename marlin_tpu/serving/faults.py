@@ -138,7 +138,7 @@ class FaultPlan:
     JSON (:meth:`from_json`); activate with :func:`install`."""
 
     def __init__(self, specs: Optional[List[FaultSpec]] = None):
-        self.specs: List[FaultSpec] = list(specs or [])
+        self.specs: List[FaultSpec] = list(specs or [])  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add(self, **kw) -> FaultSpec:

@@ -221,9 +221,11 @@ class EngineFrontend:
         self.poison_after = int(poison_after)
         self.metrics = engine.metrics
         self.restarts = 0  # lifetime successful engine rebuilds
-        self._crash_times: deque = deque()  # sliding restart window
+        # Sliding restart window; mutated by the driver's _recover,
+        # read by handler-thread debug views.
+        self._crash_times: deque = deque()  # guarded-by: _lock
         self._undelivered: List = []  # last step's un-fanned-out work
-        self._handles: Dict[int, FrontendRequest] = {}
+        self._handles: Dict[int, FrontendRequest] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._draining = threading.Event()
@@ -441,20 +443,21 @@ class EngineFrontend:
         for req in list(self._undelivered) + leftovers:
             self._deliver(req, now)
         self._undelivered = []
-        # 2. Restart budget: a sliding window, so one crash a day never
-        #    accumulates into a fail-closed verdict.
-        self._crash_times.append(now)
-        horizon = now - self.restart_window_s
-        while self._crash_times and self._crash_times[0] < horizon:
-            self._crash_times.popleft()
-        fail_closed = len(self._crash_times) > self.max_restarts
         poisoned: List = []
         poisoned_handles: List = []
         err: Optional[EngineFailed] = None
-        # 3. Capture + swap, atomic vs submit() (same lock): a
-        #    concurrent submission lands wholly in the captured set or
-        #    wholly in the successor.
+        # 2+3. Restart budget and capture + swap, atomic vs submit()
+        #    (same lock): a concurrent submission lands wholly in the
+        #    captured set or wholly in the successor; the sliding
+        #    crash window (one crash a day never accumulates into a
+        #    fail-closed verdict) mutates under the same lock the
+        #    debug views read it on.
         with self._lock:
+            self._crash_times.append(now)
+            horizon = now - self.restart_window_s
+            while self._crash_times and self._crash_times[0] < horizon:
+                self._crash_times.popleft()
+            fail_closed = len(self._crash_times) > self.max_restarts
             with eng._submit_lock:
                 captured = sorted(eng.requests.values(),
                                   key=lambda r: r.request_id)

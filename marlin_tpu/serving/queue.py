@@ -193,8 +193,8 @@ class AdmissionQueue:
     safe under concurrent submitters (module docstring)."""
 
     max_pending: int = 64
-    _q: deque = field(default_factory=deque)
-    _closed: bool = False
+    _q: deque = field(default_factory=deque)  # guarded-by: _lock
+    _closed: bool = False  # guarded-by: _lock
 
     def __post_init__(self):
         import threading

@@ -369,7 +369,11 @@ class ServingHTTPServer(ThreadingHTTPServer):
         with self._drain_once:
             if self._drained:
                 return True
-            self.runlog.emit("http_drain_begin", t_wall=time.time())
+            # Wall-clock emitted as a log FIELD (operators correlate the
+            # drain with external logs) — never read back as a control
+            # input, so replay determinism is untouched.
+            self.runlog.emit("http_drain_begin",
+                             t_wall=time.time())  # timestamp-only
             ok = self.frontend.drain(timeout)
             self.shutdown()  # returns after serve_forever exits
             if self._serve_thread is not None:

@@ -152,10 +152,12 @@ class EngineStats:
     # span restarts.
     n_recovered: int = 0    # requests requeued into a successor engine
     n_quarantined: int = 0  # requests failed closed as poisoned
-    rounds: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
-    completed: deque = field(default_factory=lambda: deque(maxlen=HISTORY))
+    rounds: deque = field(
+        default_factory=lambda: deque(maxlen=HISTORY))  # guarded-by: _lock
+    completed: deque = field(
+        default_factory=lambda: deque(maxlen=HISTORY))  # guarded-by: _lock
     quarantined: deque = field(
-        default_factory=lambda: deque(maxlen=HISTORY))
+        default_factory=lambda: deque(maxlen=HISTORY))  # guarded-by: _lock
     # Guards DEQUE ITERATION against driver-thread appends: the debug
     # endpoints (engine.debug_snapshot/debug_request) read ``completed``
     # from HTTP handler threads while the driver retires requests, and

@@ -1,0 +1,639 @@
+"""marlint (marlin_tpu/analysis) tests.
+
+Two layers:
+
+* FIXTURE tests — every rule is proven to both FIRE (re-introducing the
+  exact prior bug that motivated it: PR 2's ``device_get`` engine
+  fetch, PR 6's unlocked ``_prefilling`` insert, PR 7's
+  pre-``sys.modules`` exec loader, ...) and STAY QUIET on the
+  sanctioned pattern next to it. Fixtures go through the same
+  ``core.analyze`` pipeline as the real run (annotations, suppressions,
+  path scoping, baseline split).
+* The FULL-REPO gate — the same entry point ``make lint`` runs
+  (``analysis.main``): zero non-baselined findings over marlin_tpu/,
+  benchlib/, and tools/ in < 10 s, a clean tests/ sweep, and the
+  baseline-staleness check (every committed baseline key still matches
+  a live finding).
+
+No jax/engine imports needed for the fixture layer — the analyzer is
+stdlib-only by design.
+"""
+
+import json
+import textwrap
+import time
+
+import pytest
+
+from marlin_tpu import analysis
+from marlin_tpu.analysis import core
+from marlin_tpu.analysis.rules import rules_by_name
+
+
+def run_lint(tmp_path, files, rules=None, baseline=None):
+    """Write ``files`` ({relpath: source}) under tmp_path and analyze
+    them with the given rule subset (default: all)."""
+    targets = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        top = rel.split("/")[0]
+        if top not in targets:
+            targets.append(top)
+    return core.analyze(tmp_path, targets, rules_by_name(rules),
+                        baseline=baseline)
+
+
+def names(report):
+    return [(f.rule, f.line) for f in report.findings]
+
+
+def rules_hit(report):
+    return {f.rule for f in report.findings}
+
+
+# ---------------------------------------------------------------------
+# donation-fetch
+# ---------------------------------------------------------------------
+
+ENGINE_FIXTURE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    class Engine:
+        def __init__(self, batch):
+            self._cache = jnp.zeros((batch,))  # donated-buffer
+            self._buf = jnp.zeros((batch, 8))  # donated-buffer
+
+        def retire_bug(self):
+            # PR 2's zero-copy-view bug, verbatim shape: fetch the
+            # donated token buffer with device_get.
+            return jax.device_get(self._buf)
+
+        def retire_bug_asarray(self):
+            return np.asarray(self._cache)
+
+        def retire_ok(self):
+            return np.array(self._buf)  # the sanctioned explicit copy
+
+        def fetch_locals_ok(self, filled_d, done_d):
+            # Round RESULTS are fresh (non-donated) outputs — fetching
+            # them with device_get is the engine's sanctioned fence.
+            return jax.device_get((filled_d, done_d))
+"""
+
+
+class TestDonationFetch:
+    def test_pr2_device_get_engine_fetch_flagged_by_name(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/engine.py": ENGINE_FIXTURE},
+                       rules=["donation-fetch"])
+        assert len(rep.findings) == 2
+        lines = {f.line for f in rep.findings}
+        msgs = " ".join(f.message for f in rep.findings)
+        assert "jax.device_get() on donated buffer `._buf`" in msgs
+        assert "np.asarray() on donated buffer `._cache`" in msgs
+        assert "np.array" in msgs  # the fix is named in the message
+        # the two sanctioned fetches stay quiet
+        src = (tmp_path / "serving/engine.py").read_text()
+        ok_lines = [i + 1 for i, ln in enumerate(src.splitlines())
+                    if "retire_ok" in ln or "fetch_locals_ok" in ln]
+        assert not (lines & set(range(min(ok_lines), max(ok_lines) + 3)))
+
+    def test_cross_file_fetch_is_covered(self, tmp_path):
+        # The frontend touching eng._buf is covered by the ENGINE's
+        # declaration — the annotation is global by attribute name.
+        rep = run_lint(tmp_path, {
+            "serving/engine.py": ENGINE_FIXTURE,
+            "serving/frontend.py": """
+                import numpy as np
+
+                def fanout(eng):
+                    return np.asarray(eng._buf)  # BUG
+            """,
+        }, rules=["donation-fetch"])
+        assert any(f.path == "serving/frontend.py" for f in rep.findings)
+
+    def test_suppression_and_baseline(self, tmp_path):
+        files = {"serving/engine.py": ENGINE_FIXTURE.replace(
+            "return jax.device_get(self._buf)",
+            "return jax.device_get(self._buf)  "
+            "# marlint: disable=donation-fetch")}
+        rep = run_lint(tmp_path, files, rules=["donation-fetch"])
+        assert len(rep.findings) == 1  # only the asarray one remains
+        # baseline the survivor: new empty, key matched, nothing stale
+        key = rep.findings[0].key
+        rep2 = run_lint(tmp_path, files, rules=["donation-fetch"],
+                        baseline={key})
+        assert not rep2.new and [f.key for f in rep2.baselined] == [key]
+        assert not rep2.stale
+        # a stale key (bug fixed, entry left behind) is reported
+        rep3 = run_lint(tmp_path, files, rules=["donation-fetch"],
+                        baseline={key, "donation-fetch::gone.py::x:y"})
+        assert rep3.stale == ["donation-fetch::gone.py::x:y"]
+        assert not rep3.clean
+
+    def test_suppression_on_wrapped_statement_tail(self, tmp_path):
+        # The docs' natural trailing-comment position: the statement
+        # wraps, the disable comment lands on the LAST line, the
+        # finding anchors on the FIRST — still suppressed.
+        rep = run_lint(tmp_path, {"serving/engine.py": ENGINE_FIXTURE + """
+        def wrapped_fetch(eng):
+            return np.asarray(
+                eng._buf)  # marlint: disable=donation-fetch
+        """}, rules=["donation-fetch"])
+        assert not any("wrapped_fetch" in f.message for f in rep.findings)
+        assert len(rep.findings) == 2  # the fixture's own two bugs only
+
+    def test_keys_are_stable_across_runs(self, tmp_path):
+        rep1 = run_lint(tmp_path, {"serving/engine.py": ENGINE_FIXTURE},
+                        rules=["donation-fetch"])
+        rep2 = run_lint(tmp_path, {"serving/engine.py": ENGINE_FIXTURE},
+                        rules=["donation-fetch"])
+        assert [f.key for f in rep1.findings] == \
+            [f.key for f in rep2.findings]
+        assert all("::" in f.key for f in rep1.findings)
+
+
+# ---------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------
+
+GUARDED_FIXTURE = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._submit_lock = threading.Lock()
+            self.requests = {}          # guarded-by: _submit_lock
+            self._prefilling = {}       # guarded-by: _submit_lock
+
+        def admit_bug(self, row, job):
+            # PR 6's unlocked _prefilling insert, verbatim shape.
+            self._prefilling[row] = job
+
+        def admit_ok(self, row, job):
+            with self._submit_lock:
+                self._prefilling[row] = job
+                self.requests[row] = job
+
+        def read_bug(self):
+            return len(self.requests)
+
+        def helper_locked(self):  # marlint: holds=_submit_lock
+            return sorted(self._prefilling)
+
+        def escaping_closure_bug(self):
+            with self._submit_lock:
+                def cb():
+                    # A nested def may outlive the lock scope: held
+                    # locks do NOT propagate into it.
+                    return self._prefilling.popitem()
+                return cb
+"""
+
+
+class TestGuardedBy:
+    def test_pr6_unlocked_prefilling_insert_flagged_by_name(self,
+                                                            tmp_path):
+        rep = run_lint(tmp_path, {"serving/engine.py": GUARDED_FIXTURE},
+                       rules=["guarded-by"])
+        by_msg = {f.message for f in rep.findings}
+        assert any("_prefilling" in m and "Engine.admit_bug" in m
+                   and "_submit_lock" in m for m in by_msg), by_msg
+        assert any("requests" in m and "Engine.read_bug" in m
+                   for m in by_msg)
+        assert any("Engine.escaping_closure_bug" in m for m in by_msg)
+        # locked writes and the holds-annotated helper stay quiet
+        assert not any("admit_ok" in m or "helper_locked" in m
+                       for m in by_msg)
+        assert len(rep.findings) == 3
+
+    def test_init_is_exempt_and_reads_count(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/x.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []  # guarded-by: _lock
+                    self._q.append(1)  # construction: exempt
+
+                def peek_bug(self):
+                    return self._q[0]  # a READ also needs the lock
+        """}, rules=["guarded-by"])
+        assert len(rep.findings) == 1
+        assert "Q.peek_bug" in rep.findings[0].message
+
+    def test_holds_in_body_does_not_exempt_the_method(self, tmp_path):
+        # A holds= comment on a NESTED def (or anywhere in the body)
+        # is that def's contract only — the enclosing method's unlocked
+        # touches still flag.
+        rep = run_lint(tmp_path, {"serving/x.py": """
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}  # guarded-by: _lock
+
+                def outer_bug(self):
+                    def helper():  # marlint: holds=_lock
+                        return len(self._state)  # OK: helper's contract
+                    return self._state.copy()    # BUG: outer holds nothing
+        """}, rules=["guarded-by"])
+        assert len(rep.findings) == 1
+        assert "E.outer_bug" in rep.findings[0].message
+
+    def test_dataclass_field_declaration(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/q.py": """
+            import threading
+            from collections import deque
+            from dataclasses import dataclass, field
+
+            @dataclass
+            class AdmissionQueue:
+                _q: deque = field(default_factory=deque)  # guarded-by: _lock
+
+                def __post_init__(self):
+                    self._lock = threading.Lock()
+
+                def submit_ok(self, req):
+                    with self._lock:
+                        self._q.append(req)
+
+                def submit_bug(self, req):
+                    self._q.append(req)
+        """}, rules=["guarded-by"])
+        assert len(rep.findings) == 1
+        assert "submit_bug" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# deterministic-serving
+# ---------------------------------------------------------------------
+
+
+class TestDeterministicServing:
+    def test_ambient_rng_and_wall_clock_flagged(self, tmp_path):
+        rep = run_lint(tmp_path, {"marlin_tpu/serving/engine.py": """
+            import random
+            import time
+            import numpy as np
+
+            def schedule(reqs):
+                if random.random() < 0.5:       # BUG: ambient draw
+                    reqs = list(reqs)
+                    np.random.shuffle(reqs)     # BUG: ambient shuffle
+                deadline = time.time() + 5      # BUG: clock as control
+                t0 = time.perf_counter()        # OK: sanctioned clock
+                return reqs, deadline, t0
+
+            def emit(runlog):
+                runlog.emit("drain", t_wall=time.time())  # timestamp-only
+
+            def workload(vocab):
+                rng = random.Random(0)          # OK: seeded = replayable
+                return [rng.randrange(vocab) for _ in range(4)]
+        """}, rules=["deterministic-serving"])
+        msgs = [f.message for f in rep.findings]
+        assert len(msgs) == 3, msgs
+        assert any("random.random" in m for m in msgs)
+        assert any("np.random.shuffle" in m for m in msgs)
+        assert any("time.time" in m for m in msgs)
+
+    def test_timestamp_only_on_wrapped_statement_tail(self, tmp_path):
+        # Like disable=, the annotation's natural position is the
+        # wrapped statement's LAST line; the call anchors on the first.
+        rep = run_lint(tmp_path, {"marlin_tpu/serving/s.py": """
+            import time
+
+            def emit(runlog):
+                runlog.emit("begin", t_wall=time.time(),
+                            extra=1)  # timestamp-only
+        """}, rules=["deterministic-serving"])
+        assert not rep.findings
+
+    def test_rule_is_path_scoped(self, tmp_path):
+        # The same nondeterminism OUTSIDE the serving/replay scope
+        # (bench workload generators, examples) is fine.
+        rep = run_lint(tmp_path, {"benchlib/gen.py": """
+            import random, time
+
+            def workload():
+                return random.random(), time.time()
+        """}, rules=["deterministic-serving"])
+        assert not rep.findings
+
+
+# ---------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------
+
+
+class TestRetraceHazard:
+    def test_host_conversions_inside_jit(self, tmp_path):
+        rep = run_lint(tmp_path, {"marlin_tpu/kern.py": """
+            import functools
+            import time
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=("steps",))
+            def round_fn(buf, filled, steps):
+                n = int(filled)            # BUG: traced int()
+                t = time.perf_counter()    # BUG: trace-time clock
+                v = buf[0].item()          # BUG: host sync
+                k = int(steps)             # OK: static_argnames
+                m = float(buf.shape[0])    # OK: shapes are static
+                return buf + n + v + k + m
+
+            def host_fn(x):
+                return int(x)              # OK: not a jit body
+        """}, rules=["retrace-hazard"])
+        msgs = [f.message for f in rep.findings]
+        assert len(msgs) == 3, msgs
+        assert any(".item()" in m for m in msgs)
+        assert any("int()" in m for m in msgs)
+        assert any("time.perf_counter" in m for m in msgs)
+
+    def test_traced_value_mixed_into_shape_arithmetic_flags(self,
+                                                            tmp_path):
+        # `.shape` subterms are static, but a traced value MIXED into
+        # the expression keeps the conversion a hazard.
+        rep = run_lint(tmp_path, {"marlin_tpu/kern3.py": """
+            import jax
+
+            @jax.jit
+            def f(buf, filled):
+                n = int(filled + buf.shape[0])   # BUG: filled is traced
+                m = int(buf.shape[0] * 2)        # OK: pure shape math
+                return buf + n + m
+        """}, rules=["retrace-hazard"])
+        assert len(rep.findings) == 1
+        assert rep.findings[0].line == 6
+
+    def test_call_form_and_inner_defs(self, tmp_path):
+        # jax.jit(f) closures and while_loop body defs are traced too.
+        rep = run_lint(tmp_path, {"marlin_tpu/kern2.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def make(n):
+                def f(x):
+                    def body(c):
+                        return c + float(x[0])  # BUG: traced float()
+                    return jax.lax.while_loop(
+                        lambda c: c < n, body, x.sum())
+                return jax.jit(f)
+        """}, rules=["retrace-hazard"])
+        assert len(rep.findings) == 1
+        assert "float()" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# exec-loader
+# ---------------------------------------------------------------------
+
+
+class TestExecLoader:
+    def test_pr7_pre_sys_modules_loader_flagged_by_name(self, tmp_path):
+        rep = run_lint(tmp_path, {"tools/loader.py": """
+            import importlib.util
+            import sys
+
+            def load_bug(path):
+                # PR 7's dataclass-annotation crash, verbatim shape.
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                return mod
+
+            def load_ok(path):
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                sys.modules["m"] = mod  # BEFORE exec: the contract
+                spec.loader.exec_module(mod)
+                return mod
+
+            def load_bug_late(path):
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                sys.modules["m"] = mod  # too late
+                return mod
+        """}, rules=["exec-loader"])
+        assert len(rep.findings) == 2
+        assert {"load_bug", "load_bug_late"} == {
+            f.message.split(" in ")[1].split(":")[0]
+            for f in rep.findings}
+        assert all("sys.modules" in f.message for f in rep.findings)
+
+    def test_unrelated_modules_dict_does_not_vouch(self, tmp_path):
+        # A local dict named `modules` is NOT a sys.modules
+        # registration; `from sys import modules` (aliased or not) is.
+        rep = run_lint(tmp_path, {"tools/l3.py": """
+            import importlib.util
+
+            def load_bug(path):
+                modules = {}
+                modules["m"] = object()  # unrelated local dict
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                return mod
+        """, "tools/l4.py": """
+            import importlib.util
+            from sys import modules
+
+            def load_ok(path):
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                modules["m"] = mod  # the real sys.modules, imported
+                spec.loader.exec_module(mod)
+                return mod
+        """}, rules=["exec-loader"])
+        assert len(rep.findings) == 1
+        assert rep.findings[0].path == "tools/l3.py"
+
+    def test_exec_compile_form(self, tmp_path):
+        rep = run_lint(tmp_path, {"tools/l2.py": """
+            def load(src, g):
+                exec(compile(src, "<mem>", "exec"), g)
+        """}, rules=["exec-loader"])
+        assert len(rep.findings) == 1
+        assert "exec(compile)" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# export-integrity
+# ---------------------------------------------------------------------
+
+
+class TestExportIntegrity:
+    def test_stale_exports_flagged(self, tmp_path):
+        rep = run_lint(tmp_path, {
+            "pkg/__init__.py": """
+                from .mod import real_fn, gone_fn
+                from . import missing_sub
+
+                __all__ = ["real_fn", "never_bound"]
+            """,
+            "pkg/mod.py": """
+                def real_fn():
+                    return 1
+            """,
+        }, rules=["export-integrity"])
+        msgs = " | ".join(f.message for f in rep.findings)
+        assert len(rep.findings) == 3, msgs
+        assert "gone_fn" in msgs
+        assert "missing_sub" in msgs
+        assert "never_bound" in msgs
+
+    def test_clean_package_is_quiet(self, tmp_path):
+        rep = run_lint(tmp_path, {
+            "pkg/__init__.py": """
+                from . import mod
+                from .mod import real_fn
+
+                __all__ = ["mod", "real_fn"]
+            """,
+            "pkg/mod.py": """
+                def real_fn():
+                    return 1
+            """,
+        }, rules=["export-integrity"])
+        assert not rep.findings
+
+    def test_function_locals_do_not_count_as_bindings(self, tmp_path):
+        # A name bound INSIDE a function (even under `if`) is not a
+        # module binding — importing it is an ImportError at runtime
+        # and must flag.
+        rep = run_lint(tmp_path, {
+            "pkg/__init__.py": """
+                from .mod import helper
+            """,
+            "pkg/mod.py": """
+                if True:
+                    def outer():
+                        helper = 1
+                        return helper
+            """,
+        }, rules=["export-integrity"])
+        assert len(rep.findings) == 1
+        assert "helper" in rep.findings[0].message
+
+    def test_package_submodule_reexport_is_quiet(self, tmp_path):
+        # `from .sub import real_mod` where real_mod is a SUBMODULE of
+        # package sub/ (not a binding of sub/__init__.py) is a valid
+        # re-export — a gone submodule still flags.
+        rep = run_lint(tmp_path, {
+            "pkg/__init__.py": """
+                from .sub import real_mod, gone_mod
+            """,
+            "pkg/sub/__init__.py": "",
+            "pkg/sub/real_mod.py": "X = 1\n",
+        }, rules=["export-integrity"])
+        assert len(rep.findings) == 1
+        assert "gone_mod" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# the full-repo tier-1 gate
+# ---------------------------------------------------------------------
+
+
+class TestFullRepoGate:
+    def test_repo_is_clean_via_the_make_lint_entry_point(self, capsys):
+        # THE gate: the exact entry point `make lint` runs, default
+        # targets (marlin_tpu/ benchlib/ tools/) + committed baseline.
+        # Zero non-baselined findings, zero stale baseline entries,
+        # exit 0 — and the acceptance bound: < 10 s on CPU.
+        t0 = time.perf_counter()
+        rc = analysis.main([])
+        dt = time.perf_counter() - t0
+        out = capsys.readouterr().out
+        assert rc == 0, f"marlint found violations:\n{out}"
+        assert dt < 10.0, f"marlint took {dt:.1f}s (acceptance: < 10 s)"
+
+    def test_tests_tree_is_clean_too(self):
+        # The by-path loader sweep (PR 7's bug class lived in tests/):
+        # the whole tests tree passes every rule, no baseline needed.
+        root = core.Path(analysis.cli.REPO_ROOT)
+        rep = core.analyze(root, ["tests"], rules_by_name(None))
+        assert not rep.findings, "\n".join(
+            f.text() for f in rep.findings)
+        assert not rep.parse_errors
+
+    def test_baseline_staleness_contract(self):
+        # Every committed baseline key must still match a live finding
+        # (an empty baseline is trivially fresh — and is the policy).
+        root = core.Path(analysis.cli.REPO_ROOT)
+        baseline_path = root / "tools" / "marlint_baseline.json"
+        keys = core.load_baseline(baseline_path)
+        rep = core.analyze(root, list(core.DEFAULT_TARGETS),
+                           rules_by_name(None), baseline=keys)
+        assert not rep.stale, (
+            f"stale baseline entries (fixed findings whose keys were "
+            f"left behind — remove them): {rep.stale}")
+        assert not rep.new, "\n".join(f.text() for f in rep.new)
+
+    def test_cli_surfaces(self, capsys):
+        assert analysis.main(["--list-rules"]) == 0
+        listing = capsys.readouterr().out
+        for rule in ("donation-fetch", "guarded-by",
+                     "deterministic-serving", "retrace-hazard",
+                     "exec-loader", "export-integrity"):
+            assert rule in listing
+        rc = analysis.main(["--json", "--no-baseline"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["clean"] and doc["files"] > 50
+        # unknown rule name -> internal-error exit code (2), not a crash
+        assert analysis.main(["--rules", "nope"]) == 2
+
+    def test_overlapping_targets_analyze_each_file_once(self, tmp_path):
+        (tmp_path / "serving").mkdir()
+        (tmp_path / "serving" / "e.py").write_text(
+            textwrap.dedent(ENGINE_FIXTURE))
+        rep = core.analyze(tmp_path, ["serving", "serving/e.py"],
+                           rules_by_name(["donation-fetch"]))
+        assert rep.n_files == 1
+        assert len(rep.findings) == 2  # not doubled
+
+    def test_write_baseline_round_trip(self, tmp_path, capsys):
+        # --write-baseline accepts the current findings; the re-run is
+        # exit 0 with every finding baselined; fixing the bug then
+        # makes the entry STALE (exit 1) — the full workflow.
+        src = textwrap.dedent(ENGINE_FIXTURE)
+        (tmp_path / "eng.py").write_text(src)
+        base = tmp_path / "base.json"
+        argv = ["--root", str(tmp_path), "eng.py",
+                "--baseline", str(base)]
+        assert analysis.main(argv + ["--write-baseline"]) == 0
+        assert analysis.main(argv) == 0  # all baselined
+        out = capsys.readouterr().out
+        assert "(baselined)" in out
+        (tmp_path / "eng.py").write_text(
+            src.replace("jax.device_get(self._buf)",
+                        "np.array(self._buf)"))
+        assert analysis.main(argv) == 1  # fixed finding -> stale key
+        assert "STALE" in capsys.readouterr().out
+
+    def test_internal_error_exit_code(self, tmp_path, monkeypatch):
+        # A crashing rule must surface as exit 2 (the Makefile's
+        # "internal error" arm), never as a silent 0.
+        class Broken(core.Rule):
+            name = "broken"
+            description = "boom"
+
+            def check(self, sf, ctx):
+                raise RuntimeError("boom")
+
+        monkeypatch.setattr(analysis.cli, "ALL_RULES", (Broken(),))
+        monkeypatch.setattr(
+            "marlin_tpu.analysis.cli.rules_by_name",
+            lambda names=None: [Broken()])
+        (tmp_path / "x.py").write_text("pass\n")
+        assert analysis.main(
+            ["--root", str(tmp_path), "--no-baseline", "x.py"]) == 2

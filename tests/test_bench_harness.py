@@ -349,10 +349,15 @@ class TestServingTraceSmoke:
 class TestCaptureSummaryHistory:
     def test_history_skips_replays_and_flags_deltas(self, tmp_path, monkeypatch):
         import importlib.util
+        import sys
 
         spec = importlib.util.spec_from_file_location(
             "capture_summary", "tools/capture_summary.py")
         cs = importlib.util.module_from_spec(spec)
+        # Register BEFORE exec (the importlib contract): dataclasses in
+        # a by-path module resolve string annotations via sys.modules
+        # (marlint exec-loader).
+        sys.modules["capture_summary"] = cs
         spec.loader.exec_module(cs)
         monkeypatch.setattr(bench, "_CAPTURE_DIR", str(tmp_path))
 

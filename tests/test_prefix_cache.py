@@ -548,10 +548,15 @@ class TestSloCheck:
     @pytest.fixture()
     def slo(self):
         import importlib.util
+        import sys
 
         spec = importlib.util.spec_from_file_location(
             "slo_check", "tools/slo_check.py")
         mod = importlib.util.module_from_spec(spec)
+        # Register BEFORE exec (the importlib contract): dataclasses in
+        # a by-path module resolve string annotations via sys.modules
+        # (marlint exec-loader).
+        sys.modules["slo_check"] = mod
         spec.loader.exec_module(mod)
         return mod
 

@@ -17,6 +17,7 @@ Two layers, each pinned:
 import importlib.util
 import json
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -34,6 +35,10 @@ def rr():
         "runlog_report", os.path.join(_REPO, "tools",
                                       "runlog_report.py"))
     mod = importlib.util.module_from_spec(spec)
+    # Register BEFORE exec (the importlib contract): dataclasses in a
+    # by-path module resolve string annotations via sys.modules
+    # (marlint exec-loader).
+    sys.modules["runlog_report"] = mod
     spec.loader.exec_module(mod)
     return mod
 

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from marlin_tpu.models import TransformerConfig, generate, init_params
+from marlin_tpu.obs.watch import no_transfers
 from marlin_tpu.serving import (AdmissionQueue, QueueClosed, QueueFull,
                                 Request, ServingEngine, SlotManager,
                                 pad_prompt_len, static_completed_at_budget,
@@ -139,7 +140,14 @@ class TestServingExactness:
         workload = [(rng.integers(0, cfg.vocab, s), steps)
                     for s, steps in ((9, 20), (17, 5), (20, 12), (5, 30),
                                      (33, 7), (12, 18), (6, 3))]
-        ids, done = _run_workload(eng, workload, waves=3)
+        # The marlint donation-fetch rule's DYNAMIC cousin: the whole
+        # serving loop runs under the scoped transfer guard, so an
+        # accidental IMPLICIT hot-loop host transfer (a `float(arr)`/
+        # `if arr:` slipping into the round path) fails loudly here —
+        # the engine's explicit np.array fetches and jnp.asarray feeds
+        # stay allowed (obs/watch.no_transfers, docs/static_analysis.md).
+        with no_transfers():
+            ids, done = _run_workload(eng, workload, waves=3)
         assert eng.stats.n_completed == len(workload)
         assert not eng.requests  # finished work is handed back, not held
         for rid, (prompt, steps) in ids.items():
