@@ -32,7 +32,8 @@ CONFIGS = {
     "decodespec": [configs_ml.config_decode_spec],
     "trend": [configs_trend.config_trend_cpu],
     "serving": [configs_trend.config_serving,
-                configs_trend.config_serving_prefix],
+                configs_trend.config_serving_prefix,
+                configs_trend.config_serving_paged],
     "http": [configs_http.config_http],
     "sweep": [configs_gemm.config_dispatch_sweep],
     "attnsweep": [configs_kernels.config_attention_sweep],

@@ -788,6 +788,172 @@ def prefill_chunk(params, cache, tokens, pos, cfg: TransformerConfig,
     return _readout(params, h)[:, 0], new_cache
 
 
+# ---------------------------------------------------------------------------
+# Paged KV: gather-read / scatter-write chunk body over a page pool
+# ---------------------------------------------------------------------------
+#
+# serving/pages.py owns the POOL — per layer, one buffer per KV key at
+# (n_pages, page, Hk, Dh) with page = 16 (the flash sublane bucket /
+# trie GRAIN) — and the host-side allocator/refcounts. These functions
+# are the model half: the SAME per-position chunk body as
+# ``_chunk_states``, with the row-major cache replaced by PAGE-GATHERED
+# reads and page-SCATTERED writes through a traced int32 page table
+# (rows hold tables, not KV rows). Bit-exactness argument
+# (docs/serving.md §paged KV): a gather of identical bytes hands
+# ``_attend_cached`` a bitwise-identical operand, and positions beyond
+# the row's fill are masked to exactly-zero softmax weight in BOTH
+# representations (exp(-1e30 - max) underflows to 0.0 at f32, and the
+# garbage a dead page holds is finite), so the page-gathered read is
+# bit-identical to the contiguous read of the same logical cache —
+# which is what lets a prefix hit ALIAS pages instead of copying them.
+
+
+def gather_kv_pages(pool, tables):
+    """Materialize contiguous per-layer cache views from a page pool.
+
+    ``pool``: list of per-layer dicts of (P, page, Hk, Dh) buffers
+    (scales (P, page, Hk, 1) ride along on an int8 pool);
+    ``tables``: (B, n_chunks) traced int32 page ids. Returns per-layer
+    dicts of (B, n_chunks * page, Hk, Dh) arrays — slot index ==
+    absolute position, exactly the dense-cache layout the attention
+    masks assume. The gather is the paged read: identical bytes land at
+    identical positions, so everything downstream is unchanged."""
+    b = tables.shape[0]
+    return [
+        {name: layer[name][tables].reshape(
+            (b, -1) + layer[name].shape[2:])
+         for name in layer}
+        for layer in pool
+    ]
+
+
+def _paged_guards(pool, tables, cfg: TransformerConfig):
+    """Contract checks for the paged chunk paths — the _chunk_guards
+    analogue. The paged pool is dense-only (slot == position through the
+    table) and the table extent must tile max_len exactly, or gathered
+    positions would silently truncate/overhang the mask arithmetic."""
+    if cfg.window:
+        raise NotImplementedError(
+            "paged decode needs the dense slot==position layout: a ring "
+            "cache cannot be paged at fixed position-aligned chunks")
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "paged decode shares decode_chunk's (B, C, D) activation "
+            "shape, which does not fit the MoE router's (T, D) contract")
+    page = pool[0]["k"].shape[1]
+    if tables.shape[-1] * page != cfg.max_len:
+        raise ValueError(
+            f"page table covers {tables.shape[-1]} x {page} slots != "
+            f"max_len {cfg.max_len}; build tables at max_len // page "
+            "entries (serving/pages.py)")
+    if ("ks" in pool[0]) != bool(cfg.kv_quant):
+        raise ValueError(
+            f"pool {'is' if 'ks' in pool[0] else 'is not'} int8-quantized "
+            f"but cfg.kv_quant={cfg.kv_quant!r}; build the pool with "
+            "PagePool(cfg, ...) from the SAME config")
+
+
+def _chunk_states_paged(params, pool, tables, tokens, pos,
+                        cfg: TransformerConfig):
+    """:func:`_chunk_states` over a page pool: run (B, C) tokens at
+    positions pos..pos+C-1, scatter each position's K/V into its page
+    (page = table[row, p // page_size], slot = p % page_size), attend
+    each position over the row's page-gathered prefix. Returns
+    ``(hidden states (B, C, D) before the final LN, updated pool)``.
+    ``params`` must already be cast.
+
+    Every op stays PER-POSITION (the bit-stability property the serving
+    prefix machinery rests on); the only representation change is where
+    the bytes live. Rows whose table entries point at the reserved
+    write-sink page (serving/pages.py) scatter dead values there —
+    duplicate sink writes race benignly because nothing ever attends
+    the sink through a live mask."""
+    b, c = tokens.shape
+    x = _embed_rows(params, tokens, cfg.compute_dtype)  # (B, C, D)
+    pos = jnp.asarray(pos, jnp.int32)
+    pos_b = jnp.broadcast_to(pos, (b,))
+    chunk_pos = pos_b[:, None] + jnp.arange(c, dtype=jnp.int32)  # (B, C)
+    if not cfg.rope:
+        x = x + params["pos"][chunk_pos].astype(x.dtype)
+    positions = chunk_pos.reshape(-1) if cfg.rope else None
+    hk, dh = pool[0]["k"].shape[2:]
+    page = pool[0]["k"].shape[1]
+    p_idx = chunk_pos // page  # (B, C) table index per written position
+    s_idx = chunk_pos % page   # (B, C) slot within the page
+    brange = jnp.arange(b)
+    page_ids = tables[brange[:, None], p_idx]  # (B, C) pool page per write
+    quant = bool(cfg.kv_quant)
+    new_pool = []
+    for bp, layer in zip(params["blocks"], pool):
+        q, k, v = _split_qkv(bp, x.reshape(b * c, -1), cfg,
+                             positions=positions)
+        q = q.reshape(b, c, cfg.n_heads, dh)
+        k = k.reshape(b, c, hk, dh)
+        v = v.reshape(b, c, hk, dh)
+
+        def put(buf, val):
+            # Page-scattered write: (B, C) writes land at their own
+            # (page, slot); live rows' pages are private by the
+            # allocator's refcount discipline (an aliased prefix page is
+            # never at a written position — docs/serving.md §paged KV).
+            return buf.at[page_ids, s_idx].set(val.astype(buf.dtype))
+
+        layer = _put_kv(layer, k, v, put, quant)
+        gathered = gather_kv_pages([layer], tables)[0]
+        extra, _ = _scale_args(gathered, quant)
+
+        def att_one(qb, ckb, cvb, pb, *scales):
+            # Identical structure to _chunk_states.att_one: each chunk
+            # position against its own prefix mask, over the gathered
+            # (now position-major) cache view.
+            return jax.vmap(
+                lambda qc, pc: _attend_cached(qc, ckb, cvb, pc, *scales)
+            )(qb, pb)
+
+        att = jax.vmap(att_one)(q, gathered["k"], gathered["v"],
+                                chunk_pos, *extra)
+        new_pool.append(layer)
+        x = _mlp_residual(
+            bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
+    return x, new_pool
+
+
+def decode_chunk_paged(params, pool, tables, tokens, pos,
+                       cfg: TransformerConfig):
+    """:func:`decode_chunk` over a page pool: tokens (B, C) at per-row
+    positions ``pos`` -> (logits (B, C, vocab), updated pool). The
+    serving engine's paged decode round runs this at C=1 with per-row
+    positions — the continuous-batching feed, reading and writing
+    through each row's page table."""
+    _paged_guards(pool, tables, cfg)
+    params = _cast_params(params, cfg)
+    x, new_pool = _chunk_states_paged(params, pool, tables, tokens, pos,
+                                      cfg)
+    x = _layer_norm(params["ln_f"], x)
+    return _readout(params, x), new_pool
+
+
+def prefill_chunk_paged(params, pool, tables, tokens, pos,
+                        cfg: TransformerConfig, last=None):
+    """:func:`prefill_chunk` over a page pool: run (B, C) prompt tokens
+    at positions pos..pos+C-1 against pages already holding [0, pos) —
+    earlier chunks, or ALIASED prefix pages (zero-copy admission,
+    serving/pages.py) — writing this chunk's K/V through the table and
+    returning ``(logits (B, vocab) at chunk index ``last``, updated
+    pool)``. Same one-position readout economics as the contiguous
+    sibling; ``last`` traced."""
+    _paged_guards(pool, tables, cfg)
+    params = _cast_params(params, cfg)
+    x, new_pool = _chunk_states_paged(params, pool, tables, tokens, pos,
+                                      cfg)
+    if last is None:
+        last = tokens.shape[1] - 1
+    h = jax.vmap(
+        lambda xi: jax.lax.dynamic_slice_in_dim(xi, last, 1, axis=0))(x)
+    h = _layer_norm(params["ln_f"], h)
+    return _readout(params, h)[:, 0], new_pool
+
+
 def prefill(params, tokens, cfg: TransformerConfig):
     """Run the prompt (B, S) through the model once, filling the cache for
     positions [0, S): returns (last-position logits (B, vocab), cache).

@@ -9,16 +9,17 @@ that converts PR 1's "skew-proof" into reclaimed throughput
 """
 
 from . import faults
-from .engine import ServingEngine, _decode_round
+from .engine import ServingEngine, _decode_round, _decode_round_paged
 from .faults import (EngineStateCorrupt, FaultInjected, FaultPlan,
                      FaultSpec)
 from .frontend import (EngineFailed, EngineFrontend, FrontendError,
                        FrontendRequest, PoisonedRequest)
-from .prefix import PrefixCache, copy_kv_rows
+from .pages import PAGE, PagePool
+from .prefix import PagedPrefixIndex, PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
 from .server import ServingHTTPServer, install_signal_handlers, serve
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
-                    prefill_into_row)
+                    prefill_chunk_into_row_paged, prefill_into_row)
 from .stats import (EngineStats, request_stats, static_completed_at_budget,
                     static_schedule_iters)
 
@@ -33,6 +34,9 @@ __all__ = [
     "FaultSpec",
     "FrontendError",
     "FrontendRequest",
+    "PAGE",
+    "PagePool",
+    "PagedPrefixIndex",
     "PoisonedRequest",
     "PrefixCache",
     "faults",
@@ -47,6 +51,7 @@ __all__ = [
     "serve",
     "pad_prompt_len",
     "prefill_chunk_into_row",
+    "prefill_chunk_into_row_paged",
     "prefill_into_row",
     "request_stats",
     "static_completed_at_budget",

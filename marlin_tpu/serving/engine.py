@@ -63,10 +63,11 @@ from ..obs.runlog import RunLog
 from ..obs.watch import CompileWatchdog
 from ..utils import cost_model as cm
 from . import faults
-from .prefix import PrefixCache, copy_kv_rows
+from .pages import PAGE, PagePool
+from .prefix import PagedPrefixIndex, PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, Request
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
-                    prefill_into_row)
+                    prefill_chunk_into_row_paged, prefill_into_row)
 from .stats import EngineStats
 
 
@@ -127,6 +128,26 @@ def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
     live-iteration count — the verify_chunks-style ledger stats.py
     turns into occupancy and reclaimed-FLOPs figures.
     """
+    return _round_loop(params, cache,
+                       lambda p, kv, t, pos: tr.decode_chunk(p, kv, t,
+                                                             pos, cfg),
+                       buf, filled, target, done0, keys,
+                       round_steps=round_steps, temperature=temperature,
+                       eos_id=eos_id)
+
+
+def _round_loop(params, kv, step_fn, buf, filled, target, done0, keys,
+                round_steps: int, temperature: float,
+                eos_id: Optional[int]):
+    """The ONE copy of the round's scheduling semantics, shared by the
+    contiguous and paged jitted entry points — ``kv`` is whatever the
+    KV representation is (contiguous cache pytree / page pool) and
+    ``step_fn(params, kv, tokens, pos) -> (logits, kv)`` is its C=1
+    decode step. Everything subtle about the round — freeze-at-entry
+    ordering, eos handling, frozen-row stream non-advance, the live
+    ledger, the post-loop eos re-check — lives here exactly once, so a
+    fix to an invariant cannot land in one representation and silently
+    miss the other."""
     bsz = buf.shape[0]
     brange = jnp.arange(bsz)
 
@@ -135,7 +156,7 @@ def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
         return (i < round_steps) & ~jnp.all(done)
 
     def body(carry):
-        i, buf, filled, done, cache, keys, live = carry
+        i, buf, filled, done, kv, keys, live = carry
         tok = buf[brange, filled - 1]
         # Freeze-at-entry, BEFORE this iteration appends: a row admitted
         # already at target (steps == 1: the admission prefill's first
@@ -147,8 +168,7 @@ def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
             # A row whose LAST token is eos is finished — this also
             # catches an admission whose first sampled token was eos.
             done = done | (tok == eos_id)
-        logits, cache = tr.decode_chunk(params, cache, tok[:, None],
-                                        filled - 1, cfg)
+        logits, kv = step_fn(params, kv, tok[:, None], filled - 1)
         ks_all = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
         nxt = jax.vmap(
             lambda lg, kk: tr._sample(lg, temperature, kk)
@@ -167,17 +187,46 @@ def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
         live = live + (~done).astype(jnp.int32)
         filled = jnp.where(done, filled, filled + 1)
         done = done | (filled >= target)
-        return i + 1, buf, filled, done, cache, keys, live
+        return i + 1, buf, filled, done, kv, keys, live
 
     live0 = jnp.zeros((bsz,), jnp.int32)
-    iters, buf, filled, done, cache, keys, live = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), buf, filled, done0, cache, keys, live0))
+    iters, buf, filled, done, kv, keys, live = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), buf, filled, done0, kv, keys, live0))
     if eos_id is not None:
         # An eos emitted on the round's last iteration only freezes the
         # row at the NEXT feed; report it finished now so the engine
         # retires it at this round boundary.
         done = done | (buf[brange, filled - 1] == eos_id)
-    return buf, filled, done, cache, iters, live, keys
+    return buf, filled, done, kv, iters, live, keys
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
+    donate_argnums=(1, 2),
+)
+@jax.named_scope("marlin.serving.decode_round_paged")
+def _decode_round_paged(params, pool, buf, tables, filled, target, done0,
+                        keys, cfg, round_steps: int, temperature: float,
+                        eos_id: Optional[int] = None):
+    """:func:`_decode_round` over the PAGED KV pool (serving/pages.py):
+    identical scheduling semantics — bounded while_loop, freeze-at-
+    entry, per-row PRNG streams, live-iteration ledger — with the
+    contiguous cache replaced by ``pool`` (donated page buffers) plus
+    ``tables`` ((B, max_len // PAGE) traced int32 page tables, loop-
+    invariant within a round: pages are RESERVED at admission, so a
+    round never allocates). Each iteration reads and writes through
+    :func:`models.transformer.decode_chunk_paged` at C=1; frozen rows'
+    fixed-point rewrites land in dead slots exactly as before (a free
+    or mid-prefill row's parked feed scatters into the reserved write
+    sink — never read through a live mask). Returns
+    ``(buf, filled, done, pool, iters, live_iters, keys)``."""
+    return _round_loop(params, pool,
+                       lambda p, kv, t, pos: tr.decode_chunk_paged(
+                           p, kv, tables, t, pos, cfg),
+                       buf, filled, target, done0, keys,
+                       round_steps=round_steps, temperature=temperature,
+                       eos_id=eos_id)
 
 
 class ServingEngine:
@@ -201,7 +250,9 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: Optional[PrefixCache] = None,
                  prefill_chunks_per_round: int = 2,
-                 stats: Optional[EngineStats] = None):
+                 stats: Optional[EngineStats] = None,
+                 kv_pages: Optional[int] = None,
+                 prefix_sharing: bool = True):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -225,6 +276,28 @@ class ServingEngine:
         # long cold prompt can no longer stall the live batch — which is
         # also the substrate prefix reuse is bit-exact on; attaching a
         # ``prefix_cache`` therefore implies (and defaults) it.
+        # Paged KV mode (kv_pages set; serving/pages.py, docs/serving.md
+        # §paged KV): the contiguous per-row cache is replaced by a page
+        # pool + per-row page tables, prefix sharing becomes zero-copy
+        # table aliasing, and admission is reservation-based at page
+        # granularity. Paged serving runs on the chunked admission
+        # discipline (the bit-stable substrate), so it implies
+        # ``prefill_chunk`` exactly like ``prefix_cache`` does.
+        if kv_pages is not None:
+            if prefix_cache is not None:
+                raise ValueError(
+                    "kv_pages and prefix_cache are mutually exclusive: "
+                    "the paged engine shares prefixes through its own "
+                    "page pool (prefix_sharing=True, the default); "
+                    "PrefixCache is the contiguous-row engine's copy-"
+                    "based surface")
+            if prefill_chunk is None:
+                prefill_chunk = 32
+        elif not prefix_sharing:
+            raise ValueError(
+                "prefix_sharing applies to the PAGED engine "
+                "(kv_pages=...); disable the contiguous engine's "
+                "copy-based sharing by omitting prefix_cache instead")
         if prefix_cache is not None and prefill_chunk is None:
             prefill_chunk = 32
         if prefill_chunk is not None and (prefill_chunk < 16
@@ -258,6 +331,9 @@ class ServingEngine:
         self.prefill_chunk = prefill_chunk
         self.prefill_chunks_per_round = prefill_chunks_per_round
         self.prefix_cache = prefix_cache
+        self.kv_pages = kv_pages
+        self.paged = kv_pages is not None
+        self.prefix_sharing = bool(prefix_sharing)
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.queue = AdmissionQueue(max_pending=max_pending)
@@ -281,13 +357,22 @@ class ServingEngine:
         self.stats = stats if stats is not None else EngineStats(
             batch=batch, cfg=cfg, registry=self.metrics)
         self.watchdog = CompileWatchdog(registry=self.metrics)
-        self.watchdog.register("serving.decode_round", _decode_round)
-        self.watchdog.register("serving.prefill_into_row",
-                               prefill_into_row)
-        if prefill_chunk is not None:
-            self.watchdog.register("serving.prefill_chunk_into_row",
-                                   prefill_chunk_into_row)
-            self.watchdog.register("serving.prefix_copy", copy_kv_rows)
+        if self.paged:
+            # Paged entry points only: the contiguous round/prefill
+            # compiles never happen in this engine, and the copy entry
+            # has no paged analogue (hits alias, they don't copy).
+            self.watchdog.register("serving.decode_round_paged",
+                                   _decode_round_paged)
+            self.watchdog.register("serving.prefill_chunk_into_row_paged",
+                                   prefill_chunk_into_row_paged)
+        else:
+            self.watchdog.register("serving.decode_round", _decode_round)
+            self.watchdog.register("serving.prefill_into_row",
+                                   prefill_into_row)
+            if prefill_chunk is not None:
+                self.watchdog.register("serving.prefill_chunk_into_row",
+                                       prefill_chunk_into_row)
+                self.watchdog.register("serving.prefix_copy", copy_kv_rows)
         # Per-request PRNG streams (the sampled-path reproducibility
         # contract): every request's keys derive from fold_in(base,
         # request_id), so its sampled tokens are a pure function of
@@ -338,9 +423,34 @@ class ServingEngine:
         # dead state; target=0 keeps them done from round one. Both are
         # re-threaded through the donation-aliased jitted entry points
         # every round/admission — host fetches MUST be np.array copies
-        # (marlint donation-fetch, docs/static_analysis.md).
-        self._cache = init_kv_cache(cfg, batch,
-                                    dtype=cfg.compute_dtype)  # donated-buffer
+        # (marlint donation-fetch, docs/static_analysis.md). In paged
+        # mode the contiguous cache is replaced by the page pool
+        # (PagePool.pages, equally donated) + host-side per-row page
+        # tables pushed as traced operands each dispatch.
+        if self.paged:
+            self._cache = None
+            self.page_pool = PagePool(cfg, kv_pages,
+                                      registry=self.metrics)
+            self.prefix_index = PagedPrefixIndex(
+                self.page_pool, registry=self.metrics) \
+                if self.prefix_sharing else None
+            # Row r's page table: chunk index -> pool page. Entries of
+            # unallocated chunks point at the write sink (0). Driver-
+            # owned host state, mutated only at admission/retire.
+            self._tables = np.zeros((batch, cfg.max_len // PAGE),
+                                    np.int32)
+            self._row_pages: Dict[int, List[int]] = {}  # row -> held refs
+            # Internal fragmentation ledger: slack slots in each row's
+            # LAST page (reservations are otherwise exact) — the
+            # numerator of the round fragmentation gauge.
+            self._row_slack: Dict[int, int] = {}
+            self.stats.page_pool = self.page_pool
+        else:
+            self.page_pool = None
+            self.prefix_index = None
+            self._cache = init_kv_cache(cfg, batch,
+                                        dtype=cfg.compute_dtype)  # donated-buffer
+            self.stats.page_pool = None
         self._buf = jnp.zeros((batch, cfg.max_len), jnp.int32)  # donated-buffer
         self._filled = np.ones((batch,), np.int32)
         self._target = np.zeros((batch,), np.int32)
@@ -357,7 +467,10 @@ class ServingEngine:
                          prefill_chunk=prefill_chunk,
                          max_pending=max_pending,
                          max_len=cfg.max_len,
-                         prefix_cache=prefix_cache is not None)
+                         prefix_cache=prefix_cache is not None,
+                         kv_pages=kv_pages,
+                         prefix_sharing=(self.paged
+                                         and self.prefix_sharing))
 
     # -- submission ---------------------------------------------------
 
@@ -392,6 +505,13 @@ class ServingEngine:
             raise ValueError(
                 f"padded prompt {pad_prompt_len(s)} exceeds max_len "
                 f"{self.cfg.max_len}")
+        if self.paged and -(-(s + steps) // PAGE) > self.kv_pages:
+            # Hopeless even against an EMPTY pool: fail at submit like
+            # the max_len check, not by queuing forever.
+            raise ValueError(
+                f"request needs {-(-(s + steps) // PAGE)} KV pages "
+                f"> pool size {self.kv_pages} (prompt {s} + steps "
+                f"{steps} at {PAGE} tokens/page)")
         now = time.perf_counter()
         with self._submit_lock:
             req = Request(
@@ -544,7 +664,14 @@ class ServingEngine:
             expired.extend(dropped)
             if req is None:
                 break
-            self._start_prefill(req)
+            if not self._start_prefill(req):
+                # Paged page pressure: the request's reservation did not
+                # fit even after evicting stored prefixes. It goes back
+                # to the queue HEAD (FIFO preserved, no stamps written)
+                # and admission stops — retires free pages, the next
+                # round retries.
+                self.queue.push_front(req)
+                break
         # Snapshot under the lock (handler threads iterate _prefilling
         # in debug_snapshot); the driver is the only mutator, so the
         # snapshot stays exact for the loop below.
@@ -564,7 +691,94 @@ class ServingEngine:
         self._drop_expired(expired)
         return expired
 
-    def _start_prefill(self, req: Request) -> None:
+    def _reserve_pages(self, req: Request):
+        """Paged admission placement: resolve the prefix-index hit and
+        reserve the request's FULL page complement — ``ceil((prompt +
+        steps) / PAGE)`` chunks, aliased prefix pages first, fresh pages
+        for the rest — so a placed request can never run out of pages
+        mid-decode. Returns ``(alias_pages, hit_len, fresh_pages)`` or
+        None when the pool cannot fit the reservation even after
+        evicting stored prefixes (the caller leaves the request
+        queued)."""
+        entry_pages, hit = (None, 0)
+        if self.prefix_index is not None:
+            entry_pages, hit = self.prefix_index.lookup(req.prompt)
+        n_total = -(-(req.prompt_len + req.steps) // PAGE)
+        n_alias = hit // PAGE
+        need = n_total - n_alias
+        if hit:
+            # Pin the aliased pages FIRST: the eviction pass below may
+            # drop the very entry this hit resolved to, and the pin is
+            # what keeps its pages live for this row regardless.
+            self.page_pool.ref(entry_pages)
+        if self.page_pool.n_free < need and self.prefix_index is not None:
+            self.prefix_index.evict_until_free(need)
+        fresh = self.page_pool.alloc(need)
+        if fresh is None:
+            if hit:
+                self.page_pool.unref(entry_pages)  # undo the pin
+            return None
+        # Hit/miss/zero-copy accounting happens AFTER _bind_row_pages'
+        # fault site (the stats object survives engine incarnations —
+        # recording here would double-count a crashed-and-replayed
+        # admission, exactly like the contiguous path's check-then-
+        # record ordering avoids).
+        return (list(entry_pages) if hit else []), hit, fresh
+
+    def _bind_row_pages(self, req: Request, row: int, alias_pages,
+                        hit: int, fresh) -> None:
+        """Write the claimed row's page table: aliased prefix pages for
+        chunks [0, hit/PAGE), fresh private pages up to the reservation,
+        the write sink (0) beyond it. This IS the paged admission's
+        storage work — no KV bytes move."""
+        n_total = -(-(req.prompt_len + req.steps) // PAGE)
+        held: List[int] = []
+        if hit:
+            # Same blame/fault site as the contiguous prefix copy: a
+            # chaos plan targeting "prefix_copy" crashes mid prefix-hit
+            # admission here, leaving torn refcounts for
+            # spawn_successor's fresh pool to discard
+            # (tests/test_faults.py pins the recovery).
+            self._admitting_rid = req.request_id
+            faults.check("prefix_copy", round_idx=self.round_idx,
+                         request_id=req.request_id)
+            with self.tracer.span("serving.prefix_alias", scope=False,
+                                  request_id=req.request_id, row=row,
+                                  hit_len=hit):
+                held.extend(int(p) for p in alias_pages)
+            self._admitting_rid = None
+        table = self._tables[row]
+        table[:] = 0
+        table[:len(held)] = held
+        table[len(held):n_total] = fresh
+        held.extend(int(p) for p in fresh)
+        self._row_pages[row] = held
+        self._row_slack[row] = n_total * PAGE - (req.prompt_len
+                                                + req.steps)
+
+    def _start_prefill(self, req: Request) -> bool:
+        """Claim a row and start a chunked admission. Returns False —
+        nothing stamped or claimed — when the PAGED reservation cannot
+        be placed; True otherwise."""
+        if self.paged:
+            placed = self._reserve_pages(req)
+            if placed is None:
+                return False
+            alias_pages, hit, fresh = placed
+            req.admit_start_time = time.perf_counter()  # queue_wait ends
+            row = self.slots.acquire(req.request_id)
+            self._bind_row_pages(req, row, alias_pages, hit, fresh)
+            if self.prefix_index is not None:
+                # Recorded only once the bind SURVIVED its fault site:
+                # the ledger spans incarnations, and a crashed-then-
+                # replayed admission must count one hit, not two.
+                self.prefix_index.record(hit)
+                self.stats.record_prefix_lookup(hit, req.prompt_len)
+                # The zero-copy ledger: a paged hit admits by writing a
+                # page table — 0 KV bytes moved, counted as such.
+                self.stats.record_admission_copy(0, zero_copy=bool(hit))
+            self._arm_prefill_job(req, row, hit)
+            return True
         req.admit_start_time = time.perf_counter()  # queue_wait ends
         row = self.slots.acquire(req.request_id)
         hit_row, hit = (None, 0)
@@ -594,11 +808,21 @@ class ServingEngine:
                 req.prefix_copy_s = time.perf_counter() - t0
                 # Copy cost is byte-priced: admission_cost at tail=0
                 # reduces to exactly the copy's read+write traffic.
-                self.stats.calibration.record(
-                    "copy", cm.admission_cost(self.cfg, hit,
-                                              hit_len=hit)[1],
-                    req.prefix_copy_s)
+                copy_bytes = cm.admission_cost(self.cfg, hit,
+                                               hit_len=hit)[1]
+                self.stats.calibration.record("copy", copy_bytes,
+                                              req.prefix_copy_s)
+                # The copy-based admission's byte bill — what the paged
+                # engine's zero-copy aliasing makes structurally 0
+                # (docs/serving.md §paged KV).
+                self.stats.record_admission_copy(copy_bytes)
             self.stats.record_prefix_lookup(hit, req.prompt_len)
+        self._arm_prefill_job(req, row, hit)
+        return True
+
+    def _arm_prefill_job(self, req: Request, row: int, hit: int) -> None:
+        """Shared chunked-admission arming (contiguous and paged): key
+        derivation, the parked frozen feed, the job record."""
         k_first, k_decode = self._request_keys(req)
         # Mid-prefill rows ride through decode rounds FROZEN, and a
         # frozen row's fixed-point rewrite lands at slot filled - 1. The
@@ -640,7 +864,33 @@ class ServingEngine:
                 jax.transfer_guard("allow"):
             # transfer_guard("allow"): sanctioned admission-site
             # host->device pushes (see _admit_oneshot).
-            if final:
+            if self.paged:
+                # The paged chunk writes through the row's page table —
+                # the pool and buf donate through, the table is a small
+                # per-dispatch push like the other admission scalars.
+                table = jnp.asarray(self._tables[job.row])
+                if final:
+                    padded = np.zeros((pad_prompt_len(s),), np.int32)
+                    padded[:s] = req.prompt
+                    self.page_pool.pages, self._buf, _ = \
+                        prefill_chunk_into_row_paged(
+                            self.params, self.page_pool.pages, self._buf,
+                            jnp.int32(job.row), table, jnp.asarray(seg),
+                            jnp.int32(c0), jnp.int32(clen),
+                            jnp.asarray(padded), jnp.int32(s),
+                            jnp.asarray(job.k_first), cfg=self.cfg,
+                            temperature=self.temperature, final=True)
+                    job.done = True
+                else:
+                    self.page_pool.pages, self._buf = \
+                        prefill_chunk_into_row_paged(
+                            self.params, self.page_pool.pages, self._buf,
+                            jnp.int32(job.row), table, jnp.asarray(seg),
+                            jnp.int32(c0), jnp.int32(clen),
+                            jnp.asarray(seg), jnp.int32(s),
+                            jnp.asarray(job.k_first), cfg=self.cfg,
+                            temperature=self.temperature, final=False)
+            elif final:
                 padded = np.zeros((pad_prompt_len(s),), np.int32)
                 padded[:s] = req.prompt
                 self._cache, self._buf, _ = prefill_chunk_into_row(
@@ -672,7 +922,15 @@ class ServingEngine:
     def _finish_admission(self, job: _PrefillJob) -> None:
         req = job.req
         self._activate_row(req, job.row, job.k_decode)
-        if self.prefix_cache is not None:
+        if self.paged and self.prefix_index is not None:
+            # Zero-copy store: pin the row's OWN prefix pages into the
+            # index (one refcount each) — no donor pool, no device
+            # dispatch. Later admissions of the same prefix alias these
+            # pages straight into their tables.
+            self.prefix_index.store(
+                req.prompt,
+                self._tables[job.row][:req.prompt_len // PAGE])
+        elif self.prefix_cache is not None:
             # The row now holds canonical-path K/V for the whole prompt
             # — store its 16-aligned prefix so later admissions of the
             # same system prompt copy instead of recompute. Sanctioned
@@ -724,6 +982,15 @@ class ServingEngine:
             self._active[row] = False
             self._target[row] = 0
             self.slots.release(row)
+            if self.paged:
+                # Page-granular free: drop every reference this row
+                # held (aliased prefix pages AND private pages). Private
+                # pages a store pinned stay live in the index; the rest
+                # return to the free list. The table resets to the
+                # write sink so the freed row's frozen feed stays dead.
+                self.page_pool.unref(self._row_pages.pop(row, ()))
+                self._row_slack.pop(row, None)
+                self._tables[row] = 0
             self.stats.record_completion(req)
             self.runlog.emit(
                 "complete", request_id=req.request_id, row=row,
@@ -797,15 +1064,34 @@ class ServingEngine:
             faults.check("decode_round", round_idx=self.round_idx)
             with self.tracer.span("serving.decode_round", scope=False,
                                   occupied=self.slots.n_occupied):
-                self._buf, filled_d, done_d, self._cache, iters_d, \
-                    live_d, keys_d = _decode_round(
-                        self.params, self._cache, self._buf,
-                        jnp.asarray(self._filled),
-                        jnp.asarray(self._target),
-                        jnp.asarray(done0), jnp.asarray(self._keys),
-                        cfg=self.cfg,
-                        round_steps=self.round_steps,
-                        temperature=self.temperature, eos_id=self.eos_id)
+                if self.paged:
+                    # The paged round: same scheduling body, KV through
+                    # the page pool + per-row tables (tables are a
+                    # small explicit push; pages are RESERVED at
+                    # admission so the round never allocates).
+                    self._buf, filled_d, done_d, pages_d, iters_d, \
+                        live_d, keys_d = _decode_round_paged(
+                            self.params, self.page_pool.pages, self._buf,
+                            jnp.asarray(self._tables),
+                            jnp.asarray(self._filled),
+                            jnp.asarray(self._target),
+                            jnp.asarray(done0), jnp.asarray(self._keys),
+                            cfg=self.cfg,
+                            round_steps=self.round_steps,
+                            temperature=self.temperature,
+                            eos_id=self.eos_id)
+                    self.page_pool.pages = pages_d
+                else:
+                    self._buf, filled_d, done_d, self._cache, iters_d, \
+                        live_d, keys_d = _decode_round(
+                            self.params, self._cache, self._buf,
+                            jnp.asarray(self._filled),
+                            jnp.asarray(self._target),
+                            jnp.asarray(done0), jnp.asarray(self._keys),
+                            cfg=self.cfg,
+                            round_steps=self.round_steps,
+                            temperature=self.temperature,
+                            eos_id=self.eos_id)
                 filled, done, iters, live, keys = jax.device_get(
                     (filled_d, done_d, iters_d, live_d, keys_d))
             filled = faults.corrupt("decode_round", filled,
@@ -854,6 +1140,26 @@ class ServingEngine:
         live_sum = int(live.sum())
         with self._submit_lock:
             n_prefilling = len(self._prefilling)
+        page_fields = {}
+        if self.paged:
+            # Per-round page ledger: occupancy/aliasing from the pool,
+            # internal fragmentation from the per-row slack tracker
+            # (slack slots in each reservation's last page over the
+            # slots the used pages could hold). Mirrored as a gauge and
+            # narrated offline by tools/runlog_report.py.
+            ps = self.page_pool.summary()
+            used = ps["kv_pages_used"]
+            frag = (sum(self._row_slack.values()) / (PAGE * used)) \
+                if used else 0.0
+            self.metrics.gauge(
+                "serving_kv_page_fragmentation",
+                help="unusable slack slots / slots in used KV pages "
+                     "(docs/serving.md section paged KV)").set(
+                round(frag, 4))
+            page_fields = dict(
+                pages_used=used, pages_free=ps["kv_pages_free"],
+                pages_aliased=ps["kv_pages_aliased"],
+                page_fragmentation=round(frag, 4))
         faults.check("runlog_emit", round_idx=self.round_idx)
         self.runlog.emit(
             "round", round=self.round_idx, iters=int(iters),
@@ -865,7 +1171,8 @@ class ServingEngine:
             wasted_row_iters=int(iters) * self.batch - live_sum,
             round_s=round(time.perf_counter() - t_round0, 6),
             decode_s=round(decode_s, 6),
-            drift_decode=round(self.stats.calibration.drift("decode"), 4))
+            drift_decode=round(self.stats.calibration.drift("decode"), 4),
+            **page_fields)
         self.round_idx += 1
         # Ownership transfers through the return below; the crash-
         # consistency copy is only needed while a raise could still
@@ -958,6 +1265,13 @@ class ServingEngine:
             # depended on it (tests/test_prefix_cache.py).
             new_pc = PrefixCache(self.cfg, pool_rows=pc.pool_rows,
                                  registry=pc._registry)
+        # Paged engines rebuild the page pool + prefix index from
+        # scratch the same way (kv_pages/prefix_sharing carry through
+        # __init__): a crash mid prefix-hit admission leaves TORN page
+        # refcounts — aliases pinned with no owning row — and the pool,
+        # like the row cache, is pure performance state; discarding it
+        # wholesale is the correctness move (tests/test_faults.py pins
+        # the recovery, docs/robustness.md §paged).
         eng = ServingEngine(
             self.params, self.cfg, batch=self.batch,
             round_steps=self.round_steps,
@@ -968,7 +1282,8 @@ class ServingEngine:
             prefill_chunk=self.prefill_chunk,
             prefix_cache=new_pc,
             prefill_chunks_per_round=self.prefill_chunks_per_round,
-            stats=self.stats)
+            stats=self.stats, kv_pages=self.kv_pages,
+            prefix_sharing=self.prefix_sharing)
         eng._next_id = self._next_id
         eng.round_idx = self.round_idx + 1
         if self.queue.closed:
@@ -1037,6 +1352,10 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             out["prefix_pool"] = self.prefix_cache.summary()
+        if self.paged:
+            out["kv_pages"] = self.page_pool.summary()
+            if self.prefix_index is not None:
+                out["prefix_index"] = self.prefix_index.summary()
         return out
 
     def debug_request(self, request_id: int) -> Optional[dict]:

@@ -1,0 +1,235 @@
+"""Paged KV storage for the serving engine: a block-granular device
+page pool with host-side allocation, refcounts, and zero-copy sharing.
+
+PR 4's prefix reuse copies donor KV rows and PR 2's row-granular cache
+binds one ``max_len`` row to every live request — at production fan-out
+(thousands of requests off a few system prompts) the admission copy
+bytes and the row-granular residency are the dominant costs on the
+memory-bandwidth-bound serving path. This module is the PagedAttention
+answer (vLLM, SOSP '23 — PAPERS.md) shaped for the frozen-row
+substrate:
+
+* the KV cache is ONE device pool per layer, ``(n_pages, PAGE, Hk,
+  Dh)`` per KV key at the 16-token PAGE granularity the radix trie
+  already chunks by (``serving/prefix.GRAIN``) — int8 caches carry
+  their per-vector scale buffers as sibling pool entries
+  (``models/quant.kv_layer_keys``), so scales travel with their pages;
+* a batch row holds a PAGE TABLE (int32, ``max_len // PAGE`` entries),
+  not KV rows — attention reads gather pages into the dense layout
+  (``models/transformer.gather_kv_pages``), writes scatter through the
+  table (bit-exact by the gather-of-identical-bytes argument,
+  docs/serving.md §paged KV);
+* a prefix hit is REFCOUNTED PAGE-TABLE ALIASING: admission writes a
+  page table, never KV bytes (``admission_copy_bytes == 0``), and a
+  store into the prefix index is a refcount bump on the row's own
+  prefix pages — zero copy in BOTH directions;
+* eviction and free run at page granularity: a page returns to the
+  free list exactly when its last reference (row table or stored
+  prefix) drops, so evicting a stored prefix that live rows still
+  alias frees nothing until those rows retire — no use-after-free by
+  construction.
+
+Allocation is RESERVATION-BASED, not on-demand: a request's page count
+is exact at admission (``ceil((prompt_len + steps) / PAGE)`` minus its
+aliased prefix pages — the engine knows ``steps`` up front), so a
+placed request can never OOM mid-decode and the engine needs no
+preemption/swap machinery. Page 0 is the reserved WRITE SINK: frozen
+rows' fixed-point rewrites and mid-prefill parked feeds scatter their
+dead values there (table entries of unallocated chunks point at it);
+it is never allocated, never referenced, and never read through a live
+mask.
+
+Thread-safety: the allocator state (free list, refcounts) is read by
+HTTP handler threads through ``summary()``/``debug`` surfaces while
+the driver thread allocates and frees, so every mutation and every
+reading scan holds ``_lock``. The device pool itself is single-writer
+(driver-thread dispatches only) and donated through every jitted entry
+point — host fetches MUST be ``np.array`` copies (marlint
+donation-fetch, docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from ..models import init_kv_cache
+from ..obs import metrics as obs_metrics
+
+PAGE = 16  # tokens per page: the flash 16-sublane bucket, the trie
+# GRAIN, and the finest split the chunked admission path is bit-stable
+# under — one constant, three subsystems (docs/serving.md §paged KV).
+
+SINK_PAGE = 0  # reserved write sink (module docstring); never allocated
+
+
+class PagePool:
+    """Device page pool + host allocator/refcounts for the paged engine.
+
+    Construct with the SAME :class:`TransformerConfig` as the engine
+    (pages must be shape- and quantization-identical to what the chunk
+    body writes) and ``n_pages`` USABLE pages — the device allocation
+    is ``n_pages + 1`` (the write sink rides at index 0). One pool
+    serves one engine; ``ServingEngine.spawn_successor`` rebuilds a
+    fresh pool after a crash (torn refcounts discarded,
+    docs/robustness.md)."""
+
+    def __init__(self, cfg, n_pages: int, registry=None):
+        if not isinstance(n_pages, int) or isinstance(n_pages, bool) \
+                or n_pages < 1:
+            raise ValueError(
+                f"n_pages must be an int >= 1, got {n_pages!r}")
+        if cfg.max_len % PAGE:
+            raise ValueError(
+                f"paged KV needs max_len divisible by the page size "
+                f"{PAGE}, got max_len={cfg.max_len}")
+        if cfg.window:
+            raise ValueError(
+                "paged KV needs the dense slot==position layout "
+                "(cfg.window == 0); a ring cache cannot be paged at "
+                "fixed position-aligned chunks")
+        self.cfg = cfg
+        self.n_pages = n_pages
+        # Per-layer (n_pages + 1, PAGE, Hk, Dh) buffers (+ scales on an
+        # int8 cfg): init_kv_cache at max_len=PAGE is exactly the page
+        # shape, so pool pages are bit-compatible with cache rows.
+        self.pages = init_kv_cache(  # donated-buffer
+            cfg._replace(max_len=PAGE), n_pages + 1,
+            dtype=cfg.compute_dtype)
+        self._registry = registry
+        # Allocator state: pages 1..n_pages start free; refcounts exist
+        # only for live pages (allocated rows + stored prefixes).
+        self._free: List[int] = list(range(1, n_pages + 1))[::-1]  # guarded-by: _lock
+        self._refs: Dict[int, int] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self.allocs = 0
+        self.frees = 0
+        self.alloc_failures = 0
+        self.registry.gauge(
+            "serving_kv_pages_total",
+            help="usable KV pages in the paged pool (excludes the "
+                 "write sink)").set(n_pages)
+        with self._lock:
+            self._mirror_locked()
+
+    # -- bookkeeping --------------------------------------------------
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None \
+            else obs_metrics.registry
+
+    @property
+    def page_bytes(self) -> int:
+        """Device bytes of ONE page across every layer's KV (and scale)
+        buffers — the denominator of the capacity-per-byte claim."""
+        total = 0
+        for layer in self.pages:
+            for name in layer:
+                buf = layer[name]
+                total += buf.dtype.itemsize * int(
+                    buf.shape[1] * buf.shape[2] * buf.shape[3])
+        return total
+
+    @property
+    def pool_bytes(self) -> int:
+        return self.page_bytes * self.n_pages
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_pages - self.n_free
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def _mirror_locked(self) -> None:  # marlint: holds=_lock
+        reg = self.registry
+        used = self.n_pages - len(self._free)
+        aliased = sum(1 for n in self._refs.values() if n >= 2)
+        reg.gauge("serving_kv_pages_used",
+                  help="KV pages currently referenced by a row table "
+                       "or a stored prefix").set(used)
+        reg.gauge("serving_kv_pages_aliased",
+                  help="KV pages with >= 2 references (shared between "
+                       "rows and/or stored prefixes)").set(aliased)
+
+    # -- allocate / reference / free ----------------------------------
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` free pages (each at refcount 1) or None when the
+        free list is short — the caller decides whether to evict stored
+        prefixes and retry or leave the request queued. ``n == 0``
+        returns an empty list (a fully-aliased admission allocates
+        nothing)."""
+        if n < 0:
+            raise ValueError(f"alloc of {n} pages")
+        with self._lock:
+            if n > len(self._free):
+                self.alloc_failures += 1
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._refs[p] = 1
+            self.allocs += n
+            self._mirror_locked()
+        return out
+
+    def ref(self, pages: Iterable[int]) -> None:
+        """Add one reference to each LIVE page — the zero-copy half of
+        a prefix hit (aliasing stored pages into a row table) and of a
+        store (pinning a row's prefix pages into the index)."""
+        with self._lock:
+            for p in pages:
+                if self._refs.get(p, 0) <= 0:
+                    raise RuntimeError(
+                        f"ref of free/unallocated page {p} (refcount "
+                        "discipline bug: aliases may only point at "
+                        "live pages)")
+            for p in pages:
+                self._refs[p] += 1
+            self._mirror_locked()
+
+    def unref(self, pages: Iterable[int]) -> None:
+        """Drop one reference per page; a page reaching zero returns to
+        the free list exactly once (the property test's invariant)."""
+        with self._lock:
+            for p in pages:
+                n = self._refs.get(p, 0)
+                if n <= 0:
+                    raise RuntimeError(
+                        f"unref of free page {p} (double free)")
+            for p in pages:
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._free.append(p)
+                    self.frees += 1
+            self._mirror_locked()
+
+    # -- observability ------------------------------------------------
+
+    def summary(self) -> dict:
+        """The page-pool ledger block (EngineStats.summary /
+        GET /debug/engine / the bench line). Point-in-time consistent:
+        one lock hold covers the whole scan."""
+        with self._lock:
+            used = self.n_pages - len(self._free)
+            aliased = sum(1 for n in self._refs.values() if n >= 2)
+            refs_total = sum(self._refs.values())
+            return {
+                "kv_pages_total": self.n_pages,
+                "kv_pages_free": len(self._free),
+                "kv_pages_used": used,
+                "kv_pages_aliased": aliased,
+                "kv_page_refs_total": refs_total,
+                "kv_page_bytes": self.page_bytes,
+                "kv_page_allocs": self.allocs,
+                "kv_page_frees": self.frees,
+                "kv_page_alloc_failures": self.alloc_failures,
+            }

@@ -87,14 +87,67 @@ def _floor_grain(n: int) -> int:
 
 class _TrieNode:
     """One radix-trie node: children keyed by the next 16-token chunk's
-    bytes; ``rows`` = pool rows whose stored prefix passes through this
-    node (i.e. covers this depth) — lookup's hit set at this depth."""
+    bytes; ``rows`` = the MEMBERS whose stored prefix passes through
+    this node (pool rows for :class:`PrefixCache`, entry ids for
+    :class:`PagedPrefixIndex`) — lookup's hit set at this depth."""
 
     __slots__ = ("children", "rows")
 
     def __init__(self):
         self.children: Dict[bytes, "_TrieNode"] = {}
         self.rows: set = set()
+
+
+# The ONE copy of the trie machinery, shared by both prefix surfaces
+# (copy-based PrefixCache, zero-copy PagedPrefixIndex): a fix to hit
+# semantics, insert, or pruning cannot land in one and miss the other.
+
+def _trie_chunks(tokens: np.ndarray, length: int):
+    for d in range(length // GRAIN):
+        yield tokens[d * GRAIN:(d + 1) * GRAIN].tobytes()
+
+
+def _trie_descend(root: _TrieNode, prompt: np.ndarray, limit: int):
+    """Walk ``root`` along ``prompt``'s 16-chunks up to ``limit``
+    tokens; returns ``(node, depth)`` for the DEEPEST node holding live
+    members (``(None, 0)`` on a clean miss) — the one walk both lookup
+    (hit selection) and store (coverage dedup) are defined by, so hit
+    and dedup semantics cannot drift apart."""
+    node = root
+    best, best_depth = None, 0
+    for d in range(limit // GRAIN):
+        key = prompt[d * GRAIN:(d + 1) * GRAIN].tobytes()
+        node = node.children.get(key)
+        if node is None:
+            break
+        if node.rows:
+            best, best_depth = node, (d + 1) * GRAIN
+    return best, best_depth
+
+
+def _trie_insert(root: _TrieNode, tokens: np.ndarray, length: int,
+                 member) -> None:
+    node = root
+    for key in _trie_chunks(tokens, length):
+        node = node.children.setdefault(key, _TrieNode())
+        node.rows.add(member)
+
+
+def _trie_remove(root: _TrieNode, tokens: np.ndarray, length: int,
+                 member) -> None:
+    """Remove ``member`` from its path, pruning now-empty branches
+    bottom-up so the trie stays O(stored tokens), not O(ever-stored
+    tokens)."""
+    node = root
+    path = []
+    for key in _trie_chunks(tokens, length):
+        path.append((node, key))
+        node = node.children[key]
+        node.rows.discard(member)
+    for parent, key in reversed(path):
+        child = parent.children[key]
+        if not child.rows and not child.children:
+            del parent.children[key]
 
 
 class PrefixCache:
@@ -170,27 +223,10 @@ class PrefixCache:
         self._clock += 1
         self._used[row] = self._clock
 
-    def _chunks(self, tokens: np.ndarray, length: int):
-        for d in range(length // GRAIN):
-            yield tokens[d * GRAIN:(d + 1) * GRAIN].tobytes()
-
     def _descend(self, prompt: np.ndarray, limit: int):
-        """Walk the trie along ``prompt``'s 16-chunks up to ``limit``
-        tokens; returns ``(node, depth)`` for the DEEPEST node holding
-        live rows (``(None, 0)`` on a clean miss) — the one walk both
-        :meth:`lookup` (hit selection) and :meth:`store_from` (coverage
-        dedup) are defined by, so hit and dedup semantics cannot
-        drift apart."""
-        node = self._root
-        best, best_depth = None, 0
-        for d in range(limit // GRAIN):
-            key = prompt[d * GRAIN:(d + 1) * GRAIN].tobytes()
-            node = node.children.get(key)
-            if node is None:
-                break
-            if node.rows:
-                best, best_depth = node, (d + 1) * GRAIN
-        return best, best_depth
+        """:func:`_trie_descend` over this cache's root (module
+        comment: the shared walk both lookup and store dedup use)."""
+        return _trie_descend(self._root, prompt, limit)
 
     # -- refcounts ----------------------------------------------------
 
@@ -263,18 +299,7 @@ class PrefixCache:
 
     def _evict(self, row: int) -> None:
         tokens, length = self._tokens[row], self._len[row]
-        node = self._root
-        path = []
-        for key in self._chunks(tokens, length):
-            path.append((node, key))
-            node = node.children[key]
-            node.rows.discard(row)
-        # Prune now-empty branches bottom-up so the trie stays O(stored
-        # tokens), not O(ever-stored tokens).
-        for parent, key in reversed(path):
-            child = parent.children[key]
-            if not child.rows and not child.children:
-                del parent.children[key]
+        _trie_remove(self._root, tokens, length, row)
         del self._tokens[row], self._len[row]
         self._used.pop(row, None)
         self._refs.pop(row, None)
@@ -316,10 +341,7 @@ class PrefixCache:
         self.pool = copy_kv_rows(self.pool, cache, jnp.int32(row),
                                  jnp.int32(src_row), length=length)
         tokens = prompt[:length].copy()
-        node = self._root
-        for key in self._chunks(tokens, length):
-            node = node.children.setdefault(key, _TrieNode())
-            node.rows.add(row)
+        _trie_insert(self._root, tokens, length, row)
         self._len[row] = length
         self._tokens[row] = tokens
         self._touch(row)
@@ -344,4 +366,180 @@ class PrefixCache:
             "prefix_evictions": self.evictions,
             "prefix_pool_rows_used": self.rows_used,
             "prefix_pool_rows": self.pool_rows,
+        }
+
+
+class _PrefixEntry:
+    """One stored prefix in the paged index: its tokens, 16-aligned
+    length, and the POOL PAGES holding its K/V — aliased, not owned
+    exclusively (per-page refcounts in serving/pages.PagePool arbitrate
+    lifetime; the entry holds exactly one reference per page)."""
+
+    __slots__ = ("entry_id", "tokens", "length", "pages")
+
+    def __init__(self, entry_id: int, tokens: np.ndarray, length: int,
+                 pages: Tuple[int, ...]):
+        self.entry_id = entry_id
+        self.tokens = tokens
+        self.length = length
+        self.pages = pages
+
+
+class PagedPrefixIndex:
+    """The radix trie mapped to PAGE LISTS — the paged engine's prefix
+    surface (serving/pages.py; docs/serving.md §paged KV).
+
+    Same trie/GRAIN/LRU semantics as :class:`PrefixCache`, but the
+    device side vanishes: a *store* pins the admitted row's own prefix
+    pages with one refcount each (zero copy — no donor pool, no
+    ``copy_kv_rows`` dispatch), and a *hit* hands the admission a page
+    list to alias into the new row's table (zero copy again —
+    ``admission_copy_bytes == 0`` is structural, not an optimization).
+    Eviction drops the index's references; pages still aliased by live
+    rows stay out of the free list until those rows retire, so there is
+    no refcount-pinned "cannot store" case and no use-after-evict.
+
+    Driver-owned: the engine's driver thread is the only mutator, and
+    the summary exposed to handler threads reads scalar counters only.
+    Hit/miss/reclaim counters are bumped by :meth:`record` AFTER the
+    engine successfully places the admission — a lookup whose placement
+    fails on page pressure (request stays queued, retried next round)
+    must not double-count.
+    """
+
+    def __init__(self, pool, registry=None):
+        self.pool = pool
+        self._registry = registry
+        self._root = _TrieNode()   # rows-sets hold ENTRY IDs here
+        self._entries: Dict[int, _PrefixEntry] = {}
+        self._used: Dict[int, int] = {}   # entry id -> LRU clock stamp
+        self._clock = 0
+        self._next_id = 0
+        self.stored_tokens = 0
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.store_skips = 0
+        self.evictions = 0
+        self.reclaimed_tokens = 0
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None \
+            else obs_metrics.registry
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+    def _touch(self, entry_id: int) -> None:
+        self._clock += 1
+        self._used[entry_id] = self._clock
+
+    # -- lookup / account ---------------------------------------------
+
+    def lookup(self, prompt: np.ndarray):
+        """Longest stored prefix of ``prompt`` at GRAIN granularity:
+        ``(page_list, hit_len)`` or ``(None, 0)``. Pure apart from the
+        LRU touch — counters land in :meth:`record` once the engine has
+        actually placed the admission (class docstring). Hit capped at
+        ``floor16(prompt_len - 1)`` exactly like :class:`PrefixCache`
+        (the last prompt position is always computed, never stored)."""
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        limit = _floor_grain(int(prompt.shape[0]) - 1)
+        node, hit = _trie_descend(self._root, prompt, limit)
+        if not hit:
+            return None, 0
+        eid = max(node.rows, key=lambda e: self._used.get(e, 0))
+        self._touch(eid)
+        return self._entries[eid].pages[:hit // GRAIN], hit
+
+    def record(self, hit_len: int) -> None:
+        """Account one PLACED admission's lookup outcome."""
+        if hit_len:
+            self.hits += 1
+            self.reclaimed_tokens += hit_len
+        else:
+            self.misses += 1
+
+    # -- store / evict ------------------------------------------------
+
+    def store(self, prompt: np.ndarray, pages) -> int:
+        """Pin ``prompt``'s GRAIN-aligned prefix into the index by
+        REFERENCING the admitted row's own pages — ``pages`` must cover
+        chunks ``[0, floor16(prompt_len) / GRAIN)`` of the row's table.
+        Zero device work; returns the stored length (0 when skipped as
+        already covered)."""
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        length = _floor_grain(int(prompt.shape[0]))
+        if length == 0:
+            return 0
+        _, covered = _trie_descend(self._root, prompt, length)
+        if covered >= length:
+            self.store_skips += 1
+            return 0
+        page_list = tuple(int(p) for p in pages)[:length // GRAIN]
+        if len(page_list) != length // GRAIN:
+            raise ValueError(
+                f"store of {length} tokens needs {length // GRAIN} "
+                f"pages, got {len(page_list)}")
+        self.pool.ref(page_list)  # one index reference per page
+        eid = self._next_id
+        self._next_id += 1
+        tokens = prompt[:length].copy()
+        _trie_insert(self._root, tokens, length, eid)
+        self._entries[eid] = _PrefixEntry(eid, tokens, length, page_list)
+        self.stored_tokens += length
+        self._touch(eid)
+        self.stores += 1
+        self.registry.counter("serving_prefix_stores_total").inc()
+        self.registry.gauge("serving_prefix_entries").set(
+            len(self._entries))
+        return length
+
+    def _evict(self, eid: int) -> None:
+        entry = self._entries[eid]
+        _trie_remove(self._root, entry.tokens, entry.length, eid)
+        del self._entries[eid]
+        self._used.pop(eid, None)
+        self.stored_tokens -= entry.length
+        # Drop the index's references; pages free when the LAST holder
+        # (a live row still aliasing them, perhaps) lets go.
+        self.pool.unref(entry.pages)
+        self.evictions += 1
+        self.registry.counter("serving_prefix_evictions_total").inc()
+        self.registry.gauge("serving_prefix_entries").set(
+            len(self._entries))
+
+    def evict_lru(self) -> bool:
+        """Evict the least-recently-used entry; False when empty."""
+        if not self._entries:
+            return False
+        self._evict(min(self._entries,
+                        key=lambda e: self._used.get(e, 0)))
+        return True
+
+    def evict_until_free(self, n_pages: int) -> None:
+        """Evict LRU entries until the pool has ``n_pages`` free pages
+        or the index is empty. Eviction of an entry whose pages live
+        rows still alias frees nothing immediately — the loop makes no
+        progress assumption beyond running out of entries."""
+        while self.pool.n_free < n_pages and self._entries:
+            self.evict_lru()
+
+    # -- observability ------------------------------------------------
+
+    def summary(self) -> dict:
+        """Scalar-only ledger block (safe from handler threads)."""
+        total = self.hits + self.misses
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "prefix_reclaimed_prefill_tokens": self.reclaimed_tokens,
+            "prefix_stores": self.stores,
+            "prefix_store_skips": self.store_skips,
+            "prefix_evictions": self.evictions,
+            "prefix_entries": len(self._entries),
+            "prefix_stored_tokens": self.stored_tokens,
         }

@@ -248,6 +248,16 @@ class AdmissionQueue:
                 return req, expired
         return None, expired
 
+    def push_front(self, req: Request) -> None:
+        """Return a popped-but-unplaced request to the queue HEAD — the
+        paged engine's page-pressure path (a reservation that doesn't
+        fit leaves the request first in line; admission retries once
+        retires free pages). Bypasses the caps like :meth:`restore`:
+        the request was already accepted once, and its pop was a
+        scheduling probe, not a drop decision."""
+        with self._lock:
+            self._q.appendleft(req)
+
     def restore(self, req: Request) -> None:
         """Supervised-restart recovery path (serving/frontend.py):
         re-append a captured request, bypassing BOTH the ``max_pending``

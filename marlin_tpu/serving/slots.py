@@ -52,6 +52,25 @@ import jax.numpy as jnp
 from ..models import transformer as tr
 
 
+def _write_row_tokens(buf, row, prompt, prompt_len, first):
+    """The ONE copy of the admission token-buffer contract, shared by
+    every prefill entry point: row ``row`` of ``buf`` becomes the real
+    prompt in [0, prompt_len), zeros past it (wiping the previous
+    occupant's stale tokens), and the first generated token at index
+    ``prompt_len`` — exactly the layout retire's output extraction
+    reads. ``row``/``prompt_len``/``first`` traced; built full-width
+    then written as one row update."""
+    zero = jnp.zeros((), row.dtype)
+    length = buf.shape[1]
+    rowbuf = jnp.zeros((length,), buf.dtype)
+    rowbuf = jax.lax.dynamic_update_slice(rowbuf, prompt.astype(buf.dtype),
+                                          (0,))
+    rowbuf = jnp.where(jnp.arange(length) < prompt_len, rowbuf, 0)
+    rowbuf = jax.lax.dynamic_update_slice(
+        rowbuf, first[None].astype(buf.dtype), (prompt_len,))
+    return jax.lax.dynamic_update_slice(buf, rowbuf[None], (row, zero))
+
+
 def pad_prompt_len(prompt_len: int) -> int:
     """The padded (static) admission shape for a prompt: the flash
     kernel's 16-sublane bucket — the unique padding that keeps the
@@ -129,17 +148,7 @@ def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
                               (1, x.shape[-1]))
     logits = tr._readout(params, h)  # (1, V)
     first = tr._sample(logits, temperature, key)[0]
-
-    # Token-buffer row: real prompt, zeros past it, first token at
-    # prompt_len. Built full-width then written as one row update.
-    length = buf.shape[1]
-    rowbuf = jnp.zeros((length,), buf.dtype)
-    rowbuf = jax.lax.dynamic_update_slice(rowbuf, prompt.astype(buf.dtype),
-                                          (0,))
-    rowbuf = jnp.where(jnp.arange(length) < prompt_len, rowbuf, 0)
-    rowbuf = jax.lax.dynamic_update_slice(
-        rowbuf, first[None].astype(buf.dtype), (prompt_len,))
-    buf = jax.lax.dynamic_update_slice(buf, rowbuf[None], (row, zero))
+    buf = _write_row_tokens(buf, row, prompt, prompt_len, first)
     return cache, buf, prompt_len + 1, first
 
 
@@ -186,7 +195,6 @@ def prefill_chunk_into_row(params, cache, buf, row, chunk, start, chunk_len,
     the flash one-shot path is ARGMAX-level, not bitwise (different
     attention kernels); the engine therefore never mixes the two
     disciplines within one mode (docs/serving.md §prefix cache)."""
-    zero = jnp.zeros((), row.dtype)
     row_cache = [
         {name: jax.lax.dynamic_slice_in_dim(layer[name], row, 1, axis=0)
          for name in layer}
@@ -204,15 +212,47 @@ def prefill_chunk_into_row(params, cache, buf, row, chunk, start, chunk_len,
     if not final:
         return cache, buf
     first = tr._sample(logits, temperature, key)[0]
-    length = buf.shape[1]
-    rowbuf = jnp.zeros((length,), buf.dtype)
-    rowbuf = jax.lax.dynamic_update_slice(rowbuf, prompt.astype(buf.dtype),
-                                          (0,))
-    rowbuf = jnp.where(jnp.arange(length) < prompt_len, rowbuf, 0)
-    rowbuf = jax.lax.dynamic_update_slice(
-        rowbuf, first[None].astype(buf.dtype), (prompt_len,))
-    buf = jax.lax.dynamic_update_slice(buf, rowbuf[None], (row, zero))
+    buf = _write_row_tokens(buf, row, prompt, prompt_len, first)
     return cache, buf, first
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "final"),
+    donate_argnums=(1, 2),
+)
+@jax.named_scope("marlin.serving.prefill_chunk_paged")
+def prefill_chunk_into_row_paged(params, pool, buf, row, table, chunk,
+                                 start, chunk_len, prompt, prompt_len,
+                                 key, cfg, temperature: float = 0.0,
+                                 final: bool = False):
+    """The PAGED sibling of :func:`prefill_chunk_into_row`: one
+    admission-prefill chunk written through the row's PAGE TABLE into
+    the shared page pool (serving/pages.py) instead of into a
+    contiguous cache row.
+
+    ``pool`` (the per-layer page buffers) and ``buf`` are DONATED;
+    ``table`` is the row's traced (max_len // PAGE,) int32 page table —
+    the row indirection lives entirely in the table, so no KV row index
+    exists here (``row`` addresses only the token buffer). Earlier
+    chunks — or ALIASED prefix pages from a zero-copy hit — must
+    already hold K/V for [0, start). Static axes and the
+    ``final``-chunk contract (first-token sample + whole-row token
+    buffer write) match the contiguous sibling exactly; compiles are
+    bounded by distinct 16-buckets, not admissions.
+
+    Bit-exactness: the chunk body is :func:`models.transformer.
+    _chunk_states_paged` — the same per-position math over
+    page-gathered reads, bit-identical to the contiguous path
+    (docs/serving.md §paged KV; pinned in tests/test_paged_kv.py)."""
+    logits, pool = tr.prefill_chunk_paged(
+        params, pool, table[None], chunk[None], start, cfg,
+        last=chunk_len - 1)
+    if not final:
+        return pool, buf
+    first = tr._sample(logits, temperature, key)[0]
+    buf = _write_row_tokens(buf, row, prompt, prompt_len, first)
+    return pool, buf, first
 
 
 class SlotManager:

@@ -145,6 +145,18 @@ class EngineStats:
     n_prefix_misses: int = 0
     reclaimed_prefill_tokens: int = 0
     reclaimed_prefill_flops: float = 0.0
+    # Admission byte ledger (paged KV, docs/serving.md §paged KV):
+    # KV bytes MOVED to satisfy prefix hits. The contiguous engine's
+    # copy-based reuse bills each hit's donor-row copy here; the paged
+    # engine's zero-copy aliasing bills 0 and counts the hit — the
+    # SLO baseline pins admission_copy_bytes ~0 in the paged arm.
+    admission_copy_bytes: float = 0.0
+    n_zero_copy_hits: int = 0
+    # The CURRENT engine incarnation's page pool (serving/pages.py;
+    # None on contiguous engines). Rebound by every ServingEngine
+    # __init__ — the stats object outlives crashed engines, the pool
+    # does not.
+    page_pool: object = None
     # Crash-recovery ledger (supervised restart, serving/frontend.py;
     # docs/robustness.md). The stats object is CARRIED ACROSS engine
     # incarnations by ``ServingEngine.spawn_successor`` — one serving
@@ -214,6 +226,26 @@ class EngineStats:
                 self.registry.counter(
                     "serving_prefix_reclaimed_prefill_tokens_total").inc(
                         hit_len)
+
+    def record_admission_copy(self, n_bytes: float,
+                              zero_copy: bool = False) -> None:
+        """One prefix-hit admission's KV byte bill: the donor-row copy
+        traffic on the contiguous engine, exactly 0 on the paged engine
+        (``zero_copy=True`` counts the aliasing hit instead)."""
+        self.admission_copy_bytes += n_bytes
+        if self.registry is not None and n_bytes:
+            self.registry.counter(
+                "serving_admission_copy_bytes_total",
+                help="KV bytes copied to satisfy prefix-hit admissions "
+                     "(0 by construction on the paged engine)").inc(
+                n_bytes)
+        if zero_copy:
+            self.n_zero_copy_hits += 1
+            if self.registry is not None:
+                self.registry.counter(
+                    "serving_kv_zero_copy_hits_total",
+                    help="prefix hits admitted by page-table aliasing "
+                         "with zero KV bytes moved").inc()
 
     def prefix_hit_rate(self) -> float:
         total = self.n_prefix_hits + self.n_prefix_misses
@@ -404,7 +436,11 @@ class EngineStats:
                     self.reclaimed_prefill_tokens,
                 "prefix_reclaimed_prefill_gflops": round(
                     self.reclaimed_prefill_flops / 1e9, 4),
+                "admission_copy_bytes": self.admission_copy_bytes,
+                "zero_copy_hits": self.n_zero_copy_hits,
             })
+        if self.page_pool is not None:
+            out["kv_pages"] = self.page_pool.summary()
         done = [c for c in self.completed_snapshot()
                 if c["status"] == "done"]
         if done:

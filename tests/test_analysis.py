@@ -115,6 +115,37 @@ class TestDonationFetch:
         }, rules=["donation-fetch"])
         assert any(f.path == "serving/frontend.py" for f in rep.findings)
 
+    def test_paged_pool_fetch_is_covered(self, tmp_path):
+        # The PR-9 paged pool: ``PagePool.pages`` is a donated buffer
+        # (every paged round/prefill re-threads it), so the PR-2 CPU
+        # zero-copy-view hazard applies to it VERBATIM — a device_get
+        # of the pool (even through an engine attribute chain) must
+        # fire; the np.array snapshot the tests use stays quiet.
+        rep = run_lint(tmp_path, {"serving/pages.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            class PagePool:
+                def __init__(self, n):
+                    self.pages = jnp.zeros((n, 16))  # donated-buffer
+
+                def snapshot_bug(self):
+                    return jax.device_get(self.pages)
+
+                def snapshot_ok(self):
+                    return np.array(self.pages)
+
+            def debug_bug(eng):
+                # cross-attribute chain: the engine's pool is the SAME
+                # declared buffer by name.
+                return np.asarray(eng.page_pool.pages)
+        """}, rules=["donation-fetch"])
+        msgs = " ".join(f.message for f in rep.findings)
+        assert len(rep.findings) == 2
+        assert "jax.device_get() on donated buffer `.pages`" in msgs
+        assert "np.asarray() on donated buffer `.pages`" in msgs
+
     def test_suppression_and_baseline(self, tmp_path):
         files = {"serving/engine.py": ENGINE_FIXTURE.replace(
             "return jax.device_get(self._buf)",

@@ -332,10 +332,26 @@ class TestServingTraceSmoke:
         assert pline["value"] >= 1.3, pline
         assert pline["prefix_hit_rate"] >= 0.5
         assert pline["prefix_reclaimed_prefill_tokens"] > 0
+        assert pline["admission_copy_bytes"] > 0  # copy-based arm bills
         assert pline["recompiles_after_warmup"] == 0
         assert pline["recompiles_after_warmup_off"] == 0
         assert pline["metrics"]["counters"][
             "serving_prefix_hits_total"] > 0
+        # The paged KV line (PR 9, ROADMAP 13): zero-copy sharing beats
+        # the 1.72x done-bar, admission moves ZERO KV bytes, compiles
+        # stay bounded in both arms, and the allocator capacity sweep
+        # holds strictly more sequences per pool byte than the row
+        # cache — before sharing multiplies it further.
+        (gline,) = [d for d in lines if d["metric"] == "serving_paged_kv"]
+        assert gline["value"] >= 1.72, gline
+        assert gline["admission_copy_bytes"] == 0
+        assert gline["zero_copy_hits"] > 0
+        assert gline["recompiles_after_warmup"] == 0
+        assert gline["recompiles_after_warmup_off"] == 0
+        assert gline["capacity_vs_row"] > 1.0
+        assert gline["capacity_shared_vs_row"] > gline["capacity_vs_row"]
+        assert gline["metrics"]["counters"][
+            "serving_kv_zero_copy_hits_total"] > 0
         # The SLO gate, end to end: artifact -> committed baseline.
         artifact = tmp_path / "serving_artifact.jsonl"
         artifact.write_text(r.stdout)

@@ -338,6 +338,59 @@ class TestBitExactRecovery:
         _assert_exact_accounting(fe, reg, 5)
         assert fe.drain(30.0)
 
+    def test_paged_alias_crash_discards_torn_refcounts(self, model):
+        """PAGED engine, crash landing MID prefix-hit admission: the
+        fault fires at the same ``prefix_copy`` site, after the hit's
+        pages were refcount-pinned but before the row armed — exactly
+        the torn-refcount state ``spawn_successor`` exists to discard.
+        The successor gets a FRESH PagePool + index, replays
+        bit-exactly, and ends with a pool whose only references are its
+        own stored prefixes (no leaked pins from the dead
+        incarnation)."""
+        params, cfg = model
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, cfg.vocab, 32).astype(np.int32)
+        prompts = [np.concatenate([shared, rng.integers(
+            0, cfg.vocab, 8).astype(np.int32)]) for _ in range(5)]
+        kw = dict(batch=2, round_steps=2, kv_pages=12)
+        eng_gold = ServingEngine(params, cfg,
+                                 metrics_registry=MetricsRegistry(),
+                                 **kw)
+        for p in prompts:
+            eng_gold.submit(p, 4)
+        gold = {r.request_id: list(map(int, r.tokens))
+                for r in eng_gold.run()}
+        plan = faults.install(faults.FaultPlan())
+        # Request 2 shares request 0's stored prefix -> its admission
+        # takes the zero-copy alias path, which crashes mid-pin.
+        plan.add(site="prefix_copy", request_id=2)
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, metrics_registry=reg, **kw)
+        crashed_pool = eng.page_pool
+        fe = EngineFrontend(eng).start()
+        handles = [fe.submit(p, 4) for p in prompts]
+        results = {h.request_id: h.result(60.0) for h in handles}
+        faults.reset()
+        assert plan.total_fires() == 1  # the alias path really ran
+        assert fe.restarts == 1
+        for rid, r in results.items():
+            assert list(map(int, r.tokens)) == gold[rid], rid
+        _assert_exact_accounting(fe, reg, 5)
+        # No double-count across the replay: hit/miss accounting lands
+        # AFTER the aliasing fault site, so the crashed attempt (which
+        # fired mid-pin, before the record) contributes nothing — every
+        # recorded lookup corresponds to an admission that completed.
+        st = fe.engine.stats
+        assert st.n_prefix_hits + st.n_prefix_misses == st.n_admitted
+        # The successor rebuilt storage from scratch; the crashed
+        # pool's torn pins were discarded wholesale with it.
+        pool = fe.engine.page_pool
+        assert pool is not crashed_pool
+        stored = sum(e.length // 16
+                     for e in fe.engine.prefix_index._entries.values())
+        assert pool.n_used == stored  # rows all retired: no leaked refs
+        assert fe.drain(30.0)
+
     def test_corrupted_fetch_is_detected_and_recovered(self, model):
         """A corrupted device fetch is not served: the engine's sanity
         bounds raise EngineStateCorrupt, the supervisor rebuilds, and

@@ -232,6 +232,61 @@ class TestSyntheticRunlogs:
         report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
         assert report["ok"] is True, report["anomalies"]
 
+    def test_page_pressure_is_not_a_stall_and_pages_are_narrated(
+            self, rr, tmp_path):
+        # PAGED engine (PR 9): a round pair that sits on ready work
+        # with a free ROW is legal when the PAGE pool couldn't fit a
+        # worst-case reservation (pages_free < max_len/16 = 4 here) —
+        # the same pair WITH enough free pages stays a stall. The round
+        # series also narrates the page ledger.
+        stall_pair = [
+            {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "pages_used": 6, "pages_free": 2, "pages_aliased": 3,
+             "page_fragmentation": 0.25},
+            {"kind": "round", "t": 0.107, "round": 3, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "pages_used": 6, "pages_free": 2, "pages_aliased": 3,
+             "page_fragmentation": 0.25},
+        ]
+        events = _clean_events()
+        events[0] = dict(events[0], kv_pages=8, prefix_sharing=True)
+        events[-1:-1] = stall_pair
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert not [a for a in report["anomalies"]
+                    if a["kind"] == "queue_stall"], report["anomalies"]
+        kp = report["rounds"]["kv_pages"]
+        assert kp["pages_used_max"] == 6
+        assert kp["pages_aliased_max"] == 3
+        assert kp["fragmentation_max"] == 0.25
+        # Same narrative with ROOM in the pool: the stall is real.
+        events2 = _clean_events()
+        events2[0] = dict(events2[0], kv_pages=8, prefix_sharing=True)
+        roomy = [dict(ev, pages_free=6, pages_used=2)
+                 for ev in stall_pair]
+        events2[-1:-1] = roomy
+        report2 = rr.build_report(rr.load_runlog(_write(tmp_path,
+                                                        events2)))
+        assert [a for a in report2["anomalies"]
+                if a["kind"] == "queue_stall"]
+        # A pool SMALLER than one worst-case reservation (kv_pages=3 <
+        # max_len/16=4) clamps the bar to the pool size: an all-free
+        # pool that still admits nothing is a provable stall — the
+        # detector must not go permanently blind on small pools.
+        events3 = _clean_events()
+        events3[0] = dict(events3[0], kv_pages=3, prefix_sharing=True)
+        tiny = [dict(ev, pages_free=3, pages_used=0)
+                for ev in stall_pair]
+        events3[-1:-1] = tiny
+        report3 = rr.build_report(rr.load_runlog(_write(tmp_path,
+                                                        events3)))
+        assert [a for a in report3["anomalies"]
+                if a["kind"] == "queue_stall"]
+
     def test_unresolved_request_only_in_sealed_logs(self, rr, tmp_path):
         events = _clean_events()
         orphan = {"kind": "submit", "t": 0.012, "request_id": 9,

@@ -218,6 +218,21 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
     if drifts:
         out["drift_decode_last"] = drifts[-1]
         out["drift_decode_range"] = [min(drifts), max(drifts)]
+    # Paged-KV occupancy narration (PR 9, docs/serving.md §paged KV):
+    # rounds from a paged engine carry the page ledger — summarize it
+    # so a sealed log answers "how full was the pool, how shared, how
+    # fragmented" without the live /metrics surface.
+    pages = [ev["pages_used"] for ev in rounds if "pages_used" in ev]
+    if pages:
+        out["kv_pages"] = {
+            "pages_used_mean": round(sum(pages) / len(pages), 2),
+            "pages_used_max": max(pages),
+            "pages_aliased_max": max(ev.get("pages_aliased", 0)
+                                     for ev in rounds),
+            "fragmentation_max": max(
+                ev.get("page_fragmentation", 0.0) for ev in rounds),
+            "fragmentation_last": rounds[-1].get("page_fragmentation"),
+        }
     return out
 
 
@@ -278,13 +293,30 @@ def find_anomalies(events: List[dict], reqs: Dict[int, dict],
     # pair: round N ends with ready work and free rows, and round N+1
     # still neither admits, starts a prefill, nor expires anything —
     # the scheduler provably sat on ready work for a full round.
-    batch = next((ev.get("batch") for ev in events
+    start = next((ev for ev in events
                   if ev["kind"] == "engine_start"), None)
+    batch = start.get("batch") if start else None
     if batch:
+        # Paged engines (PR 9) legitimately sit on ready work with free
+        # ROWS when the PAGE pool can't fit the head request's
+        # reservation. A stall is only provable when the round also had
+        # enough free pages for a worst-case reservation — a full
+        # max_len at the 16-token page size, clamped to the pool size
+        # (a pool smaller than one max_len reservation can still stall
+        # with every page free) — pages_free rides on paged round
+        # events; contiguous rounds carry no page ledger and keep the
+        # original row-only rule.
+        max_len = start.get("max_len") if start else None
+        worst_pages = -(-int(max_len) // 16) if max_len else 0
+        kv_pages = start.get("kv_pages") if start else None
+        if kv_pages:
+            worst_pages = min(worst_pages, int(kv_pages))
         rounds = [ev for ev in events if ev["kind"] == "round"]
         for prev, cur in zip(rounds, rounds[1:]):
             if (prev.get("queue_depth", 0) > 0
                     and prev.get("occupied", 0) < batch
+                    and prev.get("pages_free", worst_pages)
+                    >= worst_pages
                     and cur.get("admitted", 0) == 0
                     and cur.get("prefilling", 0) == 0
                     and cur.get("expired", 0) == 0):
