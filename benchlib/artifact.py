@@ -66,6 +66,7 @@ _CACHE_PREFIX = {
     "config_decode_spec": "decode_spec_tokens_per_s",
     "config_serving": "serving_continuous_vs_static",
     "config_http": "serving_http_frontend",
+    "config_fleet": "serving_fleet_scaling",
 }
 
 

@@ -8,8 +8,9 @@ benchlib/artifact.py (tests/test_bench_harness.py enforces it), or it
 silently drops out of the dead-tunnel fallback.
 """
 
-from . import (configs_gemm, configs_http, configs_kernels,
-               configs_linalg, configs_ml, configs_sparse, configs_trend)
+from . import (configs_fleet, configs_gemm, configs_http,
+               configs_kernels, configs_linalg, configs_ml,
+               configs_sparse, configs_trend)
 
 CONFIGS = {
     "headline": [configs_gemm.headline],
@@ -35,13 +36,15 @@ CONFIGS = {
                 configs_trend.config_serving_prefix,
                 configs_trend.config_serving_paged],
     "http": [configs_http.config_http],
+    "fleet": [configs_fleet.config_fleet],
     "sweep": [configs_gemm.config_dispatch_sweep],
     "attnsweep": [configs_kernels.config_attention_sweep],
 }
 # "all" = the artifact configs; the sweeps and the CPU-oriented
-# validation configs (trend, serving, http) are policy/tuning tools,
-# run explicitly.
+# validation configs (trend, serving, http, fleet) are policy/tuning
+# tools, run explicitly.
 CONFIGS["all"] = [
     fns[0] for k, fns in CONFIGS.items()
-    if k not in ("sweep", "attnsweep", "trend", "serving", "http")
+    if k not in ("sweep", "attnsweep", "trend", "serving", "http",
+                 "fleet")
 ]

@@ -276,7 +276,12 @@ class DeterministicServingRule(Rule):
     name = "deterministic-serving"
     description = ("random.*/np.random.* or bare time.time() in the "
                    "serving/replay scope (bit-exact-replay contract)")
-    paths = ("marlin_tpu/serving/*", "tools/serving_client.py")
+    # fleet/ is in scope: the router's failover replay leans on the
+    # same output = f(prompt, steps, seed, request_id) contract, so
+    # ambient nondeterminism in the routing/proxy path is just as
+    # replay-breaking as in the engine.
+    paths = ("marlin_tpu/serving/*", "marlin_tpu/fleet/*",
+             "tools/serving_client.py")
 
     _CLOCKS = {"time.time", "time.time_ns"}
 

@@ -1,0 +1,24 @@
+"""Fleet tier: N supervised engine replicas behind one prefix-affinity
+router (docs/fleet.md).
+
+Each replica is a full ``serving/server.py`` stack in its own process
+on an ephemeral port; the router front door speaks the same
+``POST /v1/generate`` contract and adds horizontal capacity, replica
+supervision (restart budget + fail-closed, PR 7's doctrine one level
+up), prefix-affinity dispatch on the ``serving/prefix.py`` radix trie,
+and aggregated ``/metrics`` under a ``replica=`` label.
+"""
+
+from .config import FleetConfig
+from .replica import Replica
+from .router import PrefixAffinityRouter, RouteDecision
+from .server import FleetHTTPServer, FleetSupervisor
+
+__all__ = [
+    "FleetConfig",
+    "Replica",
+    "PrefixAffinityRouter",
+    "RouteDecision",
+    "FleetHTTPServer",
+    "FleetSupervisor",
+]

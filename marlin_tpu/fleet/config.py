@@ -1,0 +1,175 @@
+"""Fleet configuration: replica count, model/engine knobs forwarded to
+every replica, affinity and supervision budgets (docs/fleet.md).
+
+One :class:`FleetConfig` describes the whole fleet. Every replica gets
+the SAME model/engine arguments — in particular the same ``--seed`` —
+which is what makes router failover byte-exact: engine output is
+f(prompt, steps, seed, request_id), and the router assigns globally
+unique ids, so a replayed submit reproduces identical bytes on any
+peer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Everything the fleet supervisor needs to spawn and run N
+    replicas. Frozen: a fleet's shape does not change mid-run (replicas
+    restart, they are not reconfigured)."""
+
+    # -- topology ------------------------------------------------------
+    n_replicas: int = 2
+    host: str = "127.0.0.1"
+    port: int = 0  # front door; 0 = ephemeral
+
+    # -- model/engine knobs, forwarded verbatim to every replica -------
+    d_model: int = 32
+    n_layers: int = 1
+    n_heads: int = 2
+    vocab: int = 64
+    max_len: int = 128
+    batch: int = 4
+    round_steps: int = 4
+    max_pending: int = 64
+    temperature: float = 0.0
+    seed: int = 0
+    kv_pages: Optional[int] = None
+    prefill_chunk: Optional[int] = None
+    # Per-replica (in-process) supervisor budget — PR 7's knobs.
+    max_restarts: int = 3
+    restart_window_s: float = 60.0
+    poison_after: int = 2
+
+    # -- affinity ------------------------------------------------------
+    affinity: bool = True
+    # Most-recently-routed prefix paths tracked in the router trie; the
+    # oldest path is evicted (trie-removed) past this. Bounds router
+    # memory to O(affinity_paths * prompt chunks).
+    affinity_paths: int = 1024
+    # Affinity is a hint, not a pin: if the affinity replica has this
+    # many more outstanding requests than the least-loaded healthy
+    # peer, fall back to least-outstanding (load trumps locality).
+    affinity_max_imbalance: int = 8
+
+    # -- fleet-level supervision (process restarts) --------------------
+    # Budget for RESPAWNING a dead/fail-closed replica process, distinct
+    # from the in-process engine restart budget above. Spent budget =>
+    # the replica is permanently failed (fail-closed, PR 7 doctrine one
+    # level up) and the fleet runs degraded on its peers.
+    replica_max_restarts: int = 2
+    replica_restart_window_s: float = 60.0
+    min_ready: int = 1  # /readyz quorum: healthy replicas required
+    probe_interval_s: float = 0.25
+    probe_timeout_s: float = 2.0
+    # Consecutive not-ready probes (503, not draining) before the
+    # supervisor treats a live-but-unready replica (fail-closed engine)
+    # as restartable — kill + respawn against the same budget.
+    unready_probe_limit: int = 8
+    startup_timeout_s: float = 60.0
+    drain_timeout_s: float = 60.0
+    request_timeout_s: float = 300.0
+
+    # -- plumbing ------------------------------------------------------
+    # Directory for per-replica runlogs (replica<i>.jsonl) + the
+    # router's own runlog (router.jsonl); None = no runlogs.
+    runlog_dir: Optional[str] = None
+    # Extra env vars per replica index (e.g. MARLIN_FAULT_PLAN arming
+    # exactly one replica in the chaos tests). Tuple of (index, name,
+    # value) triples so the dataclass stays hashable.
+    replica_env: Tuple[Tuple[int, str, str], ...] = ()
+    python: str = sys.executable
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError(
+                f"n_replicas must be >= 1, got {self.n_replicas}")
+        if not (1 <= self.min_ready <= self.n_replicas):
+            raise ValueError(
+                f"min_ready must be in [1, n_replicas], got "
+                f"{self.min_ready} with n_replicas={self.n_replicas}")
+
+    # -- derived -------------------------------------------------------
+
+    def replica_runlog(self, index: int,
+                       incarnation: int = 0) -> Optional[str]:
+        """Per-INCARNATION runlog path: RunLog opens its sink in append
+        mode, so a respawned replica must get a fresh file or two
+        engine timelines (with colliding auto request ids) interleave
+        in one JSONL. ``replica<i>.jsonl``, then ``replica<i>.r<n>.
+        jsonl`` for respawns — tools/runlog_report.py's fleet merge
+        keys both to replica ``i``."""
+        if self.runlog_dir is None:
+            return None
+        stem = (f"replica{index}.jsonl" if incarnation == 0
+                else f"replica{index}.r{incarnation}.jsonl")
+        return os.path.join(self.runlog_dir, stem)
+
+    def router_runlog(self) -> Optional[str]:
+        if self.runlog_dir is None:
+            return None
+        return os.path.join(self.runlog_dir, "router.jsonl")
+
+    def replica_argv(self, index: int,
+                     incarnation: int = 0) -> List[str]:
+        """argv for replica ``index``: ``python -m marlin_tpu.serving.
+        server`` on an ephemeral port, forced to the CPU backend (the
+        fleet's replicas are CPU-mesh processes until the TPU tunnel
+        heals — docs/fleet.md §topology)."""
+        argv = [
+            self.python, "-m", "marlin_tpu.serving.server",
+            "--host", self.host, "--port", "0", "--force-cpu",
+            "--d-model", str(self.d_model),
+            "--n-layers", str(self.n_layers),
+            "--n-heads", str(self.n_heads),
+            "--vocab", str(self.vocab),
+            "--max-len", str(self.max_len),
+            "--batch", str(self.batch),
+            "--round-steps", str(self.round_steps),
+            "--max-pending", str(self.max_pending),
+            "--temperature", str(self.temperature),
+            "--seed", str(self.seed),
+            "--max-restarts", str(self.max_restarts),
+            "--restart-window-s", str(self.restart_window_s),
+            "--poison-after", str(self.poison_after),
+        ]
+        if self.kv_pages is not None:
+            argv += ["--kv-pages", str(self.kv_pages)]
+        if self.prefill_chunk is not None:
+            argv += ["--prefill-chunk", str(self.prefill_chunk)]
+        runlog = self.replica_runlog(index, incarnation)
+        if runlog is not None:
+            argv += ["--runlog", runlog]
+        return argv
+
+    def replica_environ(self, index: int) -> Dict[str, str]:
+        """Process env for replica ``index``: the parent env plus the
+        jax flags the engine's byte-exactness depends on (x64 +
+        partitionable threefry — the same config tests/conftest.py
+        pins, so subprocess replicas and in-process goldens agree),
+        plus any per-replica overrides (fault arming)."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_ENABLE_X64"] = "True"
+        env["JAX_THREEFRY_PARTITIONABLE"] = "true"
+        # A replica must not inherit a fault plan aimed at a sibling.
+        env.pop("MARLIN_FAULT_PLAN", None)
+        for i, name, value in self.replica_env:
+            if i == index:
+                env[name] = value
+        return env
+
+
+def sized_from_env(env: Dict[str, str], prefix: str = "MARLIN_FLEET_",
+                   **defaults) -> Dict[str, int]:
+    """Read integer knobs ``{prefix}{NAME}`` from ``env`` with
+    defaults — the bench/tests share one knob convention."""
+    out = {}
+    for key, default in defaults.items():
+        out[key] = int(env.get(prefix + key.upper(), default))
+    return out

@@ -1,0 +1,333 @@
+"""One supervised replica: spawn / probe / restart / drain a
+``serving/server.py`` subprocess (docs/fleet.md §supervision).
+
+PR 7's supervisor doctrine, one level up. The in-process frontend
+supervisor restarts a crashed ENGINE inside a live server; this layer
+restarts a dead SERVER process (or one whose engine failed closed) on a
+fresh ephemeral port, against its own restart budget. Spent budget =>
+the replica is permanently ``failed`` and the fleet runs degraded on
+its peers — fail-closed, never a crash loop.
+
+States::
+
+    starting --ready probe--> healthy <--probes--> unhealthy
+        |                        |                     |
+        +---- begin_drain -----> draining --exit 0--> drained
+        |                                              (terminal, ok)
+        +--- process exit / stuck-unready ---> dead --budget ok--> starting
+                                                |
+                                                +--budget spent--> failed
+                                                    (terminal, fail-closed)
+
+All mutable state is guarded by ``_lock`` (marlint guarded-by): the
+supervisor's probe thread, the router's health reads, and the admin
+drain thread all touch it concurrently.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import signal
+import subprocess
+import threading
+import time
+from collections import deque
+from typing import Deque, Optional
+
+from .config import FleetConfig
+
+# Terminal states: the supervisor never advances a replica out of these.
+TERMINAL = ("failed", "drained")
+
+
+class Replica:
+    """Lifecycle owner of one replica subprocess."""
+
+    def __init__(self, index: int, config: FleetConfig, runlog=None):
+        self.index = index
+        self.config = config
+        self.runlog = runlog  # the ROUTER's runlog (shared, thread-safe)
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None  # guarded-by: _lock
+        self._port: Optional[int] = None  # guarded-by: _lock
+        self._state: str = "starting"  # guarded-by: _lock
+        self._incarnation: int = 0  # guarded-by: _lock
+        self._restart_times: Deque[float] = deque()  # guarded-by: _lock
+        self._unready_probes: int = 0  # guarded-by: _lock
+        self._stdout_tail: Deque[str] = deque(maxlen=64)  # guarded-by: _lock
+        self._reader: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._port_event = threading.Event()
+
+    # -- introspection (router / status surface) ----------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def healthy(self) -> bool:
+        with self._lock:
+            return self._state == "healthy"
+
+    @property
+    def port(self) -> Optional[int]:
+        with self._lock:
+            return self._port
+
+    @property
+    def pid(self) -> Optional[int]:
+        with self._lock:
+            return self._proc.pid if self._proc is not None else None
+
+    @property
+    def incarnation(self) -> int:
+        with self._lock:
+            return self._incarnation
+
+    @property
+    def restarts(self) -> int:
+        with self._lock:
+            return len(self._restart_times)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "index": self.index,
+                "state": self._state,
+                "port": self._port,
+                "pid": (self._proc.pid if self._proc is not None
+                        else None),
+                "incarnation": self._incarnation,
+                "restarts_in_window": len(self._restart_times),
+                "max_restarts": self.config.replica_max_restarts,
+            }
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.runlog is not None:
+            self.runlog.emit(kind, replica=self.index, **fields)
+
+    # -- spawn ---------------------------------------------------------
+
+    def start(self) -> "Replica":
+        """Spawn the subprocess and the stdout reader; returns without
+        waiting for readiness (``wait_ready`` does that)."""
+        with self._lock:
+            if self._state in TERMINAL:
+                raise RuntimeError(
+                    f"replica {self.index} is {self._state}")
+            incarnation = self._incarnation
+            argv = self.config.replica_argv(self.index, incarnation)
+            env = self.config.replica_environ(self.index)
+            self._port = None
+            self._port_event.clear()
+            self._state = "starting"
+            self._unready_probes = 0
+            proc = subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            self._proc = proc
+            reader = threading.Thread(
+                target=self._read_stdout, args=(proc,),
+                name=f"fleet-replica{self.index}-stdout", daemon=True)
+            self._reader = reader
+        self._emit("replica_spawn", incarnation=incarnation,
+                   pid=proc.pid)
+        reader.start()
+        return self
+
+    def _read_stdout(self, proc: subprocess.Popen) -> None:
+        """Reader thread: captures the subprocess's stdout tail and
+        parses the ``SERVING host=... port=...`` banner for the
+        ephemeral port. One thread per incarnation; exits at EOF."""
+        for line in proc.stdout:
+            line = line.rstrip("\n")
+            with self._lock:
+                self._stdout_tail.append(line)
+                if line.startswith("SERVING ") and self._proc is proc:
+                    for tok in line.split():
+                        if tok.startswith("port="):
+                            self._port = int(tok[len("port="):])
+                            self._port_event.set()
+        proc.stdout.close()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the replica answers ``/readyz`` 200 (or the
+        process dies / ``timeout`` passes). Probes inline — the
+        supervisor's probe loop may not be running yet at startup."""
+        timeout = (self.config.startup_timeout_s if timeout is None
+                   else timeout)
+        deadline = time.perf_counter() + timeout
+        if not self._port_event.wait(timeout):
+            return False
+        while time.perf_counter() < deadline:
+            state = self.probe()
+            if state == "healthy":
+                return True
+            if state in ("dead",) + TERMINAL:
+                return False
+            time.sleep(min(0.05, self.config.probe_interval_s))
+        return False
+
+    # -- probing -------------------------------------------------------
+
+    def probe(self) -> str:
+        """One health probe: GET ``/readyz``; classifies the replica and
+        returns the new state. Called by the supervisor loop and by
+        ``wait_ready``."""
+        with self._lock:
+            if self._state in TERMINAL or self._state == "draining":
+                return self._state
+            proc, port = self._proc, self._port
+        if proc is not None and proc.poll() is not None:
+            return self._mark_dead(f"process exited {proc.returncode}")
+        if port is None:
+            return "starting"
+        ready, draining = self._http_readyz(port)
+        with self._lock:
+            if self._state in TERMINAL or self._state == "draining":
+                return self._state
+            if ready:
+                self._state = "healthy"
+                self._unready_probes = 0
+                return self._state
+            self._state = "unhealthy"
+            if not draining:
+                self._unready_probes += 1
+                stuck = (self._unready_probes
+                         >= self.config.unready_probe_limit)
+            else:
+                stuck = False
+        if stuck:
+            # Live process, engine fail-closed (or wedged): kill it and
+            # let the restart budget decide — same doctrine as death.
+            self._emit("replica_stuck_unready",
+                       probes=self.config.unready_probe_limit)
+            proc.kill()
+            proc.wait()
+            return self._mark_dead("killed: stuck not-ready")
+        return "unhealthy"
+
+    def _http_readyz(self, port: int):
+        """(ready, draining) from ``/readyz``; (False, False) when the
+        listener is unreachable."""
+        conn = http.client.HTTPConnection(
+            self.config.host, port, timeout=self.config.probe_timeout_s)
+        try:
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status == 200:
+                return True, False
+            try:
+                return False, bool(json.loads(body).get("draining"))
+            except (json.JSONDecodeError, AttributeError):
+                return False, False
+        except OSError:
+            return False, False
+        finally:
+            conn.close()
+
+    def _mark_dead(self, reason: str) -> str:
+        with self._lock:
+            if self._state in TERMINAL or self._state == "draining":
+                return self._state
+            self._state = "dead"
+            self._port = None
+        self._emit("replica_dead", reason=reason)
+        return "dead"
+
+    # -- restart budget (PR 7 doctrine, process-level) -----------------
+
+    def maybe_restart(self) -> str:
+        """Respawn a ``dead`` replica within the budget; flip to
+        ``failed`` (terminal) past it. No-op in any other state."""
+        with self._lock:
+            if self._state != "dead":
+                return self._state
+            now = time.perf_counter()
+            window = self.config.replica_restart_window_s
+            while (self._restart_times
+                   and now - self._restart_times[0] > window):
+                self._restart_times.popleft()
+            if (len(self._restart_times)
+                    >= self.config.replica_max_restarts):
+                self._state = "failed"
+                spent = True
+            else:
+                self._restart_times.append(now)
+                self._incarnation += 1
+                spent = False
+        if spent:
+            self._emit("replica_failed",
+                       restarts=self.config.replica_max_restarts)
+            return "failed"
+        self._emit("replica_restart", incarnation=self.incarnation)
+        self.start()
+        return "starting"
+
+    # -- drain / teardown ---------------------------------------------
+
+    def begin_drain(self) -> None:
+        """SIGTERM the replica (its own handler drains gracefully:
+        in-flight requests finish, runlog seals, exit 0). The router
+        stops routing here the moment the state flips."""
+        with self._lock:
+            if self._state in TERMINAL or self._state == "draining":
+                return
+            self._state = "draining"
+            proc = self._proc
+        self._emit("replica_drain_begin")
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Wait for a draining replica to exit; True iff it exited 0
+        (byte-complete streams + sealed runlog — the server's drain
+        contract). The state flips to terminal ``drained``."""
+        timeout = (self.config.drain_timeout_s if timeout is None
+                   else timeout)
+        with self._lock:
+            proc = self._proc
+        if proc is None:
+            return True
+        try:
+            rc = proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return False
+        ok = rc == 0
+        with self._lock:
+            self._state = "drained" if ok else "dead"
+            self._port = None
+        self._emit("replica_drained", ok=ok, returncode=rc)
+        return ok
+
+    def reset_for_respawn(self) -> None:
+        """Admin restart after a completed drain: re-arm a ``drained``
+        replica so ``start()`` may run again (the drain/restart drill —
+        NOT part of the failure path, which goes through the budget)."""
+        with self._lock:
+            if self._state != "drained":
+                raise RuntimeError(
+                    f"replica {self.index} is {self._state}, not "
+                    "drained")
+            self._state = "starting"
+            self._incarnation += 1
+
+    def stop(self) -> None:
+        """Hard teardown (tests): kill without drain."""
+        with self._lock:
+            proc = self._proc
+            self._state = "drained"
+            self._port = None
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(10.0)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def stdout_tail(self) -> list:
+        with self._lock:
+            return list(self._stdout_tail)

@@ -1,0 +1,308 @@
+"""Prefix-affinity dispatch + byte-transparent proxying
+(docs/fleet.md §routing).
+
+Routing policy, in order:
+
+1. **Affinity**: descend the same 16-token-chunk radix trie
+   ``serving/prefix.py`` defines (``_trie_descend`` — the ONE copy of
+   the trie machinery) over the prompt; requests sharing a cached
+   prefix land on the replica whose paged prefix pool owns those KV
+   pages, the fleet-level analogue of vLLM-style block sharing. The
+   trie here maps prefix chunks -> replica indices (which replica last
+   served the prefix), LRU-bounded to ``affinity_paths``.
+2. **Fallback**: least-outstanding-requests among healthy replicas —
+   also the override when the affinity replica is overloaded by more
+   than ``affinity_max_imbalance`` outstanding vs the least-loaded peer
+   (load trumps locality) or unhealthy (circuit open).
+
+The router assigns every request a globally unique monotonic id and
+passes it downstream in the body (``request_id`` — engine.submit's
+explicit-id path). Engine output is f(prompt, steps, seed, request_id)
+and every replica runs the same seed/params, so a submit REPLAYED on a
+peer after a connection-refused/pre-acceptance rejection produces
+byte-identical output — failover is byte-exact by construction, not by
+luck. Replays happen only for submissions no replica accepted (connect
+error, 429 QueueFull, 503 draining/fail-closed); a response that began
+streaming is NEVER silently resubmitted (the idempotency doctrine
+tools/serving_client.py enforces client-side, applied router-side).
+
+All shared router state is guarded by ``_lock`` (marlint guarded-by);
+handler threads route/release concurrently with the supervisor's
+health flips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..serving.prefix import (GRAIN, _floor_grain, _TrieNode,
+                              _trie_descend, _trie_insert, _trie_remove)
+
+# Pre-acceptance rejections: the replica did NOT register the request
+# (QueueFull 429 raises before the id advances; QueueClosed/fail-closed
+# 503 likewise), so replaying the same id on a peer cannot double-run.
+REPLAYABLE_STATUS = (429, 503)
+
+
+class NoHealthyReplica(Exception):
+    """Every replica is dead/failed/draining — the fleet-level
+    fail-closed surface (front door maps this to 503)."""
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    """One routing outcome: the id the router minted, where the request
+    goes first, and why."""
+
+    request_id: int
+    replica_index: int
+    policy: str  # "affinity" | "fallback"
+    hit_depth: int  # trie depth (tokens) the affinity hit matched
+    prefix: Optional[np.ndarray]  # GRAIN-floored prompt copy (trie key)
+    prefix_len: int
+
+
+class PrefixAffinityRouter:
+    """Routing + per-replica bookkeeping for the fleet front door."""
+
+    def __init__(self, replicas, config, registry, runlog=None):
+        self.replicas = list(replicas)
+        self.config = config
+        self.metrics = registry
+        self.runlog = runlog
+        self._lock = threading.Lock()
+        self._root = _TrieNode()  # guarded-by: _lock
+        # LRU of inserted trie paths: (prefix bytes, replica) -> tokens.
+        self._paths: OrderedDict = OrderedDict()  # guarded-by: _lock
+        self._next_id: int = 0  # guarded-by: _lock
+        self._outstanding: Dict[int, int] = {
+            i: 0 for i in range(len(self.replicas))}  # guarded-by: _lock
+        # Lifetime routed count: the fallback tie-break, so an idle
+        # fleet round-robins instead of piling onto replica 0.
+        self._routed: Dict[int, int] = {
+            i: 0 for i in range(len(self.replicas))}  # guarded-by: _lock
+        self._affinity_hits: int = 0  # guarded-by: _lock
+        self._fallbacks: int = 0  # guarded-by: _lock
+        self._failovers: int = 0  # guarded-by: _lock
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.runlog is not None:
+            self.runlog.emit(kind, **fields)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"affinity_hits": self._affinity_hits,
+                    "fallbacks": self._fallbacks,
+                    "failovers": self._failovers,
+                    "next_id": self._next_id,
+                    "outstanding": dict(self._outstanding)}
+
+    def outstanding(self, index: int) -> int:
+        with self._lock:
+            return self._outstanding[index]
+
+    # -- routing -------------------------------------------------------
+
+    def _healthy_indices(self) -> List[int]:
+        # Replica.healthy takes the replica's own lock; replicas never
+        # take the router lock, so router-lock -> replica-lock nesting
+        # cannot deadlock.
+        return [i for i, r in enumerate(self.replicas) if r.healthy]
+
+    def route(self, prompt: np.ndarray) -> RouteDecision:
+        """Pick a replica for ``prompt``, mint the request id, and
+        count it outstanding. Callers MUST pair with :meth:`release`
+        (finally-block) once the response is done."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        limit = _floor_grain(int(prompt.shape[0]))
+        with self._lock:
+            healthy = self._healthy_indices()
+            if not healthy:
+                raise NoHealthyReplica(
+                    "no healthy replica (all dead, failed, or "
+                    "draining)")
+            chosen: Optional[int] = None
+            policy, depth = "fallback", 0
+            least = min(self._outstanding[i] for i in healthy)
+            if self.config.affinity and limit >= GRAIN:
+                node, d = _trie_descend(self._root, prompt, limit)
+                if node is not None:
+                    hits = [i for i in healthy if i in node.rows]
+                    if hits:
+                        best = min(hits, key=lambda i:
+                                   (self._outstanding[i], i))
+                        if (self._outstanding[best] - least
+                                <= self.config.affinity_max_imbalance):
+                            chosen, policy, depth = best, "affinity", d
+            if chosen is None:
+                chosen = min(healthy, key=lambda i:
+                             (self._outstanding[i], self._routed[i], i))
+            rid = self._next_id
+            self._next_id += 1
+            self._outstanding[chosen] += 1
+            self._routed[chosen] += 1
+            if policy == "affinity":
+                self._affinity_hits += 1
+            else:
+                self._fallbacks += 1
+            prefix = None
+            if self.config.affinity and limit >= GRAIN:
+                prefix = np.array(prompt[:limit], np.int32)
+                self._remember_path_locked(prefix, limit, chosen)
+        self.metrics.counter(
+            "fleet_route_total",
+            help="fleet routing decisions by policy",
+            policy=policy).inc()
+        self._emit("fleet_route", request_id=rid, replica=chosen,
+                   policy=policy, hit_depth=depth)
+        return RouteDecision(request_id=rid, replica_index=chosen,
+                             policy=policy, hit_depth=depth,
+                             prefix=prefix, prefix_len=limit)
+
+    def _remember_path_locked(self, tokens: np.ndarray, length: int,
+                              member: int) -> None:
+        # marlint: holds=_lock
+        key = (tokens[:length].tobytes(), member)
+        if key in self._paths:
+            self._paths.move_to_end(key)
+            return
+        _trie_insert(self._root, tokens, length, member)
+        self._paths[key] = tokens
+        while len(self._paths) > self.config.affinity_paths:
+            (old_bytes, old_member), old_tokens = self._paths.popitem(
+                last=False)
+            _trie_remove(self._root, old_tokens, len(old_tokens),
+                         old_member)
+
+    def reassign(self, decision: RouteDecision, new_index: int,
+                 reason: str) -> None:
+        """Move a not-yet-accepted request to ``new_index`` (failover):
+        transfers the outstanding count and re-points the affinity path
+        at the replica that will actually serve the prefix."""
+        with self._lock:
+            old = decision.replica_index
+            self._outstanding[old] -= 1
+            self._outstanding[new_index] += 1
+            self._routed[new_index] += 1
+            self._failovers += 1
+            if decision.prefix is not None:
+                old_key = (decision.prefix.tobytes(), old)
+                if old_key in self._paths:
+                    del self._paths[old_key]
+                    _trie_remove(self._root, decision.prefix,
+                                 decision.prefix_len, old)
+                self._remember_path_locked(decision.prefix,
+                                           decision.prefix_len,
+                                           new_index)
+        self.metrics.counter(
+            "fleet_failover_total",
+            help="submissions replayed to a healthy peer",
+            reason=reason).inc()
+        self._emit("fleet_failover", request_id=decision.request_id,
+                   from_replica=decision.replica_index,
+                   to_replica=new_index, reason=reason)
+        decision.replica_index = new_index
+
+    def release(self, decision: RouteDecision) -> None:
+        with self._lock:
+            self._outstanding[decision.replica_index] -= 1
+
+    def next_candidate(self, tried) -> Optional[int]:
+        """Least-outstanding healthy replica not yet tried, or None."""
+        with self._lock:
+            healthy = [i for i in self._healthy_indices()
+                       if i not in tried]
+            if not healthy:
+                return None
+            return min(healthy, key=lambda i:
+                       (self._outstanding[i], self._routed[i], i))
+
+
+# -- byte-transparent proxying ----------------------------------------
+#
+# The forwarding half of the router: open an HTTP connection to the
+# chosen replica, replay pre-acceptance rejections to peers, and hand
+# the (connection, response, replica) triple to the front-door handler
+# to copy upstream. Payload bytes are forwarded verbatim in both
+# directions — the fleet adds headers, never rewrites bodies (the
+# byte-exactness tests compare fleet responses to in-process goldens).
+
+
+class ProxyAttemptFailed(Exception):
+    """Terminal proxy failure: every candidate was tried. Carries the
+    last replica response (if any) so the front door can forward it."""
+
+    def __init__(self, message: str, status: Optional[int] = None,
+                 body: bytes = b"", headers: Optional[list] = None):
+        super().__init__(message)
+        self.status = status
+        self.body = body
+        self.headers = headers or []
+
+
+def proxy_submit(router: PrefixAffinityRouter,
+                 decision: RouteDecision, payload: bytes,
+                 http_id: Optional[str],
+                 timeout: float) -> Tuple[http.client.HTTPConnection,
+                                          http.client.HTTPResponse,
+                                          int]:
+    """POST ``payload`` to the decided replica, failing over on
+    connect errors and pre-acceptance rejections (429/503 — the
+    replica registered nothing, so the replay is byte-exact under the
+    request-id contract). Returns ``(conn, resp, replica_index)`` with
+    the response UNREAD — the caller streams or reads it and must close
+    ``conn``. Raises :class:`ProxyAttemptFailed` when every healthy
+    candidate rejected."""
+    tried = set()
+    last: Optional[ProxyAttemptFailed] = None
+    while True:
+        idx = decision.replica_index
+        tried.add(idx)
+        replica = router.replicas[idx]
+        port = replica.port
+        conn = None
+        failure = None
+        if port is None or not replica.healthy:
+            failure = ("connect", None, b"", [])
+        else:
+            conn = http.client.HTTPConnection(
+                router.config.host, port, timeout=timeout)
+            headers = {"Content-Type": "application/json"}
+            if http_id:
+                headers["X-Request-Id"] = http_id
+            try:
+                conn.request("POST", "/v1/generate", payload, headers)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException):
+                # Connect refused, reset, or closed without a status
+                # line (RemoteDisconnected/BadStatusLine): no response
+                # began, and a dead replica can deliver nothing later —
+                # replaying the same id on a peer is byte-safe.
+                conn.close()
+                failure = ("connect", None, b"", [])
+            else:
+                if resp.status in REPLAYABLE_STATUS:
+                    body = resp.read()
+                    hdrs = resp.getheaders()
+                    conn.close()
+                    failure = ("reject", resp.status, body, hdrs)
+                else:
+                    return conn, resp, idx
+        reason, status, body, hdrs = failure
+        last = ProxyAttemptFailed(
+            f"replica {idx} {reason}"
+            + (f" ({status})" if status else ""),
+            status=status, body=body, headers=hdrs)
+        nxt = router.next_candidate(tried)
+        if nxt is None:
+            raise last
+        router.reassign(decision, nxt, reason=reason)

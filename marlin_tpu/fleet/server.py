@@ -1,0 +1,620 @@
+"""Fleet front door: one stdlib HTTP server over N supervised replicas
+(docs/fleet.md).
+
+Endpoints:
+
+* ``POST /v1/generate`` — same body contract as a single replica
+  (``{"prompt", "steps", "deadline_s"?, "stream"?}``); the router
+  assigns the engine request id (a caller-supplied ``request_id`` is
+  rejected 400 — id uniqueness across replicas is the front door's
+  job). Responses are proxied byte-transparently: blocking JSON bodies
+  and SSE payloads come back verbatim from the replica, plus
+  ``X-Fleet-Replica`` naming the replica that served it and the
+  replica's own ``X-Request-Id``/``X-Engine-Request-Id`` echo.
+* ``GET /metrics`` — the router's own ``fleet_*`` series plus every
+  reachable replica's scraped exposition with a ``replica="<i>"``
+  label injected into each sample line.
+* ``GET /healthz`` — 200 while the front door accepts.
+* ``GET /readyz`` — 200 while >= ``min_ready`` replicas are healthy
+  (the fleet-level quorum a load balancer keys on).
+* ``GET /fleet/status`` — per-replica state/port/outstanding plus the
+  router's counters (also how tests/bench find replica ports).
+* ``POST /fleet/drain/<i>`` (``?restart=1``) — begin the drain of one
+  replica on a helper thread (202; poll ``/fleet/status``): the
+  drain-under-load drill. The router stops routing to it immediately;
+  in-flight requests finish byte-complete (the replica server's drain
+  contract); refused submissions replay to a healthy peer byte-exactly
+  (router id contract).
+
+SIGTERM drains every replica, then the listener, then exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.client import HTTPConnection, HTTPException
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs.metrics import MetricsRegistry
+from ..obs.runlog import RunLog
+from .config import FleetConfig
+from .replica import Replica
+from .router import (NoHealthyReplica, PrefixAffinityRouter,
+                     ProxyAttemptFailed, proxy_submit)
+
+RETRY_AFTER_S = 1
+
+# One exposition sample line: name, optional {labels}, value[, ts].
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{(.*)\})?\s+(.*)$")
+
+
+def inject_replica_label(text: str, replica: int) -> str:
+    """Rewrite every sample line of a Prometheus exposition with a
+    ``replica="<i>"`` label prepended; comment/blank lines are dropped
+    (the aggregate keeps HELP/TYPE only for the router's own series —
+    per-replica duplicates would conflict)."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, _, labels, value = m.groups()
+        merged = f'replica="{replica}"' + (f",{labels}" if labels
+                                           else "")
+        out.append(f"{name}{{{merged}}} {value}")
+    return "\n".join(out)
+
+
+class FleetSupervisor:
+    """Owns the replicas, the router, and the probe loop.
+
+    The probe loop is the fleet-level supervisor: it classifies every
+    replica each tick (``Replica.probe``), respawns dead ones within
+    their budget (``Replica.maybe_restart`` — fail-closed past it), and
+    keeps the ``fleet_replica_healthy`` gauges current.
+    """
+
+    def __init__(self, config: FleetConfig,
+                 registry: Optional[MetricsRegistry] = None):
+        self.config = config
+        self.registry = registry or MetricsRegistry()
+        if config.runlog_dir is not None:
+            import os
+            os.makedirs(config.runlog_dir, exist_ok=True)
+        self.runlog = RunLog(path=config.router_runlog())
+        self.replicas: List[Replica] = [
+            Replica(i, config, runlog=self.runlog)
+            for i in range(config.n_replicas)]
+        self.router = PrefixAffinityRouter(
+            self.replicas, config, self.registry, runlog=self.runlog)
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._last_incarnation = [0] * config.n_replicas
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FleetSupervisor":
+        """Spawn every replica, wait for the ready quorum, start the
+        probe loop. Raises if fewer than ``min_ready`` replicas come
+        up within the startup timeout."""
+        self.runlog.emit("fleet_start",
+                         n_replicas=self.config.n_replicas,
+                         seed=self.config.seed)
+        for r in self.replicas:
+            r.start()
+        ready = sum(1 for r in self.replicas if r.wait_ready())
+        if ready < self.config.min_ready:
+            for r in self.replicas:
+                r.stop()
+            raise RuntimeError(
+                f"only {ready}/{self.config.n_replicas} replicas "
+                f"ready (quorum {self.config.min_ready})")
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="fleet-probe", daemon=True)
+        self._probe_thread.start()
+        return self
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.config.probe_interval_s):
+            self.probe_once()
+
+    def probe_once(self) -> None:
+        """One supervision tick (the probe loop's body; callable
+        directly from tests for determinism)."""
+        for i, r in enumerate(self.replicas):
+            state = r.probe()
+            if state == "dead":
+                state = r.maybe_restart()
+                if state == "starting":
+                    r.wait_ready()
+                    state = r.state
+            inc = r.incarnation
+            if inc != self._last_incarnation[i]:
+                self.registry.counter(
+                    "fleet_replica_restarts_total",
+                    help="replica process respawns",
+                    replica=str(i)).inc(inc - self._last_incarnation[i])
+                self._last_incarnation[i] = inc
+            self.registry.gauge(
+                "fleet_replica_healthy",
+                help="1 while the replica answers /readyz 200",
+                replica=str(i)).set(1.0 if state == "healthy" else 0.0)
+
+    @property
+    def n_healthy(self) -> int:
+        return sum(1 for r in self.replicas if r.healthy)
+
+    @property
+    def ready(self) -> bool:
+        return self.n_healthy >= self.config.min_ready
+
+    def drain_replica(self, index: int, restart: bool = False,
+                      block: bool = False):
+        """Drain one replica (the under-load drill); optionally respawn
+        it after the drain completes. Runs on a helper thread unless
+        ``block``; returns the thread (or None when blocking)."""
+
+        def go():
+            r = self.replicas[index]
+            r.begin_drain()
+            ok = r.wait_drained()
+            if ok and restart:
+                r.reset_for_respawn()
+                r.start()
+                r.wait_ready()
+
+        if block:
+            go()
+            return None
+        t = threading.Thread(target=go, name=f"fleet-drain-{index}",
+                             daemon=True)
+        t.start()
+        return t
+
+    def drain_all(self, timeout: Optional[float] = None) -> bool:
+        """SIGTERM every replica, wait for byte-complete exits."""
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(5.0)
+        for r in self.replicas:
+            r.begin_drain()
+        ok = all(r.wait_drained(timeout) for r in self.replicas)
+        self.runlog.emit("fleet_drain_complete", ok=ok)
+        self.runlog.flush()
+        return ok
+
+    def stop(self) -> None:
+        """Hard teardown (tests): kill replicas without drain."""
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(5.0)
+        for r in self.replicas:
+            r.stop()
+        self.runlog.close()
+
+    # -- aggregated observability -------------------------------------
+
+    def scrape_replica(self, index: int) -> Optional[str]:
+        r = self.replicas[index]
+        port = r.port
+        if port is None:
+            return None
+        conn = HTTPConnection(self.config.host, port,
+                              timeout=self.config.probe_timeout_s)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return resp.read().decode()
+        except OSError:
+            return None
+        finally:
+            conn.close()
+
+    def aggregated_metrics(self) -> str:
+        """The fleet exposition: router series (with HELP/TYPE), then
+        every reachable replica's samples under ``replica="<i>"``."""
+        parts = [self.registry.prometheus().rstrip("\n")]
+        for i in range(len(self.replicas)):
+            text = self.scrape_replica(i)
+            if text is None:
+                continue
+            labeled = inject_replica_label(text, i)
+            if labeled:
+                parts.append(labeled)
+        return "\n".join(p for p in parts if p) + "\n"
+
+    def status(self) -> dict:
+        counters = self.router.counters()
+        outstanding = counters.pop("outstanding")
+        return {
+            "replicas": [
+                {**r.status(), "outstanding": outstanding[r.index]}
+                for r in self.replicas],
+            "router": counters,
+            "n_healthy": self.n_healthy,
+            "min_ready": self.config.min_ready,
+        }
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "marlin-fleet/1"
+
+    @property
+    def sup(self) -> FleetSupervisor:
+        return self.server.supervisor
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self.server.supervisor.registry
+
+    def log_message(self, fmt, *args):  # runlog, not stderr
+        self.sup.runlog.emit("fleet_http_access", line=fmt % args)
+
+    def _count(self, route: str, code: int) -> None:
+        self.metrics.counter("fleet_http_requests_total",
+                             route=route).inc()
+        self.metrics.counter("fleet_http_responses_total",
+                             code=str(code)).inc()
+
+    def _send_json(self, code: int, obj: dict, route: str,
+                   headers: Optional[dict] = None) -> None:
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+        self._count(route, code)
+
+    # -- GET ----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.sup.aggregated_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            self._count("/metrics", 200)
+        elif path == "/healthz":
+            self._send_json(200, {"ok": True}, "/healthz")
+        elif path == "/readyz":
+            ready = self.sup.ready
+            self._send_json(
+                200 if ready else 503,
+                {"ready": ready, "n_healthy": self.sup.n_healthy,
+                 "min_ready": self.sup.config.min_ready},
+                "/readyz",
+                headers=None if ready else {"Retry-After": RETRY_AFTER_S})
+        elif path == "/fleet/status":
+            self._send_json(200, self.sup.status(), "/fleet/status")
+        else:
+            self._send_json(404, {"error": f"no route {path}"}, path)
+
+    # -- POST ---------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/fleet/drain/"):
+            self._drain(path)
+            return
+        if path != "/v1/generate":
+            self._send_json(404, {"error": f"no route {path}"}, path)
+            return
+        route = "/v1/generate"
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = np.asarray(body["prompt"], np.int32).reshape(-1)
+            int(body["steps"])  # fail malformed here, not at a replica
+            stream = bool(body.get("stream", False))
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"}, route)
+            return
+        if body.get("request_id") is not None:
+            self._send_json(
+                400, {"error": "request_id is router-assigned at the "
+                      "fleet front door (id uniqueness across replicas "
+                      "is its job); submit without one"}, route)
+            return
+        http_id = self.headers.get("X-Request-Id")
+        try:
+            decision = self.sup.router.route(prompt)
+        except NoHealthyReplica as e:
+            self._send_json(503, {"error": str(e)}, route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+            return
+        body["request_id"] = decision.request_id
+        payload = json.dumps(body).encode()
+        try:
+            try:
+                conn, resp, idx = proxy_submit(
+                    self.sup.router, decision, payload, http_id,
+                    self.server.request_timeout_s)
+            except ProxyAttemptFailed as e:
+                if e.status is not None:
+                    # Every healthy replica rejected (draining fleet or
+                    # full queues): forward the last rejection verbatim.
+                    self._forward_body(e.status, e.body, e.headers,
+                                       route, decision)
+                else:
+                    self._send_json(
+                        503, {"error": f"no replica reachable: {e}"},
+                        route, headers={"Retry-After": RETRY_AFTER_S})
+                return
+            try:
+                ctype = resp.getheader("Content-Type", "")
+                if stream and resp.status == 200 \
+                        and "text/event-stream" in ctype:
+                    self._forward_stream(resp, idx, route, decision)
+                else:
+                    try:
+                        payload_out = resp.read()
+                    except (OSError, HTTPException):
+                        # Replica lost AFTER accepting, before the
+                        # blocking response landed. Not auto-replayed
+                        # here (the router only replays pre-acceptance
+                        # failures); a client retry with a fresh submit
+                        # is byte-safe — the dead replica delivers
+                        # nothing and ids never reuse.
+                        self._send_json(
+                            502, {"error": "replica lost mid-request; "
+                                  "retry is safe (no bytes were "
+                                  "delivered)",
+                                  "request_id": decision.request_id},
+                            route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+                        return
+                    self._forward_body(resp.status, payload_out,
+                                       resp.getheaders(), route,
+                                       decision, replica=idx)
+            finally:
+                conn.close()
+        finally:
+            self.sup.router.release(decision)
+
+    _FORWARD_HEADERS = ("Content-Type", "X-Request-Id",
+                        "X-Engine-Request-Id", "Retry-After")
+
+    def _id_headers(self, headers, decision, replica=None) -> dict:
+        out = {}
+        for k, v in headers or []:
+            if k in self._FORWARD_HEADERS:
+                out[k] = v
+        # The router id is authoritative even when no replica answered.
+        out.setdefault("X-Engine-Request-Id", str(decision.request_id))
+        out.setdefault("X-Request-Id", str(decision.request_id))
+        if replica is not None:
+            out["X-Fleet-Replica"] = str(replica)
+        return out
+
+    def _forward_body(self, status, body, headers, route, decision,
+                      replica=None) -> None:
+        """Blocking path: replica response forwarded verbatim (status +
+        body bytes + id headers) — byte-transparent by construction."""
+        hdrs = self._id_headers(headers, decision, replica)
+        self.send_response(status)
+        for k, v in hdrs.items():
+            self.send_header(k, str(v))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        self._count(route, status)
+
+    def _forward_stream(self, resp, replica, route, decision) -> None:
+        """SSE path: re-chunk the replica's decoded stream line by
+        line. The concatenated payload equals the replica's payload
+        byte for byte (the exactness tests rely on it); only transfer
+        framing is re-done."""
+        self.send_response(200)
+        for k, v in self._id_headers(resp.getheaders(), decision,
+                                     replica).items():
+            self.send_header(k, str(v))
+        self.send_header("Cache-Control", "no-store")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        code = 200
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                self._chunk(line)
+            self._chunk(b"")
+        except OSError:
+            # Upstream client hung up, or the replica connection broke
+            # mid-stream. The latter is NOT silently replayed (the
+            # stream already delivered bytes — the idempotency
+            # doctrine); the client sees the truncated stream end.
+            code = 499
+            self.metrics.counter(
+                "fleet_streams_broken_total",
+                help="proxied SSE streams that ended early "
+                     "(client hangup or replica loss mid-stream)").inc()
+            try:
+                self._chunk(b"")
+            except OSError:
+                pass
+        self._count(route, code)
+
+    def _chunk(self, payload: bytes) -> None:
+        self.wfile.write(f"{len(payload):x}\r\n".encode() + payload
+                         + b"\r\n")
+        self.wfile.flush()
+
+    def _drain(self, path: str) -> None:
+        route = "/fleet/drain"
+        query = self.path.partition("?")[2]
+        try:
+            idx = int(path[len("/fleet/drain/"):])
+            replica = self.sup.replicas[idx]
+        except (ValueError, IndexError):
+            self._send_json(400, {"error": "bad replica index"}, route)
+            return
+        restart = "restart=1" in query
+        self.sup.drain_replica(idx, restart=restart)
+        self._send_json(202, {"draining": idx, "restart": restart,
+                              "state": replica.state}, route)
+
+
+class FleetHTTPServer(ThreadingHTTPServer):
+    """The front-door listener; handlers reach everything through the
+    supervisor."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    # socketserver's default listen backlog is 5; a deep closed-loop
+    # client pool connecting at once overflows it and the kernel resets
+    # the excess connects before a handler thread ever sees them.
+    request_queue_size = 128
+
+    def __init__(self, addr, supervisor: FleetSupervisor,
+                 request_timeout_s: Optional[float] = None):
+        super().__init__(addr, _FleetHandler)
+        self.supervisor = supervisor
+        self.request_timeout_s = (
+            supervisor.config.request_timeout_s
+            if request_timeout_s is None else request_timeout_s)
+        self._drain_once = threading.Lock()
+        self._drained = False
+        self._serve_thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start_background(self) -> "FleetHTTPServer":
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="fleet-http-listener",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def begin_drain(self, timeout: Optional[float] = None) -> bool:
+        """Drain the whole fleet: every replica drains byte-complete,
+        then the front-door listener stops. Idempotent."""
+        with self._drain_once:
+            if self._drained:
+                return True
+            ok = self.supervisor.drain_all(timeout)
+            self.shutdown()
+            if self._serve_thread is not None:
+                self._serve_thread.join(timeout)
+            self.server_close()
+            self._drained = ok
+            return ok
+
+    def close_now(self) -> None:
+        """Hard teardown for tests: no drain."""
+        self.supervisor.stop()
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5.0)
+        self.server_close()
+
+
+def serve_fleet(config: FleetConfig,
+                registry: Optional[MetricsRegistry] = None
+                ) -> FleetHTTPServer:
+    """Spawn the replicas (blocking until the ready quorum) and bind
+    the front door; call ``serve_forever()`` or ``start_background()``
+    on the result."""
+    supervisor = FleetSupervisor(config, registry).start()
+    return FleetHTTPServer((config.host, config.port), supervisor)
+
+
+def install_signal_handlers(server: FleetHTTPServer,
+                            drain_timeout: Optional[float] = None):
+    """SIGTERM/SIGINT → drain the fleet on a helper thread (mirrors
+    serving/server.py)."""
+    import signal
+
+    drained = threading.Event()
+
+    def _drain(signum, frame):
+        def go():
+            server.begin_drain(drain_timeout)
+            drained.set()
+
+        threading.Thread(target=go, name="fleet-drain",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    return drained
+
+
+def main(argv=None) -> int:
+    """Fleet demo/smoke entry point: N tiny demo replicas behind the
+    front door. Prints ``FLEET host=... port=... replicas=N`` once
+    bound, serves until SIGTERM/SIGINT, drains every replica
+    byte-complete, exits 0."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100,
+                   help="front door; 0 binds an ephemeral port")
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--n-heads", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--max-len", type=int, default=128)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--round-steps", type=int, default=8)
+    p.add_argument("--max-pending", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-pages", type=int, default=None)
+    p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--min-ready", type=int, default=1)
+    p.add_argument("--replica-max-restarts", type=int, default=2)
+    p.add_argument("--no-affinity", action="store_true")
+    p.add_argument("--runlog-dir", default=None,
+                   help="per-replica + router runlog JSONL directory")
+    args = p.parse_args(argv)
+
+    config = FleetConfig(
+        n_replicas=args.replicas, host=args.host, port=args.port,
+        d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=args.n_heads, vocab=args.vocab, max_len=args.max_len,
+        batch=args.batch, round_steps=args.round_steps,
+        max_pending=args.max_pending, temperature=args.temperature,
+        seed=args.seed, kv_pages=args.kv_pages,
+        prefill_chunk=args.prefill_chunk, min_ready=args.min_ready,
+        replica_max_restarts=args.replica_max_restarts,
+        affinity=not args.no_affinity, runlog_dir=args.runlog_dir)
+    server = serve_fleet(config)
+    drained = install_signal_handlers(server)
+    print(f"FLEET host={args.host} port={server.port} "
+          f"replicas={args.replicas}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        drained.wait(120.0)
+    print("DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -476,7 +476,8 @@ class ServingEngine:
 
     def submit(self, prompt, steps: int,
                deadline_rounds: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               request_id: Optional[int] = None) -> int:
         """Queue one generation request; returns its request id.
 
         ``prompt`` is a host/device 1-D int array; ``steps`` tokens will
@@ -490,6 +491,15 @@ class ServingEngine:
         at admission. Thread-safe: handler threads may call this
         concurrently with the driver thread's step()/run()
         (``_submit_lock``; the queue carries its own lock).
+
+        ``request_id`` overrides the engine's monotonic id assignment.
+        The fleet router uses this to keep ids globally unique across
+        replicas: output = f(prompt, steps, seed, request_id) — the
+        per-request sampling key folds the id into the engine key — so a
+        request replayed on a different replica with the SAME id (same
+        seed/params) reproduces the same bytes, which is what makes
+        router failover byte-exact (docs/fleet.md). Explicit ids must
+        not collide with a live or completed id still in the ledger.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         s = int(prompt.shape[0])
@@ -514,8 +524,18 @@ class ServingEngine:
                 f"{steps} at {PAGE} tokens/page)")
         now = time.perf_counter()
         with self._submit_lock:
+            if request_id is None:
+                rid = self._next_id
+            else:
+                rid = int(request_id)
+                if rid < 0:
+                    raise ValueError(
+                        f"request_id must be >= 0, got {rid}")
+                if rid in self.requests:
+                    raise ValueError(
+                        f"request_id {rid} already in use")
             req = Request(
-                request_id=self._next_id, prompt=prompt,
+                request_id=rid, prompt=prompt,
                 steps=int(steps), deadline_rounds=deadline_rounds,
                 deadline_time=(now + deadline_s
                                if deadline_s is not None else None),
@@ -525,7 +545,9 @@ class ServingEngine:
                 # Raises Full/Closed BEFORE the id advances or the
                 # request registers — a rejected submit leaves no trace.
                 self.queue.submit(req)
-            self._next_id += 1
+            # max(), not +=: an explicit (router-assigned) id must pull
+            # the auto counter past itself or a later auto id collides.
+            self._next_id = max(self._next_id, rid + 1)
             self.requests[req.request_id] = req
         self.metrics.counter("serving_submitted_total").inc()
         self.metrics.gauge("serving_queue_depth").set(len(self.queue))

@@ -297,7 +297,8 @@ class EngineFrontend:
 
     def submit(self, prompt, steps: int,
                deadline_s: Optional[float] = None,
-               stream: bool = False) -> FrontendRequest:
+               stream: bool = False,
+               request_id: Optional[int] = None) -> FrontendRequest:
         """Thread-safe submit; returns the request's handle.
 
         Registering the handle and enqueueing the request happen under
@@ -306,7 +307,9 @@ class EngineFrontend:
         handle is not yet registered — even a steps=1 request admitted
         and retired within the very round that is executing during this
         call. ``QueueFull``/``QueueClosed``/``ValueError`` propagate to
-        the caller (the HTTP 429/503/400 mapping)."""
+        the caller (the HTTP 429/503/400 mapping). ``request_id``
+        passes an explicit engine id through (fleet router ids —
+        engine.submit documents the byte-exactness contract)."""
         self._raise_if_fatal()
         # One lock hold also makes submission atomic vs the
         # supervisor's capture-and-swap: a request lands wholly in the
@@ -318,7 +321,8 @@ class EngineFrontend:
             # _abandon already failed every waiter — nothing would
             # ever complete it.
             self._raise_if_fatal()
-            rid = self.engine.submit(prompt, steps, deadline_s=deadline_s)
+            rid = self.engine.submit(prompt, steps, deadline_s=deadline_s,
+                                     request_id=request_id)
             handle = FrontendRequest(rid, stream=stream,
                                      submit_time=time.perf_counter())
             self._handles[rid] = handle

@@ -189,6 +189,11 @@ class _Handler(BaseHTTPRequestHandler):
             deadline_s = (None if body.get("deadline_s") is None
                           else float(body["deadline_s"]))
             stream = bool(body.get("stream", False))
+            # Explicit engine id (fleet router assignment): keeps ids
+            # globally unique across replicas so a replayed submit is
+            # byte-exact on any peer (engine.submit's contract).
+            request_id = (None if body.get("request_id") is None
+                          else int(body["request_id"]))
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"}, route)
@@ -199,7 +204,8 @@ class _Handler(BaseHTTPRequestHandler):
                                          route=route,
                                          http_id=http_id or ""):
                 handle = self.frontend.submit(
-                    prompt, steps, deadline_s=deadline_s, stream=stream)
+                    prompt, steps, deadline_s=deadline_s, stream=stream,
+                    request_id=request_id)
         except QueueFull as e:
             self._send_json(429, {"error": str(e)}, route,
                             headers={"Retry-After": RETRY_AFTER_S})
@@ -336,6 +342,10 @@ class ServingHTTPServer(ThreadingHTTPServer):
 
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5; a closed-loop client
+    # burst (or a fleet router fanning a burst at one replica) overflows
+    # that and the kernel resets the excess connects.
+    request_queue_size = 128
 
     def __init__(self, addr, frontend: EngineFrontend,
                  request_timeout_s: Optional[float] = 300.0):
@@ -460,6 +470,18 @@ def main(argv=None) -> int:
     p.add_argument("--max-pending", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-pages", type=int, default=None,
+                   help="paged KV pool size (pages); enables the paged "
+                        "allocator + zero-copy prefix sharing")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="chunked-prefill chunk size (tokens)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="supervisor restart budget before fail-closed")
+    p.add_argument("--restart-window-s", type=float, default=60.0,
+                   help="sliding window the restart budget counts in")
+    p.add_argument("--poison-after", type=int, default=2,
+                   help="crashes with one request in flight before it "
+                        "is quarantined as poison")
     p.add_argument("--runlog", default=None,
                    help="stream engine runlog JSONL to this path")
     p.add_argument("--force-cpu", action="store_true",
@@ -493,9 +515,18 @@ def main(argv=None) -> int:
                    batch=args.batch, round_steps=args.round_steps,
                    max_pending=args.max_pending,
                    temperature=args.temperature, seed=args.seed,
+                   max_restarts=args.max_restarts,
+                   restart_window_s=args.restart_window_s,
+                   poison_after=args.poison_after,
                    # `is not None`, not truthiness: RunLog has __len__,
-                   # so a fresh (empty) log is falsy.
-                   **({"runlog": runlog} if runlog is not None else {}))
+                   # so a fresh (empty) log is falsy; kv_pages/
+                   # prefill_chunk stay unset unless given (the engine
+                   # treats kv_pages as the paged-mode switch).
+                   **({"runlog": runlog} if runlog is not None else {}),
+                   **({"kv_pages": args.kv_pages}
+                      if args.kv_pages is not None else {}),
+                   **({"prefill_chunk": args.prefill_chunk}
+                      if args.prefill_chunk is not None else {}))
     drained = install_signal_handlers(server)
     print(f"SERVING host={args.host} port={server.port}", flush=True)
     try:

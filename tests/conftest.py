@@ -94,3 +94,42 @@ def rng(request):
 
     seed = zlib.crc32(request.node.nodeid.encode())
     return np.random.default_rng(seed)
+
+
+@pytest.fixture()
+def fleet_factory(tmp_path):
+    """Factory spawning a REAL fleet: N replica subprocesses (each a
+    full serving/server.py stack on an ephemeral port, deterministic
+    seeds — FleetConfig.replica_environ pins the same jax x64/threefry
+    config this conftest sets, so subprocess output is comparable to
+    in-process goldens) behind an in-process front door. Every spawned
+    fleet is torn down hard at test end, pass or FAIL — a dead test
+    never leaks replica processes into the next one."""
+    from marlin_tpu.fleet import FleetConfig
+    from marlin_tpu.fleet.server import serve_fleet
+
+    servers = []
+
+    def spawn(n_replicas=2, **overrides):
+        overrides.setdefault("runlog_dir", str(tmp_path / "runlogs"))
+        cfg = FleetConfig(
+            n_replicas=n_replicas,
+            d_model=overrides.pop("d_model", 32),
+            n_layers=overrides.pop("n_layers", 1),
+            n_heads=overrides.pop("n_heads", 2),
+            vocab=overrides.pop("vocab", 64),
+            max_len=overrides.pop("max_len", 128),
+            batch=overrides.pop("batch", 4),
+            round_steps=overrides.pop("round_steps", 4),
+            seed=overrides.pop("seed", 0),
+            **overrides)
+        server = serve_fleet(cfg).start_background()
+        servers.append(server)
+        return server
+
+    yield spawn
+    for s in servers:
+        try:
+            s.close_now()
+        except Exception:
+            pass

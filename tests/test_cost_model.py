@@ -186,6 +186,9 @@ class TestDecodeCost:
 
 
 class TestChunkedCECost:
+    # ~20 s of large-vocab compiles — tier-1 wall-clock budget
+    # (ROADMAP 9) moves it under -m slow.
+    @pytest.mark.slow
     def test_grad_temp_arena_does_not_scale_with_vocab(self, monkeypatch):
         """The chunked-CE contract, stated as memory accounting: the grad's
         temp arena must be VOCAB-INDEPENDENT (per-chunk logits live only

@@ -74,6 +74,7 @@ def test_sparse_multiply(capsys):
     assert len(out["seconds"]) == 6
 
 
+@pytest.mark.slow
 def test_sparse_multiply_ell_regime(capsys):
     # Low enough density that mode 1's auto dispatch takes the ELL
     # row-gather arm (and the lazy result's .values path in the CLI fence).
@@ -162,6 +163,10 @@ def test_neural_network_learns(rng):
     assert acc > 0.9, f"NN failed to learn, acc={acc}, loss={loss}"
 
 
+# The two heaviest example CLIs (~12 s and ~9 s of compile) run under
+# -m slow; the other seven examples keep the CLI contract in tier-1
+# (ROADMAP 9 wall-clock budget).
+@pytest.mark.slow
 def test_transformer_lm(capsys):
     from marlin_tpu.examples import transformer_lm
 

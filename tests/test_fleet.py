@@ -1,0 +1,541 @@
+"""Fleet tier tests (docs/fleet.md): prefix-affinity routing, failover,
+drain-under-load byte-exactness, aggregated observability.
+
+The subprocess tests use the ``fleet_factory`` fixture (conftest.py):
+N REAL replica subprocesses — each a full serving/server.py stack on an
+ephemeral port with deterministic seeds — behind an in-process front
+door, torn down hard even when the test fails. Byte-exactness is
+checked against an IN-PROCESS golden engine built with the same
+cfg/seed and the router-assigned request ids: engine output is
+f(prompt, steps, seed, request_id), so fleet responses must equal the
+golden regardless of which replica (or how many failovers) served them.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from marlin_tpu.fleet import FleetConfig, PrefixAffinityRouter
+from marlin_tpu.fleet.router import NoHealthyReplica
+from marlin_tpu.fleet.server import inject_replica_label
+
+HOST = "127.0.0.1"
+
+
+# -- HTTP helpers ------------------------------------------------------
+
+
+def _post(port, body, headers=None, timeout=60.0):
+    conn = http.client.HTTPConnection(HOST, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(body).encode(),
+                     headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, data, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30.0):
+    conn = http.client.HTTPConnection(HOST, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _gen(port, prompt, steps, **extra):
+    """Blocking generate; returns (request_id, tokens, replica, hdrs)."""
+    st, data, hdrs = _post(port, {"prompt": list(prompt),
+                                  "steps": steps, **extra})
+    assert st == 200, (st, data)
+    obj = json.loads(data)
+    return (obj["request_id"], obj["tokens"],
+            int(hdrs["X-Fleet-Replica"]), hdrs)
+
+
+def _gen_stream(port, prompt, steps):
+    """SSE generate; returns (request_id, tokens, replica)."""
+    conn = http.client.HTTPConnection(HOST, port, timeout=60.0)
+    try:
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"prompt": list(prompt), "steps": steps,
+                                 "stream": True}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        replica = int(resp.getheader("X-Fleet-Replica"))
+        raw = resp.read().decode()
+    finally:
+        conn.close()
+    tokens, rid = [], None
+    for ev in raw.split("\n\n"):
+        if ev.startswith("data: "):
+            d = json.loads(ev[len("data: "):])
+            tokens += d.get("tokens", [])
+            if d.get("done"):
+                assert d.get("status") == "done", d
+                rid = d["request_id"]
+    return rid, tokens, replica
+
+
+# -- in-process golden -------------------------------------------------
+
+
+def _golden_tokens(jobs, temperature=0.0, kv_pages=None):
+    """Run (request_id, prompt, steps) jobs on an in-process engine
+    with the fleet's cfg/seed; returns {request_id: tokens}. The fleet
+    must match these bytes exactly."""
+    from marlin_tpu.models import TransformerConfig, init_params
+    from marlin_tpu.serving.engine import ServingEngine
+    from marlin_tpu.serving.frontend import EngineFrontend
+
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                            n_layers=1, d_ff=128, max_len=128,
+                            dtype="float32")
+    params = init_params(cfg, seed=0)
+    kw = {"kv_pages": kv_pages} if kv_pages is not None else {}
+    engine = ServingEngine(params, cfg, batch=4, round_steps=4,
+                           temperature=temperature, seed=0, **kw)
+    fe = EngineFrontend(engine).start()
+    try:
+        handles = [(rid, fe.submit(np.asarray(p, np.int32), s,
+                                   request_id=rid))
+                   for rid, p, s in jobs]
+        out = {}
+        for rid, h in handles:
+            req = h.result(120.0)
+            assert req.status == "done"
+            out[rid] = np.asarray(req.tokens).tolist()
+        return out
+    finally:
+        fe.stop()
+
+
+# -- router unit tests (no subprocesses) -------------------------------
+
+
+class _StubReplica:
+    def __init__(self, index, healthy=True):
+        self.index = index
+        self.healthy = healthy
+        self.port = None
+
+
+class _Reg:
+    """Minimal metrics stand-in for router unit tests."""
+
+    class _C:
+        def inc(self, by=1.0):
+            pass
+
+    def counter(self, name, help="", **labels):
+        return self._C()
+
+
+def _router(n=2, healthy=None, **cfg_kw):
+    cfg = FleetConfig(n_replicas=n, **cfg_kw)
+    reps = [_StubReplica(i, healthy=(healthy is None or i in healthy))
+            for i in range(n)]
+    return PrefixAffinityRouter(reps, cfg, _Reg())
+
+
+class TestRouterUnit:
+    def test_affinity_hit_sticks_to_replica(self):
+        r = _router()
+        p = np.arange(32, dtype=np.int32)
+        first = r.route(p)
+        r.release(first)
+        for _ in range(4):
+            d = r.route(p)
+            r.release(d)
+            assert d.replica_index == first.replica_index
+            assert d.policy == "affinity"
+            assert d.hit_depth == 32
+
+    def test_short_prompt_never_affine(self):
+        r = _router()
+        d = r.route(np.arange(8, dtype=np.int32))  # < GRAIN
+        assert d.policy == "fallback"
+        r.release(d)
+
+    def test_fallback_spreads_when_idle(self):
+        r = _router()
+        seen = set()
+        for k in range(2):
+            d = r.route(np.arange(40 + k * 50, 40 + k * 50 + 16,
+                                  dtype=np.int32))
+            r.release(d)
+            seen.add(d.replica_index)
+        assert seen == {0, 1}  # routed-count tie-break round-robins
+
+    def test_imbalance_overrides_affinity(self):
+        r = _router(affinity_max_imbalance=1)
+        p = np.arange(32, dtype=np.int32)
+        first = r.route(p)  # stays outstanding
+        second = r.route(p)  # affinity: imbalance 1 vs 0 is tolerated
+        assert second.policy == "affinity"
+        assert second.replica_index == first.replica_index
+        # Now 2 vs 0 outstanding: load trumps locality — the route
+        # falls back to the idle peer (and re-points affinity there,
+        # so later same-prefix routes may legitimately affine to it).
+        third = r.route(p)
+        assert third.policy == "fallback"
+        assert third.replica_index != first.replica_index
+        fourth = r.route(p)
+        assert fourth.policy == "affinity"
+        assert fourth.replica_index == third.replica_index
+        for x in (first, second, third, fourth):
+            r.release(x)
+
+    def test_unhealthy_replica_skipped_and_none_raises(self):
+        r = _router(healthy={1})
+        d = r.route(np.arange(32, dtype=np.int32))
+        assert d.replica_index == 1
+        r.release(d)
+        r.replicas[1].healthy = False
+        with pytest.raises(NoHealthyReplica):
+            r.route(np.arange(32, dtype=np.int32))
+
+    def test_reassign_moves_outstanding_and_affinity(self):
+        r = _router()
+        p = np.arange(32, dtype=np.int32)
+        d = r.route(p)
+        old = d.replica_index
+        new = 1 - old
+        r.reassign(d, new, reason="connect")
+        assert r.outstanding(old) == 0
+        assert r.outstanding(new) == 1
+        r.release(d)
+        # Affinity now points at the replica that actually served it.
+        d2 = r.route(p)
+        assert d2.replica_index == new
+        assert d2.policy == "affinity"
+        r.release(d2)
+
+    def test_path_lru_bounded(self):
+        r = _router(affinity_paths=4)
+        for k in range(10):
+            d = r.route(np.arange(k * 100, k * 100 + 16,
+                                  dtype=np.int32) % 1000)
+            r.release(d)
+        with r._lock:
+            assert len(r._paths) <= 4
+
+    def test_ids_monotonic_unique(self):
+        r = _router()
+        ids = []
+        for k in range(6):
+            d = r.route(np.arange(16, dtype=np.int32) + k)
+            r.release(d)
+            ids.append(d.request_id)
+        assert ids == sorted(set(ids))
+
+
+class TestMetricsAggregation:
+    def test_inject_replica_label(self):
+        text = ("# HELP serving_completed_total done\n"
+                "# TYPE serving_completed_total counter\n"
+                "serving_completed_total 7\n"
+                'serving_http_responses_total{code="200"} 3\n'
+                'serving_phase_seconds_bucket{phase="decode",'
+                'le="0.1"} 2\n')
+        out = inject_replica_label(text, 1)
+        lines = out.splitlines()
+        assert 'serving_completed_total{replica="1"} 7' in lines
+        assert ('serving_http_responses_total{replica="1",'
+                'code="200"} 3') in lines
+        assert ('serving_phase_seconds_bucket{replica="1",'
+                'phase="decode",le="0.1"} 2') in lines
+        assert not any(ln.startswith("#") for ln in lines)
+
+
+# -- subprocess fleet tests --------------------------------------------
+
+# Two GRAIN-aligned prompt families: requests within a family share a
+# 32-token prefix (two trie chunks), so affinity keeps a family on one
+# replica while families spread across replicas.
+_FAMILY_A = [list(range(1, 33)) + [40 + k] for k in range(4)]
+_FAMILY_B = [list(range(33, 1, -1)) + [50 + k] for k in range(4)]
+
+
+class TestFleetRouting:
+    def test_affinity_metrics_and_exactness(self, fleet_factory):
+        """One fleet, many assertions (a fleet spawn costs ~5 s):
+        affinity keeps prefix families replica-local, distinct families
+        spread, responses are byte-exact vs the in-process golden
+        (sampled path — temperature > 0 makes the request-id contract
+        load-bearing), streamed == blocking framing, ids are unique,
+        the aggregated /metrics carries replica= labels, and a
+        caller-supplied request_id is rejected."""
+        server = fleet_factory(n_replicas=2, kv_pages=64,
+                               temperature=0.7)
+        port = server.port
+        results = []  # (rid, prompt, steps, tokens)
+
+        rid0, toks0, rep_a, hdrs = _gen(port, _FAMILY_A[0], 6)
+        results.append((rid0, _FAMILY_A[0], 6, toks0))
+        assert hdrs["X-Engine-Request-Id"] == str(rid0)
+        # X-Request-Id echo: the caller's id comes back verbatim.
+        st, data, hdrs2 = _post(port, {"prompt": _FAMILY_A[1],
+                                       "steps": 5},
+                                headers={"X-Request-Id": "cafe-1"})
+        assert st == 200 and hdrs2["X-Request-Id"] == "cafe-1"
+        obj = json.loads(data)
+        results.append((obj["request_id"], _FAMILY_A[1], 5,
+                        obj["tokens"]))
+        assert int(hdrs2["X-Fleet-Replica"]) == rep_a  # affinity
+
+        # The rest of family A sticks to rep_a; family B spreads away.
+        for p in _FAMILY_A[2:]:
+            rid, toks, rep, _ = _gen(port, p, 6)
+            results.append((rid, p, 6, toks))
+            assert rep == rep_a
+        rid_b, toks_b, rep_b, _ = _gen(port, _FAMILY_B[0], 6)
+        results.append((rid_b, _FAMILY_B[0], 6, toks_b))
+        assert rep_b != rep_a
+        for p in _FAMILY_B[1:3]:
+            rid, toks, rep, _ = _gen(port, p, 6)
+            results.append((rid, p, 6, toks))
+            assert rep == rep_b
+
+        # Streamed == blocking: same prompt/steps on the same replica
+        # via affinity; a fresh id, so fresh (but deterministic) bytes.
+        srid, stoks, srep = _gen_stream(port, _FAMILY_A[0], 6)
+        results.append((srid, _FAMILY_A[0], 6, stoks))
+        assert srep == rep_a
+
+        ids = [r[0] for r in results]
+        assert ids == sorted(set(ids)), "router ids must be unique"
+
+        # Router-owned ids: explicit request_id is rejected up front.
+        st, data, _ = _post(port, {"prompt": [1, 2, 3], "steps": 2,
+                                   "request_id": 7})
+        assert st == 400
+
+        # Aggregated metrics: every replica's series under replica=.
+        st, data = _get(port, "/metrics")
+        assert st == 200
+        text = data.decode()
+        for rep in ("0", "1"):
+            assert f'serving_completed_total{{replica="{rep}"}}' \
+                in text, text[:2000]
+        assert 'fleet_route_total{policy="affinity"}' in text
+        completed = sum(
+            float(ln.rsplit(" ", 1)[1])
+            for ln in text.splitlines()
+            if ln.startswith('serving_completed_total{replica='))
+        assert completed == len(results)
+
+        # Byte-exactness: the golden engine with the SAME ids must
+        # reproduce every fleet response bit for bit.
+        golden = _golden_tokens(
+            [(rid, p, s) for rid, p, s, _ in results],
+            temperature=0.7, kv_pages=64)
+        for rid, _p, _s, toks in results:
+            assert toks == golden[rid], f"request {rid} diverged"
+
+    def test_drain_under_load_byte_exact(self, fleet_factory):
+        """Drain + restart one replica mid-load: zero dropped requests,
+        every response byte-exact vs the golden, the drained replica
+        comes back healthy with a fresh incarnation runlog."""
+        server = fleet_factory(n_replicas=2, kv_pages=64)
+        sup = server.supervisor
+        port = server.port
+        # Warm affinity so a family owns each replica.
+        rid, toks, rep_a, _ = _gen(port, _FAMILY_A[0], 4)
+        results = [(rid, _FAMILY_A[0], 4, toks)]
+        rid, toks, rep_b, _ = _gen(port, _FAMILY_B[0], 4)
+        results.append((rid, _FAMILY_B[0], 4, toks))
+
+        lock = threading.Lock()
+        failures = []
+
+        def worker(prompts, steps, stream):
+            for p in prompts:
+                try:
+                    if stream:
+                        out = _gen_stream(port, p, steps)[:2]
+                    else:
+                        out = _gen(port, p, steps)[:2]
+                    with lock:
+                        results.append((out[0], p, steps, out[1]))
+                except Exception as e:  # noqa: BLE001 - collected
+                    with lock:
+                        failures.append(repr(e))
+
+        threads = [
+            threading.Thread(target=worker,
+                             args=(_FAMILY_A * 2, 5, False)),
+            threading.Thread(target=worker,
+                             args=(_FAMILY_B * 2, 5, True)),
+            threading.Thread(target=worker,
+                             args=(list(reversed(_FAMILY_A)) * 2, 6,
+                                   True)),
+        ]
+        for t in threads:
+            t.start()
+        # Mid-load: drain the replica that owns family A, then respawn
+        # it — the drill the admin endpoint exists for.
+        time.sleep(0.3)
+        st, data, _ = _post_drain(port, rep_a, restart=True)
+        assert st == 202, data
+        for t in threads:
+            t.join(180.0)
+            assert not t.is_alive()
+        assert not failures, failures
+
+        # Zero drops: every submitted request came back 200 with
+        # tokens, through routing, drain 503-replays, or refusals.
+        assert len(results) == 2 + 8 + 8 + 8
+        ids = [r[0] for r in results]
+        assert len(ids) == len(set(ids))
+
+        # The drained replica returns healthy on a fresh incarnation,
+        # with a per-incarnation runlog alongside the original.
+        deadline = time.monotonic() + 60.0
+        r = sup.replicas[rep_a]
+        while time.monotonic() < deadline and not (
+                r.healthy and r.incarnation == 1):
+            time.sleep(0.2)
+        assert r.healthy and r.incarnation == 1
+        import os
+        d = sup.config.runlog_dir
+        assert os.path.exists(
+            os.path.join(d, f"replica{rep_a}.jsonl"))
+        assert os.path.exists(
+            os.path.join(d, f"replica{rep_a}.r1.jsonl"))
+
+        golden = _golden_tokens(
+            [(rid, p, s) for rid, p, s, _ in results], kv_pages=64)
+        for rid, _p, _s, toks in results:
+            assert toks == golden[rid], f"request {rid} diverged"
+
+    def test_replica_death_rerouting_and_fail_closed(
+            self, fleet_factory):
+        """An env-armed fault plan crashes replica 0's engine on every
+        decode round; with a zero in-process restart budget it fails
+        closed, the router replays the affected submission to the
+        healthy peer (client still sees 200 + correct bytes), the fleet
+        supervisor kills + respawns it within ITS budget, and once that
+        budget is spent the replica is permanently failed while the
+        fleet keeps serving."""
+        plan = json.dumps({"specs": [{
+            "site": "decode_round", "action": "raise",
+            "round_every": 1, "max_fires": 1000}]})
+        server = fleet_factory(
+            n_replicas=2,
+            max_restarts=0,  # in-process: first crash fails closed
+            replica_max_restarts=1,  # fleet: one respawn, then failed
+            probe_interval_s=0.1, unready_probe_limit=3,
+            replica_env=((0, "MARLIN_FAULT_PLAN", plan),))
+        port = server.port
+        sup = server.supervisor
+
+        # Both replicas healthy at spawn (faults fire only under
+        # traffic). Drive fresh prompts until the armed replica has
+        # died, been respawned, died again, and failed permanently —
+        # every response must still be a 200 served somewhere.
+        results = []
+        deadline = time.monotonic() + 90.0
+        k = 0
+        while time.monotonic() < deadline:
+            if sup.replicas[0].state == "failed":
+                break
+            p = [((k * 7) + j) % 64 for j in range(16)]
+            rid, toks, rep, _ = _gen(port, p, 3)
+            results.append((rid, p, 3, toks, rep))
+            k += 1
+            time.sleep(0.1)
+        assert sup.replicas[0].state == "failed", \
+            sup.replicas[0].status()
+        assert sup.replicas[0].incarnation == 1  # one respawn happened
+        assert len(results) >= 2
+
+        # Degraded but ready: quorum 1 is met by the survivor.
+        st, _ = _get(port, "/readyz")
+        assert st == 200
+        rid, toks, rep, _ = _gen(port, list(range(20)), 3)
+        assert rep == 1
+        results.append((rid, list(range(20)), 3, toks, rep))
+
+        # Replays were byte-exact: whatever replica answered, the bytes
+        # match the golden for the router-assigned id.
+        golden = _golden_tokens(
+            [(rid, p, s) for rid, p, s, _t, _r in results])
+        for rid, _p, _s, toks, _rep in results:
+            assert toks == golden[rid], f"request {rid} diverged"
+
+        status = sup.status()
+        assert status["router"]["failovers"] >= 1
+        # Aggregated metrics still expose the survivor + fleet gauges.
+        st, data = _get(port, "/metrics")
+        text = data.decode()
+        assert 'fleet_replica_healthy{replica="0"} 0' in text
+        assert 'fleet_replica_healthy{replica="1"} 1' in text
+        assert 'fleet_replica_restarts_total{replica="0"}' in text
+
+
+def _post_drain(port, index, restart=False):
+    conn = http.client.HTTPConnection(HOST, port, timeout=30.0)
+    try:
+        q = "?restart=1" if restart else ""
+        conn.request("POST", f"/fleet/drain/{index}{q}")
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestFleetBenchSmoke:
+    def test_bench_fleet_line_and_slo_gate(self, tmp_path):
+        """`bench.py --config fleet` end to end at the default knobs:
+        the artifact line must show the MODELED capacity scaling >= the
+        committed 3.0x floor (per-replica decode-iters deltas — see
+        docs/fleet.md section bench for why raw wall-clock is ungated
+        on 1-core CI hosts), byte-exact responses including across the
+        mid-run drain/restart, zero steady-state recompiles, affinity
+        hit-rate parity with the single-replica arm, and a clean fleet
+        runlog merge — then pass tools/slo_check.py against the
+        committed baseline's fleet block (the tier-1 form of the SLO
+        gate)."""
+        env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_RETRIES="1")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--config", "fleet"],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=_REPO)
+        assert r.returncode == 0, r.stderr[-800:]
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        (line,) = [d for d in lines
+                   if d["metric"] == "serving_fleet_scaling"]
+        assert line["responses_bitexact"] is True
+        assert line["drain_under_load_ok"] is True
+        assert line["drain_restart_incarnation"] >= 1
+        assert line["recompiles_after_warmup"] == 0
+        assert line["runlog_ok"] is True
+        assert line["value"] >= 3.0
+        assert line["hit_rate_ratio"] >= 0.9
+        assert line["affinity_route_rate"] >= 0.5
+        # Every measured request appears exactly once across the
+        # fleet's merged runlogs (router-minted ids are global).
+        assert line["runlog_unique_ids"] > 0
+        artifact = tmp_path / "fleet_artifact.jsonl"
+        artifact.write_text(r.stdout)
+        slo = subprocess.run(
+            [sys.executable, "tools/slo_check.py", str(artifact),
+             "--metrics-key", "metrics_fleet"],
+            capture_output=True, text=True, timeout=60, cwd=_REPO)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "SLO OK" in slo.stdout

@@ -546,6 +546,72 @@ class TestHTTPServer:
         assert "drain_complete" in kinds
 
 
+class TestClientMultiTarget:
+    """The fleet satellite on the client: an ordered target list with
+    connect-error failover riding the existing RetryPolicy."""
+
+    def test_parse_target_forms(self, client_mod):
+        pt = client_mod.parse_target
+        assert pt("10.0.0.2:8100") == ("10.0.0.2", 8100)
+        assert pt(":8100") == ("127.0.0.1", 8100)
+        assert pt("8100") == ("127.0.0.1", 8100)
+        assert pt(8100) == ("127.0.0.1", 8100)
+        assert pt(("h", 9), default_host="x") == ("h", 9)
+
+    def test_connect_error_rotates_preferred_target(self, client_mod):
+        import socket
+
+        # Two dead ports (bound-then-closed, so nothing listens).
+        dead = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            dead.append(s.getsockname()[1])
+            s.close()
+        c = client_mod.ServingClient(
+            targets=[f":{dead[0]}", f":{dead[1]}"], timeout=2.0)
+        assert c.port == dead[0]
+        with pytest.raises(OSError):
+            c.generate([1, 2, 3], 2)
+        assert c.port == dead[1]  # next call prefers the next target
+        # With a policy: both attempts fail, the ledger records them,
+        # and the result is a connect_error dict — not a raise (the
+        # load generators keep going and count it).
+        res = c.generate([1, 2, 3], 2, retry=client_mod.RetryPolicy(
+            max_attempts=2, base_delay_s=0.001))
+        assert res["code"] is None and "connect_error" in res
+        assert res["attempts"] == 2
+
+    def test_failover_lands_on_live_target(self, http_server, model,
+                                           client_mod):
+        """Dead target first, live server second: one policy retry
+        lands the request on the live endpoint byte-exactly, and the
+        client keeps preferring the live endpoint afterwards (no
+        per-call re-probing of the dead one)."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        params, cfg = model
+        prompts = _prompts(cfg, 2, seed=7)
+        gold = _golden(params, cfg, prompts, 4, batch=2, round_steps=4)
+        c = client_mod.ServingClient(
+            targets=[f":{dead_port}", f":{http_server.port}"],
+            timeout=30.0)
+        policy = client_mod.RetryPolicy(max_attempts=3,
+                                        base_delay_s=0.01)
+        r = c.generate(prompts[0], 4, retry=policy)
+        assert r["code"] == 200 and r["status"] == "done"
+        assert r["tokens"] == gold[0]
+        assert r["attempts"] == 2  # one dead hit, one live
+        assert c.port == http_server.port
+        # Subsequent plain call goes straight to the live target.
+        r2 = c.generate(prompts[1], 4)
+        assert r2["code"] == 200 and r2["tokens"] == gold[1]
+
+
 class TestBaselineMetricConsistency:
     def test_every_baseline_metric_name_exists_in_live_registry(
             self, model):

@@ -484,3 +484,178 @@ class TestRealEngineRunlog:
         assert report["rounds"]["n_rounds"] == eng.stats.n_rounds
         assert report["rounds"]["batch"] == 2
         assert report["ledger"] == eng.stats.summary()
+
+
+# -- fleet merge (docs/fleet.md §observability) ------------------------
+
+
+def _remap_ids(events, mapping):
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        if "request_id" in ev:
+            ev["request_id"] = mapping.get(ev["request_id"],
+                                           ev["request_id"])
+        out.append(ev)
+    return out
+
+
+def _router_events():
+    return [
+        {"kind": "fleet_route", "t": 0.0, "request_id": 0,
+         "replica": 0, "policy": "fallback", "hit_depth": 0},
+        {"kind": "fleet_route", "t": 0.01, "request_id": 1,
+         "replica": 0, "policy": "affinity", "hit_depth": 16},
+        {"kind": "fleet_route", "t": 0.02, "request_id": 2,
+         "replica": 1, "policy": "fallback", "hit_depth": 0},
+        {"kind": "fleet_route", "t": 0.03, "request_id": 3,
+         "replica": 1, "policy": "affinity", "hit_depth": 16},
+    ]
+
+
+class TestFleetMerge:
+    def _entries(self, rr, paths):
+        entries = []
+        for p in paths:
+            replica, inc = rr.classify_runlog(p)
+            entries.append({"path": p, "replica": replica,
+                            "incarnation": inc,
+                            "events": rr.load_runlog(p)})
+        return entries
+
+    def test_classify_runlog_filenames(self, rr):
+        assert rr.classify_runlog("/x/replica0.jsonl") == (0, 0)
+        assert rr.classify_runlog("runlogs/replica3.r2.jsonl") == (3, 2)
+        assert rr.classify_runlog("router.jsonl") == (None, None)
+        assert rr.classify_runlog("engine.jsonl") == (None, None)
+
+    def test_clean_fleet_merges_by_replica(self, rr, tmp_path):
+        """Two clean replicas + the router log: per-replica summaries,
+        router route/policy counts, all request ids unique, ok."""
+        paths = [
+            _write(tmp_path, _clean_events(), "replica0.jsonl"),
+            _write(tmp_path, _remap_ids(_clean_events(), {0: 2, 1: 3}),
+                   "replica1.jsonl"),
+            _write(tmp_path, _router_events(), "router.jsonl"),
+        ]
+        report = rr.build_fleet_report(self._entries(rr, paths))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["n_replicas"] == 2 and report["n_files"] == 3
+        for key in ("0", "1"):
+            e = report["replicas"][key]
+            assert e["n_incarnations"] == 1
+            assert e["n_submitted"] == e["n_completed"] == 2
+            assert e["busy_s"] == pytest.approx(0.09)
+            assert e["incarnations"][0]["sealed"] is True
+        assert report["n_unique_request_ids"] == 4
+        assert report["n_replayed_after_abandonment"] == 0
+        assert report["router"]["n_routes"] == 4
+        assert report["router"]["routes_by_policy"] == {
+            "affinity": 2, "fallback": 2}
+        assert report["router"]["n_failovers"] == 0
+
+    def test_incarnations_fold_into_one_replica(self, rr, tmp_path):
+        """replica0.jsonl + replica0.r1.jsonl = ONE replica, two
+        incarnation timelines, each analyzed separately (the respawn
+        gets a fresh engine timeline by design)."""
+        paths = [
+            _write(tmp_path, _clean_events(), "replica0.jsonl"),
+            _write(tmp_path, _remap_ids(_clean_events(), {0: 4, 1: 5}),
+                   "replica0.r1.jsonl"),
+        ]
+        report = rr.build_fleet_report(self._entries(rr, paths))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["n_replicas"] == 1
+        e = report["replicas"]["0"]
+        assert e["n_incarnations"] == 2
+        assert [i["incarnation"] for i in e["incarnations"]] == [0, 1]
+        assert e["n_completed"] == 4
+        assert e["busy_s"] == pytest.approx(0.18)
+
+    def test_replay_after_abandonment_is_legitimate(self, rr,
+                                                    tmp_path):
+        """rid 10 submitted on replica 0, abandoned at engine_failed
+        (fail-closed), then replayed and completed on replica 1: NOT a
+        duplicate — the exact shape the router's failover produces."""
+        failed = [
+            {"kind": "engine_start", "t": 0.0, "batch": 2,
+             "round_steps": 4, "max_pending": 8, "max_len": 64},
+            {"kind": "submit", "t": 0.01, "request_id": 10,
+             "prompt_len": 8, "steps": 4, "round": 0,
+             "queue_depth": 1},
+            {"kind": "engine_failed", "t": 0.02, "round": 0,
+             "abandoned": [10], "error_type": "FaultInjected"},
+        ]
+        peer = _remap_ids(_clean_events(), {0: 10, 1: 11})
+        paths = [
+            _write(tmp_path, failed, "replica0.jsonl"),
+            _write(tmp_path, peer, "replica1.jsonl"),
+        ]
+        report = rr.build_fleet_report(self._entries(rr, paths))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["n_replayed_after_abandonment"] == 1
+        assert report["n_unique_request_ids"] == 2
+        assert report["replicas"]["0"]["incarnations"][0][
+            "engine_failed"] is True
+
+    def test_live_duplicate_rid_is_an_anomaly(self, rr, tmp_path):
+        """The same rid live (not abandoned) on two replicas breaks
+        the router's global-uniqueness contract — and with it the
+        byte-exactness doctrine, since two engines folded the same id
+        into their streams."""
+        paths = [
+            _write(tmp_path, _clean_events(), "replica0.jsonl"),
+            _write(tmp_path, _clean_events(), "replica1.jsonl"),
+        ]
+        report = rr.build_fleet_report(self._entries(rr, paths))
+        assert report["ok"] is False
+        dups = [a for a in report["anomalies"]
+                if a["kind"] == "duplicate_request_id"]
+        assert sorted(a["request_id"] for a in dups) == [0, 1]
+        apps = dups[0]["appearances"]
+        assert {a["replica"] for a in apps} == {"0", "1"}
+
+    def test_per_replica_anomalies_carry_the_replica_key(self, rr,
+                                                         tmp_path):
+        """A single-log anomaly (steady-state compile) surfaces in the
+        merged report tagged with its replica/incarnation."""
+        bad = _clean_events()
+        bad.insert(-1, {"kind": "compile", "t": 0.098, "round": 1,
+                        "entry": "serving.decode_round",
+                        "new_compiles": 1})
+        paths = [
+            _write(tmp_path, bad, "replica0.r1.jsonl"),
+            _write(tmp_path, _remap_ids(_clean_events(), {0: 2, 1: 3}),
+                   "replica1.jsonl"),
+        ]
+        report = rr.build_fleet_report(self._entries(rr, paths))
+        assert report["ok"] is False
+        a = next(a for a in report["anomalies"]
+                 if a["kind"] == "post_warmup_compile")
+        assert a["replica"] == "0" and a["incarnation"] == 1
+
+    def test_cli_fleet_merge_and_exit_codes(self, rr, tmp_path,
+                                            capsys):
+        paths = [
+            _write(tmp_path, _clean_events(), "replica0.jsonl"),
+            _write(tmp_path, _remap_ids(_clean_events(), {0: 2, 1: 3}),
+                   "replica1.jsonl"),
+            _write(tmp_path, _router_events(), "router.jsonl"),
+        ]
+        assert rr.main(paths + ["--json", "-"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["fleet"] is True and report["ok"] is True
+        # Human form names each replica and the router.
+        assert rr.main(paths) == 0
+        out = capsys.readouterr().out
+        assert "replica 0:" in out and "replica 1:" in out
+        assert "router: 4 route(s)" in out
+        # Duplicate ids -> exit 1.
+        dup = [_write(tmp_path, _clean_events(), "replica2.jsonl"),
+               _write(tmp_path, _clean_events(), "replica3.jsonl")]
+        assert rr.main(dup + ["--json", str(tmp_path / "r.json")]) == 1
+        capsys.readouterr()  # drain the dup run's human summary
+        # Single path keeps the original single-log behavior.
+        assert rr.main([paths[0], "--json", "-"]) == 0
+        single = json.loads(capsys.readouterr().out)
+        assert "fleet" not in single and single["n_completed"] == 2

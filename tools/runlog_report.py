@@ -41,9 +41,20 @@ that should never happen in steady state:
   legitimate; the non-chaos gate is the SLO baseline's
   ``engine_restarts == 0`` check (tools/slo_check.py).
 
+Fleet merge (docs/fleet.md): pass SEVERAL runlogs — the per-replica
+files a fleet run leaves (``replica<i>.jsonl``,
+``replica<i>.r<n>.jsonl`` per respawn, ``router.jsonl``) — and the
+report merges them keyed by replica: every engine log gets the full
+single-log analysis above, plus the cross-replica request-id
+uniqueness check (a rid submitted on two replicas is an anomaly unless
+every appearance but one was abandoned at ``engine_failed`` — the
+router's legitimate replay of a fail-closed loss).
+
 Usage:
     python tools/runlog_report.py RUNLOG.jsonl [--json OUT|-]
         [--phase-tol 0.05] [--series]
+    python tools/runlog_report.py runlogs/replica*.jsonl \\
+        runlogs/router.jsonl [--json OUT|-]
 
 Exit 0 = report clean (no anomalies), 1 = anomalies found, 2 = unusable
 input. ``--json -`` prints the JSON report to stdout (nothing else);
@@ -55,6 +66,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
 from typing import Dict, List, Optional
 
@@ -214,6 +227,10 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
     if times:
         out["round_s_mean"] = round(sum(times) / len(times), 6)
         out["round_s_max"] = round(max(times), 6)
+        # Total busy seconds: what the fleet merge and the fleet
+        # bench's modeled-parallel accounting (docs/fleet.md §bench)
+        # sum per replica.
+        out["round_s_total"] = round(sum(times), 6)
     drifts = [ev["drift_decode"] for ev in rounds if "drift_decode" in ev]
     if drifts:
         out["drift_decode_last"] = drifts[-1]
@@ -409,6 +426,172 @@ def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
     return report
 
 
+# -- fleet merge (PR: fleet tier, docs/fleet.md §observability) -------
+#
+# A fleet run leaves one runlog PER REPLICA INCARNATION
+# (``replica<i>.jsonl``, ``replica<i>.r<n>.jsonl`` after the n-th
+# respawn — the sink opens in append mode, so respawns get fresh files
+# instead of interleaving two engine timelines) plus the router's
+# ``router.jsonl``. Passing several paths to the CLI merges them into
+# one fleet report keyed by replica: per-incarnation timelines run
+# through the SAME single-log analyzer (crash-cycle and queue-stall
+# detectors unchanged), plus the one property only the merged view can
+# check — cross-replica request-id uniqueness. The router mints
+# globally unique ids, so a rid submitted on two replicas is an anomaly
+# UNLESS every appearance but one was abandoned (``engine_failed``
+# names its abandoned requests): that is the router legitimately
+# replaying a fail-closed replica's loss onto a peer.
+
+_REPLICA_RE = re.compile(r"^replica(\d+)(?:\.r(\d+))?\.jsonl$")
+
+_INCARNATION_SUMMARY = ("n_events", "sealed", "n_submitted",
+                        "n_completed", "n_timeout", "n_crashes",
+                        "engine_failed", "ok")
+
+
+def classify_runlog(path: str):
+    """``(replica_index, incarnation)`` from a fleet runlog filename,
+    or ``(None, None)`` for the router log / anything else."""
+    m = _REPLICA_RE.match(os.path.basename(path))
+    if m:
+        return int(m.group(1)), int(m.group(2) or 0)
+    return None, None
+
+
+def build_fleet_report(entries: List[dict],
+                       phase_tol: float = PHASE_TOL_DEFAULT) -> dict:
+    """Merge per-file runlogs into one fleet report. ``entries`` are
+    ``{"path", "replica", "incarnation", "events"}`` dicts (replica
+    None = router/unclassified log). Every engine log gets the full
+    single-log analysis; anomalies are aggregated with
+    ``replica``/``incarnation`` attached, plus the cross-replica
+    ``duplicate_request_id`` check."""
+    replicas: Dict[str, dict] = {}
+    router_events: List[dict] = []
+    anomalies: List[dict] = []
+    # rid -> appearances across engine logs, for the uniqueness check.
+    appearances: Dict[int, List[dict]] = {}
+    engine_logs = []
+    for e in entries:
+        is_engine = any(ev["kind"] == "engine_start"
+                        for ev in e["events"])
+        if e["replica"] is None and not is_engine:
+            router_events.extend(e["events"])
+        else:
+            engine_logs.append(e)
+    for e in sorted(engine_logs,
+                    key=lambda e: (e["replica"] if e["replica"]
+                                   is not None else -1,
+                                   e["incarnation"] or 0, e["path"])):
+        key = (str(e["replica"]) if e["replica"] is not None
+               else os.path.basename(e["path"]))
+        inc = e["incarnation"] or 0
+        rep = build_report(e["events"], phase_tol=phase_tol)
+        entry = replicas.setdefault(key, {"incarnations": []})
+        entry["incarnations"].append({
+            "path": os.path.basename(e["path"]),
+            "incarnation": inc,
+            "rounds": rep["rounds"],
+            **{k: rep[k] for k in _INCARNATION_SUMMARY},
+        })
+        abandoned = set()
+        for ev in e["events"]:
+            if ev["kind"] == "engine_failed":
+                abandoned.update(ev.get("abandoned", []))
+        for ev in e["events"]:
+            if ev["kind"] == "submit":
+                rid = int(ev["request_id"])
+                appearances.setdefault(rid, []).append(
+                    {"replica": key, "incarnation": inc,
+                     "abandoned": rid in abandoned})
+        anomalies.extend({**a, "replica": key, "incarnation": inc}
+                         for a in rep["anomalies"])
+    for key, entry in replicas.items():
+        incs = entry["incarnations"]
+        entry["n_incarnations"] = len(incs)
+        entry["n_submitted"] = sum(i["n_submitted"] for i in incs)
+        entry["n_completed"] = sum(i["n_completed"] for i in incs)
+        entry["busy_s"] = round(sum(
+            i["rounds"].get("round_s_total", 0.0) for i in incs), 6)
+    # Cross-replica request-id uniqueness: the one invariant only the
+    # merged view can check.
+    n_replayed = 0
+    for rid in sorted(appearances):
+        apps = appearances[rid]
+        if len(apps) <= 1:
+            continue
+        live = [a for a in apps if not a["abandoned"]]
+        if len(live) <= 1:
+            # Earlier appearances were all abandoned at engine_failed:
+            # the router's legitimate replay of a fail-closed loss.
+            n_replayed += 1
+        else:
+            anomalies.append({"kind": "duplicate_request_id",
+                              "request_id": rid,
+                              "appearances": apps})
+    router = None
+    if router_events:
+        routes = [ev for ev in router_events
+                  if ev["kind"] == "fleet_route"]
+        by_policy: Dict[str, int] = {}
+        for ev in routes:
+            pol = str(ev.get("policy"))
+            by_policy[pol] = by_policy.get(pol, 0) + 1
+        router = {
+            "n_events": len(router_events),
+            "n_routes": len(routes),
+            "routes_by_policy": by_policy,
+            "n_failovers": sum(1 for ev in router_events
+                               if ev["kind"] == "fleet_failover"),
+        }
+    return {
+        "fleet": True,
+        "n_files": len(entries),
+        "n_replicas": len(replicas),
+        "replicas": replicas,
+        "router": router,
+        "n_unique_request_ids": len(appearances),
+        "n_replayed_after_abandonment": n_replayed,
+        "n_submitted": sum(len(a) for a in appearances.values()),
+        "n_completed": sum(e["n_completed"]
+                           for e in replicas.values()),
+        "anomalies": anomalies,
+        "ok": not anomalies,
+    }
+
+
+def _human_fleet(report: dict) -> str:
+    lines = [f"fleet runlog: {report['n_files']} file(s), "
+             f"{report['n_replicas']} replica(s)"]
+    for key in sorted(report["replicas"]):
+        e = report["replicas"][key]
+        sealed = all(i["sealed"] for i in e["incarnations"])
+        failed = any(i["engine_failed"] for i in e["incarnations"])
+        lines.append(
+            f"replica {key}: {e['n_incarnations']} incarnation(s), "
+            f"{e['n_submitted']} submitted, "
+            f"{e['n_completed']} completed, busy {e['busy_s']}s, "
+            f"sealed={sealed}"
+            + (", FAILED CLOSED" if failed else ""))
+    r = report["router"]
+    if r:
+        pol = ", ".join(f"{k} {v}" for k, v in
+                        sorted(r["routes_by_policy"].items()))
+        lines.append(f"router: {r['n_routes']} route(s) ({pol}), "
+                     f"{r['n_failovers']} failover(s)")
+    lines.append(
+        f"request ids: {report['n_unique_request_ids']} unique across "
+        f"the fleet, {report['n_replayed_after_abandonment']} "
+        f"replayed after abandonment")
+    if report["anomalies"]:
+        lines.append(f"ANOMALIES ({len(report['anomalies'])}):")
+        lines.extend(f"  {json.dumps(a, sort_keys=True)}"
+                     for a in report["anomalies"])
+    else:
+        lines.append("no anomalies")
+    return "\n".join(lines)
+
+
 def _human(report: dict) -> str:
     lines = [
         f"runlog: {report['n_events']} events, "
@@ -449,7 +632,9 @@ def _human(report: dict) -> str:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("runlog", help="engine runlog (JSON lines)")
+    p.add_argument("runlog", nargs="+",
+                   help="engine runlog(s) (JSON lines); several paths "
+                        "= fleet merge keyed by replica filename")
     p.add_argument("--json", dest="json_out", default=None,
                    help="write the JSON report here ('-' = stdout, "
                         "suppressing the human summary)")
@@ -459,13 +644,40 @@ def main(argv=None) -> int:
     p.add_argument("--series", action="store_true",
                    help="inline the full per-round series")
     args = p.parse_args(argv)
+    if len(args.runlog) > 1:
+        entries = []
+        for path in args.runlog:
+            try:
+                events = load_runlog(path)
+            except OSError as e:
+                print(f"ERROR: {e}", file=sys.stderr)
+                return 2
+            replica, incarnation = classify_runlog(path)
+            entries.append({"path": path, "replica": replica,
+                            "incarnation": incarnation,
+                            "events": events})
+        if not any(e["events"] for e in entries):
+            print("ERROR: no runlog events in any input",
+                  file=sys.stderr)
+            return 2
+        report = build_fleet_report(entries, phase_tol=args.phase_tol)
+        if args.json_out == "-":
+            print(json.dumps(report, indent=2, sort_keys=True,
+                             default=str))
+        else:
+            if args.json_out:
+                with open(args.json_out, "w") as f:
+                    json.dump(report, f, indent=2, sort_keys=True,
+                              default=str)
+            print(_human_fleet(report))
+        return 0 if report["ok"] else 1
     try:
-        events = load_runlog(args.runlog)
+        events = load_runlog(args.runlog[0])
     except OSError as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 2
     if not events:
-        print(f"ERROR: no runlog events in {args.runlog}",
+        print(f"ERROR: no runlog events in {args.runlog[0]}",
               file=sys.stderr)
         return 2
     report = build_report(events, phase_tol=args.phase_tol,

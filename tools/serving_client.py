@@ -19,11 +19,20 @@ delivered tokens is never silently re-sent (re-sending would duplicate
 delivered output at the consumer) unless the caller opts in with
 ``retry_streamed_partial=True``.
 
+Multi-target failover (docs/fleet.md): ``ServingClient`` accepts an
+ordered ``targets`` list (``host:port`` specs); a connection-level
+failure rotates the preferred target so the next attempt — a policy
+retry or the next call — lands on the next endpoint. The same client
+therefore drives a single server OR the fleet front door with peers as
+fallback, with the idempotent-only retry rules unchanged.
+
 Usage (manual):
     python tools/serving_client.py --port 8000 generate 1 2 3 --steps 8
     python tools/serving_client.py --port 8000 stream 1 2 3 --steps 8
     python tools/serving_client.py --port 8000 load --requests 16
     python tools/serving_client.py --port 8000 metrics
+    python tools/serving_client.py --target :8100 --target :8000 \\
+        load --requests 16
 """
 
 from __future__ import annotations
@@ -123,19 +132,66 @@ def call_with_retry(attempt_fn, policy: RetryPolicy, key: str,
     return res
 
 
+def parse_target(spec, default_host: str = "127.0.0.1"
+                 ) -> Tuple[str, int]:
+    """``"host:port"``, ``":port"``, bare port (int or str), or an
+    ``(host, port)`` pair -> ``(host, port)``."""
+    if isinstance(spec, (tuple, list)):
+        return str(spec[0]), int(spec[1])
+    s = str(spec)
+    if ":" in s:
+        host, _, port = s.rpartition(":")
+        return host or default_host, int(port)
+    return default_host, int(s)
+
+
 class ServingClient:
-    """One server endpoint; a fresh connection per call (the load
-    generator runs many of these concurrently — connection state is
-    never shared across threads)."""
+    """One service endpoint — or a failover LIST of them (a single
+    server or the fleet front door plus peers); a fresh connection per
+    call (the load generator runs many of these concurrently —
+    connection state is never shared across threads).
+
+    ``targets`` is an ordered list of ``host:port`` specs. Connection-
+    level failures rotate the preferred target, so the NEXT attempt —
+    a :class:`RetryPolicy` retry or the next call — lands on the next
+    target. Failover composes with the policy rather than replacing
+    it: the idempotent-only rules are unchanged (a stream that already
+    delivered tokens is still never silently re-sent; rotation only
+    changes WHERE a permitted retry goes)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 timeout: float = 120.0):
-        self.host = host
-        self.port = port
+                 timeout: float = 120.0, targets=None):
+        if targets:
+            self.targets = [parse_target(t, host) for t in targets]
+        else:
+            self.targets = [(host, int(port))]
         self.timeout = timeout
+        self._rotate_lock = threading.Lock()
+        self._preferred = 0  # guarded-by: _rotate_lock
+
+    @property
+    def host(self) -> str:
+        return self._target()[0]
+
+    @property
+    def port(self) -> int:
+        return self._target()[1]
+
+    def _target(self) -> Tuple[str, int]:
+        with self._rotate_lock:
+            return self.targets[self._preferred % len(self.targets)]
+
+    def _rotate_target(self) -> None:
+        """A connection-level failure was observed on the preferred
+        target: prefer the next one from here on."""
+        with self._rotate_lock:
+            if len(self.targets) > 1:
+                self._preferred = (self._preferred + 1) \
+                    % len(self.targets)
 
     def _conn(self) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(self.host, self.port,
+        host, port = self._target()
+        return http.client.HTTPConnection(host, port,
                                           timeout=self.timeout)
 
     def _get(self, path: str):
@@ -146,6 +202,9 @@ class ServingClient:
             resp = conn.getresponse()
             body = resp.read()
             return resp.status, body, time.perf_counter() - t0
+        except (ConnectionError, OSError):
+            self._rotate_target()
+            raise
         finally:
             conn.close()
 
@@ -220,6 +279,9 @@ class ServingClient:
                     resp.headers.get("X-Engine-Request-Id"),
                 **payload,
             }
+        except (ConnectionError, OSError):
+            self._rotate_target()
+            raise
         finally:
             conn.close()
 
@@ -284,6 +346,7 @@ class ServingClient:
             except (ConnectionError, OSError,
                     http.client.HTTPException) as e:
                 stream_error = f"{type(e).__name__}: {e}"
+                self._rotate_target()
             return {
                 **({"stream_error": stream_error} if stream_error
                    else {}),
@@ -297,6 +360,9 @@ class ServingClient:
                     resp.headers.get("X-Engine-Request-Id"),
                 **{k: v for k, v in final.items() if k != "done"},
             }
+        except (ConnectionError, OSError):
+            self._rotate_target()
+            raise
         finally:
             conn.close()
 
@@ -307,7 +373,8 @@ class ServingClient:
 def run_closed_loop(host: str, port: int, prompts: List[Sequence[int]],
                     steps: int, concurrency: int = 4,
                     stream: bool = True,
-                    deadline_s: Optional[float] = None) -> Dict:
+                    deadline_s: Optional[float] = None,
+                    targets=None) -> Dict:
     """Closed-loop load: ``concurrency`` workers, each sending its next
     request the moment the previous one finishes, until every prompt is
     served exactly once (work-stealing over one shared index). The
@@ -320,7 +387,7 @@ def run_closed_loop(host: str, port: int, prompts: List[Sequence[int]],
     lock = threading.Lock()
 
     def worker():
-        client = ServingClient(host, port)
+        client = ServingClient(host, port, targets=targets)
         while True:
             with lock:
                 i = cursor[0]
@@ -344,7 +411,8 @@ def run_closed_loop(host: str, port: int, prompts: List[Sequence[int]],
 def run_open_loop(host: str, port: int, prompts: List[Sequence[int]],
                   steps: int, rate_per_s: float,
                   deadline_s: Optional[float] = None,
-                  stream: bool = False) -> Dict:
+                  stream: bool = False,
+                  targets=None) -> Dict:
     """Open-loop load: fire one request per ``1/rate`` seconds from a
     metronome regardless of completions (arrival process independent of
     service process — the regime where backpressure shows up as real
@@ -354,7 +422,7 @@ def run_open_loop(host: str, port: int, prompts: List[Sequence[int]],
     threads = []
 
     def fire(i):
-        client = ServingClient(host, port)
+        client = ServingClient(host, port, targets=targets)
         fn = client.stream if stream else client.generate
         results[i] = fn(prompts[i], steps, deadline_s=deadline_s)
 
@@ -420,7 +488,13 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--target", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="endpoint to drive; repeat for an ordered "
+                        "failover list (a single server, or the fleet "
+                        "front door plus peers). Overrides "
+                        "--host/--port.")
     sub = p.add_subparsers(dest="cmd", required=True)
     for name in ("generate", "stream"):
         g = sub.add_parser(name)
@@ -441,8 +515,11 @@ def main(argv=None) -> int:
     sub.add_parser("metrics")
     sub.add_parser("readyz")
     args = p.parse_args(argv)
+    if args.port is None and not args.target:
+        p.error("one of --port or --target is required")
 
-    client = ServingClient(args.host, args.port)
+    client = ServingClient(args.host, args.port or 0,
+                           targets=args.target)
     if args.cmd == "generate":
         policy = RetryPolicy(max_attempts=args.retries + 1) \
             if args.retries else None
@@ -463,12 +540,14 @@ def main(argv=None) -> int:
                     for _ in range(args.prompt_len)]
                    for _ in range(args.requests)]
         if args.rate:
-            run = run_open_loop(args.host, args.port, prompts,
-                                args.steps, rate_per_s=args.rate)
+            run = run_open_loop(args.host, args.port or 0, prompts,
+                                args.steps, rate_per_s=args.rate,
+                                targets=args.target)
         else:
-            run = run_closed_loop(args.host, args.port, prompts,
+            run = run_closed_loop(args.host, args.port or 0, prompts,
                                   args.steps,
-                                  concurrency=args.concurrency)
+                                  concurrency=args.concurrency,
+                                  targets=args.target)
         digest = summarize(run["results"])
         digest["wall_s"] = run["wall_s"]
         digest["completions_per_s"] = digest["n_ok"] / run["wall_s"]
