@@ -4,32 +4,59 @@ Mechanizes the stack's hard-won correctness rules as an ``ast``-based
 checker that runs in tier-1 (``python -m marlin_tpu.analysis``,
 ``make lint`` in tools/): donation-safe device fetches, lock-annotated
 shared state, the deterministic-replay contract, jit retrace hazards,
-``sys.modules``-before-exec loaders, and export integrity. Each rule is
+``sys.modules``-before-exec loaders, lock-order deadlock cycles,
+blocking-under-lock stalls, and export integrity. Each rule is
 grounded in a bug a real PR shipped or nearly shipped — see
-docs/static_analysis.md for the catalog, annotation grammar,
-suppression policy, and baseline workflow; PAPERS.md for the lineage
-(Tricorder, Clang Thread Safety Analysis).
+docs/static_analysis.md for the catalog, analysis model, annotation
+grammar, suppression policy, and baseline workflow; PAPERS.md for the
+lineage (Tricorder, Clang Thread Safety Analysis, RacerD).
+
+v2 is a CFG/dataflow engine: ``cfg.py`` (per-scope control-flow
+graphs), ``flow.py`` (must/may forward dataflow: lock-set and taint
+lattices), ``callgraph.py`` (project-wide name resolution +
+RacerD-style compositional per-function summaries).
 
 Dependency-free by design (stdlib only, no jax import): the pass must
 run — fast — anywhere the repo checks out.
 """
 
+from .callgraph import (FileSummary, FuncInfo, ProjectIndex,
+                        file_summary, project_index)
+from .cfg import CFG, build_cfg
 from .cli import main
 from .core import (AnalysisContext, Finding, Report, Rule, SourceFile,
-                   analyze, load_baseline, render_text, write_baseline)
+                   analyze, analyze_parallel, load_baseline,
+                   render_stats, render_text, write_baseline)
+from .flow import (TOP, iter_events, lock_states, meet_intersect,
+                   meet_union, run_forward)
 from .rules import ALL_RULES, rules_by_name
 
 __all__ = [
     "ALL_RULES",
     "AnalysisContext",
+    "CFG",
+    "FileSummary",
     "Finding",
+    "FuncInfo",
+    "ProjectIndex",
     "Report",
     "Rule",
     "SourceFile",
+    "TOP",
     "analyze",
+    "analyze_parallel",
+    "build_cfg",
+    "file_summary",
+    "iter_events",
     "load_baseline",
+    "lock_states",
     "main",
+    "meet_intersect",
+    "meet_union",
+    "project_index",
+    "render_stats",
     "render_text",
     "rules_by_name",
+    "run_forward",
     "write_baseline",
 ]

@@ -1,0 +1,723 @@
+"""Project-wide call graph + per-function summaries (marlint v2).
+
+RacerD's core trade (Blackshear et al., OOPSLA 2018, PAPERS.md): don't
+do whole-program alias analysis — compute a small compositional summary
+per function (locks acquired, locks required, blocking calls, what the
+return value carries) and let call sites consult the callee's summary.
+Name resolution is deliberately heuristic and deliberately silent about
+failure: ``self.m()`` resolves inside the declaring class, a bare
+``f()`` resolves to a same-module function, ``obj.m()`` resolves only
+when exactly one class in the scanned project defines ``m`` (the
+unique-member heuristic; also applied to ``@property`` accesses, which
+is how ``r.healthy`` under the router lock becomes a
+``Router._lock -> Replica._lock`` acquisition edge). A dynamic call
+nothing matches is NOT an error — it contributes no facts, so rules
+degrade to no-finding rather than crash or guess.
+
+Everything stored here is a flat tuple-of-strings dataclass: the
+``--jobs`` path pickles per-file summaries from worker processes and
+merges them in the parent, so summaries must never hold AST nodes.
+
+Lock identity: ``Class.attr`` for instance locks (``self._lock`` in
+``Replica`` is ``Replica._lock`` — a DIFFERENT lock from the router's
+``_lock``), ``module.py:NAME`` for module-level locks. A non-``self``
+attribute reference (``eng._submit_lock``) resolves only when exactly
+one scanned class declares that lock attribute; ambiguous names are
+dropped rather than merged (merging distinct locks under one identity
+is how false deadlock cycles are born).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .cfg import build_cfg
+from .core import SourceFile, dotted_name, self_attr
+from .flow import held_refs, iter_events, lock_states
+
+# -- blocking-call matcher --------------------------------------------
+#
+# Dotted call names and method names that block the calling thread.
+# Curated, not exhaustive: every entry is either a syscall-ish wait or
+# a network round-trip. ``.join`` is deliberately absent (str.join);
+# ``.acquire`` is deliberately absent (lock nesting is lock-order's
+# jurisdiction, not blocking-under-lock's).
+
+BLOCKING_DOTTED = frozenset({
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "select.select",
+    "jax.block_until_ready",
+})
+BLOCKING_METHODS = frozenset({
+    "wait", "wait_for", "communicate", "getresponse",
+    "block_until_ready",
+})
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock", "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+    "Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+}
+
+# Reentrant kinds: re-acquiring on the same thread is legal, so a
+# self-edge on these is not a self-deadlock.
+_REENTRANT_KINDS = {"RLock", "Condition"}
+
+# Protocol methods of ubiquitous stdlib objects (files, sockets,
+# processes, threads, queues, containers). An ``obj.flush()`` whose
+# receiver type we cannot see matches these names constantly —
+# resolving one to a project method by name alone (``self._sink.flush``
+# inside RunLog name-matching RunLog.flush) manufactures false call
+# edges and false deadlock cycles. Attr-style unique-method resolution
+# refuses these names; ``self.flush()`` still resolves (class-typed).
+STDLIB_PROTO_METHODS = frozenset({
+    "flush", "close", "read", "readline", "readlines", "write",
+    "writelines", "seek", "tell", "fileno", "detach",
+    "send", "sendall", "recv", "connect", "accept", "bind", "listen",
+    "settimeout", "makefile", "shutdown",
+    "poll", "terminate", "kill",
+    "acquire", "release", "locked", "set", "clear", "is_set",
+    "join", "start", "cancel", "notify", "notify_all",
+    "get", "put", "get_nowait", "put_nowait", "task_done", "qsize",
+    "append", "appendleft", "pop", "popleft", "extend", "remove",
+    "update", "items", "keys", "values", "setdefault", "copy",
+})
+
+# Raw lock refs (pre-resolution): ("self", attr) | ("obj", attr) |
+# ("name", module_level_name). Plain tuples so they pickle and sort.
+
+
+def resolve_lock_expr(expr: ast.AST,
+                      module_locks: frozenset = frozenset()
+                      ) -> Optional[Tuple[str, str]]:
+    """Raw lock ref for a ``with`` context expression, or None when the
+    expression cannot be a tracked lock (calls, literals, locals)."""
+    attr = self_attr(expr)
+    if attr is not None:
+        return ("self", attr)
+    if isinstance(expr, ast.Attribute):
+        return ("obj", expr.attr)
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return ("name", expr.id)
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncInfo:
+    """One function's compositional summary. ``held`` tuples are raw
+    lock refs — resolution against the merged project happens in
+    :class:`ProjectIndex`."""
+
+    rel: str
+    qual: str              # dotted scope name ("Cls.meth", "outer.inner")
+    cls: str               # immediately-enclosing class name, "" if none
+    name: str
+    line: int
+    is_property: bool
+    requires: Tuple[Tuple[str, str], ...]
+    # (ref, line, held-before) per with-acquisition
+    acquires: Tuple[Tuple[Tuple[str, str], int, tuple], ...]
+    # (kind, name, line, held, recv) per call site; kind:
+    # self|bare|attr; recv is the receiver's simple Name (``eng`` in
+    # ``eng.submit()``, ``json`` in ``json.dumps()``) or None — the
+    # resolver uses it to refuse method-matching calls whose receiver
+    # is an imported module
+    calls: Tuple[Tuple[str, str, int, tuple, object], ...]
+    # (label, line, held, recv) per direct blocking call; recv is the
+    # raw lock ref of the receiver for method-style blockers (so
+    # ``with self._cv: self._cv.wait()`` — which RELEASES the lock —
+    # can be exempted), None otherwise
+    blocking: Tuple[Tuple[str, int, tuple, object], ...]
+    # (kind, attr, line, held) attribute reads, deduped per (attr,
+    # held); kind: self|obj (simple-Name receiver)|chain (anything
+    # deeper — ``self._proc.pid`` must NOT match a @property by name)
+    attr_uses: Tuple[Tuple[str, str, int, tuple], ...]
+    returns_self_attrs: Tuple[str, ...]
+    returns_static: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class FileSummary:
+    rel: str
+    funcs: Tuple[FuncInfo, ...]
+    # (cls, attr, kind); cls == "" for module-level lock names
+    locks: Tuple[Tuple[str, str, str], ...]
+    # names the file binds via import — an attr call whose receiver is
+    # one of these (``json.dumps``) is a module function, never a
+    # method of a scanned class
+    imports: Tuple[str, ...]
+    # per-line suppression sets, carried so --jobs workers can hand the
+    # parent enough to apply suppression to cross-file (finalize-phase)
+    # findings without re-reading the file
+    suppressed: Tuple[Tuple[int, Tuple[str, ...]], ...]
+
+
+# -- per-file extraction ----------------------------------------------
+
+
+def scope_nodes(stmt_list):
+    """All nodes of one scope: descend expressions and compound
+    statements but never nested def/class bodies."""
+    todo = list(stmt_list)
+    while todo:
+        n = todo.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        yield n
+        todo.extend(ast.iter_child_nodes(n))
+
+
+def event_nodes(ev):
+    """Nodes to scan for calls/attribute-uses in one CFG event.
+    ``with_enter``/``with_exit``/``def`` contribute nothing (the
+    context expression already appeared as a ``use`` event; nested defs
+    are their own scopes)."""
+    kind, node = ev
+    if kind in ("stmt", "use"):
+        return scope_nodes([node])
+    if kind == "forassign":
+        return scope_nodes([node.target])
+    return ()
+
+
+def _returns_static_expr(expr: ast.AST) -> bool:
+    """True when the expression is concrete under jax tracing no matter
+    what the arguments are: constants and shape/len arithmetic only —
+    any Name outside a shape/len subtree disqualifies (``return x``
+    must NOT summarize as static)."""
+    ok = True
+
+    def visit(n, in_static):
+        nonlocal ok
+        if isinstance(n, ast.Attribute) and n.attr in (
+                "shape", "ndim", "size", "dtype"):
+            in_static = True
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            in_static = True
+        elif isinstance(n, ast.Name) and not in_static:
+            ok = False
+        for c in ast.iter_child_nodes(n):
+            visit(c, in_static)
+
+    visit(expr, False)
+    return ok
+
+
+def _blocking_label(call: ast.Call) -> Optional[str]:
+    fn = dotted_name(call.func)
+    if fn in BLOCKING_DOTTED:
+        return fn
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in BLOCKING_METHODS:
+        return f".{call.func.attr}"
+    return None
+
+
+def _call_ref(call: ast.Call) -> Optional[Tuple[str, str]]:
+    f = call.func
+    m = self_attr(f)
+    if m is not None:
+        return ("self", m)
+    if isinstance(f, ast.Name):
+        return ("bare", f.id)
+    if isinstance(f, ast.Attribute):
+        return ("attr", f.attr)
+    return None
+
+
+class _FuncExtractor:
+    """Builds one FuncInfo. Uses the lock-set fixpoint only when the
+    function can hold a lock at all (a ``with`` on an attribute/name or
+    a ``holds=`` contract); every other function gets the cheap lexical
+    walk with a constant (empty) held set."""
+
+    def __init__(self, sf: SourceFile, node, qual: str, cls: str,
+                 module_locks: frozenset):
+        self.sf = sf
+        self.node = node
+        self.qual = qual
+        self.cls = cls
+        self.module_locks = module_locks
+
+    def extract(self) -> FuncInfo:
+        sf, node = self.sf, self.node
+        requires: Tuple[Tuple[str, str], ...] = ()
+        h = sf.header_annotation(node, sf.holds)
+        if h:
+            requires = (("self", h),)
+        is_prop = any(dotted_name(d) in ("property", "functools.cached_property",
+                                         "cached_property")
+                      for d in node.decorator_list)
+        resolve = lambda e: resolve_lock_expr(e, self.module_locks)
+        needs_flow = bool(requires) or any(
+            isinstance(n, (ast.With, ast.AsyncWith)) and any(
+                resolve(item.context_expr) is not None
+                for item in n.items)
+            for n in scope_nodes(node.body)
+            if isinstance(n, (ast.With, ast.AsyncWith)))
+        acquires: List[tuple] = []
+        calls: List[tuple] = []
+        blocking: List[tuple] = []
+        attr_seen: Dict[tuple, tuple] = {}
+        if needs_flow:
+            cfg = build_cfg(node.body)
+            states, transfer = lock_states(
+                cfg, resolve, [r for r in requires])
+            for ev, state in iter_events(cfg, states, transfer):
+                held = held_refs(state)
+                if ev[0] == "with_enter":
+                    ref = resolve(ev[1].context_expr)
+                    if ref is not None:
+                        acquires.append((ref, ev[1].context_expr.lineno,
+                                         held))
+                    continue
+                self._scan(ev, held, calls, blocking, attr_seen)
+        else:
+            held = tuple(requires)
+            for stmt in node.body:
+                self._scan(("stmt", stmt), held, calls, blocking,
+                           attr_seen)
+        rets: List[str] = []
+        rets_static = True
+        saw_return_value = False
+        for n in scope_nodes(node.body):
+            if isinstance(n, ast.Return) and n.value is not None:
+                saw_return_value = True
+                a = self_attr(n.value)
+                if a is not None:
+                    rets.append(a)
+                if not _returns_static_expr(n.value):
+                    rets_static = False
+        if not saw_return_value:
+            rets_static = False  # implicit None: nothing to vouch for
+        return FuncInfo(
+            rel=sf.rel, qual=self.qual, cls=self.cls, name=node.name,
+            line=node.lineno, is_property=is_prop, requires=requires,
+            acquires=tuple(acquires), calls=tuple(calls),
+            blocking=tuple(blocking),
+            attr_uses=tuple(attr_seen.values()),
+            returns_self_attrs=tuple(dict.fromkeys(rets)),
+            returns_static=rets_static)
+
+    def _scan(self, ev, held, calls, blocking, attr_seen) -> None:
+        for n in event_nodes(ev):
+            if isinstance(n, ast.Call):
+                label = _blocking_label(n)
+                if label is not None:
+                    recv = None
+                    if isinstance(n.func, ast.Attribute):
+                        recv = resolve_lock_expr(n.func.value,
+                                                 self.module_locks)
+                    blocking.append((label, n.lineno, held, recv))
+                ref = _call_ref(n)
+                if ref is not None:
+                    recv = None
+                    if ref[0] == "attr" and \
+                            isinstance(n.func.value, ast.Name):
+                        recv = n.func.value.id
+                    calls.append((ref[0], ref[1], n.lineno, held, recv))
+            elif isinstance(n, ast.Attribute):
+                if self_attr(n) is not None:
+                    kind = "self"
+                elif isinstance(n.value, ast.Name):
+                    kind = "obj"
+                else:
+                    kind = "chain"
+                key = (kind, n.attr, held)
+                if key not in attr_seen:
+                    attr_seen[key] = (kind, n.attr, n.lineno, held)
+
+
+def file_summary(sf: SourceFile) -> FileSummary:
+    """Extract (and memoize on the SourceFile — which the content-hash
+    cache in core keeps alive across runs) the file's lock declarations
+    and per-function summaries."""
+    cached = getattr(sf, "_marlint_file_summary", None)
+    if cached is not None:
+        return cached
+    locks: List[Tuple[str, str, str]] = []
+    module_locks: Set[str] = set()
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            kind = _LOCK_CTORS.get(dotted_name(stmt.value.func) or "")
+            if kind:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        locks.append(("", t.id, kind))
+                        module_locks.add(t.id)
+    funcs: List[FuncInfo] = []
+    mlocks = frozenset(module_locks)
+
+    def visit(body, prefix: str, cls: str):
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                _class_locks(sf, stmt, locks)
+                visit(stmt.body, f"{prefix}{stmt.name}.", stmt.name)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                funcs.append(_FuncExtractor(
+                    sf, stmt, qual, cls, mlocks).extract())
+                visit(stmt.body, f"{qual}.", "")
+            elif isinstance(stmt, (ast.If, ast.Try, ast.With,
+                                   ast.AsyncWith, ast.For, ast.While)):
+                # defs under version shims / guards still exist
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        visit([child], prefix, cls)
+                    elif isinstance(child, ast.excepthandler):
+                        visit(child.body, prefix, cls)
+
+    visit(sf.tree.body, "", "")
+    imports: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    imports.add(a.asname or a.name)
+    out = FileSummary(
+        rel=sf.rel, funcs=tuple(funcs), locks=tuple(locks),
+        imports=tuple(sorted(imports)),
+        suppressed=tuple(sorted(
+            (ln, tuple(sorted(rs))) for ln, rs in sf.suppressed.items())))
+    sf._marlint_file_summary = out
+    return out
+
+
+def _class_locks(sf: SourceFile, cls: ast.ClassDef,
+                 locks: List[Tuple[str, str, str]]) -> None:
+    """Lock attributes of a class: explicit ``threading.*`` constructor
+    assignments (class body + __init__/__post_init__), plus any lock
+    NAMED by a guarded-by/holds= annotation in the class (a lock built
+    elsewhere is still a lock once the discipline names it)."""
+    seen: Set[str] = set()
+
+    def add(attr: str, kind: str):
+        if attr not in seen:
+            seen.add(attr)
+            locks.append((cls.name, attr, kind))
+
+    def scan_stmt(stmt):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            return
+        value = stmt.value
+        if value is None or not isinstance(value, ast.Call):
+            return
+        kind = _LOCK_CTORS.get(dotted_name(value.func) or "")
+        if not kind:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            attr = self_attr(t)
+            if attr is None and isinstance(t, ast.Name):
+                attr = t.id
+            if attr:
+                add(attr, kind)
+
+    for stmt in cls.body:
+        scan_stmt(stmt)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if stmt.name in ("__init__", "__post_init__"):
+                for sub in ast.walk(stmt):
+                    scan_stmt(sub)
+            h = sf.header_annotation(stmt, sf.holds)
+            if h:
+                add(h, "Lock")
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            lock = sf.annotation_on(node, sf.guarded)
+            if lock:
+                add(lock, "Lock")
+
+
+# -- the merged project index -----------------------------------------
+
+
+_CHAIN_CAP = 6          # witness chains longer than this stop growing
+_PROP_PASSES = 12       # closure iteration backstop (graph is shallow)
+
+
+class ProjectIndex:
+    """Merged per-file summaries + lazy resolution/propagation. Lives
+    on the AnalysisContext; per-file adds happen in the collect phase
+    (possibly in worker processes — FileSummary pickles), finalization
+    happens once, on first rule query."""
+
+    def __init__(self):
+        self.files: Dict[str, FileSummary] = {}
+        self._resolved = None
+
+    def add(self, fsum: FileSummary) -> None:
+        self.files[fsum.rel] = fsum
+        self._resolved = None
+
+    def add_source(self, sf: SourceFile) -> None:
+        if sf.rel not in self.files:
+            self.add(file_summary(sf))
+
+    def resolved(self) -> "ResolvedGraph":
+        if self._resolved is None:
+            self._resolved = ResolvedGraph(self.files)
+        return self._resolved
+
+
+def project_index(ctx) -> ProjectIndex:
+    """The per-run ProjectIndex, stashed on the AnalysisContext so the
+    dataflow rules share one merged view (and so core's ``--jobs`` path
+    can install a pre-merged index into worker contexts)."""
+    idx = getattr(ctx, "marlint_index", None)
+    if idx is None:
+        idx = ProjectIndex()
+        ctx.marlint_index = idx
+    return idx
+
+
+class ResolvedGraph:
+    def __init__(self, files: Dict[str, FileSummary]):
+        self.files = files
+        self.lock_kind: Dict[str, str] = {}
+        # lock attr -> {class names declaring it}
+        self.attr_classes: Dict[str, Set[str]] = {}
+        self.module_lock_rel: Dict[Tuple[str, str], str] = {}
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.by_method: Dict[str, List[Tuple[str, str]]] = {}
+        self.by_module_func: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self.by_property: Dict[str, List[Tuple[str, str]]] = {}
+        self.imports_by_rel: Dict[str, frozenset] = {}
+        for rel, fs in sorted(files.items()):
+            self.imports_by_rel[rel] = frozenset(fs.imports)
+            for cls, attr, kind in fs.locks:
+                if cls:
+                    lid = f"{cls}.{attr}"
+                    self.attr_classes.setdefault(attr, set()).add(cls)
+                else:
+                    lid = f"{rel}:{attr}"
+                    self.module_lock_rel[(rel, attr)] = lid
+                self.lock_kind.setdefault(lid, kind)
+            for fi in fs.funcs:
+                key = (rel, fi.qual)
+                self.funcs[key] = fi
+                if fi.cls:
+                    self.by_method.setdefault(fi.name, []).append(key)
+                    if fi.is_property:
+                        self.by_property.setdefault(
+                            fi.name, []).append(key)
+                elif "." not in fi.qual:
+                    self.by_module_func[(rel, fi.name)] = key
+        self._close()
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve_lock(self, ref, cls: str, rel: str) -> Optional[str]:
+        """Raw lock ref -> lock identity, or None (unknown receiver,
+        ambiguous attr, undeclared lock — all degrade silently)."""
+        kind, name = ref
+        if kind == "self":
+            if cls and cls in self.attr_classes.get(name, ()):
+                return f"{cls}.{name}"
+            return None
+        if kind == "obj":
+            owners = self.attr_classes.get(name, ())
+            if len(owners) == 1:
+                return f"{next(iter(owners))}.{name}"
+            return None
+        if kind == "name":
+            return self.module_lock_rel.get((rel, name))
+        return None
+
+    def resolve_held(self, held, cls: str, rel: str) -> Tuple[str, ...]:
+        out = []
+        for ref in held:
+            lid = self.resolve_lock(ref, cls, rel)
+            if lid is not None:
+                out.append(lid)
+        return tuple(out)
+
+    def resolve_call(self, kind: str, name: str, rel: str, cls: str,
+                     recv: Optional[str] = None
+                     ) -> Optional[Tuple[str, str]]:
+        if kind == "self":
+            if cls:
+                key = (rel, f"{cls}.{name}")
+                if key in self.funcs:
+                    return key
+            cands = self.by_method.get(name, [])
+            return cands[0] if len(cands) == 1 else None
+        if kind == "bare":
+            return self.by_module_func.get((rel, name))
+        if kind == "attr":
+            if recv and recv in self.imports_by_rel.get(rel, ()):
+                return None  # module function (json.dumps), not a method
+            if name in STDLIB_PROTO_METHODS:
+                return None  # no type evidence; name matches stdlib noise
+            cands = self.by_method.get(name, [])
+            return cands[0] if len(cands) == 1 else None
+        return None
+
+    def resolve_property(self, kind: str, attr: str, rel: str, cls: str
+                         ) -> Optional[Tuple[str, str]]:
+        if kind not in ("self", "obj"):
+            # "chain" receivers (self._proc.pid) carry no type evidence
+            # — matching a @property by name alone breeds false cycles.
+            return None
+        cands = self.by_property.get(attr, [])
+        if kind == "self" and cls:
+            key = (rel, f"{cls}.{attr}")
+            return key if key in cands else None
+        return cands[0] if len(cands) == 1 else None
+
+    # -- reachability closures ----------------------------------------
+
+    def _callees(self, fi: FuncInfo):
+        """Resolved callee keys of one function: explicit calls plus
+        unique-@property attribute reads."""
+        me = (fi.rel, fi.qual)
+        out = []
+        for kind, name, line, held, recv in fi.calls:
+            key = self.resolve_call(kind, name, fi.rel, fi.cls, recv)
+            if key is None:
+                continue
+            if key == me and kind == "attr":
+                # ``self._sink.flush()`` inside RunLog.flush name-matching
+                # RunLog.flush itself: a non-self receiver resolving to
+                # the very caller is the heuristic misfiring, not
+                # recursion (kind "self"/"bare" recursion is kept).
+                continue
+            out.append((key, line, held))
+        for kind, attr, line, held in fi.attr_uses:
+            key = self.resolve_property(kind, attr, fi.rel, fi.cls)
+            if key is not None and key != me:
+                out.append((key, line, held))
+        return out
+
+    def _close(self) -> None:
+        """Propagate may-acquire / may-block over the resolved graph to
+        fixpoint. Chains record the qualname path for witnesses."""
+        self.may_acquire: Dict[Tuple[str, str], Dict[str, tuple]] = {}
+        self.may_block: Dict[Tuple[str, str], Dict[str, tuple]] = {}
+        for key, fi in self.funcs.items():
+            acq = {}
+            for ref, line, _held in fi.acquires:
+                lid = self.resolve_lock(ref, fi.cls, fi.rel)
+                if lid is not None:
+                    acq.setdefault(lid, ())
+            blk = {}
+            for label, line, held, recv in fi.blocking:
+                if recv is not None and recv in held:
+                    continue  # condition-wait: the held lock is released
+                blk.setdefault(label, ())
+            self.may_acquire[key] = acq
+            self.may_block[key] = blk
+        callees = {key: self._callees(fi)
+                   for key, fi in self.funcs.items()}
+        for _ in range(_PROP_PASSES):
+            changed = False
+            for key, fi in self.funcs.items():
+                for ckey, _line, _held in callees[key]:
+                    cqual = self.funcs[ckey].qual
+                    for lid, chain in self.may_acquire[ckey].items():
+                        if len(chain) >= _CHAIN_CAP:
+                            continue
+                        mine = self.may_acquire[key]
+                        if lid not in mine:
+                            mine[lid] = (cqual,) + chain
+                            changed = True
+                    for label, chain in self.may_block[ckey].items():
+                        if len(chain) >= _CHAIN_CAP:
+                            continue
+                        mine = self.may_block[key]
+                        if label not in mine:
+                            mine[label] = (cqual,) + chain
+                            changed = True
+            if not changed:
+                break
+        self._callees_map = callees
+
+    def callees_of(self, key) -> list:
+        """Resolved call sites of one function:
+        ``[(callee_key, line, held), ...]``."""
+        return self._callees_map.get(key, [])
+
+    # -- the global lock-acquisition graph ----------------------------
+
+    def order_edges(self):
+        """Directed edges (held -> acquired) with witnesses:
+        (held_id, acq_id, rel, qual, line, chain). Direct with-nesting
+        and held-across-call composition both contribute."""
+        edges = []
+        for key, fi in self.funcs.items():
+            for ref, line, held in fi.acquires:
+                lid = self.resolve_lock(ref, fi.cls, fi.rel)
+                if lid is None:
+                    continue
+                for hid in self.resolve_held(held, fi.cls, fi.rel):
+                    edges.append((hid, lid, fi.rel, fi.qual, line, ()))
+            for ckey, line, held in self._callees_map[key]:
+                hids = self.resolve_held(held, fi.cls, fi.rel)
+                if not hids:
+                    continue
+                cqual = self.funcs[ckey].qual
+                for lid, chain in self.may_acquire[ckey].items():
+                    for hid in hids:
+                        edges.append((hid, lid, fi.rel, fi.qual, line,
+                                      (cqual,) + chain))
+        return edges
+
+    def lock_cycles(self):
+        """Cycles in the acquisition graph. Returns a list of
+        (locks_in_cycle, witness_edges) — one entry per distinct cycle,
+        each witness edge the first-seen edge for that (held, acquired)
+        pair. Self-edges on non-reentrant locks come back as 1-cycles.
+        """
+        first_edge: Dict[Tuple[str, str], tuple] = {}
+        adj: Dict[str, Set[str]] = {}
+        for hid, lid, rel, qual, line, chain in self.order_edges():
+            if hid == lid:
+                if self.lock_kind.get(hid) in _REENTRANT_KINDS:
+                    continue
+            if (hid, lid) not in first_edge:
+                first_edge[(hid, lid)] = (hid, lid, rel, qual, line,
+                                          chain)
+                adj.setdefault(hid, set()).add(lid)
+        cycles = []
+        seen_cycles: Set[tuple] = set()
+        # self-deadlocks first
+        for (hid, lid), w in sorted(first_edge.items()):
+            if hid == lid:
+                cycles.append(((hid,), [w]))
+                seen_cycles.add((hid,))
+        # simple cycles between distinct locks: DFS from each node over
+        # the (small) lock graph, canonicalized by the sorted lock set
+        nodes = sorted(adj)
+        for start in nodes:
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == start and len(path) > 1:
+                        key = tuple(sorted(path))
+                        if key in seen_cycles:
+                            continue
+                        seen_cycles.add(key)
+                        ws = [first_edge[(path[i],
+                                          path[(i + 1) % len(path)])]
+                              for i in range(len(path))]
+                        cycles.append((tuple(path), ws))
+                    elif nxt not in path and nxt > start:
+                        if len(path) < 5:
+                            stack.append((nxt, path + [nxt]))
+        return cycles

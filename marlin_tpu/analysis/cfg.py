@@ -1,0 +1,297 @@
+"""Per-function control-flow graphs over ``ast`` (marlint v2).
+
+The lexical rules of PR 8 walked statement lists in source order, which
+is exactly why they could not see that a ``sys.modules`` store in one
+``if`` arm does not dominate an ``exec`` in the other, or that a lock
+acquired in a loop body is NOT held at the loop header on the next
+iteration. This module builds the graph those questions need: one CFG
+per scope (function body or module body), blocks of *events*, edges for
+if/while/for/try/with/break/continue/return/raise.
+
+Events, not raw statements: the dataflow transfer functions in
+``flow.py`` pattern-match on a small event vocabulary instead of the
+full statement zoo —
+
+``("stmt", node)``
+    A simple statement (assign, expr, return, raise, ...). Transfer
+    functions inspect the node type themselves.
+``("use", expr)``
+    A bare expression evaluated for control flow: an ``if``/``while``
+    test, a ``for`` iterable, a ``with`` context expression. Emitted in
+    the block where the expression evaluates, BEFORE the construct's
+    effect (so a guarded attribute inside ``with self.<lock>:``'s own
+    context expression is checked against the OUTER lock-set).
+``("with_enter", withitem)`` / ``("with_exit", withitem)``
+    Context-manager entry/exit. Lock-set transfer functions add/remove
+    here; taint transfer kills ``optional_vars``. Exits are emitted on
+    the normal path only — an exception unwinds out of the function (or
+    into a coarse handler edge, see below) and the analyses this feeds
+    are must/may over *reachable* states, not exactness about unwinding.
+``("forassign", For)``
+    The per-iteration target binding of a ``for`` loop, emitted at the
+    loop body entry (the target is re-bound every iteration, which is
+    what kills taint/static facts about it on the back edge).
+``("def", node)``
+    A nested ``def``/``class`` statement. The nested body is its own
+    scope — rules recurse explicitly with whatever entry state their
+    semantics demand (guarded-by resets the lock-set; retrace inherits
+    the enclosing statics).
+
+Exception edges are coarse on purpose: every block built inside a
+``try`` body gets an edge to every handler entry. That is the standard
+over-approximation (any statement may raise) and it is conservative in
+the direction the must-analyses need — a handler's entry state is the
+meet over all throw points.
+
+Unreachable code (statements after ``return``/``raise``/``break``)
+lands in a fresh block with no predecessors; the fixpoint in ``flow.py``
+leaves its in-state at TOP and rules skip it.
+
+Stdlib-only (``ast``); no imports from the rest of the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+Event = Tuple[str, ast.AST]
+
+
+class Block:
+    """A straight-line run of events plus successor edges."""
+
+    __slots__ = ("idx", "events", "succs")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.events: List[Event] = []
+        self.succs: List["Block"] = []
+
+    def edge(self, other: "Block") -> None:
+        if other is not self and other not in self.succs:
+            self.succs.append(other)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<B{self.idx} {len(self.events)}ev>"
+
+
+class CFG:
+    """Control-flow graph of one scope. ``blocks[0]`` is the entry;
+    ``exit`` is the single synthetic exit block (also in ``blocks``)."""
+
+    def __init__(self, blocks: List[Block], exit_block: Block):
+        self.blocks = blocks
+        self.exit = exit_block
+
+    @property
+    def entry(self) -> Block:
+        return self.blocks[0]
+
+    def describe(self) -> List[str]:
+        """Compact shape snapshot for tests: one line per block,
+        ``B<i>: <event kinds> -> <succs>`` with the exit rendered as
+        ``exit``. Stable across runs (construction order)."""
+        names = {}
+        for b in self.blocks:
+            names[b.idx] = "exit" if b is self.exit else f"B{b.idx}"
+        lines = []
+        for b in self.blocks:
+            if b is self.exit:
+                continue
+            kinds = " ".join(k for k, _ in b.events) or "-"
+            succs = ",".join(names[s.idx] for s in b.succs) or "-"
+            lines.append(f"{names[b.idx]}: {kinds} -> {succs}")
+        return lines
+
+
+class _Builder:
+    def __init__(self):
+        self.blocks: List[Block] = []
+        self.exit = None  # type: Optional[Block]
+        # (header_block, after_block) per enclosing loop
+        self.loops: List[Tuple[Block, Block]] = []
+
+    def new(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def build(self, body: List[ast.stmt]) -> CFG:
+        entry = self.new()
+        self.exit = self.new()
+        out = self.stmts(body, entry)
+        if out is not None:
+            out.edge(self.exit)
+        return CFG(self.blocks, self.exit)
+
+    # -- statement dispatch -------------------------------------------
+
+    def stmts(self, body: List[ast.stmt],
+              cur: Optional[Block]) -> Optional[Block]:
+        """Thread ``body`` through the graph starting at ``cur``.
+        Returns the block control falls out of, or None when every path
+        terminated (return/raise/break/continue)."""
+        for stmt in body:
+            if cur is None:
+                # Dead code after a terminator: parked in a fresh,
+                # predecessor-less block so its events still exist.
+                cur = self.new()
+            cur = self.stmt(stmt, cur)
+        return cur
+
+    def stmt(self, node: ast.stmt, cur: Block) -> Optional[Block]:
+        if isinstance(node, ast.If):
+            return self._if(node, cur)
+        if isinstance(node, (ast.While,)):
+            return self._while(node, cur)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            return self._for(node, cur)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            return self._with(node, cur)
+        if isinstance(node, ast.Try):
+            return self._try(node, cur)
+        if isinstance(node, ast.Match):
+            return self._match(node, cur)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            cur.events.append(("def", node))
+            return cur
+        if isinstance(node, ast.Return):
+            cur.events.append(("stmt", node))
+            cur.edge(self.exit)
+            return None
+        if isinstance(node, ast.Raise):
+            cur.events.append(("stmt", node))
+            cur.edge(self.exit)
+            return None
+        if isinstance(node, ast.Break):
+            if self.loops:
+                cur.edge(self.loops[-1][1])
+            return None
+        if isinstance(node, ast.Continue):
+            if self.loops:
+                cur.edge(self.loops[-1][0])
+            return None
+        # Every remaining statement kind is straight-line.
+        cur.events.append(("stmt", node))
+        return cur
+
+    # -- compound forms -----------------------------------------------
+
+    def _if(self, node: ast.If, cur: Block) -> Optional[Block]:
+        cur.events.append(("use", node.test))
+        then_in = self.new()
+        cur.edge(then_in)
+        then_out = self.stmts(node.body, then_in)
+        else_out: Optional[Block] = cur
+        if node.orelse:
+            else_in = self.new()
+            cur.edge(else_in)
+            else_out = self.stmts(node.orelse, else_in)
+        if then_out is None and else_out is None:
+            return None
+        join = self.new()
+        for b in (then_out, else_out):
+            if b is not None:
+                b.edge(join)
+        return join
+
+    def _while(self, node: ast.While, cur: Block) -> Optional[Block]:
+        header = self.new()
+        cur.edge(header)
+        header.events.append(("use", node.test))
+        after = self.new()
+        body_in = self.new()
+        header.edge(body_in)
+        self.loops.append((header, after))
+        body_out = self.stmts(node.body, body_in)
+        self.loops.pop()
+        if body_out is not None:
+            body_out.edge(header)
+        if node.orelse:
+            oe_in = self.new()
+            header.edge(oe_in)
+            oe_out = self.stmts(node.orelse, oe_in)
+            if oe_out is not None:
+                oe_out.edge(after)
+        else:
+            header.edge(after)
+        return after
+
+    def _for(self, node, cur: Block) -> Optional[Block]:
+        cur.events.append(("use", node.iter))
+        header = self.new()
+        cur.edge(header)
+        after = self.new()
+        body_in = self.new()
+        header.edge(body_in)
+        body_in.events.append(("forassign", node))
+        self.loops.append((header, after))
+        body_out = self.stmts(node.body, body_in)
+        self.loops.pop()
+        if body_out is not None:
+            body_out.edge(header)
+        if node.orelse:
+            oe_in = self.new()
+            header.edge(oe_in)
+            oe_out = self.stmts(node.orelse, oe_in)
+            if oe_out is not None:
+                oe_out.edge(after)
+        else:
+            header.edge(after)
+        return after
+
+    def _with(self, node, cur: Block) -> Optional[Block]:
+        for item in node.items:
+            cur.events.append(("use", item.context_expr))
+            cur.events.append(("with_enter", item))
+        out = self.stmts(node.body, cur)
+        if out is None:
+            return None
+        for item in reversed(node.items):
+            out.events.append(("with_exit", item))
+        return out
+
+    def _try(self, node: ast.Try, cur: Block) -> Optional[Block]:
+        body_mark = len(self.blocks)
+        body_in = self.new()
+        cur.edge(body_in)
+        body_out = self.stmts(node.body, body_in)
+        body_blocks = self.blocks[body_mark:len(self.blocks)]
+        if node.orelse and body_out is not None:
+            body_out = self.stmts(node.orelse, body_out)
+        join = self.new()
+        if body_out is not None:
+            body_out.edge(join)
+        for handler in node.handlers:
+            h_in = self.new()
+            for b in body_blocks:
+                b.edge(h_in)
+            h_out = self.stmts(handler.body, h_in)
+            if h_out is not None:
+                h_out.edge(join)
+        if node.finalbody:
+            return self.stmts(node.finalbody, join)
+        return join
+
+    def _match(self, node: ast.Match, cur: Block) -> Optional[Block]:
+        cur.events.append(("use", node.subject))
+        join = self.new()
+        cur.edge(join)  # coarse: no case may match
+        for case in node.cases:
+            c_in = self.new()
+            cur.edge(c_in)
+            if case.guard is not None:
+                c_in.events.append(("use", case.guard))
+            c_out = self.stmts(case.body, c_in)
+            if c_out is not None:
+                c_out.edge(join)
+        return join
+
+
+def build_cfg(body: List[ast.stmt]) -> CFG:
+    """CFG for one scope's statement list (a function body or a module
+    body). Nested def/class bodies are NOT descended into — they appear
+    as ``("def", node)`` events and are scopes of their own."""
+    return _Builder().build(body)

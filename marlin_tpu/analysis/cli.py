@@ -57,6 +57,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="machine-readable report on stdout")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="findings only, no summary line")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="analyze across N worker processes (same "
+                        "findings as sequential; falls back to "
+                        "sequential when a pool is unavailable)")
+    p.add_argument("--stats", action="store_true",
+                   help="append the per-rule findings/suppressions/"
+                        "annotations/timing table (gate-time "
+                        "regressions stay attributable)")
     return p
 
 
@@ -78,8 +86,8 @@ def _main(argv: Optional[List[str]]) -> int:
             scope = ", ".join(r.paths) if r.paths else "all files"
             print(f"{r.name:22s} {r.description}  [scope: {scope}]")
         return 0
-    rules = rules_by_name(
-        [r.strip() for r in args.rules.split(",") if r.strip()] or None)
+    rule_names = [r.strip() for r in args.rules.split(",") if r.strip()]
+    rules = rules_by_name(rule_names or None)
     root = Path(args.root).resolve()
     baseline_path = Path(args.baseline) if args.baseline \
         else root / DEFAULT_BASELINE
@@ -87,7 +95,13 @@ def _main(argv: Optional[List[str]]) -> int:
     if not args.no_baseline and not args.write_baseline \
             and baseline_path.is_file():
         baseline = core.load_baseline(baseline_path)
-    report = core.analyze(root, args.targets, rules, baseline=baseline)
+    if args.jobs and args.jobs > 1:
+        report = core.analyze_parallel(
+            root, args.targets, rule_names or None, baseline=baseline,
+            jobs=args.jobs)
+    else:
+        report = core.analyze(root, args.targets, rules,
+                              baseline=baseline)
     if args.write_baseline:
         core.write_baseline(baseline_path, report.findings)
         print(f"marlint: wrote {len(report.findings)} key(s) to "
@@ -101,4 +115,6 @@ def _main(argv: Optional[List[str]]) -> int:
             text = "\n".join(text.splitlines()[:-1])
         if text:
             print(text)
+        if args.stats:
+            print(core.render_stats(report))
     return 0 if report.clean else 1

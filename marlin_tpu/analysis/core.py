@@ -38,6 +38,14 @@ Annotation grammar (docs/static_analysis.md has the full catalog):
     used as a control input, so the deterministic-serving rule allows
     it.
 
+``# marlint: allow-blocking=<reason>``
+    Trailing comment on a statement that performs a blocking call while
+    a lock is held, asserting the hold is deliberate (e.g. an
+    idempotence guard that MUST serialize a slow drain). Unlike
+    ``disable=``, this is an annotation, not a suppression: it is
+    counted separately in ``--stats`` and does not trip the
+    zero-suppressions gate — the reason is part of the contract.
+
 ``# marlint: disable=<rule>[,<rule>...]``
     Per-line suppression. Policy (docs/static_analysis.md): a
     suppression must ride with a human-readable reason in the same
@@ -56,9 +64,12 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import re
+import threading
+import time
 import tokenize
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -70,6 +81,7 @@ _HOLDS_RE = re.compile(r"marlint:\s*holds\s*=\s*(\w+)")
 _GUARDED_RE = re.compile(r"guarded-by:\s*(\w+)")
 _DONATED_RE = re.compile(r"\bdonated-buffer\b")
 _TIMESTAMP_RE = re.compile(r"\btimestamp-only\b")
+_ALLOW_BLOCKING_RE = re.compile(r"marlint:\s*allow-blocking\s*=\s*(\S.*)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +129,7 @@ class SourceFile:
         # line -> comment text, annotation_on-compatible tables.
         self.donated: Dict[int, str] = {}
         self.timestamp_only: Dict[int, str] = {}
+        self.allow_blocking: Dict[int, str] = {}
         for ln, c in self.comments.items():
             m = _DISABLE_RE.search(c)
             if m:
@@ -132,6 +145,9 @@ class SourceFile:
                 self.donated[ln] = c
             if _TIMESTAMP_RE.search(c):
                 self.timestamp_only[ln] = c
+            m = _ALLOW_BLOCKING_RE.search(c)
+            if m:
+                self.allow_blocking[ln] = m.group(1).strip()
         self._expand_suppressions()
 
     # Simple (non-compound) statements: a disable comment at the
@@ -144,7 +160,8 @@ class SourceFile:
                      ast.Return, ast.Raise, ast.Assert, ast.Delete)
 
     def _expand_suppressions(self) -> None:
-        if not (self.suppressed or self.timestamp_only or self.donated):
+        if not (self.suppressed or self.timestamp_only or self.donated
+                or self.allow_blocking):
             return
         for node in ast.walk(self.tree):
             if not isinstance(node, self._SIMPLE_STMTS):
@@ -163,7 +180,8 @@ class SourceFile:
             # Annotation marks expand the same way: the comment's
             # natural position is the wrapped statement's LAST line,
             # the flagged/declared node's anchor is usually the first.
-            for table in (self.timestamp_only, self.donated):
+            for table in (self.timestamp_only, self.donated,
+                          self.allow_blocking):
                 mark = next((table[ln] for ln in span if ln in table),
                             None)
                 if mark is not None:
@@ -210,6 +228,14 @@ class AnalysisContext:
         # attr name -> declaring rel path (donation-fetch collection)
         self.donated_attrs: Dict[str, str] = {}
         self._module_cache: Dict[Path, Optional[Set[str]]] = {}
+        # rule name -> count of allow-style annotations honored this
+        # run (allow-blocking etc.) — reported in --stats, distinct
+        # from suppressions, which the gate keeps at zero.
+        self.annotation_counts: Dict[str, int] = {}
+
+    def note_annotation(self, rule: str) -> None:
+        self.annotation_counts[rule] = \
+            self.annotation_counts.get(rule, 0) + 1
 
     def module_bindings(self, path: Path) -> Optional[Set[str]]:
         """Top-level bound names of the module at ``path`` (defs,
@@ -295,6 +321,14 @@ class Rule:
               ctx: AnalysisContext) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
 
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        """Optional whole-project phase after every per-file check —
+        for rules whose findings are properties of the merged graph
+        (lock-order cycles), not of any single file. Findings still
+        carry a path/line (the first witness) so suppression and
+        baseline keys work unchanged."""
+        return []
+
 
 class KeyMaker:
     """Stable baseline keys: ``rule::path::anchor[#n]`` with ``#n``
@@ -362,7 +396,13 @@ def iter_py_files(root: Path, targets: Sequence[str]) -> List[Path]:
 class Report:
     """One analysis run's outcome: every unsuppressed finding, split
     against the baseline, plus parse failures (reported, never fatal —
-    a syntax error in one file must not hide findings in the rest)."""
+    a syntax error in one file must not hide findings in the rest).
+
+    ``stats`` maps rule name -> {"findings", "suppressed", "time_ms"}
+    (plus an ``annotations`` count where the rule honors an allow-style
+    annotation) so gate-time and precision regressions are attributable
+    per rule; ``cache_hits`` counts files served from the content-hash
+    memo instead of re-parsed."""
 
     findings: List[Finding]
     new: List[Finding]
@@ -370,10 +410,17 @@ class Report:
     stale: List[str]          # baseline keys with no matching finding
     parse_errors: List[str]
     n_files: int
+    stats: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    cache_hits: int = 0
+    wall_ms: float = 0.0
 
     @property
     def clean(self) -> bool:
         return not self.new and not self.stale and not self.parse_errors
+
+    @property
+    def n_suppressed(self) -> int:
+        return sum(s.get("suppressed", 0) for s in self.stats.values())
 
     def as_dict(self) -> dict:
         return {
@@ -384,6 +431,9 @@ class Report:
             "stale_baseline_keys": list(self.stale),
             "parse_errors": list(self.parse_errors),
             "clean": self.clean,
+            "stats": self.stats,
+            "cache_hits": self.cache_hits,
+            "wall_ms": self.wall_ms,
         }
 
 
@@ -406,42 +456,283 @@ def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
     Path(path).write_text(json.dumps(doc, indent=2) + "\n")
 
 
+# Content-hash memo of parsed files. The tier-1 gate and the test
+# suite run the full pass several times per process; a SourceFile (and
+# the CFG/summary artifacts rules memoize onto it) depends only on the
+# file's bytes, so re-parsing identical content is pure waste. Keyed by
+# resolved path; invalidated by sha256 of the text. Process-local —
+# worker processes in the --jobs path grow their own.
+_FILE_CACHE: Dict[str, Tuple[str, "SourceFile"]] = {}
+_FILE_CACHE_LOCK = threading.Lock()
+_FILE_CACHE_MAX = 4096
+
+
+def _load_source(f: Path, rel: str) -> Tuple["SourceFile", bool]:
+    """(SourceFile, was_cache_hit). Raises SyntaxError like the ctor."""
+    text = f.read_text()
+    digest = hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()
+    key = str(f.resolve())
+    with _FILE_CACHE_LOCK:
+        hit = _FILE_CACHE.get(key)
+        if hit is not None and hit[0] == digest and hit[1].rel == rel:
+            return hit[1], True
+    sf = SourceFile(f, rel, text)
+    with _FILE_CACHE_LOCK:
+        if len(_FILE_CACHE) >= _FILE_CACHE_MAX:
+            _FILE_CACHE.clear()
+        _FILE_CACHE[key] = (digest, sf)
+    return sf, False
+
+
+def _rel_of(f: Path, root: Path) -> str:
+    r = f.resolve()
+    return r.relative_to(root).as_posix() if r.is_relative_to(root) \
+        else f.as_posix()
+
+
+def _new_stats(rules: Sequence[Rule]) -> Dict[str, dict]:
+    return {r.name: {"findings": 0, "suppressed": 0, "time_ms": 0.0}
+            for r in rules}
+
+
 def analyze(root: Path, targets: Sequence[str], rules: Sequence[Rule],
             baseline: Optional[Set[str]] = None) -> Report:
-    """Run ``rules`` over every .py file under ``targets``: parse once,
-    one cross-file ``collect`` phase, then per-file checks, suppression,
-    and the baseline split."""
+    """Run ``rules`` over every .py file under ``targets``: parse once
+    (content-hash memoized), one cross-file ``collect`` phase, per-file
+    checks, the whole-project ``finalize`` phase, suppression, and the
+    baseline split."""
+    t0 = time.perf_counter()
     root = Path(root).resolve()
     files = iter_py_files(root, targets)
     sources: List[SourceFile] = []
     parse_errors: List[str] = []
+    cache_hits = 0
     for f in files:
-        rel = f.resolve().relative_to(root).as_posix() \
-            if f.resolve().is_relative_to(root) else f.as_posix()
+        rel = _rel_of(f, root)
         try:
-            sources.append(SourceFile(f, rel, f.read_text()))
+            sf, hit = _load_source(f, rel)
+            sources.append(sf)
+            cache_hits += int(hit)
         except SyntaxError as e:
             parse_errors.append(f"{rel}: {e.msg} (line {e.lineno})")
     ctx = AnalysisContext(root)
+    stats = _new_stats(rules)
     for rule in rules:
+        rt0 = time.perf_counter()
         for sf in sources:
             if rule.applies(sf):
                 rule.collect(sf, ctx)
+        stats[rule.name]["time_ms"] += \
+            (time.perf_counter() - rt0) * 1000.0
     findings: List[Finding] = []
     for sf in sources:
         for rule in rules:
             if not rule.applies(sf):
                 continue
+            rt0 = time.perf_counter()
             for fd in rule.check(sf, ctx):
-                if not sf.is_suppressed(fd.rule, fd.line):
+                if sf.is_suppressed(fd.rule, fd.line):
+                    stats[rule.name]["suppressed"] += 1
+                else:
                     findings.append(fd)
+            stats[rule.name]["time_ms"] += \
+                (time.perf_counter() - rt0) * 1000.0
+    by_rel = {sf.rel: sf for sf in sources}
+    for rule in rules:
+        rt0 = time.perf_counter()
+        for fd in rule.finalize(ctx):
+            sf = by_rel.get(fd.path)
+            if sf is not None and sf.is_suppressed(fd.rule, fd.line):
+                stats[rule.name]["suppressed"] += 1
+            else:
+                findings.append(fd)
+        stats[rule.name]["time_ms"] += \
+            (time.perf_counter() - rt0) * 1000.0
+    return _finish(findings, baseline, parse_errors, len(sources),
+                   stats, ctx.annotation_counts, cache_hits, t0)
+
+
+def _finish(findings: List[Finding], baseline, parse_errors,
+            n_files: int, stats: Dict[str, dict],
+            annotation_counts: Dict[str, int], cache_hits: int,
+            t0: float) -> Report:
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    for fd in findings:
+        if fd.rule in stats:
+            stats[fd.rule]["findings"] += 1
+    for rule_name, n in annotation_counts.items():
+        if rule_name in stats:
+            stats[rule_name]["annotations"] = n
     base = baseline or set()
     new = [f for f in findings if f.key not in base]
     old = [f for f in findings if f.key in base]
     stale = sorted(base - {f.key for f in findings})
     return Report(findings=findings, new=new, baselined=old, stale=stale,
-                  parse_errors=parse_errors, n_files=len(sources))
+                  parse_errors=parse_errors, n_files=n_files,
+                  stats=stats, cache_hits=cache_hits,
+                  wall_ms=(time.perf_counter() - t0) * 1000.0)
+
+
+# -- process-parallel run (--jobs N) ----------------------------------
+#
+# Two rounds over a process pool, mirroring the sequential phases:
+# round 1 parses each partition and returns the picklable cross-file
+# state (parse errors, donated attrs, per-file call-graph summaries);
+# the parent merges it; round 2 re-runs checks per partition against
+# the merged state. Workers keep their own _FILE_CACHE, so with a
+# stable pool each file is parsed once per worker across both rounds.
+# The whole-project finalize phase (lock-order) runs in the parent over
+# the merged summaries — suppression for those findings uses the
+# suppression tables the summaries carry. Any pool failure falls back
+# to the sequential path: --jobs is an optimization, never a behavior
+# change.
+
+
+def _worker_collect(args):
+    root_str, file_strs, rel_strs, rule_names = args
+    from .rules import rules_by_name
+    rules = rules_by_name(rule_names or None)
+    ctx = AnalysisContext(Path(root_str))
+    parse_errors: List[str] = []
+    sfs: List[SourceFile] = []
+    for fstr, rel in zip(file_strs, rel_strs):
+        try:
+            sf, _ = _load_source(Path(fstr), rel)
+            sfs.append(sf)
+        except SyntaxError as e:
+            parse_errors.append(f"{rel}: {e.msg} (line {e.lineno})")
+    for rule in rules:
+        for sf in sfs:
+            if rule.applies(sf):
+                rule.collect(sf, ctx)
+    idx = getattr(ctx, "marlint_index", None)
+    summaries = list(idx.files.values()) if idx is not None else []
+    return parse_errors, dict(ctx.donated_attrs), summaries, len(sfs)
+
+
+def _worker_check(args):
+    (root_str, file_strs, rel_strs, rule_names, donated,
+     summaries) = args
+    from .callgraph import ProjectIndex
+    from .rules import rules_by_name
+    rules = rules_by_name(rule_names or None)
+    ctx = AnalysisContext(Path(root_str))
+    ctx.donated_attrs.update(donated)
+    idx = ProjectIndex()
+    for s in summaries:
+        idx.add(s)
+    ctx.marlint_index = idx
+    stats = _new_stats(rules)
+    findings: List[Finding] = []
+    hits = 0
+    for fstr, rel in zip(file_strs, rel_strs):
+        try:
+            sf, hit = _load_source(Path(fstr), rel)
+        except SyntaxError:
+            continue  # already reported by round 1
+        hits += int(hit)
+        for rule in rules:
+            if not rule.applies(sf):
+                continue
+            rt0 = time.perf_counter()
+            for fd in rule.check(sf, ctx):
+                if sf.is_suppressed(fd.rule, fd.line):
+                    stats[rule.name]["suppressed"] += 1
+                else:
+                    findings.append(fd)
+            stats[rule.name]["time_ms"] += \
+                (time.perf_counter() - rt0) * 1000.0
+    return findings, stats, dict(ctx.annotation_counts), hits
+
+
+def analyze_parallel(root: Path, targets: Sequence[str],
+                     rule_names: Optional[Sequence[str]],
+                     baseline: Optional[Set[str]] = None,
+                     jobs: int = 2) -> Report:
+    """The --jobs N entry point: same Report as :func:`analyze` (same
+    findings, same ordering, same baseline split), computed across
+    ``jobs`` worker processes."""
+    from .callgraph import ProjectIndex
+    from .rules import rules_by_name
+    rules = rules_by_name(rule_names or None)
+    if jobs <= 1:
+        return analyze(root, targets, rules, baseline)
+    t0 = time.perf_counter()
+    root = Path(root).resolve()
+    files = iter_py_files(root, targets)
+    rels = [_rel_of(f, root) for f in files]
+    parts = [(list(map(str, files[i::jobs])), rels[i::jobs])
+             for i in range(jobs)]
+    parts = [p for p in parts if p[0]]
+    names = list(rule_names) if rule_names else None
+    import multiprocessing
+
+    try:
+        mp = multiprocessing.get_context("fork")
+    except ValueError:  # pragma: no cover - non-posix
+        mp = multiprocessing.get_context()
+    try:
+        with mp.Pool(processes=len(parts)) as pool:
+            collected = pool.map(
+                _worker_collect,
+                [(str(root), fs, rs, names) for fs, rs in parts])
+            parse_errors: List[str] = []
+            donated: Dict[str, str] = {}
+            merged = ProjectIndex()
+            n_files = 0
+            for perr, don, summaries, n in collected:
+                parse_errors.extend(perr)
+                for k, v in don.items():
+                    donated.setdefault(k, v)
+                for s in summaries:
+                    merged.add(s)
+                n_files += n
+            all_summaries = list(merged.files.values())
+            checked = pool.map(
+                _worker_check,
+                [(str(root), fs, rs, names, donated, all_summaries)
+                 for fs, rs in parts])
+    except (OSError, ValueError, AttributeError,
+            ImportError):  # pragma: no cover - pool unavailable
+        return analyze(root, targets, rules, baseline)
+    findings: List[Finding] = []
+    stats = _new_stats(rules)
+    annotations: Dict[str, int] = {}
+    cache_hits = 0
+    for fds, st, ann, hits in checked:
+        findings.extend(fds)
+        cache_hits += hits
+        for name, bucket in st.items():
+            dst = stats.setdefault(
+                name, {"findings": 0, "suppressed": 0, "time_ms": 0.0})
+            dst["suppressed"] += bucket.get("suppressed", 0)
+            dst["time_ms"] += bucket.get("time_ms", 0.0)
+        for name, n in ann.items():
+            annotations[name] = annotations.get(name, 0) + n
+    # whole-project finalize in the parent, over the merged summaries
+    ctx = AnalysisContext(root)
+    ctx.donated_attrs.update(donated)
+    ctx.marlint_index = merged
+    sup_lookup = {s.rel: dict(s.suppressed)
+                  for s in merged.files.values()}
+
+    def _is_sup(rule_name: str, rel: str, line: int) -> bool:
+        sup = sup_lookup.get(rel, {}).get(line)
+        return bool(sup) and (rule_name in sup or "all" in sup)
+
+    for rule in rules:
+        rt0 = time.perf_counter()
+        for fd in rule.finalize(ctx):
+            if _is_sup(fd.rule, fd.path, fd.line):
+                stats[rule.name]["suppressed"] += 1
+            else:
+                findings.append(fd)
+        stats[rule.name]["time_ms"] += \
+            (time.perf_counter() - rt0) * 1000.0
+    for name, n in ctx.annotation_counts.items():
+        annotations[name] = annotations.get(name, 0) + n
+    return _finish(findings, baseline, parse_errors, n_files, stats,
+                   annotations, cache_hits, t0)
 
 
 def render_text(report: Report) -> str:
@@ -459,4 +750,25 @@ def render_text(report: Report) -> str:
         f"marlint: {report.n_files} files, "
         f"{len(report.new)} new / {len(report.baselined)} baselined "
         f"finding(s), {len(report.stale)} stale baseline entr(y/ies)")
+    return "\n".join(lines)
+
+
+def render_stats(report: Report) -> str:
+    """Per-rule attribution table for --stats: findings, suppressions,
+    allow-annotations honored, and wall time — the numbers that make a
+    gate-time or precision regression attributable to one rule."""
+    rows = [("rule", "findings", "suppressed", "annotations", "time_ms")]
+    for name in sorted(report.stats):
+        s = report.stats[name]
+        rows.append((name, str(s.get("findings", 0)),
+                     str(s.get("suppressed", 0)),
+                     str(s.get("annotations", 0)),
+                     f"{s.get('time_ms', 0.0):.1f}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.append(
+        f"files: {report.n_files} ({report.cache_hits} from cache), "
+        f"suppressed: {report.n_suppressed}, "
+        f"wall: {report.wall_ms:.0f} ms")
     return "\n".join(lines)

@@ -1,0 +1,194 @@
+"""Forward dataflow over the marlint CFG (v2 core).
+
+A deliberately small framework: states are immutable values (frozensets
+and sorted tuples — hashable, comparable by ``==``), ``transfer(state,
+event)`` folds one event, ``join`` meets predecessor out-states, and a
+worklist iterates to fixpoint. Two meet disciplines cover every rule:
+
+must-analysis (``meet_intersect``)
+    Facts that hold on EVERY path: lock-sets (guarded-by,
+    blocking-under-lock, lock-order) and the exec-loader "sys.modules
+    registered" bit. Unreachable blocks sit at TOP, the identity of the
+    meet, so a fact is never lost to dead code.
+
+may-analysis (``meet_union``)
+    Facts that hold on SOME path: donated-buffer aliases and retrace
+    taint. (The retrace *statics* set is must — a name is static only
+    if every path assigned it a static value.)
+
+Interprocedural depth is RacerD-style summaries (``callgraph.py``):
+rules consult a callee's summary at the call site, one level of precise
+composition, with reachability closures (may-acquire / may-block)
+propagated over the resolved call graph so deadlock cycles and blocking
+chains spanning several hops still surface — each with its witness
+chain.
+
+Everything here is pure stdlib and pure functions; per-scope fixpoints
+are tiny (blocks ~ statements), which is what keeps the repo-wide gate
+inside its 10 s budget.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+from .cfg import CFG, Block, Event
+
+
+class _Top:
+    """Lattice top: the in-state of an unreachable block, identity of
+    every meet. A singleton so ``state is TOP`` is the test."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "TOP"
+
+
+TOP = _Top()
+
+
+def meet_intersect(a, b):
+    """Must-meet over frozensets (TOP-absorbing)."""
+    if a is TOP:
+        return b
+    if b is TOP:
+        return a
+    return a & b
+
+
+def meet_union(a, b):
+    """May-meet over frozensets (TOP-absorbing)."""
+    if a is TOP:
+        return b
+    if b is TOP:
+        return a
+    return a | b
+
+
+def run_forward(cfg: CFG, entry_state, transfer: Callable,
+                meet: Callable, max_iters: int = 1000
+                ) -> Dict[int, object]:
+    """Worklist fixpoint. Returns ``block idx -> in-state`` (TOP for
+    unreachable blocks). ``transfer`` must be pure; states must be
+    hashable immutables so convergence is plain ``==``.
+
+    ``max_iters`` is a backstop, not a tuning knob: the lattices here
+    are finite (names/locks in one function) so real runs converge in a
+    handful of passes; hitting the cap would indicate a non-monotone
+    transfer and we fail conservative (latest states) rather than loop.
+    """
+    in_states: Dict[int, object] = {b.idx: TOP for b in cfg.blocks}
+    in_states[cfg.entry.idx] = entry_state
+    work = [cfg.entry]
+    budget = max(max_iters, 20 * len(cfg.blocks))
+    iters = 0
+    while work and iters < budget:
+        iters += 1
+        block = work.pop()
+        state = in_states[block.idx]
+        if state is TOP:
+            continue
+        for ev in block.events:
+            state = transfer(state, ev)
+        for succ in block.succs:
+            cur_in = in_states[succ.idx]
+            merged = meet(cur_in, state)
+            if cur_in is TOP or merged != cur_in:
+                in_states[succ.idx] = merged
+                if succ not in work:
+                    work.append(succ)
+    return in_states
+
+
+def iter_events(cfg: CFG, in_states: Dict[int, object],
+                transfer: Callable
+                ) -> Iterator[Tuple[Event, object]]:
+    """Replay the converged fixpoint: yield ``(event, state-before)``
+    for every event of every REACHABLE block, in block construction
+    order (stable, roughly source order). This is how rules check: the
+    fixpoint computes states, the replay applies the rule predicate at
+    each event with the exact in-state."""
+    for block in cfg.blocks:
+        state = in_states.get(block.idx, TOP)
+        if state is TOP:
+            continue
+        for ev in block.events:
+            yield ev, state
+            state = transfer(state, ev)
+
+
+# -- lock-set lattice --------------------------------------------------
+#
+# A lock-set state is a sorted tuple of (ref, count) pairs — a multiset,
+# because `with self._lock:` can nest under an RLock and the exit of the
+# inner with must not pretend the outer hold is gone. ``ref`` is the
+# raw, unresolved lock reference from callgraph.resolve_lock_expr.
+
+LockState = Tuple[Tuple[object, int], ...]
+
+EMPTY_LOCKS: LockState = ()
+
+
+def lock_acquire(state: LockState, ref) -> LockState:
+    d = dict(state)
+    d[ref] = d.get(ref, 0) + 1
+    return tuple(sorted(d.items()))
+
+
+def lock_release(state: LockState, ref) -> LockState:
+    d = dict(state)
+    if ref in d:
+        d[ref] -= 1
+        if d[ref] <= 0:
+            del d[ref]
+    return tuple(sorted(d.items()))
+
+
+def lock_meet(a, b):
+    """Must-meet for lock multisets: held on every path = min count."""
+    if a is TOP:
+        return b
+    if b is TOP:
+        return a
+    da, db = dict(a), dict(b)
+    out = {}
+    for ref, n in da.items():
+        m = min(n, db.get(ref, 0))
+        if m > 0:
+            out[ref] = m
+    return tuple(sorted(out.items()))
+
+
+def held_refs(state: LockState) -> Tuple[object, ...]:
+    return tuple(ref for ref, n in state if n > 0)
+
+
+def make_lock_transfer(resolve_lock: Callable[[object], Optional[object]]
+                       ) -> Callable:
+    """Transfer function tracking the lock multiset through
+    with_enter/with_exit events. ``resolve_lock(expr)`` maps a context
+    expression to a raw lock ref (or None for non-lock contexts —
+    ``with open(...)`` must not pollute the set)."""
+
+    def transfer(state: LockState, ev: Event) -> LockState:
+        kind, node = ev
+        if kind == "with_enter":
+            ref = resolve_lock(node.context_expr)
+            if ref is not None:
+                return lock_acquire(state, ref)
+        elif kind == "with_exit":
+            ref = resolve_lock(node.context_expr)
+            if ref is not None:
+                return lock_release(state, ref)
+        return state
+
+    return transfer
+
+
+def lock_states(cfg: CFG, resolve_lock, entry_refs=()
+                ) -> Tuple[Dict[int, object], Callable]:
+    """Convenience: run the lock-set must-analysis with ``entry_refs``
+    pre-held (a ``holds=`` contract). Returns (in_states, transfer) —
+    feed both to :func:`iter_events` to check per-event."""
+    entry: LockState = tuple(sorted((r, 1) for r in set(entry_refs)))
+    transfer = make_lock_transfer(resolve_lock)
+    return run_forward(cfg, entry, transfer, lock_meet), transfer

@@ -10,8 +10,13 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from .callgraph import (event_nodes, file_summary, project_index,
+                        scope_nodes)
+from .cfg import build_cfg
 from .core import (AnalysisContext, Finding, KeyMaker, Rule, SourceFile,
                    dotted_name, self_attr)
+from .flow import (held_refs, iter_events, lock_states, meet_intersect,
+                   meet_union, run_forward)
 
 
 def _walk_scopes(tree: ast.AST):
@@ -64,7 +69,13 @@ class DonationFetchRule(Rule):
     ``# donated-buffer`` annotation on their assignment; this rule
     flags ``jax.device_get``/``np.asarray`` whose argument mentions a
     declared attribute name — in any file, so a frontend touching
-    ``eng._buf`` is covered by the engine's declaration."""
+    ``eng._buf`` is covered by the engine's declaration.
+
+    v2 (alias-aware): a may-taint dataflow per scope tracks locals that
+    alias a donated attribute — ``buf = self._buf; np.asarray(buf)``
+    and ``buf = self._get_buf()`` (where the same-file helper returns
+    the donated attr) are caught; re-assignment from a clean value
+    kills the taint, as do ``for`` targets and ``with ... as`` names."""
 
     name = "donation-fetch"
     description = ("jax.device_get/np.asarray on a # donated-buffer "
@@ -99,33 +110,129 @@ class DonationFetchRule(Rule):
             return []
         km = KeyMaker()
         out: List[Finding] = []
+        # Same-file helpers whose return value IS a donated attribute:
+        # `buf = self._get_buf()` taints `buf` one call level deep.
+        ret_map: Dict[str, str] = {}
+        for fi in file_summary(sf).funcs:
+            for a in fi.returns_self_attrs:
+                if a in ctx.donated_attrs:
+                    ret_map.setdefault(fi.name, a)
+        scopes: List[Tuple[str, List[ast.stmt]]] = [
+            ("<module>", sf.tree.body)]
         for node, stack in _walk_scopes(sf.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = dotted_name(node.func)
-            if fn not in self._FETCHERS:
-                continue
-            hit: Optional[str] = None
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                for sub in ast.walk(arg):
-                    if (isinstance(sub, ast.Attribute)
-                            and sub.attr in ctx.donated_attrs):
-                        hit = sub.attr
-                        break
-                if hit:
-                    break
-            if hit is None:
-                continue
-            scope = _scope_name(stack)
-            out.append(Finding(
-                rule=self.name, path=sf.rel, line=node.lineno,
-                message=(
-                    f"{fn}() on donated buffer `.{hit}` (declared "
-                    f"donated-buffer in {ctx.donated_attrs[hit]}): a "
-                    f"CPU zero-copy view permanently disables donation "
-                    f"aliasing — fetch with np.array(...) instead"),
-                key=km.key(self.name, sf.rel, f"{scope}:{hit}")))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                scopes.append((_scope_name(stack + (node,)), node.body))
+        for scope, body in scopes:
+            self._check_scope(sf, ctx, scope, body, ret_map, km, out)
         return out
+
+    def _check_scope(self, sf, ctx, scope, body, ret_map, km, out):
+        donated = ctx.donated_attrs
+
+        def target_names(t) -> Set[str]:
+            if isinstance(t, ast.Name):
+                return {t.id}
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return {e.id for e in t.elts if isinstance(e, ast.Name)}
+            return set()
+
+        def value_taint(value, state) -> Optional[str]:
+            """Donated attr the RHS carries: a direct attribute, a
+            tainted local, or a same-file getter's return."""
+            if isinstance(value, ast.Attribute) and value.attr in donated:
+                return value.attr
+            if isinstance(value, ast.Name):
+                for name, attr in state:
+                    if name == value.id:
+                        return attr
+            if isinstance(value, ast.Call):
+                callee = self_attr(value.func)
+                if callee is None and isinstance(value.func, ast.Name):
+                    callee = value.func.id
+                if callee is None and isinstance(value.func,
+                                                 ast.Attribute):
+                    # eng.view() — any receiver; ret_map is same-file
+                    # and donated-only, so name evidence suffices.
+                    callee = value.func.attr
+                if callee in ret_map:
+                    return ret_map[callee]
+            return None
+
+        def transfer(state, ev):
+            kind, node = ev
+            if kind == "stmt" and isinstance(node, (ast.Assign,
+                                                    ast.AnnAssign)):
+                if node.value is None:
+                    return state
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                names: Set[str] = set()
+                for t in targets:
+                    names |= target_names(t)
+                if not names:
+                    return state
+                attr = value_taint(node.value, state)  # RHS: old state
+                state = frozenset(
+                    (n, a) for n, a in state if n not in names)
+                if attr is not None:
+                    state = state | {(n, attr) for n in names}
+                return state
+            if kind == "forassign":
+                kill = target_names(node.target)
+                return frozenset(
+                    (n, a) for n, a in state if n not in kill)
+            if kind == "with_enter" and node.optional_vars is not None:
+                kill = target_names(node.optional_vars)
+                return frozenset(
+                    (n, a) for n, a in state if n not in kill)
+            return state
+
+        cfg = build_cfg(body)
+        states = run_forward(cfg, frozenset(), transfer, meet_union)
+        for ev, state in iter_events(cfg, states, transfer):
+            for node in event_nodes(ev):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func)
+                if fn not in self._FETCHERS:
+                    continue
+                hit: Optional[str] = None
+                alias: Optional[str] = None
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for arg in args:
+                    for sub in ast.walk(arg):
+                        if (isinstance(sub, ast.Attribute)
+                                and sub.attr in donated):
+                            hit, alias = sub.attr, None
+                            break
+                        if isinstance(sub, ast.Name) and hit is None:
+                            for name, attr in state:
+                                if name == sub.id:
+                                    hit, alias = attr, sub.id
+                                    break
+                    if hit is not None and alias is None:
+                        break
+                if hit is None:
+                    continue
+                if alias is None:
+                    msg = (
+                        f"{fn}() on donated buffer `.{hit}` (declared "
+                        f"donated-buffer in {donated[hit]}): a "
+                        f"CPU zero-copy view permanently disables "
+                        f"donation aliasing — fetch with np.array(...) "
+                        f"instead")
+                else:
+                    msg = (
+                        f"{fn}() on `{alias}`, an alias of donated "
+                        f"buffer `.{hit}` (declared donated-buffer in "
+                        f"{donated[hit]}): a CPU zero-copy view "
+                        f"permanently disables donation aliasing — "
+                        f"fetch with np.array(...) instead")
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    message=msg,
+                    key=km.key(self.name, sf.rel, f"{scope}:{hit}")))
 
 
 class GuardedByRule(Rule):
@@ -135,10 +242,16 @@ class GuardedByRule(Rule):
     read or written inside a ``with self.<lock>:`` block in methods of
     the declaring class (``__init__``/``__post_init__`` are
     construction — exempt). ``# marlint: holds=<lock>`` on a ``def``
-    asserts the caller holds the lock (Clang TSA's REQUIRES); call
-    sites are not verified — name such helpers ``*_locked``. Accesses
+    asserts the caller holds the lock (Clang TSA's REQUIRES). Accesses
     through other objects (``eng.requests`` from the frontend) are out
-    of scope: the declaring class owns the discipline."""
+    of scope: the declaring class owns the discipline.
+
+    v2: the held set is a lock-set MUST-dataflow over the method's CFG
+    (flow.lock_states), so a lock acquired in one branch does not vouch
+    for the join, and a release on loop back-edges is modeled. Call
+    sites of ``holds=`` helpers ARE now verified: ``self.m()`` where
+    ``m`` declares ``holds=<lock>`` and the lock-set does not contain
+    the lock is a finding (the ``*_locked``-helper-without-lock bug)."""
 
     name = "guarded-by"
     description = ("# guarded-by: <lock> attribute touched outside "
@@ -186,7 +299,15 @@ class GuardedByRule(Rule):
     def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
                      km: KeyMaker) -> List[Finding]:
         decls = self._class_decls(sf, cls)
-        if not decls:
+        # Methods asserting holds=: call sites inside the class must
+        # actually hold the named lock.
+        holds_map: Dict[str, str] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                h = sf.header_annotation(stmt, sf.holds)
+                if h:
+                    holds_map[stmt.name] = h
+        if not decls and not holds_map:
             return []
         out: List[Finding] = []
         for stmt in cls.body:
@@ -195,68 +316,76 @@ class GuardedByRule(Rule):
                 continue
             if stmt.name in ("__init__", "__post_init__"):
                 continue
-            held: Set[str] = set()
+            entry: Set[str] = set()
             # HEADER lines only: a holds= comment buried in the body
             # (e.g. on a nested def) must not exempt the whole method.
             h = sf.header_annotation(stmt, sf.holds)
             if h:
-                held.add(h)
-            self._check_body(sf, cls, stmt, stmt.body, decls, held, km,
-                             out)
+                entry.add(h)
+            self._check_scope(sf, cls, stmt, stmt.body, decls,
+                              holds_map, entry, km, out)
         return out
 
-    def _with_locks(self, node) -> Set[str]:
-        locks: Set[str] = set()
-        for item in node.items:
-            attr = self_attr(item.context_expr)
-            if attr:
-                locks.add(attr)
-        return locks
+    def _check_scope(self, sf, cls, func, body, decls, holds_map,
+                     entry_locks, km, out):
+        cfg = build_cfg(body)
 
-    def _check_body(self, sf, cls, func, body, decls, held, km, out):
-        for stmt in body:
-            self._check_node(sf, cls, func, stmt, decls, held, km, out)
+        def resolve(expr):
+            attr = self_attr(expr)
+            return ("self", attr) if attr is not None else None
 
-    def _check_node(self, sf, cls, func, node, decls, held, km, out):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                self._check_node(sf, cls, func, item.context_expr,
-                                 decls, held, km, out)
-                if item.optional_vars is not None:
-                    self._check_node(sf, cls, func, item.optional_vars,
-                                     decls, held, km, out)
-            inner = held | self._with_locks(node)
-            self._check_body(sf, cls, func, node.body, decls, inner, km,
-                             out)
-            return
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # A nested def may escape the lock scope (run on another
-            # thread, after release): only its own holds= annotation
-            # (header lines) counts. Lambdas stay in the enclosing held
-            # set — they are overwhelmingly immediate (sort keys,
-            # comprehension args).
-            inner: Set[str] = set()
-            h = sf.header_annotation(node, sf.holds)
-            if h:
-                inner.add(h)
-            self._check_body(sf, cls, func, node.body, decls, inner, km,
-                             out)
-            return
-        if isinstance(node, ast.Attribute):
-            attr = self_attr(node)
-            if attr in decls and decls[attr] not in held:
-                lock = decls[attr]
-                out.append(Finding(
-                    rule=self.name, path=sf.rel, line=node.lineno,
-                    message=(
-                        f"self.{attr} (guarded-by {lock}) touched "
-                        f"outside `with self.{lock}:` in "
-                        f"{cls.name}.{func.name}"),
-                    key=km.key(self.name, sf.rel,
-                               f"{cls.name}.{func.name}:{attr}")))
-            # still recurse: self.a.b chains
-        for child in ast.iter_child_nodes(node):
-            self._check_node(sf, cls, func, child, decls, held, km, out)
+        states, transfer = lock_states(
+            cfg, resolve, [("self", lk) for lk in entry_locks])
+        for ev, state in iter_events(cfg, states, transfer):
+            kind, node = ev
+            if kind == "def":
+                # A nested def may escape the lock scope (run on
+                # another thread, after release): only its own holds=
+                # annotation counts. Lambdas stay in the enclosing
+                # lock-set — they are overwhelmingly immediate (sort
+                # keys, comprehension args).
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    inner: Set[str] = set()
+                    h = sf.header_annotation(node, sf.holds)
+                    if h:
+                        inner.add(h)
+                    self._check_scope(sf, cls, func, node.body, decls,
+                                      holds_map, inner, km, out)
+                continue
+            held = {ref[1] for ref in held_refs(state)}
+            if kind == "with_enter":
+                nodes = scope_nodes([node.optional_vars]) \
+                    if node.optional_vars is not None else ()
+            else:
+                nodes = event_nodes(ev)
+            for n in nodes:
+                if isinstance(n, ast.Call):
+                    m = self_attr(n.func)
+                    if m in holds_map and holds_map[m] not in held:
+                        lock = holds_map[m]
+                        out.append(Finding(
+                            rule=self.name, path=sf.rel, line=n.lineno,
+                            message=(
+                                f"{cls.name}.{func.name} calls {m}() "
+                                f"(marlint: holds={lock}) without "
+                                f"holding `with self.{lock}:`"),
+                            key=km.key(
+                                self.name, sf.rel,
+                                f"{cls.name}.{func.name}:call:{m}")))
+                elif isinstance(n, ast.Attribute):
+                    attr = self_attr(n)
+                    if attr in decls and decls[attr] not in held:
+                        lock = decls[attr]
+                        out.append(Finding(
+                            rule=self.name, path=sf.rel, line=n.lineno,
+                            message=(
+                                f"self.{attr} (guarded-by {lock}) "
+                                f"touched outside `with self.{lock}:` "
+                                f"in {cls.name}.{func.name}"),
+                            key=km.key(
+                                self.name, sf.rel,
+                                f"{cls.name}.{func.name}:{attr}")))
 
 
 class DeterministicServingRule(Rule):
@@ -338,7 +467,14 @@ class RetraceHazardRule(Rule):
     defs, which are traced too). Arguments named in
     ``static_argnames`` are concrete Python values — conversions of
     those (and of ``.shape``/``len()`` expressions, static under
-    tracing) are exempt."""
+    tracing) are exempt.
+
+    v2: staticness is a MUST-dataflow over the jit body's CFG — a
+    local assigned from a static expression on every path is itself
+    static (``n = x.shape[0]; int(n)`` stays quiet), while a local
+    assigned from a traced value taints every conversion that reads it
+    (``x = logits[0]; int(x)`` now flags). Same-file helpers whose
+    every return is shape/len arithmetic vouch for their call sites."""
 
     name = "retrace-hazard"
     description = (".item()/float()/int()/bool() on traced values or "
@@ -348,61 +484,137 @@ class RetraceHazardRule(Rule):
 
     def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
         jitted = self._jitted_functions(sf.tree)
+        if not jitted:
+            return []
         km = KeyMaker()
         out: List[Finding] = []
+        # Same-file functions whose EVERY valued return is shape/len
+        # arithmetic: their call sites are static too. A name is
+        # trusted only when every same-name def qualifies.
+        vouch: Dict[str, bool] = {}
+        for fi in file_summary(sf).funcs:
+            vouch[fi.name] = vouch.get(fi.name, True) and fi.returns_static
+        ret_static = frozenset(n for n, ok in vouch.items() if ok)
         for fn, static in jitted:
             label = getattr(fn, "name", "<lambda>")
-            statics = set(static)
+            statics = frozenset(static)
             if isinstance(fn, ast.Lambda):
-                body_iter = ast.walk(fn.body)
-            else:
-                body_iter = (n for st in fn.body for n in ast.walk(st))
-            for node in body_iter:
-                if not isinstance(node, ast.Call):
-                    continue
-                name = dotted_name(node.func)
-                if (isinstance(node.func, ast.Attribute)
-                        and node.func.attr == "item"
-                        and not node.args):
-                    out.append(Finding(
-                        rule=self.name, path=sf.rel, line=node.lineno,
-                        message=(
-                            f".item() inside jit body `{label}`: host "
-                            f"sync under tracing (ConcretizationError "
-                            f"or a trace-time constant)"),
-                        key=km.key(self.name, sf.rel, f"{label}:item")))
-                elif (isinstance(node.func, ast.Name)
-                      and node.func.id in self._CONVERTERS
-                      and len(node.args) == 1
-                      and not self._is_static_expr(node.args[0], statics)):
-                    out.append(Finding(
-                        rule=self.name, path=sf.rel, line=node.lineno,
-                        message=(
-                            f"{node.func.id}() on a (possibly traced) "
-                            f"value inside jit body `{label}`: bakes a "
-                            f"trace-time constant or raises under "
-                            f"tracing; keep it an array op or hoist to "
-                            f"the host"),
-                        key=km.key(self.name, sf.rel,
-                                   f"{label}:{node.func.id}")))
-                elif name and name.startswith("time."):
-                    out.append(Finding(
-                        rule=self.name, path=sf.rel, line=node.lineno,
-                        message=(
-                            f"{name}() inside jit body `{label}`: "
-                            f"executes ONCE at trace time, not per "
-                            f"call — time on the host around the "
-                            f"dispatch instead"),
-                        key=km.key(self.name, sf.rel, f"{label}:{name}")))
+                for node in ast.walk(fn.body):
+                    self._check_call(sf, node, label, statics,
+                                     ret_static, km, out)
+                continue
+            self._check_jit_body(sf, fn.body, label, statics,
+                                 ret_static, km, out)
         return out
 
+    def _check_jit_body(self, sf, body, label, entry, ret_static, km,
+                        out):
+        cfg = build_cfg(body)
+
+        def names_of(t) -> Set[str]:
+            if isinstance(t, ast.Name):
+                return {t.id}
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return {e.id for e in t.elts if isinstance(e, ast.Name)}
+            return set()
+
+        def transfer(state, ev):
+            kind, node = ev
+            if kind == "stmt":
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    if node.value is None:
+                        return state
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    names: Set[str] = set()
+                    for t in targets:
+                        names |= names_of(t)
+                    if not names:
+                        return state
+                    if self._is_static_expr(node.value, state,
+                                            ret_static):
+                        return state | names
+                    return state - names
+                if isinstance(node, ast.AugAssign) and \
+                        isinstance(node.target, ast.Name):
+                    if node.target.id in state and self._is_static_expr(
+                            node.value, state, ret_static):
+                        return state
+                    return state - {node.target.id}
+            elif kind == "forassign":
+                names = names_of(node.target)
+                if self._is_static_expr(node.iter, state, ret_static):
+                    return state | names
+                return state - names
+            elif kind == "with_enter" and node.optional_vars is not None:
+                return state - names_of(node.optional_vars)
+            return state
+
+        states = run_forward(cfg, entry, transfer, meet_intersect)
+        for ev, state in iter_events(cfg, states, transfer):
+            kind, node = ev
+            if kind == "def":
+                # Inner cond/body defs are traced too: they inherit the
+                # statics known at their definition point.
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._check_jit_body(sf, node.body, label, state,
+                                         ret_static, km, out)
+                continue
+            for n in event_nodes(ev):
+                self._check_call(sf, n, label, state, ret_static, km,
+                                 out)
+
+    def _check_call(self, sf, node, label, statics, ret_static, km,
+                    out):
+        if not isinstance(node, ast.Call):
+            return
+        name = dotted_name(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args):
+            out.append(Finding(
+                rule=self.name, path=sf.rel, line=node.lineno,
+                message=(
+                    f".item() inside jit body `{label}`: host "
+                    f"sync under tracing (ConcretizationError "
+                    f"or a trace-time constant)"),
+                key=km.key(self.name, sf.rel, f"{label}:item")))
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in self._CONVERTERS
+              and len(node.args) == 1
+              and not self._is_static_expr(node.args[0], statics,
+                                           ret_static)):
+            out.append(Finding(
+                rule=self.name, path=sf.rel, line=node.lineno,
+                message=(
+                    f"{node.func.id}() on a (possibly traced) "
+                    f"value inside jit body `{label}`: bakes a "
+                    f"trace-time constant or raises under "
+                    f"tracing; keep it an array op or hoist to "
+                    f"the host"),
+                key=km.key(self.name, sf.rel,
+                           f"{label}:{node.func.id}")))
+        elif name and name.startswith("time."):
+            out.append(Finding(
+                rule=self.name, path=sf.rel, line=node.lineno,
+                message=(
+                    f"{name}() inside jit body `{label}`: "
+                    f"executes ONCE at trace time, not per "
+                    f"call — time on the host around the "
+                    f"dispatch instead"),
+                key=km.key(self.name, sf.rel, f"{label}:{name}")))
+
     @staticmethod
-    def _is_static_expr(node: ast.AST, statics: Set[str]) -> bool:
+    def _is_static_expr(node: ast.AST, statics,
+                        ret_static=frozenset()) -> bool:
         """Conservatively static under tracing: every Name reached
-        OUTSIDE a shape/len subtree must be a static_argnames binding
-        (shape/len expressions are concrete during tracing; a traced
-        value MIXED into the arithmetic still makes the whole
-        conversion a hazard)."""
+        OUTSIDE a shape/len subtree must be a known-static binding —
+        static_argnames or a local the dataflow proved static on every
+        path (shape/len expressions are concrete during tracing; a
+        traced value MIXED into the arithmetic still makes the whole
+        conversion a hazard). ``ret_static`` names same-file helpers
+        whose returns are statically concrete."""
         traced_names: List[str] = []
 
         def visit(n: ast.AST, in_static: bool) -> None:
@@ -410,7 +622,8 @@ class RetraceHazardRule(Rule):
                     "shape", "ndim", "size", "dtype"):
                 in_static = True
             elif isinstance(n, ast.Call) and \
-                    isinstance(n.func, ast.Name) and n.func.id == "len":
+                    isinstance(n.func, ast.Name) and \
+                    (n.func.id == "len" or n.func.id in ret_static):
                 in_static = True
             elif isinstance(n, ast.Name) and not in_static:
                 traced_names.append(n.id)
@@ -507,10 +720,15 @@ class ExecLoaderRule(Rule):
     annotations via ``sys.modules[cls.__module__]`` at class-creation
     time — a by-path module with any dataclass crashes with a KeyError
     unless the registration precedes the exec (the importlib
-    contract)."""
+    contract).
+
+    v2 (path-sensitive): "registered" is a MUST-fact over the scope's
+    CFG — a ``sys.modules`` store in one ``if`` arm no longer
+    satisfies an ``exec`` reached through the other arm; the
+    registration must dominate the exec on every path."""
 
     name = "exec-loader"
-    description = ("exec_module()/exec(compile()) without a prior "
+    description = ("exec_module()/exec(compile()) not dominated by a "
                    "sys.modules[...] registration in the same scope")
 
     def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
@@ -530,36 +748,175 @@ class ExecLoaderRule(Rule):
         for node, stack in _walk_scopes(sf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scopes.append((_scope_name(stack + (node,)), node.body))
+        REG = frozenset({"reg"})
+
+        def transfer(state, ev):
+            kind, node = ev
+            if kind == "stmt" and isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and dotted_name(t.value) in reg_names):
+                        return REG
+            return state
+
         for scope, body in scopes:
-            regs: List[int] = []   # lines assigning sys.modules[...]
-            execs: List[Tuple[int, str]] = []
-            for sub in _scope_walk(body):
-                if isinstance(sub, ast.Assign):
-                    for t in sub.targets:
-                        if (isinstance(t, ast.Subscript)
-                                and dotted_name(t.value) in reg_names):
-                            regs.append(sub.lineno)
-                if isinstance(sub, ast.Call):
+            cfg = build_cfg(body)
+            states = run_forward(cfg, frozenset(), transfer,
+                                 meet_intersect)
+            for ev, state in iter_events(cfg, states, transfer):
+                for sub in event_nodes(ev):
+                    if not isinstance(sub, ast.Call):
+                        continue
                     fn = dotted_name(sub.func)
                     if (isinstance(sub.func, ast.Attribute)
                             and sub.func.attr == "exec_module"):
-                        execs.append((sub.lineno, "exec_module"))
+                        kind = "exec_module"
                     elif fn == "exec" and sub.args and \
                             isinstance(sub.args[0], ast.Call) and \
                             dotted_name(sub.args[0].func) == "compile":
-                        execs.append((sub.lineno, "exec(compile)"))
-            for line, kind in execs:
-                if any(r < line for r in regs):
+                        kind = "exec(compile)"
+                    else:
+                        continue
+                    if "reg" in state:
+                        continue
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=sub.lineno,
+                        message=(
+                            f"{kind} without a prior `sys.modules[name]"
+                            f" = mod` in {scope}: dataclasses in the "
+                            f"loaded module resolve string annotations "
+                            f"via sys.modules[cls.__module__] — "
+                            f"register BEFORE exec on EVERY path (the "
+                            f"importlib contract)"),
+                        key=km.key(self.name, sf.rel,
+                                   f"{scope}:{kind}")))
+        return out
+
+
+class LockOrderRule(Rule):
+    """Deadlock-by-inversion: thread A holds L1 and wants L2 while
+    thread B holds L2 and wants L1. With seven locks across the
+    serving/fleet stack no reviewer holds the global acquisition order
+    in their head (the Clang TSA argument, CGO 2014). This rule builds
+    the project-wide lock-acquisition graph from the per-function
+    summaries — direct ``with`` nesting plus locks reachable through
+    resolved calls (may-acquire closure) — and reports every cycle,
+    printing one witness acquisition path per edge. A non-reentrant
+    lock that can be re-acquired while held (``self.m()`` from inside
+    ``with self._lock:`` where ``m`` takes the same lock) is a
+    1-cycle: guaranteed self-deadlock, not just a window."""
+
+    name = "lock-order"
+    description = ("cycle in the global lock-acquisition graph "
+                   "(deadlock); witness paths printed per edge")
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        project_index(ctx).add_source(sf)
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        return []
+
+    def finalize(self, ctx: AnalysisContext) -> List[Finding]:
+        graph = project_index(ctx).resolved()
+        km = KeyMaker()
+        out: List[Finding] = []
+        for locks, witnesses in graph.lock_cycles():
+            paths = []
+            for i, (hid, lid, rel, qual, line, chain) in enumerate(
+                    witnesses, 1):
+                via = f" via {' -> '.join(chain)}" if chain else ""
+                paths.append(f"path {i}: {qual} ({rel}:{line}) holds "
+                             f"{hid} -> acquires {lid}{via}")
+            if len(locks) == 1:
+                head = (f"non-reentrant lock {locks[0]} may be "
+                        f"re-acquired while held (self-deadlock)")
+            else:
+                head = ("lock-order inversion between "
+                        + " and ".join(sorted(locks))
+                        + " (opposite acquisition orders deadlock "
+                          "under contention)")
+            _hid, _lid, rel0, _qual0, line0, _chain0 = witnesses[0]
+            out.append(Finding(
+                rule=self.name, path=rel0, line=line0,
+                message=head + "\n    " + "\n    ".join(paths),
+                key=km.key(self.name, rel0,
+                           "cycle:" + "<".join(sorted(locks)))))
+        return out
+
+
+class BlockingUnderLockRule(Rule):
+    """The fleet-supervision stall class: a blocking call —
+    ``time.sleep``, ``subprocess`` spawn/wait/communicate, socket or
+    urllib round-trips, ``jax.block_until_ready`` — reached while the
+    lock-set is non-empty serializes every contender behind an
+    unbounded wait (the health probe holds the replica lock through a
+    multi-second HTTP timeout and the router's hot path stalls).
+    Flags direct blocking calls under a resolved lock AND calls to
+    functions whose may-block closure is non-empty, with the witness
+    chain. ``with cv: cv.wait()`` is exempt (wait RELEASES the
+    condition's lock — that is the sanctioned pattern). A deliberate
+    hold is annotated ``# marlint: allow-blocking=<reason>`` — an
+    annotation counted in --stats, not a suppression."""
+
+    name = "blocking-under-lock"
+    description = ("blocking call (sleep/subprocess/socket/urllib/"
+                   "wait) reached while holding a lock; escape hatch: "
+                   "# marlint: allow-blocking=<reason>")
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        project_index(ctx).add_source(sf)
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> List[Finding]:
+        idx = project_index(ctx)
+        idx.add_source(sf)
+        graph = idx.resolved()
+        km = KeyMaker()
+        out: List[Finding] = []
+        for fi in file_summary(sf).funcs:
+            for label, line, held, recv in fi.blocking:
+                if recv is not None and recv in held:
+                    continue  # condition-wait releases the held lock
+                hids = graph.resolve_held(held, fi.cls, fi.rel)
+                if not hids:
+                    continue
+                if line in sf.allow_blocking:
+                    ctx.note_annotation(self.name)
                     continue
                 out.append(Finding(
                     rule=self.name, path=sf.rel, line=line,
                     message=(
-                        f"{kind} without a prior `sys.modules[name] = "
-                        f"mod` in {scope}: dataclasses in the loaded "
-                        f"module resolve string annotations via "
-                        f"sys.modules[cls.__module__] — register "
-                        f"BEFORE exec (the importlib contract)"),
-                    key=km.key(self.name, sf.rel, f"{scope}:{kind}")))
+                        f"blocking {label}() while holding "
+                        f"{', '.join(sorted(hids))} in {fi.qual}: "
+                        f"every contender stalls behind this call — "
+                        f"hoist it out of the critical section, or "
+                        f"annotate `# marlint: allow-blocking=<reason>`"
+                        f" if the serialization is the point"),
+                    key=km.key(self.name, sf.rel,
+                               f"{fi.qual}:{label}")))
+            for ckey, line, held in graph.callees_of((fi.rel, fi.qual)):
+                hids = graph.resolve_held(held, fi.cls, fi.rel)
+                if not hids:
+                    continue
+                blk = graph.may_block.get(ckey) or {}
+                if not blk:
+                    continue
+                if line in sf.allow_blocking:
+                    ctx.note_annotation(self.name)
+                    continue
+                cfi = graph.funcs[ckey]
+                label = sorted(blk)[0]
+                via = " -> ".join((cfi.qual,) + blk[label])
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=line,
+                    message=(
+                        f"call to {cfi.qual}() while holding "
+                        f"{', '.join(sorted(hids))} in {fi.qual} "
+                        f"reaches blocking {label} (via {via}): "
+                        f"hoist the call out of the critical section, "
+                        f"or annotate `# marlint: "
+                        f"allow-blocking=<reason>`"),
+                    key=km.key(self.name, sf.rel,
+                               f"{fi.qual}:call:{cfi.name}")))
         return out
 
 
@@ -663,6 +1020,8 @@ ALL_RULES: Tuple[Rule, ...] = (
     DeterministicServingRule(),
     RetraceHazardRule(),
     ExecLoaderRule(),
+    LockOrderRule(),
+    BlockingUnderLockRule(),
     ExportIntegrityRule(),
 )
 

@@ -124,13 +124,19 @@ class Replica:
             self._port_event.clear()
             self._state = "starting"
             self._unready_probes = 0
-            proc = subprocess.Popen(
-                argv, env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True)
+        # Spawn OUTSIDE the lock: fork/exec blocks in the kernel, and
+        # every health probe / status() poll contends on _lock — a slow
+        # spawn must not stall the whole supervision loop. The
+        # "starting" state set above keeps observers honest while the
+        # process comes up; _proc/_reader land under the lock below.
+        proc = subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        reader = threading.Thread(
+            target=self._read_stdout, args=(proc,),
+            name=f"fleet-replica{self.index}-stdout", daemon=True)
+        with self._lock:
             self._proc = proc
-            reader = threading.Thread(
-                target=self._read_stdout, args=(proc,),
-                name=f"fleet-replica{self.index}-stdout", daemon=True)
             self._reader = reader
         self._emit("replica_spawn", incarnation=incarnation,
                    pid=proc.pid)

@@ -494,6 +494,8 @@ class FleetHTTPServer(ThreadingHTTPServer):
             if request_timeout_s is None else request_timeout_s)
         self._drain_once = threading.Lock()
         self._drained = False
+        self._drain_leader_active = False
+        self._drain_done = threading.Event()
         self._serve_thread: Optional[threading.Thread] = None
 
     @property
@@ -509,17 +511,37 @@ class FleetHTTPServer(ThreadingHTTPServer):
 
     def begin_drain(self, timeout: Optional[float] = None) -> bool:
         """Drain the whole fleet: every replica drains byte-complete,
-        then the front-door listener stops. Idempotent."""
+        then the front-door listener stops. Idempotent; a failed drain
+        may be retried by a later call.
+
+        Leader election, not a critical section: ``_drain_once`` only
+        guards the flags. The actual drain (replica ``proc.wait`` et
+        al.) runs OUTSIDE the lock, so concurrent callers wait on the
+        event with their own timeout instead of queueing unbounded on
+        the lock behind a multi-second drain."""
         with self._drain_once:
             if self._drained:
                 return True
-            ok = self.supervisor.drain_all(timeout)
-            self.shutdown()
-            if self._serve_thread is not None:
-                self._serve_thread.join(timeout)
-            self.server_close()
+            if self._drain_leader_active:
+                waiter = self._drain_done
+            else:
+                self._drain_leader_active = True
+                self._drain_done = threading.Event()
+                waiter = None
+        if waiter is not None:
+            waiter.wait(timeout)
+            return self._drained
+        ok = self.supervisor.drain_all(timeout)
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout)
+        self.server_close()
+        with self._drain_once:
             self._drained = ok
-            return ok
+            self._drain_leader_active = False
+            done = self._drain_done
+        done.set()
+        return ok
 
     def close_now(self) -> None:
         """Hard teardown for tests: no drain."""

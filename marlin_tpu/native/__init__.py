@@ -46,7 +46,7 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         _tried = True
         if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
-            if not _build():
+            if not _build():  # marlint: allow-blocking=once-per-process lazy compile; serializing concurrent first loads is the point
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)

@@ -19,6 +19,7 @@ No jax/engine imports needed for the fixture layer — the analyzer is
 stdlib-only by design.
 """
 
+import ast
 import json
 import textwrap
 import time
@@ -26,7 +27,8 @@ import time
 import pytest
 
 from marlin_tpu import analysis
-from marlin_tpu.analysis import core
+from marlin_tpu.analysis import callgraph, core, flow
+from marlin_tpu.analysis import cfg as cfg_mod
 from marlin_tpu.analysis.rules import rules_by_name
 
 
@@ -571,6 +573,568 @@ class TestExportIntegrity:
 
 
 # ---------------------------------------------------------------------
+# the dataflow core (cfg.py / flow.py / callgraph.py)
+# ---------------------------------------------------------------------
+
+
+def _describe(src):
+    tree = ast.parse(textwrap.dedent(src))
+    return cfg_mod.build_cfg(tree.body).describe()
+
+
+class TestCFG:
+    def test_if_else_joins(self):
+        assert _describe("""
+            a = 1
+            if a:
+                b = 2
+            else:
+                c = 3
+            d = 4
+        """) == [
+            "B0: stmt use -> B2,B3",
+            "B2: stmt -> B4",
+            "B3: stmt -> B4",
+            "B4: stmt -> exit",
+        ]
+
+    def test_while_break_continue(self):
+        assert _describe("""
+            while cond:
+                if x:
+                    break
+                y = 1
+                continue
+            z = 2
+        """) == [
+            "B0: - -> B2",
+            "B2: use -> B4,B3",   # header -> body, after
+            "B3: stmt -> exit",   # after-loop
+            "B4: use -> B5,B6",   # if x
+            "B5: - -> B3",        # break jumps to after
+            "B6: stmt -> B2",     # continue jumps to header
+        ]
+
+    def test_try_except_finally_edges(self):
+        # Coarse exception model: the try body may fall into the
+        # handler; both routes reach the finally block.
+        assert _describe("""
+            try:
+                a = 1
+            except ValueError:
+                b = 2
+            finally:
+                c = 3
+        """) == [
+            "B0: - -> B2",
+            "B2: stmt -> B3,B4",
+            "B3: stmt -> exit",   # finally
+            "B4: stmt -> B3",     # handler -> finally
+        ]
+
+    def test_with_emits_enter_exit_and_return_skips_exit_event(self):
+        # The in-with return leaves the scope directly; only the
+        # fall-through path replays with_exit before the tail.
+        assert _describe("""
+            with lk:
+                if p:
+                    return 1
+            tail = 2
+        """) == [
+            "B0: use with_enter use -> B2,B3",
+            "B2: stmt -> exit",
+            "B3: with_exit stmt -> exit",
+        ]
+
+    def test_code_after_return_has_no_predecessor(self):
+        # Dead code lands in a block no edge reaches — dataflow sees
+        # TOP there and every rule stays quiet on it by construction.
+        cfg = cfg_mod.build_cfg(ast.parse("return 1\ndead = 2").body)
+        dead = [b for b in cfg.blocks
+                if b is not cfg.exit and b is not cfg.entry and b.events]
+        assert len(dead) == 1
+        preds = {s.idx for b in cfg.blocks for s in b.succs}
+        assert dead[0].idx not in preds
+
+
+class TestLockLattice:
+    A, B = ("self", "_a"), ("self", "_b")
+
+    def test_acquire_release_roundtrip(self):
+        s = flow.lock_acquire(flow.EMPTY_LOCKS, self.A)
+        s = flow.lock_acquire(s, self.B)
+        assert flow.held_refs(s) == (self.A, self.B)
+        s = flow.lock_release(s, self.A)
+        assert flow.held_refs(s) == (self.B,)
+        assert flow.lock_release(s, self.B) == flow.EMPTY_LOCKS
+
+    def test_meet_takes_min_counts(self):
+        # Must-analysis: a lock held on only ONE branch is NOT held at
+        # the join — exactly the branch-acquired guarded-by bug.
+        one = flow.lock_acquire(flow.EMPTY_LOCKS, self.A)
+        two = flow.lock_acquire(one, self.A)
+        assert flow.lock_meet(one, flow.EMPTY_LOCKS) == flow.EMPTY_LOCKS
+        assert flow.lock_meet(two, one) == one
+        assert flow.lock_meet(one, flow.lock_acquire(
+            flow.EMPTY_LOCKS, self.B)) == flow.EMPTY_LOCKS
+
+    def test_top_is_meet_identity(self):
+        one = flow.lock_acquire(flow.EMPTY_LOCKS, self.A)
+        assert flow.lock_meet(flow.TOP, one) == one
+        assert flow.meet_intersect(flow.TOP, frozenset({"x"})) == \
+            frozenset({"x"})
+        assert flow.meet_intersect(frozenset({"x", "y"}),
+                                   frozenset({"y"})) == frozenset({"y"})
+        assert flow.meet_union(frozenset({"x"}), flow.TOP) == \
+            frozenset({"x"})
+        assert flow.meet_union(frozenset({"x"}),
+                               frozenset({"y"})) == frozenset({"x", "y"})
+
+
+CALLGRAPH_FIXTURE = """
+    import json
+    import threading
+
+    class RunLog:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def emit(self, rec):
+            with self._lock:
+                return json.dumps(rec)
+
+        def dumps(self, rec):
+            return str(rec)
+
+        def seal(self):
+            with self._lock:
+                self._sink.flush()
+
+        def flush(self):
+            with self._lock:
+                pass
+
+    def helper():
+        return 1
+
+    def caller():
+        return helper()
+"""
+
+
+class TestCallResolution:
+    def _graph(self, tmp_path):
+        p = tmp_path / "obs.py"
+        src = textwrap.dedent(CALLGRAPH_FIXTURE)
+        p.write_text(src)
+        idx = callgraph.ProjectIndex()
+        idx.add_source(core.SourceFile(p, "obs.py", src))
+        return idx.resolved()
+
+    def test_self_call_resolves_to_declaring_class(self, tmp_path):
+        g = self._graph(tmp_path)
+        assert g.resolve_call("self", "dumps", "obs.py", "RunLog") == \
+            ("obs.py", "RunLog.dumps")
+
+    def test_bare_call_resolves_same_module_only(self, tmp_path):
+        g = self._graph(tmp_path)
+        assert g.resolve_call("bare", "helper", "obs.py", None) == \
+            ("obs.py", "helper")
+        assert g.resolve_call("bare", "nope", "obs.py", None) is None
+
+    def test_imported_receiver_refuses_method_match(self, tmp_path):
+        # json.dumps name-matches the unique method RunLog.dumps; the
+        # module receiver is the evidence that it is NOT one.
+        g = self._graph(tmp_path)
+        assert g.resolve_call("attr", "dumps", "obs.py", "RunLog",
+                              recv="json") is None
+
+    def test_stdlib_proto_names_never_match_by_name_alone(self, tmp_path):
+        # self._sink.flush() must not resolve to RunLog.flush — the
+        # file-object protocol names carry no type evidence.
+        g = self._graph(tmp_path)
+        assert "flush" in callgraph.STDLIB_PROTO_METHODS
+        assert g.resolve_call("attr", "flush", "obs.py", "RunLog") is None
+
+    def test_unresolvable_dynamic_calls_degrade_to_no_finding(self,
+                                                              tmp_path):
+        # handlers[k]() / getattr(...)() under a lock: no resolution,
+        # no finding, no crash — and never exit-code-2 material.
+        rep = run_lint(tmp_path, {"serving/dyn.py": """
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._handlers = {}
+
+                def dispatch(self, kind):
+                    with self._lock:
+                        fn = self._handlers[kind]
+                        fn()
+                        getattr(self, "on_" + kind)()
+        """}, rules=["lock-order", "blocking-under-lock", "guarded-by"])
+        assert not rep.findings and not rep.parse_errors
+
+
+# ---------------------------------------------------------------------
+# guarded-by v2 (flow-sensitive lock-sets)
+# ---------------------------------------------------------------------
+
+
+class TestGuardedByFlow:
+    def test_branch_acquired_lock_is_not_held_at_join(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/g2.py": """
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []  # guarded-by: _lock
+
+                def join_bug(self, flag):
+                    if flag:
+                        self._lock.acquire()
+                    self._q.append(1)
+
+                def both_arms_ok(self, flag):
+                    with self._lock:
+                        if flag:
+                            self._q.append(1)
+                        else:
+                            self._q.append(2)
+        """}, rules=["guarded-by"])
+        assert len(rep.findings) == 1
+        assert "join_bug" in rep.findings[0].message
+
+    def test_holds_helper_called_without_lock_flags(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/g3.py": """
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []  # guarded-by: _lock
+
+                def call_bug(self):
+                    return self._helper()
+
+                def call_ok(self):
+                    with self._lock:
+                        return self._helper()
+
+                def _helper(self):  # marlint: holds=_lock
+                    return len(self._q)
+        """}, rules=["guarded-by"])
+        assert len(rep.findings) == 1
+        m = rep.findings[0].message
+        assert "E.call_bug calls _helper()" in m and "holds=_lock" in m
+
+
+# ---------------------------------------------------------------------
+# donation-fetch v2 (alias-aware taint)
+# ---------------------------------------------------------------------
+
+
+class TestDonationFetchFlow:
+    def test_alias_of_donated_buffer_fires(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/al.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class Eng:
+                def __init__(self):
+                    self._buf = jnp.zeros((4,))  # donated-buffer
+
+                def alias_bug(self):
+                    buf = self._buf
+                    return np.asarray(buf)
+
+                def realias_ok(self):
+                    buf = self._buf
+                    buf = np.zeros(4)
+                    return np.asarray(buf)
+        """}, rules=["donation-fetch"])
+        assert len(rep.findings) == 1
+        m = rep.findings[0].message
+        assert "`buf`, an alias of donated buffer `._buf`" in m
+
+    def test_alias_through_returning_method_fires(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/al2.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class Eng:
+                def __init__(self):
+                    self._buf = jnp.zeros((4,))  # donated-buffer
+
+                def view(self):
+                    return self._buf
+
+            def fetch_bug(eng):
+                b = eng.view()
+                return np.asarray(b)
+        """}, rules=["donation-fetch"])
+        assert len(rep.findings) == 1
+        assert "alias of donated buffer" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# retrace-hazard v2 (static-set propagation)
+# ---------------------------------------------------------------------
+
+
+class TestRetraceHazardFlow:
+    def test_tracedness_propagates_through_locals(self, tmp_path):
+        rep = run_lint(tmp_path, {"marlin_tpu/rt.py": """
+            import jax
+
+            @jax.jit
+            def f(logits):
+                x = logits[0]
+                bad = int(x)          # BUG: x aliases a traced value
+                n = logits.shape[0]
+                ok = int(n)           # OK: n is shape-derived = static
+                return bad + ok
+        """}, rules=["retrace-hazard"])
+        assert len(rep.findings) == 1
+        assert rep.findings[0].line == 7
+
+
+# ---------------------------------------------------------------------
+# exec-loader v2 (path-sensitive domination)
+# ---------------------------------------------------------------------
+
+
+class TestExecLoaderFlow:
+    def test_one_arm_registration_does_not_dominate(self, tmp_path):
+        rep = run_lint(tmp_path, {"tools/pl.py": """
+            import importlib.util
+            import sys
+
+            def load_one_arm_bug(path, fast):
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                if fast:
+                    sys.modules["m"] = mod
+                spec.loader.exec_module(mod)
+                return mod
+
+            def load_both_arms_ok(path, fast):
+                spec = importlib.util.spec_from_file_location("m", path)
+                mod = importlib.util.module_from_spec(spec)
+                if fast:
+                    sys.modules["m"] = mod
+                else:
+                    sys.modules["m"] = mod
+                spec.loader.exec_module(mod)
+                return mod
+        """}, rules=["exec-loader"])
+        assert len(rep.findings) == 1
+        assert "load_one_arm_bug" in rep.findings[0].message
+        assert "EVERY path" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# lock-order (project-wide deadlock cycles)
+# ---------------------------------------------------------------------
+
+
+class TestLockOrder:
+    def test_two_lock_inversion_prints_both_witness_paths(self, tmp_path):
+        # THE acceptance fixture: opposite acquisition orders across
+        # two methods; the finding names the cycle and prints one
+        # witness acquisition path per edge.
+        rep = run_lint(tmp_path, {"fleet/inv.py": """
+            import threading
+
+            class Router:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            return 2
+        """}, rules=["lock-order"])
+        assert len(rep.findings) == 1
+        m = rep.findings[0].message
+        assert "lock-order inversion between Router._a and Router._b" in m
+        assert ("path 1: Router.forward (fleet/inv.py:11) holds "
+                "Router._a -> acquires Router._b") in m
+        assert ("path 2: Router.backward (fleet/inv.py:16) holds "
+                "Router._b -> acquires Router._a") in m
+
+    def test_consistent_order_is_quiet(self, tmp_path):
+        rep = run_lint(tmp_path, {"fleet/ok.py": """
+            import threading
+
+            class Router:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def also_forward(self):
+                    with self._a:
+                        with self._b:
+                            return 2
+        """}, rules=["lock-order"])
+        assert not rep.findings
+
+    def test_self_deadlock_through_call_vs_rlock(self, tmp_path):
+        # Plain Lock re-acquired via self.m() while held: 1-cycle,
+        # guaranteed deadlock, witness names the call chain. The same
+        # shape on an RLock is reentrant and stays quiet.
+        rep = run_lint(tmp_path, {"fleet/re.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._rl = threading.RLock()
+
+                def outer(self):
+                    with self._rl:
+                        return self.inner()
+
+                def inner(self):
+                    with self._rl:
+                        return 1
+
+            class B:
+                def __init__(self):
+                    self._lk = threading.Lock()
+
+                def outer(self):
+                    with self._lk:
+                        return self.inner()
+
+                def inner(self):
+                    with self._lk:
+                        return 1
+        """}, rules=["lock-order"])
+        assert len(rep.findings) == 1
+        m = rep.findings[0].message
+        assert "non-reentrant lock B._lk" in m and "self-deadlock" in m
+        assert "via B.inner" in m
+
+
+# ---------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------
+
+
+BLOCKING_FIXTURE = """
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition()
+
+        def stall_bug(self):
+            with self._lock:
+                time.sleep(1.0)
+
+        def cv_ok(self):
+            # wait() RELEASES the condition's own lock — the
+            # sanctioned pattern, never a stall.
+            with self._cv:
+                self._cv.wait()
+
+        def deliberate_ok(self):
+            with self._lock:
+                time.sleep(0.1)  # marlint: allow-blocking=serializing is the point
+
+        def chain_bug(self):
+            with self._lock:
+                self._spin()
+
+        def _spin(self):
+            time.sleep(2.0)
+"""
+
+
+class TestBlockingUnderLock:
+    def test_direct_chain_and_exemptions(self, tmp_path):
+        rep = run_lint(tmp_path, {"serving/blk.py": BLOCKING_FIXTURE},
+                       rules=["blocking-under-lock"])
+        msgs = [f.message for f in rep.findings]
+        assert len(msgs) == 2, msgs
+        assert any("blocking time.sleep() while holding W._lock in "
+                   "W.stall_bug" in m for m in msgs)
+        assert any("call to W._spin() while holding W._lock in "
+                   "W.chain_bug reaches blocking time.sleep "
+                   "(via W._spin)" in m for m in msgs)
+        # cv_ok and deliberate_ok are quiet; the annotation is COUNTED
+        # (an annotation, not a suppression — the zero-suppression
+        # gate stays satisfiable).
+        assert not any("cv_ok" in m or "deliberate_ok" in m for m in msgs)
+        assert rep.stats["blocking-under-lock"]["annotations"] == 1
+        assert rep.n_suppressed == 0
+
+
+# ---------------------------------------------------------------------
+# --stats / --jobs / cache (core plumbing)
+# ---------------------------------------------------------------------
+
+
+class TestStatsAndCache:
+    def test_stats_flag_prints_per_rule_table(self, tmp_path, capsys):
+        (tmp_path / "blk.py").write_text(textwrap.dedent(BLOCKING_FIXTURE))
+        rc = analysis.main(["--root", str(tmp_path), "--no-baseline",
+                            "--stats", "blk.py"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "rule" in out and "annotations" in out
+        assert "blocking-under-lock" in out
+        assert "files: 1" in out and "wall:" in out
+
+    def test_content_hash_cache_hits_on_second_run(self, tmp_path):
+        files = {"serving/blk.py": BLOCKING_FIXTURE,
+                 "serving/g2.py": GUARDED_FIXTURE}
+        rep1 = run_lint(tmp_path, files)
+        rep2 = run_lint(tmp_path, files)
+        assert rep2.n_files == len(files)
+        assert rep2.cache_hits == rep2.n_files
+        assert names(rep1) == names(rep2)
+
+    def test_jobs_flag_matches_sequential_findings(self, tmp_path):
+        # --jobs forks workers; run it out of process (this pytest
+        # process carries jax) and compare the JSON verdict with the
+        # sequential run over the same tree.
+        import subprocess
+        import sys
+        for rel, src in {"serving/blk.py": BLOCKING_FIXTURE,
+                         "serving/eng.py": ENGINE_FIXTURE}.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        argv = [sys.executable, "-m", "marlin_tpu.analysis",
+                "--root", str(tmp_path), "--no-baseline", "--json",
+                "serving"]
+        seq = subprocess.run(argv, capture_output=True, text=True)
+        par = subprocess.run(argv + ["--jobs", "2"],
+                             capture_output=True, text=True)
+        assert seq.returncode == par.returncode == 1
+        d_seq, d_par = json.loads(seq.stdout), json.loads(par.stdout)
+        key = lambda d: sorted((f["rule"], f["path"], f["line"])
+                               for f in d["findings"])
+        assert key(d_seq) == key(d_par) and d_par["files"] == 2
+
+
+# ---------------------------------------------------------------------
 # the full-repo tier-1 gate
 # ---------------------------------------------------------------------
 
@@ -609,6 +1173,12 @@ class TestFullRepoGate:
             f"stale baseline entries (fixed findings whose keys were "
             f"left behind — remove them): {rep.stale}")
         assert not rep.new, "\n".join(f.text() for f in rep.new)
+        # Policy: ZERO suppressions in product code (tests/fixtures may
+        # use disable= to stage bugs). A real FP becomes a fixture plus
+        # a precision fix, not a disable comment; a deliberate blocking
+        # hold uses allow-blocking=, which is an annotation, not a
+        # suppression — so this stays 0 without losing the escape hatch.
+        assert rep.n_suppressed == 0, rep.stats
 
     def test_cli_surfaces(self, capsys):
         assert analysis.main(["--list-rules"]) == 0
