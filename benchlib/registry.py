@@ -35,6 +35,7 @@ CONFIGS = {
     "serving": [configs_trend.config_serving,
                 configs_trend.config_serving_prefix,
                 configs_trend.config_serving_paged],
+    "serving_spec": [configs_trend.config_serving_spec],
     "http": [configs_http.config_http],
     "fleet": [configs_fleet.config_fleet],
     "sweep": [configs_gemm.config_dispatch_sweep],
@@ -45,6 +46,6 @@ CONFIGS = {
 # tools, run explicitly.
 CONFIGS["all"] = [
     fns[0] for k, fns in CONFIGS.items()
-    if k not in ("sweep", "attnsweep", "trend", "serving", "http",
-                 "fleet")
+    if k not in ("sweep", "attnsweep", "trend", "serving",
+                 "serving_spec", "http", "fleet")
 ]

@@ -90,6 +90,18 @@ class MarlinConfig:
     # `sparsedist` measures it per chip.
     sparse_ell_density_max: float = 5e-3
 
+    # Column-count boundary for SVD "auto" mode dispatch: at or below it
+    # the Gramian is materialized on host and swept locally
+    # (``local-eigs``); above it the sweep runs device-resident against
+    # the distributed matvec (``dist-eigs``). The seed hard-coded 15000
+    # from the reference; the CPU-mesh trend harness measures the real
+    # crossover per host (`bench.py --config trend`, svd_mode_crossover
+    # line via utils/cost_model.run_svd_mode_crossover_sweep ->
+    # derive_svd_local_eigs_max) — on small-RAM CI hosts the measured
+    # boundary is far below 15000 because the O(n^2) host Gramian
+    # thrashes long before the reference's cluster assumption holds.
+    svd_local_eigs_max: int = 15000
+
     # Mesh axis names (rows, cols) used throughout.
     mesh_axis_rows: str = "mr"
     mesh_axis_cols: str = "mc"

@@ -51,9 +51,15 @@ def compute_svd(
         raise ValueError(f"Request up to n singular values, got k={k}, n={n}.")
 
     if mode == "auto":
+        from ..config import get_config
+
+        # The local/dist boundary is a measured policy constant, not a
+        # magic number: config.svd_local_eigs_max defaults to the
+        # reference's 15000 and the trend harness re-derives it from a
+        # timed sweep (utils/cost_model.run_svd_mode_crossover_sweep).
         if n < 100 or k > n / 2:
             mode = "local-svd"
-        elif n <= 15000:
+        elif n <= get_config().svd_local_eigs_max:
             mode = "local-eigs"
         else:
             mode = "dist-eigs"

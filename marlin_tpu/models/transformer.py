@@ -1106,6 +1106,59 @@ def _decode_scan(params, first, pos0, cache, key, cfg: TransformerConfig,
     return out, cache
 
 
+def _prompt_lookup_draft(buf, filled, fin, draft_len: int, ngram: int,
+                         mask_history: bool = False):
+    """The ONE copy of the prompt-lookup drafting rule, shared by the
+    batched :func:`_speculative_loop` and the serving engine's
+    speculative rounds (serving/engine._spec_round_loop): for each row,
+    find the freshest prior occurrence of its last ``ngram`` tokens
+    inside its ``filled`` region and propose the ``draft_len - 1``
+    tokens that followed it. Rows with no match — and rows marked
+    ``fin`` (frozen) — fall back to the constant repeat-last draft.
+    Returns the (B, draft_len) verify chunk: the row's last token
+    followed by its draft.
+
+    ``mask_history=True`` additionally replaces draft positions at or
+    beyond ``filled`` with the repeat-last token, making the draft a
+    pure function of the row's OWN committed history. The batched loop
+    runs over a per-call zero-initialized buffer, so its beyond-filled
+    reads are deterministic zeros and it skips the mask (bit-exactness
+    with its pinned outputs); a serving row's buffer carries a previous
+    occupant's tokens, and without the mask a draft could depend on who
+    held the slot before — breaking the arrival-pattern invariance the
+    per-request PRNG streams are built to give."""
+    bsz, total = buf.shape
+    n_win = total - ngram + 1
+    brange = jnp.arange(bsz)
+    gram = jax.vmap(
+        lambda bb, f: jax.lax.dynamic_slice(bb, (f - ngram,), (ngram,))
+    )(buf, filled)  # (B, ngram)
+    # Freshest prior occurrence of each row's gram, entirely inside its
+    # filled region (static shifted slices of the live buf).
+    win = jnp.stack(
+        [buf[:, i:n_win + i] for i in range(ngram)], axis=2)
+    match = jnp.all(win == gram[:, None, :], axis=2)  # (B, n_win)
+    jidx = jnp.arange(n_win, dtype=jnp.int32)
+    valid = match & (jidx[None] < (filled - ngram)[:, None])
+    j_star = jnp.max(jnp.where(valid, jidx[None], -1), axis=1)  # (B,)
+    src = jnp.maximum(j_star, 0) + ngram
+    draft = jax.vmap(
+        lambda bb, sp: jax.lax.dynamic_slice(bb, (sp,),
+                                             (draft_len - 1,))
+    )(buf, src)  # (B, C-1)
+    last = buf[brange, filled - 1]  # (B,)
+    # Frozen rows draft the constant repeat-last chunk (the same
+    # fallback a failed history lookup uses), never a fresh lookup.
+    draft = jnp.where(((j_star >= 0) & ~fin)[:, None], draft,
+                      jnp.broadcast_to(last[:, None], draft.shape))
+    if mask_history:
+        didx = src[:, None] + jnp.arange(draft_len - 1,
+                                         dtype=jnp.int32)[None]
+        draft = jnp.where(didx < filled[:, None], draft,
+                          jnp.broadcast_to(last[:, None], draft.shape))
+    return jnp.concatenate([last[:, None], draft], axis=1)  # (B, C)
+
+
 def _spec_emit(lp, drafts, key):
     """The speculative-sampling acceptance kernel, pure for testability:
     ``lp`` (C, V) target log-probs at the chunk's positions, ``drafts``
@@ -1172,8 +1225,7 @@ def _speculative_loop(params, buf, filled0, cache, key,
     the loop's WALL-CLOCK already tracks only the slowest member (the
     while_loop exits the moment every sequence finishes); see
     docs/decode_serving.md for the full cost accounting."""
-    bsz, total = buf.shape
-    n_win = total - ngram + 1
+    bsz = buf.shape[0]
     # filled0 = prompt + 1 (the prefill's token is already in buf), so the
     # output needs filled >= prompt + steps = filled0 + steps - 1 — not
     # + steps, which would burn one discarded verify chunk. Sequences are
@@ -1183,29 +1235,11 @@ def _speculative_loop(params, buf, filled0, cache, key,
     def body(carry):
         buf, filled, cache, key, vsteps, iters = carry
         fin = filled >= target  # frozen: emitted everything already
-        brange = jnp.arange(bsz)
-        gram = jax.vmap(
-            lambda bb, f: jax.lax.dynamic_slice(bb, (f - ngram,), (ngram,))
-        )(buf, filled)  # (B, ngram)
-        # Freshest prior occurrence of each sequence's gram, entirely
-        # inside its filled region (static shifted slices of the live buf).
-        win = jnp.stack(
-            [buf[:, i:n_win + i] for i in range(ngram)], axis=2)
-        match = jnp.all(win == gram[:, None, :], axis=2)  # (B, n_win)
-        jidx = jnp.arange(n_win, dtype=jnp.int32)
-        valid = match & (jidx[None] < (filled - ngram)[:, None])
-        j_star = jnp.max(jnp.where(valid, jidx[None], -1), axis=1)  # (B,)
-        src = jnp.maximum(j_star, 0) + ngram
-        draft = jax.vmap(
-            lambda bb, sp: jax.lax.dynamic_slice(bb, (sp,),
-                                                 (draft_len - 1,))
-        )(buf, src)  # (B, C-1)
-        last = buf[brange, filled - 1]  # (B,)
-        # Frozen sequences draft the constant repeat-last chunk (the same
-        # fallback a failed history lookup uses), never a fresh lookup.
-        draft = jnp.where(((j_star >= 0) & ~fin)[:, None], draft,
-                          jnp.broadcast_to(last[:, None], draft.shape))
-        chunk = jnp.concatenate([last[:, None], draft], axis=1)  # (B, C)
+        # The shared prompt-lookup drafting rule; no history mask here —
+        # this loop's buf is zero-initialized per call, so beyond-filled
+        # draft reads are deterministic (see _prompt_lookup_draft).
+        chunk = _prompt_lookup_draft(buf, filled, fin, draft_len,
+                                     ngram)  # (B, C)
         # bsz is static: a single sequence passes a scalar pos so
         # decode_chunk keeps the contiguous KV-write fast path (the
         # vmapped per-sequence form lowers to a scatter) — B=1 is the

@@ -9,7 +9,8 @@ that converts PR 1's "skew-proof" into reclaimed throughput
 """
 
 from . import faults
-from .engine import ServingEngine, _decode_round, _decode_round_paged
+from .engine import (ServingEngine, _decode_round, _decode_round_paged,
+                     _decode_round_spec, _decode_round_spec_paged)
 from .faults import (EngineStateCorrupt, FaultInjected, FaultPlan,
                      FaultSpec)
 from .frontend import (EngineFailed, EngineFrontend, FrontendError,

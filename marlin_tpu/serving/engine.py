@@ -229,6 +229,194 @@ def _decode_round_paged(params, pool, buf, tables, filled, target, done0,
                        eos_id=eos_id)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "draft_len", "ngram",
+                     "temperature", "eos_id"),
+    donate_argnums=(1, 2),
+)
+@jax.named_scope("marlin.serving.decode_round_spec")
+def _decode_round_spec(params, cache, buf, filled, target, done0, keys,
+                       cfg, round_steps: int, draft_len: int, ngram: int,
+                       temperature: float, eos_id: Optional[int] = None):
+    """:func:`_decode_round` with PR 1's draft+verify chunks inside the
+    round (ROADMAP 15, docs/serving.md §7): each iteration drafts
+    ``draft_len - 1`` tokens per live row via the shared prompt-lookup
+    rule (models/transformer._prompt_lookup_draft, history-masked so a
+    draft is a pure function of the row's own tokens), verifies the
+    whole batch's chunks in ONE ``decode_chunk`` dispatch, and advances
+    each row by its own accepted count — the ragged per-row advance the
+    ``filled``/positions machinery already supports. Greedy accepts the
+    longest argmax-agreeing prefix plus the correction (bit-exact vs
+    the non-speculative engine); sampling runs the delta-draft kernel
+    (``_spec_emit``) per row on the row's own key stream (distribution-
+    exact per request, arrival-pattern-invariant). ``draft_len`` and
+    ``ngram`` are STATIC: the engine compiles one executable per member
+    of its small draft-length set at init and the acceptance-adaptive
+    policy moves between them with zero steady-state recompiles.
+
+    Returns ``(buf, filled, done, cache, iters, live, keys, drafted,
+    accepted)`` — the round-loop contract plus the per-row acceptance
+    ledger (``drafted``/``accepted`` (B,) int32) stats.py turns into
+    the EWMA the draft-length policy reads. ``iters`` counts verify
+    CHUNKS here, not tokens."""
+    return _spec_round_loop(params, cache,
+                            lambda p, kv, t, pos: tr.decode_chunk(
+                                p, kv, t, pos, cfg),
+                            buf, filled, target, done0, keys,
+                            round_steps=round_steps, draft_len=draft_len,
+                            ngram=ngram, temperature=temperature,
+                            eos_id=eos_id)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "draft_len", "ngram",
+                     "temperature", "eos_id"),
+    donate_argnums=(1, 2),
+)
+@jax.named_scope("marlin.serving.decode_round_spec_paged")
+def _decode_round_spec_paged(params, pool, buf, tables, filled, target,
+                             done0, keys, cfg, round_steps: int,
+                             draft_len: int, ngram: int,
+                             temperature: float,
+                             eos_id: Optional[int] = None):
+    """:func:`_decode_round_spec` over the PAGED KV pool — identical
+    speculative scheduling semantics through ``decode_chunk_paged``
+    (PR 9's page tables, loop-invariant within a round). The paged
+    engine's admission reserves ``draft_len_max - 1`` slots of write
+    overhang past ``prompt + steps`` (see ``ServingEngine.submit``) so
+    a chunk straddling the target never writes through an unreserved
+    table entry; entries beyond the reservation stay pointed at the
+    write sink (page 0) and swallow frozen rows' dead writes."""
+    return _spec_round_loop(params, pool,
+                            lambda p, kv, t, pos: tr.decode_chunk_paged(
+                                p, kv, tables, t, pos, cfg),
+                            buf, filled, target, done0, keys,
+                            round_steps=round_steps, draft_len=draft_len,
+                            ngram=ngram, temperature=temperature,
+                            eos_id=eos_id)
+
+
+def _spec_round_loop(params, kv, step_fn, buf, filled, target, done0,
+                     keys, round_steps: int, draft_len: int, ngram: int,
+                     temperature: float, eos_id: Optional[int]):
+    """The ONE copy of the SPECULATIVE round's scheduling semantics,
+    shared by the contiguous and paged entry points exactly as
+    :func:`_round_loop` is for the one-token round. Everything subtle
+    lives here once:
+
+    * draft purity — the prompt-lookup draft is history-masked
+      (``mask_history=True``), so a serving row's draft can never read
+      a previous occupant's stale tokens: drafts (hence sampled
+      outputs) stay pure functions of (request, engine seed), which is
+      the arrival-pattern-invariance contract;
+    * frozen rows — draft the constant repeat-last chunk, their verify
+      base is clamped to ``total - C`` so even a row parked at
+      ``filled == max_len`` (mid chunked-prefill) writes in bounds, and
+      their writes land only in dead state: the buf rewrite at the base
+      is a fixed point (same token, same position) and everything past
+      it is beyond the row's output span or rewritten before read
+      (decode_chunk's slot==position write-before-read self-healing);
+    * ragged advance — a live row commits ``adv = min(m + 1, eos_cut,
+      target - filled)`` tokens of its chunk: the accepted prefix plus
+      the correction/bonus, cut at an accepted eos (the eos itself
+      commits, matching the one-token round's emitted-includes-eos
+      accounting) and clamped at target;
+    * the PRNG stream — one split per LIVE chunk (the delta-draft
+      kernel's three subkeys come off the chunk's subkey), frozen rows'
+      streams do not advance — so request r's n-th verify chunk uses
+      the n-th split of r's stream regardless of neighbors or slot;
+    * the acceptance ledger — per live chunk, ``drafted += C - 1`` and
+      ``accepted += adv - 1`` (the chunk's non-draft token is billed to
+      the chunk, like the one-token round bills its token to the
+      iteration), giving the exact per-request identity
+      ``emitted == 1 + live_iters + spec_accepted`` the tests pin.
+    """
+    bsz, total = buf.shape
+    brange = jnp.arange(bsz)
+    C = draft_len
+
+    def cond(carry):
+        i, _, _, done, _, _, _, _, _ = carry
+        return (i < round_steps) & ~jnp.all(done)
+
+    def body(carry):
+        i, buf, filled, done, kv, keys, live, drafted, accepted = carry
+        tok = buf[brange, filled - 1]
+        # Freeze-at-entry, exactly as _round_loop: at-target rows and
+        # eos rows must not decode.
+        done = done | (filled >= target)
+        if eos_id is not None:
+            done = done | (tok == eos_id)
+        chunk = tr._prompt_lookup_draft(buf, filled, done, C, ngram,
+                                        mask_history=True)  # (B, C)
+        # Verify base. Live rows: filled - 1 (refeed the last committed
+        # token; its KV rewrite is a fixed point). The minimum only ever
+        # clamps FROZEN rows (submit validates prompt + steps +
+        # draft_len_max - 1 <= max_len, so a live row's base is always
+        # <= total - C - 1): a chunked admission parks its row at
+        # filled == max_len, and an unclamped base would write cache
+        # slots past the buffer.
+        base = jnp.minimum(filled - 1, total - C)
+        logits, kv = step_fn(params, kv, chunk, base)
+        lf = logits.astype(jnp.float32)  # (B, C, V)
+        ks_all = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+        if temperature > 0.0:
+            lp = jax.nn.log_softmax(lf / temperature, axis=-1)
+            emit, m = jax.vmap(tr._spec_emit)(lp, chunk[:, 1:],
+                                              ks_all[:, 1])
+        else:
+            emit = jnp.argmax(lf, axis=-1).astype(buf.dtype)  # (B, C)
+            agree = emit[:, :-1] == chunk[:, 1:]
+            m = jnp.where(jnp.all(agree, axis=1), C - 1,
+                          jnp.argmin(agree, axis=1).astype(jnp.int32))
+        # A frozen row's sample is discarded and its stream does NOT
+        # advance (the stream position counts live chunks only).
+        keys = jnp.where(done[:, None], keys, ks_all[:, 0])
+        # Committed advance: accepted prefix + correction/bonus, cut at
+        # an emitted eos (the eos commits; everything after it in the
+        # chunk is dead), clamped at target.
+        adv = m + 1
+        if eos_id is not None:
+            is_eos = emit == eos_id
+            e = jnp.where(jnp.any(is_eos, axis=1),
+                          jnp.argmax(is_eos, axis=1).astype(jnp.int32),
+                          jnp.int32(C))
+            adv = jnp.minimum(adv, e + 1)
+        adv = jnp.minimum(adv, target - filled)
+        adv = jnp.where(done, 0, adv)
+        # Frozen rows rewrite their last token C times starting at the
+        # (clamped) base: position base is the fixed point, the tail
+        # lands past the row's output span (retire reads only
+        # [prompt, prompt + emitted) and eos-pads the rest).
+        emit = jnp.where(done[:, None],
+                         jnp.broadcast_to(tok[:, None], emit.shape),
+                         emit).astype(buf.dtype)
+        w = jnp.where(done, base, filled)
+        buf = jax.vmap(
+            lambda b, t, p: jax.lax.dynamic_update_slice(b, t, (p,))
+        )(buf, emit, w)
+        live = live + (~done).astype(jnp.int32)
+        drafted = drafted + jnp.where(done, 0, C - 1)
+        accepted = accepted + jnp.where(done, 0, adv - 1)
+        filled = filled + adv
+        done = done | (filled >= target)
+        return (i + 1, buf, filled, done, kv, keys, live, drafted,
+                accepted)
+
+    zeros = jnp.zeros((bsz,), jnp.int32)
+    (iters, buf, filled, done, kv, keys, live, drafted,
+     accepted) = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), buf, filled, done0, kv, keys, zeros,
+                     zeros, zeros))
+    if eos_id is not None:
+        # Same round-boundary re-check as _round_loop: an eos committed
+        # on the last chunk must retire now, not next round.
+        done = done | (buf[brange, filled - 1] == eos_id)
+    return buf, filled, done, kv, iters, live, keys, drafted, accepted
+
+
 class ServingEngine:
     """Continuous-batching engine: ``submit`` -> ``step``/``run``.
 
@@ -252,7 +440,10 @@ class ServingEngine:
                  prefill_chunks_per_round: int = 2,
                  stats: Optional[EngineStats] = None,
                  kv_pages: Optional[int] = None,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 spec_draft_lens: Optional[tuple] = None,
+                 spec_ngram: int = 2,
+                 spec_adaptive: bool = True):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -309,6 +500,52 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_chunks_per_round must be >= 1, got "
                 f"{prefill_chunks_per_round}")
+        # Speculative rounds (docs/serving.md §7, ROADMAP 15):
+        # ``spec_draft_lens`` switches the engine's decode round to
+        # draft+verify chunks (_decode_round_spec[_paged]). The SET of
+        # draft lengths is the compile budget — one executable per
+        # member, prewarmed at init — and the acceptance-adaptive
+        # policy (cost_model.pick_draft_len over the stats EWMA) moves
+        # between members with zero steady-state recompiles.
+        self.spec = spec_draft_lens is not None
+        if self.spec:
+            lens = tuple(sorted({int(c) for c in spec_draft_lens}))
+            if not lens:
+                raise ValueError("spec_draft_lens must be non-empty")
+            if lens[0] < 2:
+                raise ValueError(
+                    f"every spec draft_len must be >= 2 (1 token of "
+                    f"draft + the verify correction), got {lens[0]}")
+            if spec_ngram < 1:
+                raise ValueError(
+                    f"spec_ngram must be >= 1, got {spec_ngram}")
+            if lens[-1] >= cfg.max_len:
+                raise ValueError(
+                    f"max spec draft_len {lens[-1]} must be < max_len "
+                    f"{cfg.max_len}")
+            self.spec_draft_lens = lens
+        else:
+            self.spec_draft_lens = ()
+        self.spec_ngram = int(spec_ngram)
+        self.spec_adaptive = bool(spec_adaptive)
+        # Verify-window overhang: a live row's chunk may write KV/buf up
+        # to draft_len_max - 1 slots past its own target, so submit
+        # tightens the extent check and paged admission reserves the
+        # extra slots (see _reserve_pages).
+        self._spec_overhang = (self.spec_draft_lens[-1] - 1) if self.spec \
+            else 0
+        # Current draft length — the adaptive policy's output, read at
+        # dispatch. Starts at the smallest compiled length (cautious
+        # until acceptance evidence accumulates). Deliberately NOT a
+        # lock-annotated attribute: it is a driver-thread single-writer
+        # scalar like round_idx — debug_snapshot's unlocked read is the
+        # documented racy-by-a-round debug view, and the cross-engine
+        # handoff in spawn_successor runs with the driver quiesced.
+        # The per-request spec_drafted/spec_accepted mirrors ARE lock
+        # state: they live behind the requests dict (annotated with
+        # _submit_lock above) and are only bumped inside step()'s
+        # locked ledger block.
+        self._draft_len = self.spec_draft_lens[0] if self.spec else None
         if prefix_cache is not None and prefix_cache.cfg != cfg:
             raise ValueError(
                 "prefix_cache was built for a different TransformerConfig; "
@@ -361,12 +598,18 @@ class ServingEngine:
             # Paged entry points only: the contiguous round/prefill
             # compiles never happen in this engine, and the copy entry
             # has no paged analogue (hits alias, they don't copy).
-            self.watchdog.register("serving.decode_round_paged",
-                                   _decode_round_paged)
+            # Speculative engines register their round entry AFTER the
+            # init prewarm (end of __init__) so the per-draft-len
+            # compiles land in the baseline, not in round ledgers.
+            if not self.spec:
+                self.watchdog.register("serving.decode_round_paged",
+                                       _decode_round_paged)
             self.watchdog.register("serving.prefill_chunk_into_row_paged",
                                    prefill_chunk_into_row_paged)
         else:
-            self.watchdog.register("serving.decode_round", _decode_round)
+            if not self.spec:
+                self.watchdog.register("serving.decode_round",
+                                       _decode_round)
             self.watchdog.register("serving.prefill_into_row",
                                    prefill_into_row)
             if prefill_chunk is not None:
@@ -387,6 +630,12 @@ class ServingEngine:
         # prices measured rounds against, computed once — decode shapes
         # are static, so the per-iteration prediction is a constant.
         self._decode_flops, _ = cm.decode_step_cost(cfg, batch)
+        # Speculative rounds price per verify CHUNK, draft-len-dependent
+        # (cost_model.spec_round_cost) — one constant per compiled
+        # length, same static-shape argument as above.
+        self._spec_flops = {
+            c: cm.spec_round_cost(cfg, batch, c)[0]
+            for c in self.spec_draft_lens}
         # Pending + active requests ONLY: finished/timed-out requests
         # are returned from step()/run() and dropped here, so a
         # long-running engine holds O(batch + max_pending) requests.
@@ -462,6 +711,48 @@ class ServingEngine:
         # One config event so an offline runlog analysis knows the
         # engine's shape (tools/runlog_report.py reads ``batch`` for its
         # occupancy/stall accounting instead of inferring it).
+        if self.spec:
+            # Prewarm the full draft-length set: one all-done dummy
+            # round per member compiles its executable WITHOUT running
+            # the loop body (done0 all-True short-circuits the
+            # while_loop at zero trips). Donated state is re-threaded
+            # from the results, exactly as a real round does. Registered
+            # with the watchdog only AFTER, so these expected compiles
+            # land in the baseline and every served round — including
+            # the adaptive policy's first switch to each length — is
+            # held to the zero-recompile invariant.
+            with jax.transfer_guard("allow"):
+                all_done = jnp.ones((batch,), bool)
+                for c in self.spec_draft_lens:
+                    if self.paged:
+                        self._buf, _, _, pages_d, *_ = \
+                            _decode_round_spec_paged(
+                                self.params, self.page_pool.pages,
+                                self._buf, jnp.asarray(self._tables),
+                                jnp.asarray(self._filled),
+                                jnp.asarray(self._target), all_done,
+                                jnp.asarray(self._keys), cfg=cfg,
+                                round_steps=round_steps, draft_len=c,
+                                ngram=self.spec_ngram,
+                                temperature=self.temperature,
+                                eos_id=eos_id)
+                        self.page_pool.pages = pages_d
+                    else:
+                        self._buf, _, _, self._cache, *_ = \
+                            _decode_round_spec(
+                                self.params, self._cache, self._buf,
+                                jnp.asarray(self._filled),
+                                jnp.asarray(self._target), all_done,
+                                jnp.asarray(self._keys), cfg=cfg,
+                                round_steps=round_steps, draft_len=c,
+                                ngram=self.spec_ngram,
+                                temperature=self.temperature,
+                                eos_id=eos_id)
+            self.watchdog.register(
+                "serving.decode_round_spec_paged" if self.paged
+                else "serving.decode_round_spec",
+                _decode_round_spec_paged if self.paged
+                else _decode_round_spec)
         self.runlog.emit("engine_start", batch=batch,
                          round_steps=round_steps,
                          prefill_chunk=prefill_chunk,
@@ -470,7 +761,11 @@ class ServingEngine:
                          prefix_cache=prefix_cache is not None,
                          kv_pages=kv_pages,
                          prefix_sharing=(self.paged
-                                         and self.prefix_sharing))
+                                         and self.prefix_sharing),
+                         spec_draft_lens=(list(self.spec_draft_lens)
+                                          if self.spec else None),
+                         spec_ngram=(self.spec_ngram
+                                     if self.spec else None))
 
     # -- submission ---------------------------------------------------
 
@@ -507,21 +802,37 @@ class ServingEngine:
             raise ValueError(f"steps must be >= 1, got {steps}")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
-        if s + steps > self.cfg.max_len:
+        if s + steps + self._spec_overhang > self.cfg.max_len:
+            # Speculative engines tighten the extent check by the
+            # verify-window overhang (draft_len_max - 1): a live row's
+            # last chunk may write that many slots past its target, and
+            # the slots must exist (the unclamped base argument in
+            # _spec_round_loop leans on exactly this bound).
+            extra = (f" + draft overhang {self._spec_overhang}"
+                     if self._spec_overhang else "")
             raise ValueError(
-                f"prompt {s} + steps {steps} exceeds max_len "
+                f"prompt {s} + steps {steps}{extra} exceeds max_len "
                 f"{self.cfg.max_len}")
+        if self.spec and s < self.spec_ngram:
+            raise ValueError(
+                f"prompt length {s} < spec_ngram {self.spec_ngram}: "
+                f"the prompt-lookup drafter needs at least one full "
+                f"n-gram of committed history")
         if pad_prompt_len(s) > self.cfg.max_len:
             raise ValueError(
                 f"padded prompt {pad_prompt_len(s)} exceeds max_len "
                 f"{self.cfg.max_len}")
-        if self.paged and -(-(s + steps) // PAGE) > self.kv_pages:
+        if self.paged and -(-(s + steps + self._spec_overhang)
+                            // PAGE) > self.kv_pages:
             # Hopeless even against an EMPTY pool: fail at submit like
-            # the max_len check, not by queuing forever.
+            # the max_len check, not by queuing forever. Speculative
+            # reservations include the overhang (see _reserve_pages).
             raise ValueError(
-                f"request needs {-(-(s + steps) // PAGE)} KV pages "
-                f"> pool size {self.kv_pages} (prompt {s} + steps "
-                f"{steps} at {PAGE} tokens/page)")
+                f"request needs "
+                f"{-(-(s + steps + self._spec_overhang) // PAGE)} KV "
+                f"pages > pool size {self.kv_pages} (prompt {s} + "
+                f"steps {steps} + overhang {self._spec_overhang} at "
+                f"{PAGE} tokens/page)")
         now = time.perf_counter()
         with self._submit_lock:
             if request_id is None:
@@ -725,7 +1036,12 @@ class ServingEngine:
         entry_pages, hit = (None, 0)
         if self.prefix_index is not None:
             entry_pages, hit = self.prefix_index.lookup(req.prompt)
-        n_total = -(-(req.prompt_len + req.steps) // PAGE)
+        # Speculative engines reserve the verify-window overhang too
+        # (draft_len_max - 1 slots past target): the last chunk's write
+        # must land on a page this row OWNS, never through a sink or a
+        # neighbor's entry.
+        n_total = -(-(req.prompt_len + req.steps
+                      + self._spec_overhang) // PAGE)
         n_alias = hit // PAGE
         need = n_total - n_alias
         if hit:
@@ -753,7 +1069,8 @@ class ServingEngine:
         chunks [0, hit/PAGE), fresh private pages up to the reservation,
         the write sink (0) beyond it. This IS the paged admission's
         storage work — no KV bytes move."""
-        n_total = -(-(req.prompt_len + req.steps) // PAGE)
+        n_total = -(-(req.prompt_len + req.steps
+                      + self._spec_overhang) // PAGE)  # matches _reserve_pages
         held: List[int] = []
         if hit:
             # Same blame/fault site as the contiguous prefix copy: a
@@ -1084,9 +1401,43 @@ class ServingEngine:
             done0 = ~self._active | (self._filled >= self._target)
             t_dec0 = time.perf_counter()
             faults.check("decode_round", round_idx=self.round_idx)
+            # The draft length this round dispatches with — captured
+            # before the round so the post-round ledger and the runlog
+            # bill the length that actually RAN (the adaptive pick
+            # below may move _draft_len for the NEXT round).
+            c_used = self._draft_len
+            drafted = accepted = None
             with self.tracer.span("serving.decode_round", scope=False,
                                   occupied=self.slots.n_occupied):
-                if self.paged:
+                if self.spec and self.paged:
+                    (self._buf, filled_d, done_d, pages_d, iters_d,
+                     live_d, keys_d, drafted_d, accepted_d) = \
+                        _decode_round_spec_paged(
+                            self.params, self.page_pool.pages, self._buf,
+                            jnp.asarray(self._tables),
+                            jnp.asarray(self._filled),
+                            jnp.asarray(self._target),
+                            jnp.asarray(done0), jnp.asarray(self._keys),
+                            cfg=self.cfg,
+                            round_steps=self.round_steps,
+                            draft_len=c_used, ngram=self.spec_ngram,
+                            temperature=self.temperature,
+                            eos_id=self.eos_id)
+                    self.page_pool.pages = pages_d
+                elif self.spec:
+                    (self._buf, filled_d, done_d, self._cache, iters_d,
+                     live_d, keys_d, drafted_d, accepted_d) = \
+                        _decode_round_spec(
+                            self.params, self._cache, self._buf,
+                            jnp.asarray(self._filled),
+                            jnp.asarray(self._target),
+                            jnp.asarray(done0), jnp.asarray(self._keys),
+                            cfg=self.cfg,
+                            round_steps=self.round_steps,
+                            draft_len=c_used, ngram=self.spec_ngram,
+                            temperature=self.temperature,
+                            eos_id=self.eos_id)
+                elif self.paged:
                     # The paged round: same scheduling body, KV through
                     # the page pool + per-row tables (tables are a
                     # small explicit push; pages are RESERVED at
@@ -1114,18 +1465,28 @@ class ServingEngine:
                             round_steps=self.round_steps,
                             temperature=self.temperature,
                             eos_id=self.eos_id)
-                filled, done, iters, live, keys = jax.device_get(
-                    (filled_d, done_d, iters_d, live_d, keys_d))
+                if self.spec:
+                    (filled, done, iters, live, keys, drafted,
+                     accepted) = jax.device_get(
+                        (filled_d, done_d, iters_d, live_d, keys_d,
+                         drafted_d, accepted_d))
+                else:
+                    filled, done, iters, live, keys = jax.device_get(
+                        (filled_d, done_d, iters_d, live_d, keys_d))
             filled = faults.corrupt("decode_round", filled,
                                     round_idx=self.round_idx)
             # The device_get above fences the round, so this host delta
             # covers dispatch + execution — the measured side the drift
             # ledger confronts the decode cost model with. All-idle
             # rounds (iters == 0) carry no model work and are skipped.
+            # Speculative rounds are priced per verify CHUNK at the
+            # draft length that ran.
             decode_s = time.perf_counter() - t_dec0
             if int(iters):
+                flops_per_iter = self._spec_flops[c_used] if self.spec \
+                    else self._decode_flops
                 self.stats.calibration.record(
-                    "decode", int(iters) * self._decode_flops, decode_s)
+                    "decode", int(iters) * flops_per_iter, decode_s)
             self._filled = np.array(filled, np.int32)  # writable copy
             # Fetch sanity: every legal row sits in [1, max_len]
             # (free rows park at 1, chunked prefills at max_len, live
@@ -1143,12 +1504,38 @@ class ServingEngine:
             self._keys = np.array(keys, np.uint32)
             with self._submit_lock:  # concurrent submit() inserts
                 for row in self.slots.occupied_rows():
-                    self.requests[self.slots.owner_of(row)].live_iters \
-                        += int(live[row])
+                    req = self.requests[self.slots.owner_of(row)]
+                    req.live_iters += int(live[row])
+                    if self.spec:
+                        # Per-request acceptance ledger: the exact
+                        # identity emitted == 1 + live_iters +
+                        # spec_accepted rides on these two counters
+                        # (tests/test_serving_spec.py pins it).
+                        req.spec_drafted += int(drafted[row])
+                        req.spec_accepted += int(accepted[row])
             occupied = self.slots.n_occupied  # pre-retire, as decoded
             self.stats.record_round(
                 self.round_idx, int(iters), occupied=occupied,
                 live_iters=int(live.sum()))
+            spec_fields = {}
+            if self.spec:
+                d_sum = int(drafted.sum())
+                a_sum = int(accepted.sum())
+                if d_sum:
+                    self.stats.record_spec_round(d_sum, a_sum, c_used)
+                spec_fields = dict(
+                    draft_len=c_used, spec_drafted=d_sum,
+                    spec_accepted=a_sum,
+                    accept_rate=(round(a_sum / d_sum, 4) if d_sum
+                                 else 0.0))
+                if self.spec_adaptive and d_sum:
+                    # Pick NEXT round's draft length from the measured
+                    # acceptance EWMA over the compiled set — a pure
+                    # host decision over prewarmed executables, so the
+                    # switch costs nothing on device.
+                    self._draft_len = cm.pick_draft_len(
+                        self.stats.spec_accept_rate(),
+                        self.spec_draft_lens, self.cfg, self.batch)
             finished = self._retire(self._filled, np.asarray(done))
         # Per-round compile ledger: warmup rounds log their expected
         # compiles; a steady-state round logging ANY compile is the
@@ -1194,7 +1581,7 @@ class ServingEngine:
             round_s=round(time.perf_counter() - t_round0, 6),
             decode_s=round(decode_s, 6),
             drift_decode=round(self.stats.calibration.drift("decode"), 4),
-            **page_fields)
+            **page_fields, **spec_fields)
         self.round_idx += 1
         # Ownership transfers through the return below; the crash-
         # consistency copy is only needed while a raise could still
@@ -1305,9 +1692,19 @@ class ServingEngine:
             prefix_cache=new_pc,
             prefill_chunks_per_round=self.prefill_chunks_per_round,
             stats=self.stats, kv_pages=self.kv_pages,
-            prefix_sharing=self.prefix_sharing)
+            prefix_sharing=self.prefix_sharing,
+            spec_draft_lens=(self.spec_draft_lens if self.spec
+                             else None),
+            spec_ngram=self.spec_ngram,
+            spec_adaptive=self.spec_adaptive)
         eng._next_id = self._next_id
         eng.round_idx = self.round_idx + 1
+        if self.spec:
+            # The adaptive policy's state carries over: the successor
+            # resumes at the predecessor's draft length (the shared
+            # stats ledger already carries the acceptance EWMA it was
+            # picked from), not back at the cautious floor.
+            eng._draft_len = self._draft_len
         if self.queue.closed:
             eng.queue.close()
         return eng
@@ -1372,6 +1769,14 @@ class ServingEngine:
             "stats": self.stats.summary(),
             "cost_model_drift": self.stats.calibration.summary(),
         }
+        if self.spec:
+            out["spec"] = {
+                "draft_lens": list(self.spec_draft_lens),
+                "draft_len": self._draft_len,
+                "ngram": self.spec_ngram,
+                "adaptive": self.spec_adaptive,
+                "accept_rate": round(self.stats.spec_accept_rate(), 4),
+            }
         if self.prefix_cache is not None:
             out["prefix_pool"] = self.prefix_cache.summary()
         if self.paged:

@@ -95,6 +95,14 @@ class Request:
     delivered_time: float = 0.0  # frontend fanout done (0: engine-only)
     live_iters: int = 0  # decode iterations this request was live for
     emitted: int = 0  # tokens actually generated (< steps if eos fired)
+    # Speculative-round acceptance ledger (engine._spec_round_loop,
+    # docs/serving.md §7): drafted counts the draft positions this
+    # request's live verify chunks carried (draft_len - 1 each);
+    # accepted counts the ones that committed. The chunk's non-draft
+    # token is billed to live_iters, so emitted == 1 + live_iters +
+    # spec_accepted holds exactly for speculative engines.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
     # pending -> active -> done | timeout; "poisoned" is the supervisor's
     # terminal quarantine verdict (serving/frontend.py, docs/robustness
     # .md): implicated in ``poison_after`` consecutive engine crashes,
@@ -183,6 +191,8 @@ class Request:
         self.delivered_time = 0.0
         self.live_iters = 0
         self.emitted = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self.status = "pending"
         self.tokens = None
 

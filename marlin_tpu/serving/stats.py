@@ -66,6 +66,11 @@ def request_stats(req) -> dict:
         "live_iters": req.live_iters,
         "phases": req.phases(),
     }
+    if req.spec_drafted:
+        # Speculative engines only: the request's own acceptance ledger
+        # (emitted == 1 + live_iters + spec_accepted holds exactly).
+        out["spec_drafted"] = req.spec_drafted
+        out["spec_accepted"] = req.spec_accepted
     if req.status == "done":
         dt = max(req.finish_time - req.admit_time, 1e-9)
         out["decode_rounds"] = req.finish_round - req.admit_round + 1
@@ -164,6 +169,16 @@ class EngineStats:
     # span restarts.
     n_recovered: int = 0    # requests requeued into a successor engine
     n_quarantined: int = 0  # requests failed closed as poisoned
+    # Speculative-round acceptance ledger (docs/serving.md §7; zero in
+    # non-speculative engines). Totals are lifetime-exact; the EWMA
+    # (CostCalibration's alpha discipline) is what the acceptance-
+    # adaptive draft-length policy reads — recent rounds dominate, so
+    # the policy tracks the workload's CURRENT draftability rather than
+    # a stale lifetime average. Spans engine incarnations like every
+    # other total here.
+    n_spec_drafted: int = 0
+    n_spec_accepted: int = 0
+    spec_accept_ewma: Optional[float] = None
     rounds: deque = field(
         default_factory=lambda: deque(maxlen=HISTORY))  # guarded-by: _lock
     completed: deque = field(
@@ -305,6 +320,60 @@ class EngineStats:
             self.registry.gauge("serving_utilization").set(
                 self.utilization())
 
+    # EWMA weight for the per-round acceptance rate — the same recency
+    # constant as CostCalibration's drift ledger (utils/cost_model.py).
+    SPEC_ACCEPT_ALPHA = 0.2
+
+    def record_spec_round(self, drafted: int, accepted: int,
+                          draft_len: int) -> None:
+        """One speculative round's acceptance outcome: ``drafted`` draft
+        positions carried by live verify chunks, ``accepted`` of them
+        committed, at the round's ``draft_len``. Feeds the lifetime
+        totals, the policy EWMA, and the metric mirrors
+        (``serving_spec_drafted_total``/``serving_spec_accepted_total``
+        counters, ``serving_spec_accept_rate``/``serving_spec_draft_len``
+        gauges — docs/observability.md)."""
+        self.n_spec_drafted += drafted
+        self.n_spec_accepted += accepted
+        rate = accepted / drafted if drafted else 0.0
+        if self.spec_accept_ewma is None:
+            self.spec_accept_ewma = rate
+        else:
+            a = self.SPEC_ACCEPT_ALPHA
+            self.spec_accept_ewma = a * rate \
+                + (1.0 - a) * self.spec_accept_ewma
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_spec_drafted_total",
+                help="draft positions carried by live speculative "
+                     "verify chunks (docs/serving.md section 7)").inc(
+                drafted)
+            self.registry.counter(
+                "serving_spec_accepted_total",
+                help="draft positions committed by speculative "
+                     "verification").inc(accepted)
+            self.registry.gauge(
+                "serving_spec_accept_rate",
+                help="EWMA draft-acceptance rate the adaptive "
+                     "draft-length policy reads").set(
+                round(self.spec_accept_rate(), 4))
+            self.registry.gauge(
+                "serving_spec_draft_len",
+                help="draft length the last speculative round ran "
+                     "with").set(draft_len)
+
+    def spec_accept_rate(self) -> float:
+        """The acceptance rate the draft-length policy consumes: the
+        round EWMA once one speculative round has run, else the lifetime
+        ratio (a successor engine inheriting totals but no EWMA — not
+        reachable today, the EWMA rides the shared stats object — would
+        still start informed), else 0.0 (cautious floor)."""
+        if self.spec_accept_ewma is not None:
+            return self.spec_accept_ewma
+        if self.n_spec_drafted:
+            return self.n_spec_accepted / self.n_spec_drafted
+        return 0.0
+
     # The contiguous phases mirrored into serving_phase_seconds; the
     # sub-attributions (prefill_dispatch, prefix_copy) and the
     # frontend's stream_delivery share the family but are observed at
@@ -438,6 +507,14 @@ class EngineStats:
                     self.reclaimed_prefill_flops / 1e9, 4),
                 "admission_copy_bytes": self.admission_copy_bytes,
                 "zero_copy_hits": self.n_zero_copy_hits,
+            })
+        if self.n_spec_drafted:
+            out.update({
+                "spec_drafted": self.n_spec_drafted,
+                "spec_accepted": self.n_spec_accepted,
+                "spec_accept_rate": round(self.spec_accept_rate(), 4),
+                "spec_accept_lifetime": round(
+                    self.n_spec_accepted / self.n_spec_drafted, 4),
             })
         if self.page_pool is not None:
             out["kv_pages"] = self.page_pool.summary()

@@ -49,6 +49,9 @@ __all__ = [
     "run_spmm_trend_sweep", "SPMM_TREND_GRID",
     "run_spmm_crossover_sweep", "SPMM_CROSSOVER_SLOTS",
     "derive_ell_density_max",
+    "spec_round_cost", "pick_draft_len",
+    "run_svd_mode_crossover_sweep", "SVD_CROSSOVER_GRID",
+    "derive_svd_local_eigs_max",
     "CostCalibration",
 ]
 
@@ -284,6 +287,73 @@ def admission_cost(cfg, prompt_len: int, hit_len: int = 0,
         + tail * pos_bytes \
         + 2.0 * hit_len * pos_bytes  # pool read + row write of the copy
     return flops, float(byts)
+
+
+def spec_round_cost(cfg, batch: int, draft_len: int,
+                    param_itemsize: int = 4, cache_itemsize: int = 4,
+                    quant_weights: bool = False) -> Tuple[float, float]:
+    """(flops, bytes) of ONE speculative verify-chunk iteration at batch
+    B and chunk width C = ``draft_len`` (serving/engine._spec_round_loop:
+    every row's C-token draft verified in one decode_chunk dispatch).
+
+    The Leviathan-style win, priced: FLOPs scale ~C-fold (every chunk
+    position pays the matmuls, and each attends the full cache), but the
+    dominant byte terms do NOT — the parameters and the KV cache stream
+    ONCE per chunk regardless of C; only the written-slot share grows
+    C-fold. On the memory-bound decode roofline the per-iteration cost
+    is nearly flat in C while the expected committed tokens grow with
+    acceptance — which is exactly the ratio :func:`pick_draft_len`
+    maximizes. Int8 pricing conventions are :func:`decode_step_cost`'s.
+    """
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    flops1, _ = decode_step_cost(cfg, batch, param_itemsize=param_itemsize,
+                                 cache_itemsize=cache_itemsize,
+                                 quant_weights=quant_weights)
+    dh = cfg.d_model // cfg.n_heads
+    cache_len = min(cfg.window, cfg.max_len) if cfg.window else cfg.max_len
+    cache_elems = 2 * cfg.n_layers * batch * cache_len * cfg.kv_heads * dh
+    if getattr(cfg, "kv_quant", ""):
+        cache_bytes = cache_elems * 1.0 + (cache_elems // dh) * 4.0
+    else:
+        cache_bytes = float(cache_elems * cache_itemsize)
+    flops = flops1 * draft_len
+    if quant_weights:
+        q_elems, n_scales = quantized_weight_counts(cfg)
+        params = transformer_param_count(cfg)
+        p_bytes = q_elems * 1.0 \
+            + (n_scales + params - q_elems) * float(param_itemsize)
+    else:
+        p_bytes = float(transformer_param_count(cfg) * param_itemsize)
+    byts = p_bytes + cache_bytes \
+        + draft_len * cache_bytes / cache_len  # C written slots
+    return float(flops), float(byts)
+
+
+def pick_draft_len(accept_rate: float, draft_lens, cfg, batch: int,
+                   **cost_kwargs) -> int:
+    """The acceptance-adaptive draft-length policy: over the engine's
+    STATIC set of compiled draft lengths, pick the C maximizing expected
+    committed tokens per streamed byte at the measured per-position
+    acceptance rate alpha — E[tokens] = sum_{k<C} alpha^k (the run-length
+    expectation of the accept-prefix-plus-correction advance), bytes
+    from :func:`spec_round_cost` (decode is HBM-bound, so bytes are the
+    denominator that predicts wall-clock). Ties break toward the
+    SMALLEST C (less wasted verify work when the model is wrong about
+    being right). The set is static so the engine compiles each C once
+    at init and recompiles nothing as the policy moves."""
+    lens = sorted({int(c) for c in draft_lens})
+    if not lens:
+        raise ValueError("empty draft_lens")
+    a = min(max(float(accept_rate), 0.0), 0.999)
+    best, best_v = lens[0], -1.0
+    for c in lens:
+        _, byts = spec_round_cost(cfg, batch, c, **cost_kwargs)
+        exp_tokens = (1.0 - a ** c) / (1.0 - a)
+        v = exp_tokens / byts
+        if v > best_v * (1.0 + 1e-9):
+            best, best_v = c, v
+    return best
 
 
 def ce_logits_bytes(batch: int, seq: int, vocab: int,
@@ -1002,6 +1072,80 @@ def derive_ell_density_max(points) -> float:
         return float(_math.exp(
             _math.log(d0) + t * (_math.log(d1) - _math.log(d0))))
     return pts[-1]["density"]  # ELL wins across the whole sweep
+
+
+# SVD local-eigs vs dist-eigs crossover (ROADMAP item 8): auto mode's
+# boundary between "pull the (n, n) Gramian to the host and Lanczos on
+# numpy" and "Lanczos on the distributed Gramian matvec" was a
+# hard-coded n <= 15000 inherited from the reference. The sweep times
+# BOTH arms over an n-grid on the live backend; the ratio=1 crossing
+# becomes MarlinConfig.svd_local_eigs_max, data-backed like the ELL
+# density constant above. The bench trend line reports the measured
+# points so the committed constant stays auditable.
+SVD_CROSSOVER_GRID = (128, 256, 512, 1024)
+
+
+def run_svd_mode_crossover_sweep(grid=SVD_CROSSOVER_GRID, k: int = 6,
+                                 reps: int = 3, rows_factor: int = 2):
+    """Measure local-eigs vs dist-eigs SVD wall-clock over an n-grid
+    (square-ish (rows_factor * n, n) operands); returns per-point
+    ``{n, k, local_s, dist_s, local_over_dist}``. Feed the points to
+    :func:`derive_svd_local_eigs_max` for the crossover n. ``k`` stays
+    <= n/2 across the grid so auto mode's local-svd shortcut never
+    applies to these shapes."""
+    from . import random as mrand
+
+    out = []
+    for n in grid:
+        if not 0 < k <= n // 2:
+            raise ValueError(
+                f"k={k} must be in (0, n/2] across the grid (n={n})")
+        a = mrand.random_den_vec_matrix(rows_factor * n, n, seed=11)
+        local_s = measure_wallclock(
+            lambda a=a: a.compute_svd(k, compute_u=False,
+                                      mode="local-eigs", tol=1e-6).s,
+            reps=reps)
+        dist_s = measure_wallclock(
+            lambda a=a: a.compute_svd(k, compute_u=False,
+                                      mode="dist-eigs", tol=1e-6).s,
+            reps=reps)
+        out.append({"n": n, "k": k, "local_s": local_s, "dist_s": dist_s,
+                    "local_over_dist": local_s / max(dist_s, 1e-12)})
+    return out
+
+
+def derive_svd_local_eigs_max(points) -> int:
+    """Data-backed ``svd_local_eigs_max`` from a crossover sweep: the n
+    where ``local_over_dist`` crosses 1.0 (local-eigs cheaper below it,
+    dist-eigs above), log-interpolated between the last local-winning
+    point and the first dist-winning one — the same derivation contract
+    as :func:`derive_ell_density_max`. Clamps to the grid: dist-eigs
+    winning even at the floor returns half the lowest n (local-eigs only
+    below the sweep); local-eigs winning everywhere returns the highest
+    measured n (the crossover is above the sweep — stay conservative
+    rather than extrapolate). Points need not be sorted; ratios <= 0 are
+    rejected."""
+    import math as _math
+
+    pts = sorted(points, key=lambda p: p["n"])
+    if not pts:
+        raise ValueError("empty crossover sweep")
+    if any(p["local_over_dist"] <= 0 for p in pts):
+        raise ValueError("local_over_dist must be positive")
+    if pts[0]["local_over_dist"] >= 1.0:  # dist wins even at the floor
+        return max(1, int(pts[0]["n"] // 2))
+    last_win = pts[0]
+    for p in pts[1:]:
+        if p["local_over_dist"] < 1.0:
+            last_win = p
+            continue
+        # log-log interpolation of the ratio=1 crossing in n.
+        n0, r0 = last_win["n"], last_win["local_over_dist"]
+        n1, r1 = p["n"], p["local_over_dist"]
+        t = (0.0 - _math.log(r0)) / (_math.log(r1) - _math.log(r0))
+        return int(round(_math.exp(
+            _math.log(n0) + t * (_math.log(n1) - _math.log(n0)))))
+    return int(pts[-1]["n"])  # local-eigs wins across the whole sweep
 
 
 # ---------------------------------------------------------------------------

@@ -482,3 +482,38 @@ class TestEllDensityDerivation:
         with pytest.raises(ValueError, match="positive"):
             cm.derive_ell_density_max(
                 [{"density": 1e-3, "ell_over_dense": 0.0}])
+
+
+class TestSvdLocalEigsDerivation:
+    """derive_svd_local_eigs_max: the data-backed form of
+    MarlinConfig.svd_local_eigs_max (ROADMAP item 8), same derivation
+    contract as the ELL density constant above."""
+
+    def test_interpolates_the_ratio_one_crossing(self):
+        pts = [{"n": 256, "local_over_dist": 0.25},
+               {"n": 512, "local_over_dist": 0.5},
+               {"n": 1024, "local_over_dist": 2.0}]
+        d = cm.derive_svd_local_eigs_max(pts)
+        # log-log interpolation: ratio 0.5 -> 2.0 crosses 1 exactly
+        # halfway through the log-n span 512 -> 1024.
+        assert d == round(512 * 2 ** 0.5)
+
+    def test_clamps_when_one_arm_wins_everywhere(self):
+        local = [{"n": 256, "local_over_dist": 0.5},
+                 {"n": 512, "local_over_dist": 0.9}]
+        assert cm.derive_svd_local_eigs_max(local) == 512
+        dist = [{"n": 128, "local_over_dist": 1.5}]
+        assert cm.derive_svd_local_eigs_max(dist) == 64
+
+    def test_points_need_not_be_sorted(self):
+        pts = [{"n": 1024, "local_over_dist": 2.0},
+               {"n": 256, "local_over_dist": 0.5}]
+        assert cm.derive_svd_local_eigs_max(pts) == \
+            cm.derive_svd_local_eigs_max(list(reversed(pts)))
+
+    def test_rejects_degenerate_input(self):
+        with pytest.raises(ValueError, match="empty"):
+            cm.derive_svd_local_eigs_max([])
+        with pytest.raises(ValueError, match="positive"):
+            cm.derive_svd_local_eigs_max(
+                [{"n": 128, "local_over_dist": 0.0}])

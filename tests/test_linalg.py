@@ -272,6 +272,64 @@ def _best_rank_k(a, k):
     return u[:, :k] @ np.diag(s[:k]) @ vt[:k]
 
 
+class _CountingMat:
+    """Minimal ``compute_svd`` operand with per-arm call counters: a
+    host Gramian behind both the local (``compute_gramian_matrix``) and
+    distributed (``multiply_gramian_matrix_by``) interfaces, so a test
+    can pin WHICH arm auto mode dispatched without timing anything."""
+
+    def __init__(self, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        b = rng.standard_normal((2 * n, n))
+        self._g = b.T @ b
+        self.num_cols = n
+        self.gramian_calls = 0
+        self.dist_matvecs = 0
+
+    def compute_gramian_matrix(self):
+        self.gramian_calls += 1
+        return self._g
+
+    def multiply_gramian_matrix_by(self, x):
+        self.dist_matvecs += 1
+        return self._g @ x
+
+
+class TestSVDAutoModeConstant:
+    """Auto mode's local-vs-dist-eigs boundary reads
+    ``MarlinConfig.svd_local_eigs_max`` (ROADMAP item 8): a measured
+    policy constant (trend harness: ``run_svd_mode_crossover_sweep`` ->
+    ``derive_svd_local_eigs_max``), not the reference's hard-coded
+    15000. n=200 with k=4 dodges both local-svd shortcuts (n >= 100,
+    k <= n/2), so the dispatch is purely the config boundary."""
+
+    def test_default_constant_keeps_small_n_local(self):
+        m = _CountingMat()
+        s = compute_svd(m, 4, compute_u=False, tol=1e-8).s
+        assert m.gramian_calls == 1 and m.dist_matvecs == 0
+        assert s.shape == (4,)
+        np.testing.assert_allclose(
+            s, np.sqrt(np.linalg.eigvalsh(m._g)[::-1][:4]), rtol=1e-6)
+
+    def test_override_routes_to_dist_eigs(self):
+        from marlin_tpu.config import config_override
+
+        m = _CountingMat()
+        with config_override(svd_local_eigs_max=100):
+            s = compute_svd(m, 4, compute_u=False, tol=1e-8).s
+        assert m.gramian_calls == 0 and m.dist_matvecs > 0
+        np.testing.assert_allclose(
+            s, np.sqrt(np.linalg.eigvalsh(m._g)[::-1][:4]), rtol=1e-6)
+
+    def test_boundary_is_inclusive(self):
+        from marlin_tpu.config import config_override
+
+        m = _CountingMat()
+        with config_override(svd_local_eigs_max=m.num_cols):
+            compute_svd(m, 4, compute_u=False, tol=1e-8)
+        assert m.gramian_calls == 1 and m.dist_matvecs == 0
+
+
 class TestDeviceSweep:
     """Device-resident Lanczos (matvec_jax chunked recurrence) vs host sweep."""
 

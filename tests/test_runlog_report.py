@@ -287,6 +287,64 @@ class TestSyntheticRunlogs:
         assert [a for a in report3["anomalies"]
                 if a["kind"] == "queue_stall"]
 
+    def test_spec_rounds_narrated_and_low_acceptance_is_legal(
+            self, rr, tmp_path):
+        # Speculative rounds (docs/serving.md §7) carry the
+        # draft/verify ledger: the report narrates totals, the
+        # acceptance trajectory, and the draft lengths the adaptive
+        # policy ran. A ZERO-acceptance round is legal steady state —
+        # the drafter guessed badly, the verify pass still emitted one
+        # token per live row — so it must never be flagged.
+        events = _clean_events()
+        for ev in events:
+            if ev["kind"] != "round":
+                continue
+            if ev["round"] == 0:
+                ev.update(draft_len=4, spec_drafted=24,
+                          spec_accepted=12, accept_rate=0.5)
+            else:
+                ev.update(draft_len=6, spec_drafted=30,
+                          spec_accepted=0, accept_rate=0.0)
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+        sp = report["rounds"]["speculative"]
+        assert sp["n_spec_rounds"] == 2
+        assert sp["drafted_total"] == 54
+        assert sp["accepted_total"] == 12
+        assert sp["accept_rate_overall"] == pytest.approx(12 / 54,
+                                                          abs=1e-4)
+        assert sp["accept_rate_mean"] == pytest.approx(0.25)
+        assert sp["accept_rate_min"] == 0.0
+        assert sp["accept_rate_last"] == 0.0
+        assert sp["draft_lens"] == [4, 6]
+        assert sp["draft_len_last"] == 6
+        assert "speculative: 2 spec round(s)" in rr._human(report)
+
+    def test_genuine_stall_in_spec_log_is_still_flagged(self, rr,
+                                                        tmp_path):
+        # The other direction: low acceptance must not blind the stall
+        # detector — a round pair that sits on ready work with free
+        # rows inside a spec log is still a queue_stall.
+        events = _clean_events()
+        stall_pair = [
+            {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "draft_len": 4, "spec_drafted": 12, "spec_accepted": 0,
+             "accept_rate": 0.0},
+            {"kind": "round", "t": 0.107, "round": 3, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "draft_len": 4, "spec_drafted": 12, "spec_accepted": 0,
+             "accept_rate": 0.0},
+        ]
+        events[-1:-1] = stall_pair
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert [a for a in report["anomalies"]
+                if a["kind"] == "queue_stall"], report["anomalies"]
+
     def test_unresolved_request_only_in_sealed_logs(self, rr, tmp_path):
         events = _clean_events()
         orphan = {"kind": "submit", "t": 0.012, "request_id": 9,

@@ -1,0 +1,160 @@
+"""Evidence tests on the COMMITTED tiny checkpoint (ROADMAP item 3,
+data/tiny_lm — trained by tools/train_tiny_lm.py on the CPU mesh).
+
+Before this checkpoint existed, the speculative-acceptance and
+int8-drift claims were measured on RANDOM params, where "acceptance"
+is the ~1/vocab floor and "drift" is vacuous (no signal to drift
+from). These tests re-base both claims on real trained weights:
+
+* DRAFTABILITY — the model actually learned "continue the cycle", so
+  the prompt-lookup drafter earns a real acceptance rate on patterned
+  prompts (tokens/verify-chunk well above the 1.x no-acceptance
+  floor), with spec == greedy bit-exact throughout.
+* INT8 DRIFT — weight-quantized (models/quant.py) and int8-KV-cache
+  greedy generations track the float32 master's tokens at >= 0.95
+  match on the learned distribution — a claim random params cannot
+  test (argmax over noise is chaos under any rounding).
+* SERVING — the speculative serving round (docs/serving.md §7) earns
+  a measured lifetime acceptance >= 0.2 on this checkpoint while
+  staying bit-exact vs the non-spec engine; `bench.py --config
+  serving_spec` measures the wall-clock speedup on the same weights.
+
+Every bound here was measured on the committed checkpoint (cycle
+match 1.0, best tokens/chunk 5.7, int8 match 1.0, serving lifetime
+acceptance 0.30) and pinned with slack — a retrained checkpoint that
+regresses below these floors should fail loudly, not slide through.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from marlin_tpu.models import (TransformerConfig, generate,
+                               generate_speculative, init_params)
+from marlin_tpu.models.quant import quantize_params_int8
+from marlin_tpu.serving import ServingEngine
+from marlin_tpu.utils import checkpoint
+
+_CKPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data", "tiny_lm")
+
+# Held-out cyclic patterns (none of these exact base patterns is
+# guaranteed seen in training — the data is random per-row cycles —
+# but the TASK, "continue the cycle", is what the model learned).
+_PATTERNS = ([5, 9, 17, 3], [7, 2, 11], [4, 4, 9, 21, 6],
+             [8, 30, 2, 19])
+_STEPS = 40
+
+
+@pytest.fixture(scope="module")
+def ckpt():
+    meta = json.load(open(os.path.join(_CKPT, "tiny_lm.json")))
+    cfg = TransformerConfig(**meta["cfg"])
+    tmpl = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                        init_params(cfg, seed=0))
+    params = checkpoint.load_pytree(os.path.join(_CKPT, "params"), tmpl)
+    return params, cfg, meta
+
+
+def _prompts():
+    return [np.tile(np.array(p, np.int32), 12)[:20] for p in _PATTERNS]
+
+
+class TestCheckpointProvenance:
+    def test_sidecar_matches_the_test_family_shape(self, ckpt):
+        _, cfg, meta = ckpt
+        # The exact _cfg() shape the serving/speculative suites pin —
+        # so this checkpoint is a drop-in for any of those tests.
+        assert (cfg.vocab, cfg.d_model, cfg.n_heads, cfg.n_layers,
+                cfg.d_ff, cfg.max_len) == (48, 32, 2, 2, 64, 96)
+        assert meta["final_loss"] < 1.5  # converged (started ~3.9)
+        assert meta["probe"]["cycle_match"] >= 0.9
+        assert meta["probe"]["spec_tokens_per_chunk"] >= 4.0
+
+    def test_greedy_cycle_continuation(self, ckpt):
+        params, cfg, _ = ckpt
+        # The training script's own probe, re-run on the loaded
+        # checkpoint: the sidecar's claims must be reproducible from
+        # the committed bytes, not just recorded.
+        probe = np.tile(np.array([5, 9, 17, 3], np.int32), 8)[:20]
+        out = np.asarray(generate(params, probe[None], _STEPS, cfg,
+                                  temperature=0.0))
+        want = np.tile(np.array([5, 9, 17, 3], np.int32),
+                       16)[20:20 + _STEPS]
+        assert float((out[0] == want).mean()) >= 0.9
+
+
+class TestSpeculativeAcceptanceEvidence:
+    def test_real_acceptance_on_patterned_prompts(self, ckpt):
+        params, cfg, _ = ckpt
+        # tokens/verify-chunk = the speculative loop's own acceptance
+        # ledger. No-acceptance floor is ~1.1 (every chunk advances at
+        # least the corrected token); measured on the committed
+        # checkpoint: 5.71 / 5.71 / 2.35 / 2.50. Pinned: every pattern
+        # clears 2.0 (real drafts land), the short-period ones clear
+        # 4.0 (most of an 8-token draft accepted).
+        per_chunk = []
+        for p in _prompts():
+            g = np.asarray(generate(params, p[None], _STEPS, cfg,
+                                    temperature=0.0))
+            sp, st = generate_speculative(params, p[None], _STEPS, cfg,
+                                          draft_len=8, return_stats=True)
+            assert np.array_equal(np.asarray(sp), g)  # spec == greedy
+            chunks = int(np.asarray(st["verify_chunks"])[0])
+            per_chunk.append(_STEPS / chunks)
+        assert all(r >= 2.0 for r in per_chunk), per_chunk
+        assert max(per_chunk) >= 4.0, per_chunk
+
+
+class TestInt8DriftEvidence:
+    def test_weight_quant_tracks_master_tokens(self, ckpt):
+        params, cfg, _ = ckpt
+        qp = quantize_params_int8(params)
+        for p in _prompts():
+            g = np.asarray(generate(params, p[None], _STEPS, cfg,
+                                    temperature=0.0))
+            q = np.asarray(generate(qp, p[None], _STEPS, cfg,
+                                    temperature=0.0))
+            assert float((g == q).mean()) >= 0.95  # measured 1.0
+
+    def test_int8_kv_cache_tracks_master_tokens(self, ckpt):
+        params, cfg, meta = ckpt
+        cfg8 = TransformerConfig(**{**meta["cfg"], "kv_quant": "int8"})
+        for p in _prompts():
+            g = np.asarray(generate(params, p[None], _STEPS, cfg,
+                                    temperature=0.0))
+            q = np.asarray(generate(params, p[None], _STEPS, cfg8,
+                                    temperature=0.0))
+            assert float((g == q).mean()) >= 0.95  # measured 1.0
+
+
+class TestServingSpecOnCheckpoint:
+    def test_engine_earns_acceptance_and_stays_bitexact(self, ckpt):
+        params, cfg, _ = ckpt
+
+        def run(spec):
+            eng = ServingEngine(
+                params, cfg, batch=2, round_steps=4, seed=3,
+                spec_draft_lens=(4, 8) if spec else None)
+            for i, p in enumerate(_prompts()):
+                eng.submit(p, _STEPS, request_id=100 + i)
+            eng.close()
+            done = {r.request_id: r for r in eng.run()}
+            return eng, [np.asarray(done[100 + i].tokens)
+                         for i in range(len(_PATTERNS))]
+
+        _, base = run(False)
+        eng, spec = run(True)
+        for a, b in zip(base, spec):
+            assert np.array_equal(a, b)
+        s = eng.stats.summary()
+        # Measured lifetime acceptance 0.30 on this checkpoint +
+        # workload (schedule-deterministic); pinned with slack. The
+        # bench line's SLO gate holds the same floor on the
+        # serving_spec artifact (tools/serving_slo_baseline.json).
+        assert s["spec_drafted"] > 0
+        assert s["spec_accept_lifetime"] >= 0.2, s

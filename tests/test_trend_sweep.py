@@ -272,6 +272,33 @@ class TestSpmmTrendSweep:
         assert 0 < d <= 32 / 256
 
 
+class TestSvdModeCrossover:
+    """SVD local-vs-dist-eigs crossover recipe (ROADMAP item 8): the
+    sweep that re-derives MarlinConfig.svd_local_eigs_max on the trend
+    harness. Small-shape smoke here — both arms measured, ratios
+    consistent, derived boundary inside (or clamped to) the swept band;
+    the full-size sweep is the bench line's job (`--config trend`).
+    Which arm wins at which n is a HOST property, so no winner is
+    pinned."""
+
+    def test_sweep_produces_derivable_points(self):
+        pts = cm.run_svd_mode_crossover_sweep(grid=(128, 256), k=4,
+                                              reps=1)
+        assert [p["n"] for p in pts] == [128, 256]
+        for p in pts:
+            assert p["local_s"] > 0 and p["dist_s"] > 0
+            assert p["local_over_dist"] == pytest.approx(
+                p["local_s"] / p["dist_s"])
+        d = cm.derive_svd_local_eigs_max(pts)
+        assert isinstance(d, int) and 0 < d <= 256
+
+    def test_k_must_stay_below_local_svd_shortcut(self):
+        # k > n/2 would make auto mode's local-svd shortcut apply to
+        # the swept shapes — the sweep rejects it up front.
+        with pytest.raises(ValueError, match="k="):
+            cm.run_svd_mode_crossover_sweep(grid=(8,), k=5, reps=1)
+
+
 class _FactorSweepContract:
     """Shared contract for the blocked-factorization n-sweeps (ROADMAP
     item 2, LU/Cholesky slice): model FLOPs term exactly n^3 (8x-spaced

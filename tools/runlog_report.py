@@ -250,6 +250,31 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
                 ev.get("page_fragmentation", 0.0) for ev in rounds),
             "fragmentation_last": rounds[-1].get("page_fragmentation"),
         }
+    # Speculative-decoding narration (docs/serving.md §7): rounds from
+    # a spec engine carry the draft/verify ledger — totals, the
+    # acceptance-rate trajectory, and the draft lengths the adaptive
+    # policy actually ran. A low-acceptance round is LEGAL steady state
+    # (the drafter guessed badly; the verify pass still emitted one
+    # token per live row and the engine made progress) — it belongs
+    # here, in the narration, never in the anomaly list.
+    spec = [ev for ev in rounds if "accept_rate" in ev]
+    if spec:
+        rates = [ev["accept_rate"] for ev in spec]
+        drafted = sum(ev.get("spec_drafted", 0) for ev in spec)
+        accepted = sum(ev.get("spec_accepted", 0) for ev in spec)
+        out["speculative"] = {
+            "n_spec_rounds": len(spec),
+            "drafted_total": drafted,
+            "accepted_total": accepted,
+            "accept_rate_overall": round(accepted / drafted, 4)
+            if drafted else 0.0,
+            "accept_rate_mean": round(sum(rates) / len(rates), 4),
+            "accept_rate_min": min(rates),
+            "accept_rate_last": rates[-1],
+            "draft_lens": sorted({ev.get("draft_len") for ev in spec
+                                  if ev.get("draft_len") is not None}),
+            "draft_len_last": spec[-1].get("draft_len"),
+        }
     return out
 
 
@@ -416,7 +441,8 @@ def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
         report["round_series"] = [
             {k: ev.get(k) for k in ("round", "iters", "occupied",
                                     "live_iters", "queue_depth",
-                                    "round_s", "decode_s")}
+                                    "round_s", "decode_s", "draft_len",
+                                    "accept_rate")}
             for ev in events if ev["kind"] == "round"]
     # Ledger echo: the drain seal carries the engine's final summary.
     for ev in reversed(events):
@@ -616,6 +642,15 @@ def _human(report: dict) -> str:
         if "drift_decode_last" in r:
             lines.append(f"decode drift: {r['drift_decode_last']} "
                          f"(range {r['drift_decode_range']})")
+        sp = r.get("speculative")
+        if sp:
+            lines.append(
+                f"speculative: {sp['n_spec_rounds']} spec round(s), "
+                f"{sp['accepted_total']}/{sp['drafted_total']} drafts "
+                f"accepted (overall {sp['accept_rate_overall']}, mean "
+                f"{sp['accept_rate_mean']}, min {sp['accept_rate_min']}"
+                f"), draft_len {sp['draft_lens']} "
+                f"(last {sp['draft_len_last']})")
     if report["phase_sum_checked"]:
         lines.append(
             f"phase sums: {report['phase_sum_checked']} checked, max "
