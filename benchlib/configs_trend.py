@@ -49,6 +49,11 @@ def config_trend_cpu():
     # replacing the reference's hard-coded 15000 cluster assumption.
     svd_xover = cm.run_svd_mode_crossover_sweep()
     svd_local_eigs_max = cm.derive_svd_local_eigs_max(svd_xover)
+    # Paged-attention gather tax (docs/serving.md §6): the per-round
+    # dense-gather cost the paged decode path pays, vs sequence length
+    # on the CPU mesh — the standing price of paging's capacity win,
+    # now a measured trend line instead of an assumption.
+    gather_tax = cm.run_paged_gather_tax_sweep()
     dv, sv = cm.trend_verdict(decode), cm.trend_verdict(summa)
     rv, gv = cm.trend_verdict(serving), cm.trend_verdict(gemm)
     lv, cv = cm.trend_verdict(lu), cm.trend_verdict(chol)
@@ -94,6 +99,9 @@ def config_trend_cpu():
                 [p["n"], round(p["local_s"], 5), round(p["dist_s"], 5),
                  round(p["local_over_dist"], 4)]
                 for p in svd_xover],
+            "paged_attention_gather_tax": [
+                [p["length"], round(p["gather_s"], 6), int(p["bytes"])]
+                for p in gather_tax],
             "attention_exponent": attn_exp,
             "attention_model_exponent": 2.0,
             "attention_fit_residual_rms": attn_res,
@@ -717,4 +725,237 @@ def config_serving_spec():
         "checkpoint_cycle_match": meta["probe"]["cycle_match"],
         "batch": batch, "n_requests": n_req, "steps": steps,
         "round_steps": round_steps, "trials": trials,
+    }
+
+
+def config_serving_host_kv():
+    """Host-memory KV tier (serving/pages.HostKVTier, docs/serving.md
+    §6): spilled-prefix restore vs re-prefill, measured four ways.
+
+    1. BIT-EXACTNESS: for plain / rope+GQA / int8 / speculative
+       variants, a tier-on engine (small pool — every re-hit of the
+       shared prefix crosses a spill+restore cycle) drains the same
+       workload as a tier-off engine (same pool; eviction discards, the
+       re-hit re-prefills). Tokens must match exactly — a restore that
+       moved a token would be a correctness bug — and each variant's
+       tier arm must actually have spilled AND restored (a variant that
+       never exercised the tier proves nothing). Asserted inline,
+       pinned in the baseline.
+    2. CROSSOVER: cost_model.run_kv_restore_crossover_sweep times BOTH
+       arms (jitted restore scatter including the per-call h2d vs the
+       real chunked paged prefill) over a hit-length grid, min-of-reps
+       per point; derive_kv_restore_min_tokens turns the ratio=1
+       crossing into the restore_min_tokens the measured engines run
+       with — the admission auto-pick is data-backed, not folklore.
+       Gate: restore strictly cheaper at the longest measured hit.
+    3. THROUGHPUT: alternating-prefix workload at batch=1 on a pool
+       that fits ONE prefix — every admission evicts the other prefix
+       and re-hits its own, so the tier arm pays spill+restore+tail
+       prefill where the bare arm pays a full re-prefill. Headline
+       value = min-of-3 drain wall-clock ratio (off/on). A post-warmup
+       CompileWatchdog pins zero steady-state recompiles in BOTH arms
+       (the restore scatter's only static axis is the page count,
+       warmed by the warmup drain).
+    4. CAPACITY: at EQUAL device bytes, how many distinct stored
+       prefixes stay hittable — the bare index holds only what fits the
+       pool; the tier (host budget = 5x the pool's bytes) keeps evicted
+       entries restorable. Done-bar: >= 5x.
+    tools/slo_check.py gates all of it from the committed baseline's
+    ``metrics_host_kv`` block (tests/test_host_kv.py, tier-1)."""
+    import numpy as np
+
+    from marlin_tpu.models import TransformerConfig, init_params
+    from marlin_tpu.obs.metrics import MetricsRegistry
+    from marlin_tpu.obs.watch import CompileWatchdog
+    from marlin_tpu.serving import (PAGE, PagePool, ServingEngine,
+                                    _decode_round_paged,
+                                    prefill_chunk_into_row_paged)
+    from marlin_tpu.serving.pages import HostKVTier
+    from marlin_tpu.serving.prefix import PagedPrefixIndex
+    from marlin_tpu.serving.slots import restore_pages_into_pool
+    from marlin_tpu.utils import cost_model as cm
+
+    # -- crossover sweep first: the measured restore_min_tokens the
+    # engines below run with (self-contained tiny cfg, PAGE-multiple
+    # hit-length grid; reps=3 per arm per point).
+    xover = cm.run_kv_restore_crossover_sweep(
+        reps=_sized("BENCH_HOSTKV_XREPS", 3))
+    restore_min = cm.derive_kv_restore_min_tokens(xover)
+
+    # -- bit-exactness matrix: tier on vs off, identical workloads ----
+    def bitexact_arm(cfg_kw, spec, tier):
+        vcfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=128,
+                                 **cfg_kw)
+        vparams = init_params(vcfg, seed=0)
+        eng = ServingEngine(
+            vparams, vcfg, batch=2, kv_pages=10, prefill_chunk=16,
+            prefix_sharing=True,
+            spec_draft_lens=(4,) if spec else None,
+            host_kv_bytes=(1 << 22) if tier else None,
+            restore_min_tokens=16 if tier else None)
+        rng = np.random.default_rng(5)
+        prefix = rng.integers(1, vcfg.vocab, 48).astype(np.int32)
+        outs = []
+        p1 = np.concatenate([prefix, rng.integers(
+            1, vcfg.vocab, 8).astype(np.int32)])
+        eng.submit(p1, 8)
+        outs.append([list(map(int, r.tokens)) for r in eng.run()])
+        for i in range(3):  # churn: force the stored prefix out
+            q = np.random.default_rng(100 + i).integers(
+                1, vcfg.vocab, 64).astype(np.int32)
+            eng.submit(q, 8)
+        outs.append(sorted(list(map(int, r.tokens)) for r in eng.run()))
+        p3 = np.concatenate([prefix, rng.integers(
+            1, vcfg.vocab, 4).astype(np.int32)])
+        eng.submit(p3, 8)
+        outs.append([list(map(int, r.tokens)) for r in eng.run()])
+        tier_summ = eng.host_tier.summary() if tier else None
+        eng.drain()
+        return outs, tier_summ
+
+    variants = {
+        "plain": ({}, False),
+        "rope_gqa": ({"rope": True, "n_kv_heads": 1}, False),
+        "int8": ({"kv_quant": "int8"}, False),
+        "spec": ({}, True),
+    }
+    bit_exact = {}
+    for name, (kw, spec) in variants.items():
+        on, ts = bitexact_arm(kw, spec, tier=True)
+        off, _ = bitexact_arm(kw, spec, tier=False)
+        assert on == off, f"host-tier restore moved tokens ({name})"
+        assert ts["spills"] >= 1 and ts["restores"] >= 1, \
+            f"variant {name} never exercised the tier: {ts}"
+        bit_exact[name] = True
+
+    # -- throughput arms: alternating prefixes over a one-prefix pool -
+    d = _sized("BENCH_HOSTKV_D", 64)
+    prefix_len = _sized("BENCH_HOSTKV_PREFIX", 128)
+    tail_len = _sized("BENCH_HOSTKV_TAIL", 8)
+    steps = _sized("BENCH_HOSTKV_STEPS", 4)
+    chunk = _sized("BENCH_HOSTKV_CHUNK", 32)
+    n_req = _sized("BENCH_HOSTKV_REQS", 10)
+    max_len = -(-(prefix_len + tail_len + steps + 4) // PAGE) * PAGE
+    n_total = -(-(prefix_len + tail_len + steps) // PAGE)
+    # One reservation plus HALF a prefix of slack: admitting either
+    # prefix always forces the OTHER one out, but the pool never
+    # starves the reservation itself.
+    kv_pages = _sized("BENCH_HOSTKV_PAGES",
+                      n_total + (prefix_len // PAGE) // 2)
+    cfg = TransformerConfig(
+        vocab=256, d_model=d, n_heads=max(2, d // 32), n_layers=2,
+        d_ff=2 * d, max_len=max_len)
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    shared = [rng.integers(0, cfg.vocab, prefix_len).astype(np.int32)
+              for _ in range(2)]
+    prompts = [np.concatenate([shared[i % 2], rng.integers(
+        0, cfg.vocab, tail_len).astype(np.int32)])
+        for i in range(n_req)]
+
+    def run(tier: bool):
+        eng = ServingEngine(
+            params, cfg, batch=1, round_steps=8, prefill_chunk=chunk,
+            kv_pages=kv_pages, prefix_sharing=True,
+            host_kv_bytes=(1 << 26) if tier else None,
+            restore_min_tokens=restore_min if tier else None)
+        for p in prompts:
+            eng.submit(p, steps)
+        t0 = time.perf_counter()
+        eng.run()
+        return eng, time.perf_counter() - t0
+
+    run(False)  # warmup: chunk buckets + paged round compiles
+    run(True)   # warmup: the restore scatter's page-count bucket
+    wd = CompileWatchdog()
+    wd.register("serving.decode_round_paged", _decode_round_paged)
+    wd.register("serving.prefill_chunk_into_row_paged",
+                prefill_chunk_into_row_paged)
+    wd.register("serving.kv_restore", restore_pages_into_pool)
+    eng_off, dt_off = run(False)
+    for _ in range(2):
+        dt_off = min(dt_off, run(False)[1])
+    rec_off = sum(r.new_compiles for r in wd.poll(rebaseline=True))
+    eng_on, dt_on = run(True)
+    for _ in range(2):
+        dt_on = min(dt_on, run(True)[1])
+    rec_on = sum(r.new_compiles for r in wd.poll(rebaseline=True))
+    tier_summ = eng_on.host_tier.summary()
+
+    # -- capacity at equal device bytes: hittable stored prefixes -----
+    plen = prefix_len
+    n_per = plen // PAGE
+    budget_pages = 2 * n_per
+    host_factor = 5
+
+    def hittable(tiered: bool) -> int:
+        # Private registry: throwaway pools must not clobber the
+        # measured engines' serving_kv_* series in the attached block.
+        reg = MetricsRegistry()
+        pool = PagePool(cfg, budget_pages, registry=reg)
+        t = HostKVTier(pool, budget_bytes=host_factor * pool.pool_bytes,
+                       registry=reg) if tiered else None
+        idx = PagedPrefixIndex(pool, registry=reg, host_tier=t)
+        crng = np.random.default_rng(2)
+        stored = [crng.integers(0, cfg.vocab, plen).astype(np.int32)
+                  for _ in range(8 * (budget_pages // n_per))]
+        for p in stored:
+            fresh = pool.alloc(n_per)
+            if fresh is None:
+                idx.evict_until_free(n_per)
+                fresh = pool.alloc(n_per)
+            idx.store(p, fresh)
+            pool.unref(fresh)  # the row retired; the index's pin stays
+        count = 0
+        for p in stored:
+            probe = np.concatenate(
+                [p, np.zeros(tail_len, np.int32)])
+            _, hit, sp, sp_hit = idx.lookup_candidates(probe)
+            if hit >= plen:
+                count += 1
+            elif sp is not None and sp_hit >= plen and t is not None \
+                    and t.fetch(idx.host_key_of(sp)) is not None:
+                count += 1
+        return count
+
+    cap_plain = hittable(False)
+    cap_tier = hittable(True)
+
+    speedup = dt_off / dt_on
+    at_max = max(xover, key=lambda p: p["length"])
+    return {
+        "metric": "serving_host_kv",
+        "value": round(speedup, 3), "unit": "x",
+        "vs_baseline": round(speedup, 3),
+        "bit_exact": all(bit_exact.values()),
+        "bit_exact_plain": bit_exact["plain"],
+        "bit_exact_rope_gqa": bit_exact["rope_gqa"],
+        "bit_exact_int8": bit_exact["int8"],
+        "bit_exact_spec": bit_exact["spec"],
+        "restore_min_tokens_measured": restore_min,
+        "restore_vs_reprefill_at_max": round(
+            at_max["restore_over_reprefill"], 4),
+        "kv_restore_points": [
+            [p["length"], round(p["restore_s"], 6),
+             round(p["reprefill_s"], 6),
+             round(p["restore_over_reprefill"], 4)] for p in xover],
+        "wallclock_on_s": round(dt_on, 4),
+        "wallclock_off_s": round(dt_off, 4),
+        "spills_on": tier_summ["spills"],
+        "restores_on": tier_summ["restores"],
+        "host_bytes_final": tier_summ["host_bytes"],
+        "host_entries_final": tier_summ["host_entries"],
+        "recompiles_after_warmup": rec_on,
+        "recompiles_after_warmup_off": rec_off,
+        "capacity_budget_pages": budget_pages,
+        "capacity_host_factor": host_factor,
+        "capacity_resident_plain": cap_plain,
+        "capacity_with_tier": cap_tier,
+        "capacity_ratio": round(cap_tier / max(cap_plain, 1), 3),
+        "completed_on": eng_on.stats.n_completed,
+        "completed_off": eng_off.stats.n_completed,
+        "batch": 1, "n_requests": n_req, "prefix_len": prefix_len,
+        "tail_len": tail_len, "steps": steps, "prefill_chunk": chunk,
+        "kv_pages": kv_pages, "d_model": d, "max_len": max_len,
     }

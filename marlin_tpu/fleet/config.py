@@ -41,6 +41,15 @@ class FleetConfig:
     seed: int = 0
     kv_pages: Optional[int] = None
     prefill_chunk: Optional[int] = None
+    # Host-memory KV tier (docs/serving.md §6): per-replica host budget
+    # for spilled prefixes, and a spill directory SHARED by the whole
+    # fleet — durable .npz spills keyed by prompt content, so any
+    # replica can adopt a prefix a sibling spilled (the router's
+    # affinity usually sends the re-hit to the spiller, but failover
+    # and rebalance must not forfeit the warm set).
+    host_kv_bytes: Optional[int] = None
+    spill_dir: Optional[str] = None
+    restore_min_tokens: Optional[int] = None
     # Per-replica (in-process) supervisor budget — PR 7's knobs.
     max_restarts: int = 3
     restart_window_s: float = 60.0
@@ -142,6 +151,13 @@ class FleetConfig:
             argv += ["--kv-pages", str(self.kv_pages)]
         if self.prefill_chunk is not None:
             argv += ["--prefill-chunk", str(self.prefill_chunk)]
+        if self.host_kv_bytes is not None:
+            argv += ["--host-kv-bytes", str(self.host_kv_bytes)]
+        if self.spill_dir is not None:
+            argv += ["--spill-dir", self.spill_dir]
+        if self.restore_min_tokens is not None:
+            argv += ["--restore-min-tokens",
+                     str(self.restore_min_tokens)]
         runlog = self.replica_runlog(index, incarnation)
         if runlog is not None:
             argv += ["--runlog", runlog]

@@ -63,11 +63,12 @@ from ..obs.runlog import RunLog
 from ..obs.watch import CompileWatchdog
 from ..utils import cost_model as cm
 from . import faults
-from .pages import PAGE, PagePool
+from .pages import PAGE, HostKVTier, PagePool
 from .prefix import PagedPrefixIndex, PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, Request
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
-                    prefill_chunk_into_row_paged, prefill_into_row)
+                    prefill_chunk_into_row_paged, prefill_into_row,
+                    restore_pages_into_pool)
 from .stats import EngineStats
 
 
@@ -443,7 +444,10 @@ class ServingEngine:
                  prefix_sharing: bool = True,
                  spec_draft_lens: Optional[tuple] = None,
                  spec_ngram: int = 2,
-                 spec_adaptive: bool = True):
+                 spec_adaptive: bool = True,
+                 host_kv_bytes: Optional[int] = None,
+                 host_kv_dir: Optional[str] = None,
+                 restore_min_tokens: Optional[int] = None):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -491,6 +495,32 @@ class ServingEngine:
                 "copy-based sharing by omitting prefix_cache instead")
         if prefix_cache is not None and prefill_chunk is None:
             prefill_chunk = 32
+        # Host KV tier (ISSUE 16, docs/serving.md §6): spill evicted
+        # stored prefixes to host memory and restore them on a later
+        # hit instead of re-prefilling. Rides on the paged prefix
+        # index, so it needs kv_pages + prefix_sharing; off (None) by
+        # default — PR 9 behavior is unchanged without it.
+        self.host_kv = host_kv_bytes is not None or host_kv_dir is not None
+        if self.host_kv and (kv_pages is None or not prefix_sharing):
+            raise ValueError(
+                "host_kv_bytes/host_kv_dir need the paged prefix index "
+                "(kv_pages=... with prefix_sharing=True): the host "
+                "tier spills/restores stored prefix pages")
+        if restore_min_tokens is not None and not self.host_kv:
+            raise ValueError(
+                "restore_min_tokens without host_kv_bytes/host_kv_dir "
+                "configures nothing")
+        self.host_kv_bytes = host_kv_bytes
+        self.host_kv_dir = host_kv_dir
+        # The restore-vs-reprefill crossover: restore a spilled hit
+        # only when it beats the resident hit by at least this many
+        # tokens. Default is the cost model's floor
+        # (cost_model.KV_RESTORE_MIN_TOKENS_DEFAULT); the bench derives
+        # a MEASURED value from its crossover sweep and passes it in
+        # (benchlib/configs_trend.py config_serving_host_kv).
+        self.restore_min_tokens = (
+            int(restore_min_tokens) if restore_min_tokens is not None
+            else cm.KV_RESTORE_MIN_TOKENS_DEFAULT)
         if prefill_chunk is not None and (prefill_chunk < 16
                                           or prefill_chunk % 16):
             raise ValueError(
@@ -606,6 +636,13 @@ class ServingEngine:
                                        _decode_round_paged)
             self.watchdog.register("serving.prefill_chunk_into_row_paged",
                                    prefill_chunk_into_row_paged)
+            if self.host_kv:
+                # The restore scatter compiles once per distinct
+                # spilled-prefix page count; registering it holds the
+                # host tier to the same zero-steady-state-recompile
+                # invariant as every other admission entry point.
+                self.watchdog.register("serving.kv_restore",
+                                       restore_pages_into_pool)
         else:
             if not self.spec:
                 self.watchdog.register("serving.decode_round",
@@ -680,8 +717,18 @@ class ServingEngine:
             self._cache = None
             self.page_pool = PagePool(cfg, kv_pages,
                                       registry=self.metrics)
+            # Host tier BELOW the pool, fresh per incarnation
+            # (spawn_successor discards in-memory payloads wholesale —
+            # the coherent crash story; host_kv_dir payloads survive on
+            # disk and are re-adopted). The event sink threads runlog
+            # spill events through the engine so they carry round_idx.
+            self.host_tier = HostKVTier(
+                self.page_pool, budget_bytes=host_kv_bytes,
+                registry=self.metrics, event_sink=self._host_tier_event,
+                spill_dir=host_kv_dir) if self.host_kv else None
             self.prefix_index = PagedPrefixIndex(
-                self.page_pool, registry=self.metrics) \
+                self.page_pool, registry=self.metrics,
+                host_tier=self.host_tier) \
                 if self.prefix_sharing else None
             # Row r's page table: chunk index -> pool page. Entries of
             # unallocated chunks point at the write sink (0). Driver-
@@ -693,10 +740,17 @@ class ServingEngine:
             # LAST page (reservations are otherwise exact) — the
             # numerator of the round fragmentation gauge.
             self._row_slack: Dict[int, int] = {}
+            # Last-seen tier totals, for per-round spill/restore deltas
+            # in the round event (tools/runlog_report.py narrates them;
+            # the restore delta is also what declassifies a
+            # stall-shaped round — a restore IS scheduling work).
+            self._host_spills0 = 0
+            self._host_restores0 = 0
             self.stats.page_pool = self.page_pool
         else:
             self.page_pool = None
             self.prefix_index = None
+            self.host_tier = None
             self._cache = init_kv_cache(cfg, batch,
                                         dtype=cfg.compute_dtype)  # donated-buffer
             self.stats.page_pool = None
@@ -760,6 +814,8 @@ class ServingEngine:
                          max_len=cfg.max_len,
                          prefix_cache=prefix_cache is not None,
                          kv_pages=kv_pages,
+                         host_kv_bytes=host_kv_bytes,
+                         host_kv_dir=host_kv_dir,
                          prefix_sharing=(self.paged
                                          and self.prefix_sharing),
                          spec_draft_lens=(list(self.spec_draft_lens)
@@ -871,6 +927,12 @@ class ServingEngine:
     def close(self) -> None:
         """Graceful drain: no new submits; ``run`` finishes queued work."""
         self.queue.close()
+
+    def _host_tier_event(self, kind: str, **fields) -> None:
+        """Runlog sink the host tier emits through (spill/restore
+        events) — bound at tier construction so every event carries the
+        engine's round index (the tier itself has no round clock)."""
+        self.runlog.emit(kind, round=self.round_idx, **fields)
 
     # -- scheduling ---------------------------------------------------
 
@@ -1029,12 +1091,54 @@ class ServingEngine:
         reserve the request's FULL page complement — ``ceil((prompt +
         steps) / PAGE)`` chunks, aliased prefix pages first, fresh pages
         for the rest — so a placed request can never run out of pages
-        mid-decode. Returns ``(alias_pages, hit_len, fresh_pages)`` or
-        None when the pool cannot fit the reservation even after
-        evicting stored prefixes (the caller leaves the request
-        queued)."""
-        entry_pages, hit = (None, 0)
-        if self.prefix_index is not None:
+        mid-decode. Returns ``(alias_pages, hit_len, fresh_pages,
+        restore)`` or None when the pool cannot fit the reservation
+        even after evicting stored prefixes (the caller leaves the
+        request queued). ``restore`` is None on the ordinary resident
+        path; on a host-tier restore it carries the fetched payload for
+        ``_bind_row_pages`` to scatter — the reservation is still made
+        UP FRONT and in full (nothing aliased, everything fresh), so
+        the no-mid-decode-OOM guarantee is unchanged."""
+        entry_pages, hit, restore = None, 0, None
+        if self.prefix_index is not None and self.host_tier is not None:
+            entry_pages, hit, sp_eid, sp_hit = \
+                self.prefix_index.lookup_candidates(req.prompt)
+            if sp_eid is None and self.host_tier.spill_dir:
+                # Cross-replica adoption: nothing spilled LOCALLY, but
+                # a shared spill_dir may hold a prefix another replica
+                # computed (docs/fleet.md §prefix adoption).
+                key, plen = self.host_tier.probe(req.prompt)
+                if plen and self.prefix_index.adopt(
+                        req.prompt, plen, key) is not None:
+                    sp_eid, sp_hit = \
+                        self.prefix_index.lookup_candidates(
+                            req.prompt)[2:]
+            # Restore vs re-prefill, per hit: restore wins when the
+            # spilled hit's RECOMPUTE SAVINGS over the resident arm
+            # clear the measured crossover (restore_min_tokens — the
+            # length beyond which scattering bit-identical bytes beats
+            # recomputing them; utils/cost_model.py).
+            if (sp_eid is not None
+                    and sp_hit >= hit + self.restore_min_tokens):
+                fetched = self.host_tier.fetch(
+                    self.prefix_index.host_key_of(sp_eid))
+                if fetched is None:
+                    # Payload budget-dropped under the trie entry: the
+                    # hit is a lie now — forget it (stale paths must
+                    # not resurface) and admit on the resident arm.
+                    self.prefix_index.forget(sp_eid)
+                else:
+                    payload, nbytes = fetched
+                    # Payload FETCHED BEFORE the eviction/alloc below:
+                    # nothing past this point can drop it mid-
+                    # reservation. The restore aliases nothing — every
+                    # page is freshly allocated, the first sp_hit/PAGE
+                    # receive the scatter and are re-pinned into the
+                    # index by _bind_row_pages.
+                    entry_pages, hit = None, sp_hit
+                    restore = {"eid": sp_eid, "hit": sp_hit,
+                               "payload": payload, "nbytes": nbytes}
+        elif self.prefix_index is not None:
             entry_pages, hit = self.prefix_index.lookup(req.prompt)
         # Speculative engines reserve the verify-window overhang too
         # (draft_len_max - 1 slots past target): the last chunk's write
@@ -1042,9 +1146,9 @@ class ServingEngine:
         # neighbor's entry.
         n_total = -(-(req.prompt_len + req.steps
                       + self._spec_overhang) // PAGE)
-        n_alias = hit // PAGE
+        n_alias = (hit // PAGE) if restore is None else 0
         need = n_total - n_alias
-        if hit:
+        if restore is None and hit:
             # Pin the aliased pages FIRST: the eviction pass below may
             # drop the very entry this hit resolved to, and the pin is
             # what keeps its pages live for this row regardless.
@@ -1053,7 +1157,7 @@ class ServingEngine:
             self.prefix_index.evict_until_free(need)
         fresh = self.page_pool.alloc(need)
         if fresh is None:
-            if hit:
+            if restore is None and hit:
                 self.page_pool.unref(entry_pages)  # undo the pin
             return None
         # Hit/miss/zero-copy accounting happens AFTER _bind_row_pages'
@@ -1061,18 +1165,56 @@ class ServingEngine:
         # recording here would double-count a crashed-and-replayed
         # admission, exactly like the contiguous path's check-then-
         # record ordering avoids).
-        return (list(entry_pages) if hit else []), hit, fresh
+        alias = list(entry_pages) if (hit and restore is None) else []
+        return alias, hit, fresh, restore
 
     def _bind_row_pages(self, req: Request, row: int, alias_pages,
-                        hit: int, fresh) -> None:
+                        hit: int, fresh, restore=None) -> None:
         """Write the claimed row's page table: aliased prefix pages for
         chunks [0, hit/PAGE), fresh private pages up to the reservation,
         the write sink (0) beyond it. This IS the paged admission's
-        storage work — no KV bytes move."""
+        storage work — no KV bytes move on the resident path. On a
+        host-tier RESTORE (``restore`` set), the first hit/PAGE fresh
+        pages first receive the spilled payload's bit-identical bytes
+        (one scatter dispatch) and are re-pinned into the prefix index;
+        the h2d bytes are metered by the tier's own counters, never by
+        ``admission_copy_bytes`` — the zero-copy ledger keeps pricing
+        what ADMISSION moves, which is still nothing."""
         n_total = -(-(req.prompt_len + req.steps
                       + self._spec_overhang) // PAGE)  # matches _reserve_pages
         held: List[int] = []
-        if hit:
+        if restore is not None:
+            res_pages = [int(p) for p in fresh[:restore["hit"] // PAGE]]
+            # Same blame discipline as the prefix copy: set before the
+            # fault site, cleared only on success, so a chaos plan
+            # firing MID-RESTORE leaves the admission attributed and
+            # the successor's fresh pool/tier discards the torn state
+            # wholesale (tests/test_faults.py pins the recovery).
+            self._admitting_rid = req.request_id
+            faults.check("kv_restore", round_idx=self.round_idx,
+                         request_id=req.request_id)
+            t0 = time.perf_counter()
+            with self.tracer.span("serving.kv_restore", scope=False,
+                                  request_id=req.request_id, row=row,
+                                  hit_len=restore["hit"]), \
+                    jax.transfer_guard("allow"):
+                # Sanctioned h2d site: the payload push IS the restore
+                # (the metered transfer the crossover prices); the
+                # scatter is jitted with the pool donated through, like
+                # every other admission write.
+                self.page_pool.pages = restore_pages_into_pool(
+                    self.page_pool.pages, restore["payload"],
+                    jnp.asarray(np.asarray(res_pages, np.int32)))
+                jax.block_until_ready(self.page_pool.pages)
+            dt = time.perf_counter() - t0
+            self.prefix_index.rebind(restore["eid"], res_pages)
+            self.host_tier.record_restore(restore["nbytes"], dt)
+            self._host_tier_event(
+                "restore", request_id=req.request_id,
+                length=restore["hit"], bytes=restore["nbytes"],
+                restore_s=round(dt, 6))
+            self._admitting_rid = None
+        elif hit:
             # Same blame/fault site as the contiguous prefix copy: a
             # chaos plan targeting "prefix_copy" crashes mid prefix-hit
             # admission here, leaving torn refcounts for
@@ -1103,10 +1245,11 @@ class ServingEngine:
             placed = self._reserve_pages(req)
             if placed is None:
                 return False
-            alias_pages, hit, fresh = placed
+            alias_pages, hit, fresh, restore = placed
             req.admit_start_time = time.perf_counter()  # queue_wait ends
             row = self.slots.acquire(req.request_id)
-            self._bind_row_pages(req, row, alias_pages, hit, fresh)
+            self._bind_row_pages(req, row, alias_pages, hit, fresh,
+                                 restore=restore)
             if self.prefix_index is not None:
                 # Recorded only once the bind SURVIVED its fault site:
                 # the ledger spans incarnations, and a crashed-then-
@@ -1569,6 +1712,15 @@ class ServingEngine:
                 pages_used=used, pages_free=ps["kv_pages_free"],
                 pages_aliased=ps["kv_pages_aliased"],
                 page_fragmentation=round(frag, 4))
+            if self.host_tier is not None:
+                ts = self.host_tier.summary()
+                page_fields.update(
+                    spills=ts["spills"] - self._host_spills0,
+                    restores=ts["restores"] - self._host_restores0,
+                    host_bytes=ts["host_bytes"],
+                    host_entries=ts["host_entries"])
+                self._host_spills0 = ts["spills"]
+                self._host_restores0 = ts["restores"]
         faults.check("runlog_emit", round_idx=self.round_idx)
         self.runlog.emit(
             "round", round=self.round_idx, iters=int(iters),
@@ -1696,7 +1848,18 @@ class ServingEngine:
             spec_draft_lens=(self.spec_draft_lens if self.spec
                              else None),
             spec_ngram=self.spec_ngram,
-            spec_adaptive=self.spec_adaptive)
+            spec_adaptive=self.spec_adaptive,
+            # Host-tier knobs carry through; the successor's tier is
+            # FRESH — in-memory payloads are discarded wholesale with
+            # the trie entries they backed (no stale trie path can
+            # outlive its payload, no payload can outlive its entry).
+            # A host_kv_dir's on-disk payloads survive and re-enter via
+            # the adoption probe, which is the "host state owned by the
+            # frontend" arm of the crash story (docs/robustness.md).
+            host_kv_bytes=self.host_kv_bytes,
+            host_kv_dir=self.host_kv_dir,
+            restore_min_tokens=(self.restore_min_tokens
+                                if self.host_kv else None))
         eng._next_id = self._next_id
         eng.round_idx = self.round_idx + 1
         if self.spec:
@@ -1783,6 +1946,10 @@ class ServingEngine:
             out["kv_pages"] = self.page_pool.summary()
             if self.prefix_index is not None:
                 out["prefix_index"] = self.prefix_index.summary()
+            if self.host_tier is not None:
+                out["host_tier"] = dict(
+                    self.host_tier.summary(),
+                    restore_min_tokens=self.restore_min_tokens)
         return out
 
     def debug_request(self, request_id: int) -> Optional[dict]:

@@ -26,6 +26,8 @@ site                      fires inside
 ``stream_fanout``         the frontend driver's post-round delivery
                           (``EngineFrontend._fanout``)
 ``runlog_emit``           the engine's per-round runlog emission
+``kv_restore``            the host-tier restore scatter during a
+                          paged admission (``_bind_row_pages``)
 ========================  ============================================
 
 Each site calls :func:`check` (raise or sleep) or :func:`corrupt`
@@ -62,7 +64,8 @@ import numpy as np
 from ..obs import metrics as obs_metrics
 
 SITES = ("decode_round", "prefill_chunk", "prefix_copy",
-         "admission_pop", "stream_fanout", "runlog_emit")
+         "admission_pop", "stream_fanout", "runlog_emit",
+         "kv_restore")
 ACTIONS = ("raise", "delay", "corrupt")
 ENV_VAR = "MARLIN_FAULT_PLAN"
 

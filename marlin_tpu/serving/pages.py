@@ -50,10 +50,17 @@ donation-fetch, docs/static_analysis.md).
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
-from typing import Dict, Iterable, List, Optional
+import time
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
 
 from ..models import init_kv_cache
+from ..models.quant import kv_layer_keys
 from ..obs import metrics as obs_metrics
 
 PAGE = 16  # tokens per page: the flash 16-sublane bucket, the trie
@@ -232,4 +239,276 @@ class PagePool:
                 "kv_page_allocs": self.allocs,
                 "kv_page_frees": self.frees,
                 "kv_page_alloc_failures": self.alloc_failures,
+            }
+
+
+# Dtypes np.savez round-trips natively; anything else (bfloat16 and
+# friends) is upcast to float32 on save — exact, float32 is a superset
+# — and cast back to the pool dtype by the restore scatter.
+_SAVEZ_NATIVE = frozenset(
+    "float16 float32 float64 int8 int16 int32 int64 uint8".split())
+
+
+class HostKVTier:
+    """Host-memory spill tier under the device page pool: the warm set
+    behind the pool's hot set (ISSUE 16, docs/serving.md §6).
+
+    When :class:`~marlin_tpu.serving.prefix.PagedPrefixIndex` evicts a
+    stored prefix under device pressure, the entry's pages spill HERE —
+    one metered host gather — instead of vanishing; a later trie hit on
+    the spilled prefix restores by scattering the identical bytes into
+    freshly allocated pages (serving/slots.restore_pages_into_pool) and
+    aliasing them into the new row's table, skipping the tail
+    re-prefill. Device bytes bound the HOT set; ``budget_bytes`` bounds
+    the WARM set, LRU-evicted independently.
+
+    Payloads are keyed by content (sha1 of the stored tokens + length),
+    so two replicas spilling the same prefix produce the same key —
+    with a shared ``spill_dir`` any replica can ADOPT a prefix another
+    one computed (docs/fleet.md §prefix adoption). In-memory entries
+    die with the process (``spawn_successor`` rebuilds a fresh tier —
+    wholesale discard is the coherent crash story); ``spill_dir`` files
+    are the durable share and survive restarts.
+
+    Thread-safety: the driver thread spills/fetches while HTTP handler
+    threads read ``summary()`` — every mutation and reading scan holds
+    ``_lock``. The gather reads the device pool OUTSIDE the lock (pool
+    dispatches are driver-owned, single-writer)."""
+
+    def __init__(self, pool: PagePool, budget_bytes: Optional[int] = None,
+                 registry=None, event_sink=None,
+                 spill_dir: Optional[str] = None):
+        if budget_bytes is not None and budget_bytes < 1:
+            raise ValueError(
+                f"budget_bytes must be None or >= 1, got {budget_bytes}")
+        self.pool = pool
+        self.budget_bytes = budget_bytes
+        self._registry = registry
+        self.event_sink = event_sink  # callable(kind, **fields) or None
+        self.spill_dir = spill_dir
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock (in-memory payload bytes)
+        self._lock = threading.Lock()
+        self.spills = 0
+        self.restores = 0
+        self.drops = 0
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        with self._lock:
+            self._mirror_locked()
+        # Register the restore-latency series at construction (count 0
+        # until the first restore): scrapes and the baseline staleness
+        # guard see every tier series from boot, not from first use.
+        self.registry.histogram(
+            "serving_kv_restore_seconds",
+            help="host-to-device restore latency per restored "
+                 "prefix")
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None \
+            else obs_metrics.registry
+
+    def _mirror_locked(self) -> None:  # marlint: holds=_lock
+        reg = self.registry
+        reg.gauge("serving_kv_host_bytes",
+                  help="bytes of spilled KV payloads resident in host "
+                       "memory (docs/serving.md section 6)").set(
+            self._bytes)
+        reg.gauge("serving_kv_host_entries",
+                  help="spilled prefixes resident in host memory").set(
+            len(self._entries))
+
+    # -- keys / payloads ----------------------------------------------
+
+    @staticmethod
+    def key_for(tokens, length: int) -> str:
+        """Content key of a stored prefix: sha1 over the token bytes
+        plus the 16-aligned length — replica-independent, so a shared
+        ``spill_dir`` dedups across the fleet by construction."""
+        tok = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        return hashlib.sha1(tok[:length].tobytes()).hexdigest() \
+            + f"-{length}"
+
+    def _gather_payload(self, pages) -> Tuple[list, int]:
+        """One host gather of ``pages`` across every layer's KV (and
+        scale) buffers: a list per layer of ``{name: (n, PAGE, Hk,
+        Dh)}`` numpy arrays. ``np.asarray`` here is the SANCTIONED
+        donation-fetch site (marlint, docs/static_analysis.md): it
+        copies the gather RESULT — a fresh device temp, never a view of
+        the donated pool buffer — to host, exactly once per spill, and
+        the spill counters meter it."""
+        idx = np.asarray(list(pages), np.int32)
+        payload = []
+        nbytes = 0
+        for layer in self.pool.pages:
+            nl = {}
+            for name in kv_layer_keys(layer):
+                arr = np.asarray(layer[name][idx])  # sanctioned fetch
+                nl[name] = arr
+                nbytes += arr.nbytes
+            payload.append(nl)
+        return payload, nbytes
+
+    # -- spill / fetch / drop -----------------------------------------
+
+    def spill(self, tokens, length: int, pages):
+        """Spill a stored prefix's pages to host; returns ``(key,
+        nbytes, seconds)`` or None when the payload can never fit the
+        budget. Caller (the prefix index) still owns the device pages —
+        it unrefs them only on success. Evicts host-LRU entries to make
+        room; with a ``spill_dir`` the payload also lands on disk (the
+        durable copy adoption and successors read)."""
+        t0 = time.perf_counter()
+        payload, nbytes = self._gather_payload(pages)
+        if self.budget_bytes is not None and nbytes > self.budget_bytes:
+            return None
+        key = self.key_for(tokens, length)
+        tok = np.ascontiguousarray(
+            np.asarray(tokens, np.int32))[:length].copy()
+        if self.spill_dir:
+            self._save_dir(key, tok, length, payload)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+            while (self.budget_bytes is not None and self._entries
+                   and self._bytes + nbytes > self.budget_bytes):
+                _, ev = self._entries.popitem(last=False)  # host LRU
+                self._bytes -= ev["nbytes"]
+                self.drops += 1
+                self.registry.counter(
+                    "serving_kv_host_drops_total",
+                    help="spilled payloads dropped from host memory "
+                         "under the host budget").inc()
+            self._entries[key] = {"payload": payload, "tokens": tok,
+                                  "length": length, "nbytes": nbytes}
+            self._bytes += nbytes
+            self.spills += 1
+            self.registry.counter(
+                "serving_kv_spills_total",
+                help="stored prefixes spilled to the host tier").inc()
+            self._mirror_locked()
+        dt = time.perf_counter() - t0
+        if self.event_sink is not None:
+            self.event_sink("spill", key=key, length=length,
+                            bytes=nbytes, spill_s=round(dt, 6))
+        return key, nbytes, dt
+
+    def fetch(self, key: str):
+        """The payload for ``key`` as ``(payload, nbytes)`` — from host
+        memory first, the spill dir second — or None when neither holds
+        it (budget-dropped; the caller treats the hit as a miss and
+        forgets the trie entry)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                return ent["payload"], ent["nbytes"]
+        if self.spill_dir:
+            loaded = self._load_dir(key)
+            if loaded is not None:
+                return loaded
+        return None
+
+    def drop(self, key: str) -> None:
+        """Forget ``key``'s in-memory payload (trie entry removed).
+        A ``spill_dir`` file persists — the dir is the durable
+        cross-replica share, pruned by its owner, not by trie
+        lifetime."""
+        with self._lock:
+            ent = self._entries.pop(key, None)
+            if ent is None:
+                return
+            self._bytes -= ent["nbytes"]
+            self.drops += 1
+            self.registry.counter(
+                "serving_kv_host_drops_total",
+                help="spilled payloads dropped from host memory "
+                     "under the host budget").inc()
+            self._mirror_locked()
+
+    def record_restore(self, nbytes: int, seconds: float) -> None:
+        """Account one completed restore (the engine times the scatter
+        and calls this once per restored admission)."""
+        with self._lock:
+            self.restores += 1
+        self.registry.counter(
+            "serving_kv_restores_total",
+            help="spilled prefixes restored into device pages").inc()
+        self.registry.histogram(
+            "serving_kv_restore_seconds",
+            help="host-to-device restore latency per restored "
+                 "prefix").observe(seconds)
+
+    # -- cross-replica adoption (spill_dir) ---------------------------
+
+    def probe(self, prompt) -> Tuple[Optional[str], int]:
+        """Longest spilled prefix of ``prompt`` available to THIS tier
+        (memory or dir), at PAGE granularity, capped at
+        ``floor16(len - 1)`` like the trie lookup: ``(key, hit_len)``
+        or ``(None, 0)``. Content-keyed, so a shared ``spill_dir``
+        makes this the fleet adoption probe — a replica finds prefixes
+        another replica computed and spilled."""
+        tok = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        limit = ((int(tok.shape[0]) - 1) // PAGE) * PAGE
+        for length in range(limit, 0, -PAGE):
+            key = self.key_for(tok, length)
+            with self._lock:
+                if key in self._entries:
+                    return key, length
+            if self.spill_dir and os.path.exists(self._path(key)):
+                return key, length
+        return None, 0
+
+    # -- spill_dir persistence ----------------------------------------
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.spill_dir, f"{key}.npz")
+
+    def _save_dir(self, key: str, tokens: np.ndarray, length: int,
+                  payload: list) -> None:
+        data = {"tokens": tokens, "length": np.int64(length)}
+        for li, layer in enumerate(payload):
+            for name, arr in layer.items():
+                if arr.dtype.name not in _SAVEZ_NATIVE:
+                    # bfloat16 etc.: float32 is a value-exact superset;
+                    # the restore scatter casts back to the pool dtype.
+                    arr = np.asarray(arr, np.float32)
+                data[f"l{li}_{name}"] = arr
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **data)
+        os.replace(tmp, self._path(key))  # atomic vs concurrent readers
+
+    def _load_dir(self, key: str):
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as data:
+            payload = []
+            nbytes = 0
+            for li, pool_layer in enumerate(self.pool.pages):
+                nl = {}
+                for name in kv_layer_keys(pool_layer):
+                    arr = data[f"l{li}_{name}"]
+                    nl[name] = arr
+                    nbytes += arr.nbytes
+                payload.append(nl)
+        return payload, nbytes
+
+    # -- observability ------------------------------------------------
+
+    def summary(self) -> dict:
+        """The host-tier ledger block (``GET /debug/engine``
+        ``host_tier``, the bench line). One lock hold, scalars only."""
+        with self._lock:
+            return {
+                "host_entries": len(self._entries),
+                "host_bytes": self._bytes,
+                "host_budget_bytes": self.budget_bytes,
+                "spills": self.spills,
+                "restores": self.restores,
+                "host_drops": self.drops,
+                "spill_dir": self.spill_dir,
             }

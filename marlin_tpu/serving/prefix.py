@@ -107,12 +107,16 @@ def _trie_chunks(tokens: np.ndarray, length: int):
         yield tokens[d * GRAIN:(d + 1) * GRAIN].tobytes()
 
 
-def _trie_descend(root: _TrieNode, prompt: np.ndarray, limit: int):
+def _trie_descend(root: _TrieNode, prompt: np.ndarray, limit: int,
+                  member_ok=None):
     """Walk ``root`` along ``prompt``'s 16-chunks up to ``limit``
     tokens; returns ``(node, depth)`` for the DEEPEST node holding live
     members (``(None, 0)`` on a clean miss) — the one walk both lookup
     (hit selection) and store (coverage dedup) are defined by, so hit
-    and dedup semantics cannot drift apart."""
+    and dedup semantics cannot drift apart. ``member_ok`` (optional)
+    filters which members count as live — the paged index descends once
+    for RESIDENT entries and once for SPILLED ones (host tier,
+    docs/serving.md §6) over the same walk."""
     node = root
     best, best_depth = None, 0
     for d in range(limit // GRAIN):
@@ -120,7 +124,11 @@ def _trie_descend(root: _TrieNode, prompt: np.ndarray, limit: int):
         node = node.children.get(key)
         if node is None:
             break
-        if node.rows:
+        if member_ok is None:
+            live = bool(node.rows)
+        else:
+            live = any(member_ok(m) for m in node.rows)
+        if live:
             best, best_depth = node, (d + 1) * GRAIN
     return best, best_depth
 
@@ -373,16 +381,26 @@ class _PrefixEntry:
     """One stored prefix in the paged index: its tokens, 16-aligned
     length, and the POOL PAGES holding its K/V — aliased, not owned
     exclusively (per-page refcounts in serving/pages.PagePool arbitrate
-    lifetime; the entry holds exactly one reference per page)."""
+    lifetime; the entry holds exactly one reference per page).
 
-    __slots__ = ("entry_id", "tokens", "length", "pages")
+    ``state`` is ``"resident"`` (pages on device, one index reference
+    per page) or ``"spilled"`` (pages == (), payload parked in the host
+    tier under ``host_key`` — serving/pages.HostKVTier); a restore
+    transitions spilled -> resident by re-pinning freshly scattered
+    pages (:meth:`PagedPrefixIndex.rebind`)."""
+
+    __slots__ = ("entry_id", "tokens", "length", "pages", "state",
+                 "host_key")
 
     def __init__(self, entry_id: int, tokens: np.ndarray, length: int,
-                 pages: Tuple[int, ...]):
+                 pages: Tuple[int, ...], state: str = "resident",
+                 host_key: Optional[str] = None):
         self.entry_id = entry_id
         self.tokens = tokens
         self.length = length
         self.pages = pages
+        self.state = state
+        self.host_key = host_key
 
 
 class PagedPrefixIndex:
@@ -407,9 +425,15 @@ class PagedPrefixIndex:
     must not double-count.
     """
 
-    def __init__(self, pool, registry=None):
+    def __init__(self, pool, registry=None, host_tier=None):
         self.pool = pool
         self._registry = registry
+        # Optional serving/pages.HostKVTier: with it, LRU eviction of
+        # an unreferenced entry SPILLS instead of forgetting — the
+        # entry stays in the trie at state "spilled" and a later hit
+        # restores its pages (docs/serving.md §6). None (the default)
+        # keeps PR 9 behavior exactly.
+        self.host_tier = host_tier
         self._root = _TrieNode()   # rows-sets hold ENTRY IDs here
         self._entries: Dict[int, _PrefixEntry] = {}
         self._used: Dict[int, int] = {}   # entry id -> LRU clock stamp
@@ -422,6 +446,13 @@ class PagedPrefixIndex:
         self.store_skips = 0
         self.evictions = 0
         self.reclaimed_tokens = 0
+        self.spills = 0
+        self.restores = 0
+        self.adoptions = 0
+        # Scalar mirror of the spilled-entry count: summary() is read
+        # by handler threads and must not iterate _entries (driver
+        # mutates it concurrently).
+        self._n_spilled = 0
 
     @property
     def registry(self):
@@ -438,21 +469,61 @@ class PagedPrefixIndex:
 
     # -- lookup / account ---------------------------------------------
 
+    def _is_resident(self, eid: int) -> bool:
+        return self._entries[eid].state == "resident"
+
+    def _is_spilled(self, eid: int) -> bool:
+        return self._entries[eid].state == "spilled"
+
     def lookup(self, prompt: np.ndarray):
-        """Longest stored prefix of ``prompt`` at GRAIN granularity:
-        ``(page_list, hit_len)`` or ``(None, 0)``. Pure apart from the
-        LRU touch — counters land in :meth:`record` once the engine has
-        actually placed the admission (class docstring). Hit capped at
-        ``floor16(prompt_len - 1)`` exactly like :class:`PrefixCache`
-        (the last prompt position is always computed, never stored)."""
+        """Longest RESIDENT stored prefix of ``prompt`` at GRAIN
+        granularity: ``(page_list, hit_len)`` or ``(None, 0)``. Pure
+        apart from the LRU touch — counters land in :meth:`record` once
+        the engine has actually placed the admission (class docstring).
+        Hit capped at ``floor16(prompt_len - 1)`` exactly like
+        :class:`PrefixCache` (the last prompt position is always
+        computed, never stored). Spilled entries are invisible here —
+        the engine resolves them through :meth:`lookup_candidates`."""
         prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
         limit = _floor_grain(int(prompt.shape[0]) - 1)
-        node, hit = _trie_descend(self._root, prompt, limit)
+        node, hit = _trie_descend(self._root, prompt, limit,
+                                  member_ok=self._is_resident)
         if not hit:
             return None, 0
-        eid = max(node.rows, key=lambda e: self._used.get(e, 0))
+        eid = max((e for e in node.rows if self._is_resident(e)),
+                  key=lambda e: self._used.get(e, 0))
         self._touch(eid)
         return self._entries[eid].pages[:hit // GRAIN], hit
+
+    def lookup_candidates(self, prompt: np.ndarray):
+        """Both hit arms for one prompt, over the same walk: ``(res_
+        pages, res_hit, spilled_eid, spilled_hit)``. The resident arm
+        is exactly :meth:`lookup`; the spilled arm is the deepest
+        SPILLED entry covering the prompt — the engine restores it when
+        it beats the resident hit by at least the measured crossover
+        (utils/cost_model.derive_kv_restore_min_tokens). Touches only
+        the resident arm; a spilled entry's LRU stamp moves when the
+        restore actually lands (:meth:`rebind`)."""
+        res_pages, res_hit = self.lookup(prompt)
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        limit = _floor_grain(int(prompt.shape[0]) - 1)
+        # A restore rebinds a WHOLE entry (its payload covers exactly
+        # length/16 pages), so only spilled entries that fit inside the
+        # prompt's hit limit qualify — a trie descend would also
+        # surface entries merely PASSING THROUGH a shallow node on
+        # their way past the limit. Direct scan instead: the spilled
+        # set is small (bounded by the host budget) and the comparison
+        # is one vectorized prefix check per entry.
+        eid, sp_hit = None, 0
+        for e, entry in self._entries.items():
+            if (entry.state == "spilled" and sp_hit < entry.length
+                    and entry.length <= limit
+                    and np.array_equal(prompt[:entry.length],
+                                       entry.tokens)):
+                eid, sp_hit = e, entry.length
+        if eid is None:
+            return res_pages, res_hit, None, 0
+        return res_pages, res_hit, eid, sp_hit
 
     def record(self, hit_len: int) -> None:
         """Account one PLACED admission's lookup outcome."""
@@ -474,7 +545,11 @@ class PagedPrefixIndex:
         length = _floor_grain(int(prompt.shape[0]))
         if length == 0:
             return 0
-        _, covered = _trie_descend(self._root, prompt, length)
+        # Coverage counts RESIDENT entries only: a spilled entry at
+        # this prefix must not block re-storing it on device — the
+        # fresh resident copy supersedes it (deduped below).
+        _, covered = _trie_descend(self._root, prompt, length,
+                                   member_ok=self._is_resident)
         if covered >= length:
             self.store_skips += 1
             return 0
@@ -493,23 +568,76 @@ class PagedPrefixIndex:
         self._touch(eid)
         self.stores += 1
         self.registry.counter("serving_prefix_stores_total").inc()
-        self.registry.gauge("serving_prefix_entries").set(
-            len(self._entries))
+        self._mirror_entries()
+        # Dedupe: spilled entries the new resident store covers are
+        # strictly redundant (same bits, now on device) — forget them
+        # so lookups never prefer a restore over the live pages.
+        stale = [e for e, ent in self._entries.items()
+                 if ent.state == "spilled" and ent.length <= length
+                 and np.array_equal(tokens[:ent.length], ent.tokens)]
+        for e in stale:
+            self._remove(e)
         return length
 
-    def _evict(self, eid: int) -> None:
+    def _mirror_entries(self) -> None:
+        self.registry.gauge("serving_prefix_entries").set(
+            len(self._entries))
+        self.registry.gauge(
+            "serving_prefix_spilled_entries",
+            help="stored prefixes parked in the host tier "
+                 "(docs/serving.md section 6)").set(self._n_spilled)
+
+    def _remove(self, eid: int) -> None:
+        """Forget ``eid`` entirely — trie path, entry, LRU stamp, and
+        its holdings (device page references for a resident entry, the
+        host payload for a spilled one)."""
         entry = self._entries[eid]
         _trie_remove(self._root, entry.tokens, entry.length, eid)
         del self._entries[eid]
         self._used.pop(eid, None)
         self.stored_tokens -= entry.length
-        # Drop the index's references; pages free when the LAST holder
-        # (a live row still aliasing them, perhaps) lets go.
-        self.pool.unref(entry.pages)
+        if entry.state == "spilled":
+            self._n_spilled -= 1
+            if self.host_tier is not None:
+                self.host_tier.drop(entry.host_key)
+        else:
+            # Drop the index's references; pages free when the LAST
+            # holder (a live row still aliasing them, perhaps) lets go.
+            self.pool.unref(entry.pages)
+        self._mirror_entries()
+
+    def _evict(self, eid: int) -> None:
+        """LRU eviction under device pressure. With a host tier, an
+        UNREFERENCED resident entry (every page at refcount 1 — the
+        index's own pin is the only holder; the ISSUE's "spill only at
+        refcount 0" rule counted without it) SPILLS: one metered host
+        gather, pages freed, the entry stays in the trie at state
+        "spilled" so a later hit restores instead of re-prefilling.
+        Entries live rows still alias, spilled entries, and tier-less
+        indexes evict the PR 9 way — forgotten outright."""
+        entry = self._entries[eid]
+        tier = self.host_tier
+        if (tier is not None and entry.state == "resident"
+                and all(self.pool.refcount(p) == 1
+                        for p in entry.pages)):
+            spilled = tier.spill(entry.tokens, entry.length,
+                                 entry.pages)
+            if spilled is not None:
+                key, _, _ = spilled
+                self.pool.unref(entry.pages)
+                entry.pages = ()
+                entry.state = "spilled"
+                entry.host_key = key
+                self.spills += 1
+                self._n_spilled += 1
+                self.evictions += 1
+                self.registry.counter(
+                    "serving_prefix_evictions_total").inc()
+                self._mirror_entries()
+                return
+        self._remove(eid)
         self.evictions += 1
         self.registry.counter("serving_prefix_evictions_total").inc()
-        self.registry.gauge("serving_prefix_entries").set(
-            len(self._entries))
 
     def evict_lru(self) -> bool:
         """Evict the least-recently-used entry; False when empty."""
@@ -520,12 +648,79 @@ class PagedPrefixIndex:
         return True
 
     def evict_until_free(self, n_pages: int) -> None:
-        """Evict LRU entries until the pool has ``n_pages`` free pages
-        or the index is empty. Eviction of an entry whose pages live
-        rows still alias frees nothing immediately — the loop makes no
-        progress assumption beyond running out of entries."""
-        while self.pool.n_free < n_pages and self._entries:
-            self.evict_lru()
+        """Evict LRU RESIDENT entries until the pool has ``n_pages``
+        free pages or none remain. Eviction of an entry whose pages
+        live rows still alias frees nothing immediately — the loop
+        makes no progress assumption beyond running out of resident
+        entries. Spilled entries hold no device pages, so device
+        pressure never touches them (the host budget's LRU owns their
+        lifetime)."""
+        while self.pool.n_free < n_pages:
+            resident = [e for e, ent in self._entries.items()
+                        if ent.state == "resident"]
+            if not resident:
+                break
+            self._evict(min(resident,
+                            key=lambda e: self._used.get(e, 0)))
+
+    # -- spill / restore transitions (host tier) ----------------------
+
+    def rebind(self, eid: int, pages) -> None:
+        """Complete a restore: the engine scattered the spilled payload
+        into freshly allocated ``pages`` (refcount 1, row-owned) —
+        re-pin them for the index (exactly one reference, the same pin
+        a store takes) and mark the entry resident again."""
+        entry = self._entries[eid]
+        if entry.state != "spilled":
+            raise RuntimeError(
+                f"rebind of entry {eid} in state {entry.state!r}")
+        page_list = tuple(int(p) for p in pages)
+        if len(page_list) != entry.length // GRAIN:
+            raise ValueError(
+                f"rebind of {entry.length} tokens needs "
+                f"{entry.length // GRAIN} pages, got {len(page_list)}")
+        self.pool.ref(page_list)  # the restore re-pins exactly once
+        entry.pages = page_list
+        entry.state = "resident"
+        self.restores += 1
+        self._n_spilled -= 1
+        self._touch(eid)
+        self._mirror_entries()
+
+    def host_key_of(self, eid: int) -> Optional[str]:
+        """The host-tier content key of a spilled entry (the engine
+        fetches its payload by this before reserving pages)."""
+        return self._entries[eid].host_key
+
+    def forget(self, eid: int) -> None:
+        """Drop a spilled entry whose payload turned out to be gone
+        (host-budget drop raced the hit): the engine treats the hit as
+        a miss and the stale trie path must not resurface."""
+        if eid in self._entries:
+            self._remove(eid)
+
+    def adopt(self, tokens, length: int, host_key: str):
+        """Register a SPILLED entry for a payload this process did not
+        compute — the cross-replica adoption half (a shared spill_dir
+        holds the bytes; docs/fleet.md §prefix adoption). Returns the
+        entry id, or None when a resident or spilled entry already
+        covers the prefix at least as deep."""
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32))[:length].copy()
+        _, covered = _trie_descend(self._root, tokens, length)
+        if covered >= length:
+            return None
+        eid = self._next_id
+        self._next_id += 1
+        _trie_insert(self._root, tokens, length, eid)
+        self._entries[eid] = _PrefixEntry(
+            eid, tokens, length, (), state="spilled", host_key=host_key)
+        self.stored_tokens += length
+        self._touch(eid)
+        self.adoptions += 1
+        self._n_spilled += 1
+        self._mirror_entries()
+        return eid
 
     # -- observability ------------------------------------------------
 
@@ -542,4 +737,8 @@ class PagedPrefixIndex:
             "prefix_evictions": self.evictions,
             "prefix_entries": len(self._entries),
             "prefix_stored_tokens": self.stored_tokens,
+            "prefix_spilled_entries": self._n_spilled,
+            "prefix_spills": self.spills,
+            "prefix_restores": self.restores,
+            "prefix_adoptions": self.adoptions,
         }

@@ -475,6 +475,18 @@ def main(argv=None) -> int:
                         "allocator + zero-copy prefix sharing")
     p.add_argument("--prefill-chunk", type=int, default=None,
                    help="chunked-prefill chunk size (tokens)")
+    p.add_argument("--host-kv-bytes", type=int, default=None,
+                   help="host-memory KV tier budget (bytes); evicted "
+                        "stored prefixes spill to host buffers and "
+                        "restore on a later trie hit (needs --kv-pages)")
+    p.add_argument("--spill-dir", default=None,
+                   help="directory for durable spill files (.npz); "
+                        "shared across replicas it lets any peer adopt "
+                        "a sibling's spilled prefix (docs/fleet.md)")
+    p.add_argument("--restore-min-tokens", type=int, default=None,
+                   help="minimum extra hit depth (tokens) before a "
+                        "restore beats re-prefill; default from the "
+                        "measured cost-model crossover")
     p.add_argument("--max-restarts", type=int, default=3,
                    help="supervisor restart budget before fail-closed")
     p.add_argument("--restart-window-s", type=float, default=60.0,
@@ -526,7 +538,13 @@ def main(argv=None) -> int:
                    **({"kv_pages": args.kv_pages}
                       if args.kv_pages is not None else {}),
                    **({"prefill_chunk": args.prefill_chunk}
-                      if args.prefill_chunk is not None else {}))
+                      if args.prefill_chunk is not None else {}),
+                   **({"host_kv_bytes": args.host_kv_bytes}
+                      if args.host_kv_bytes is not None else {}),
+                   **({"host_kv_dir": args.spill_dir}
+                      if args.spill_dir is not None else {}),
+                   **({"restore_min_tokens": args.restore_min_tokens}
+                      if args.restore_min_tokens is not None else {}))
     drained = install_signal_handlers(server)
     print(f"SERVING host={args.host} port={server.port}", flush=True)
     try:

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import transformer as tr
+from ..models.quant import kv_layer_keys
 
 
 def _write_row_tokens(buf, row, prompt, prompt_len, first):
@@ -253,6 +254,37 @@ def prefill_chunk_into_row_paged(params, pool, buf, row, table, chunk,
     first = tr._sample(logits, temperature, key)[0]
     buf = _write_row_tokens(buf, row, prompt, prompt_len, first)
     return pool, buf, first
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+@jax.named_scope("marlin.serving.kv_restore")
+def restore_pages_into_pool(pool, payload, pages):
+    """Scatter a spilled prefix's host payload back into freshly
+    allocated pages of the (donated) device pool, in place — the
+    device half of a host-tier restore (serving/pages.HostKVTier,
+    docs/serving.md §6).
+
+    ``payload`` is the tier's gathered copy: a list per layer of
+    ``{name: (n, PAGE, Hk, Dh)}`` arrays over
+    :func:`models.quant.kv_layer_keys` (int8 scale buffers travel with
+    their pages); ``pages`` is the (n,) int32 target page list. Both
+    are traced — the only static axis is the page count ``n``, so
+    compiles are bounded by distinct spilled-prefix page counts (the
+    same 16-bucket discipline as every admission entry point; the
+    engine registers this with its CompileWatchdog).
+
+    Bit-exactness: the payload bytes ARE the evicted pages' bytes (one
+    gather, one scatter, no arithmetic — any cast is to the dtype the
+    bytes came from), so a restored prefix is bit-identical to the
+    never-evicted pages it replaces."""
+    out = []
+    for layer, pl in zip(pool, payload):
+        nl = {}
+        for name in kv_layer_keys(layer):
+            nl[name] = layer[name].at[pages].set(
+                pl[name].astype(layer[name].dtype))
+        out.append(nl)
+    return out
 
 
 class SlotManager:

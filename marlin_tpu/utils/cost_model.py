@@ -52,6 +52,10 @@ __all__ = [
     "spec_round_cost", "pick_draft_len",
     "run_svd_mode_crossover_sweep", "SVD_CROSSOVER_GRID",
     "derive_svd_local_eigs_max",
+    "restore_cost", "KV_RESTORE_MIN_TOKENS_DEFAULT",
+    "run_kv_restore_crossover_sweep", "KV_RESTORE_LENGTHS",
+    "derive_kv_restore_min_tokens",
+    "run_paged_gather_tax_sweep", "GATHER_TAX_LENGTHS",
     "CostCalibration",
 ]
 
@@ -287,6 +291,40 @@ def admission_cost(cfg, prompt_len: int, hit_len: int = 0,
         + tail * pos_bytes \
         + 2.0 * hit_len * pos_bytes  # pool read + row write of the copy
     return flops, float(byts)
+
+
+# Floor for the host-KV restore-vs-reprefill decision when no measured
+# crossover is installed (utils/cost_model.run_kv_restore_crossover_sweep
+# derives the data-backed value; the serving_host_kv bench reports it).
+# Two pages: below that a restore's fixed dispatch+h2d overhead rivals
+# the tiny prefill it would replace, so re-prefilling is never worse.
+KV_RESTORE_MIN_TOKENS_DEFAULT = 32
+
+
+def restore_cost(cfg, hit_len: int,
+                 param_itemsize: int = 4) -> Tuple[float, float]:
+    """(flops, bytes) of restoring ``hit_len`` SPILLED prefix positions
+    from the host KV tier (serving/pages.HostKVTier): zero FLOPs — a
+    restore recomputes nothing — and the h2d payload transfer plus the
+    device scatter write, ``2 * hit_len * pos_bytes`` with the same
+    per-position cache pricing as :func:`admission_cost` (int8 pools
+    price slots at 1 byte plus the per-vector f32 scale).
+
+    The admission decision this prices: a spilled hit either RESTORES
+    (this cost) or RE-PREFILLS (``admission_cost(cfg, hit_len)`` —
+    quadratic FLOPs in the hit). Restore bytes scale linearly while
+    re-prefill FLOPs scale quadratically, so restore wins ABOVE a
+    crossover length; the engine's ``restore_min_tokens`` knob is that
+    crossover, measured by :func:`run_kv_restore_crossover_sweep`."""
+    if hit_len < 0:
+        raise ValueError(f"hit_len must be >= 0, got {hit_len}")
+    dh = cfg.d_model // cfg.n_heads
+    pos_elems = 2 * cfg.n_layers * cfg.kv_heads * dh
+    if getattr(cfg, "kv_quant", ""):
+        pos_bytes = pos_elems * 1.0 + (pos_elems // dh) * 4.0
+    else:
+        pos_bytes = float(pos_elems * param_itemsize)
+    return 0.0, float(2.0 * hit_len * pos_bytes)
 
 
 def spec_round_cost(cfg, batch: int, draft_len: int,
@@ -1146,6 +1184,185 @@ def derive_svd_local_eigs_max(points) -> int:
         return int(round(_math.exp(
             _math.log(n0) + t * (_math.log(n1) - _math.log(n0)))))
     return int(pts[-1]["n"])  # local-eigs wins across the whole sweep
+
+
+# Host-KV restore vs re-prefill crossover (docs/serving.md §6): a
+# spilled prefix hit can be RESTORED (h2d payload + device scatter,
+# linear bytes, zero FLOPs) or RE-PREFILLED (quadratic FLOPs in the hit
+# length). The sweep times BOTH arms over a hit-length grid with the
+# real jitted entry points — restore_pages_into_pool including the h2d
+# of the numpy payload, and the chunked paged prefill — so the derived
+# restore_min_tokens the engine gates restores on is measured, not
+# modeled. Lengths are PAGE multiples (a restore rebinds whole pages).
+KV_RESTORE_LENGTHS = (64, 128, 256, 512)
+
+
+def run_kv_restore_crossover_sweep(cfg=None, lengths=KV_RESTORE_LENGTHS,
+                                   reps: int = 3, chunk: int = 64,
+                                   seed: int = 7):
+    """Measure host-tier restore vs paged re-prefill wall-clock over a
+    hit-length grid; returns per-point ``{length, restore_s,
+    reprefill_s, restore_over_reprefill}``. Feed the points to
+    :func:`derive_kv_restore_min_tokens` for the crossover length.
+
+    Per length: a pool is prefilled once through the REAL chunked
+    admission path (that prefill is the re-prefill arm — median of
+    ``reps`` fenced passes after a warmup, measure_wallclock's
+    contract), then the pages are gathered to a host payload exactly as
+    HostKVTier.spill does and the restore arm times the jitted scatter
+    INCLUDING the per-call h2d of the numpy payload (the payload stays
+    numpy, so every call pays the transfer a real restore pays)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.quant import kv_layer_keys
+    from ..models.transformer import TransformerConfig, init_params
+    from ..obs.metrics import MetricsRegistry
+    from ..serving.pages import PAGE, PagePool
+    from ..serving.slots import (prefill_chunk_into_row_paged,
+                                 restore_pages_into_pool)
+
+    cfg = cfg or TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                   n_layers=2, d_ff=128,
+                                   max_len=max(lengths))
+    params = init_params(cfg, seed=seed)
+    rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for length in lengths:
+        if length % PAGE or length % chunk or length > cfg.max_len:
+            raise ValueError(
+                f"length {length} must be a multiple of PAGE={PAGE} and "
+                f"chunk={chunk}, and <= max_len={cfg.max_len}")
+        n = length // PAGE
+        pool = PagePool(cfg, n, registry=MetricsRegistry())
+        pages = pool.alloc(n)
+        tbl_host = np.zeros(cfg.max_len // PAGE, np.int32)
+        tbl_host[:n] = pages
+        tbl = jnp.asarray(tbl_host)
+        prompt = jnp.asarray(
+            rng.integers(1, cfg.vocab, size=length).astype(np.int32))
+
+        # -- re-prefill arm: the chunked paged admission over the hit --
+        state = {"pool": pool.pages,
+                 "buf": jnp.zeros((1, cfg.max_len), jnp.int32)}
+
+        def reprefill(state=state, tbl=tbl, prompt=prompt, length=length):
+            pl, bf = state["pool"], state["buf"]
+            for c0 in range(0, length, chunk):
+                pl, bf = prefill_chunk_into_row_paged(
+                    params, pl, bf, 0, tbl, prompt[c0:c0 + chunk], c0,
+                    chunk, prompt, length, key, cfg)
+            state["pool"], state["buf"] = pl, bf
+            return pl
+
+        reprefill_s = measure_wallclock(reprefill, reps=reps)
+
+        # -- restore arm: gather the (now real) pages to a host payload
+        # exactly as HostKVTier.spill does, then time the scatter. The
+        # np.asarray copies the GATHER RESULT (a fresh temp), never a
+        # donated pool buffer — the sanctioned donation-fetch form.
+        idx = np.asarray(pages, np.int32)
+        payload = [{name: np.asarray(layer[name][idx])
+                    for name in kv_layer_keys(layer)}
+                   for layer in state["pool"]]
+        pages_j = jnp.asarray(idx)
+
+        def restore(state=state, payload=payload, pages_j=pages_j):
+            state["pool"] = restore_pages_into_pool(
+                state["pool"], payload, pages_j)
+            return state["pool"]
+
+        restore_s = measure_wallclock(restore, reps=reps)
+        out.append({
+            "length": length, "restore_s": restore_s,
+            "reprefill_s": reprefill_s,
+            "restore_over_reprefill":
+                restore_s / max(reprefill_s, 1e-12),
+        })
+    return out
+
+
+def derive_kv_restore_min_tokens(points) -> int:
+    """Data-backed ``restore_min_tokens`` from a crossover sweep: the
+    hit length where ``restore_over_reprefill`` crosses 1.0 (re-prefill
+    cheaper below it, restore above — the ratio FALLS with length
+    because re-prefill FLOPs are quadratic while restore bytes are
+    linear), log-interpolated between the last re-prefill-winning point
+    and the first restore-winning one — the same derivation contract as
+    :func:`derive_ell_density_max`. Clamps to the grid: restore winning
+    even at the floor returns half the lowest length (bounded below by
+    one page); restore NEVER winning returns twice the highest measured
+    length — conservative, the engine then restores only hits beyond
+    anything the sweep priced. Points need not be sorted; ratios <= 0
+    are rejected."""
+    import math as _math
+
+    pts = sorted(points, key=lambda p: p["length"])
+    if not pts:
+        raise ValueError("empty crossover sweep")
+    if any(p["restore_over_reprefill"] <= 0 for p in pts):
+        raise ValueError("restore_over_reprefill must be positive")
+    if pts[0]["restore_over_reprefill"] <= 1.0:
+        # Restore wins even at the floor: crossover is below the sweep.
+        return max(16, int(pts[0]["length"] // 2))
+    last_lose = pts[0]
+    for p in pts[1:]:
+        if p["restore_over_reprefill"] > 1.0:
+            last_lose = p
+            continue
+        # log-log interpolation of the ratio=1 crossing in length.
+        l0, r0 = last_lose["length"], last_lose["restore_over_reprefill"]
+        l1, r1 = p["length"], p["restore_over_reprefill"]
+        t = (0.0 - _math.log(r0)) / (_math.log(r1) - _math.log(r0))
+        return int(round(_math.exp(
+            _math.log(l0) + t * (_math.log(l1) - _math.log(l0)))))
+    return int(2 * pts[-1]["length"])  # restore never won in the sweep
+
+
+# Paged-attention gather tax (the trend bench's standing question): the
+# paged decode path materializes dense per-row cache views by gathering
+# pages every round (models/transformer.gather_kv_pages). The sweep
+# times that gather alone over a sequence-length grid so the trend line
+# shows how the per-round indirection cost grows with context — the tax
+# paged KV pays for its capacity win.
+GATHER_TAX_LENGTHS = (64, 128, 256, 512)
+
+
+def run_paged_gather_tax_sweep(cfg=None, lengths=GATHER_TAX_LENGTHS,
+                               reps: int = 3):
+    """Measure the jitted per-round page gather over a sequence-length
+    grid; returns per-point ``{length, gather_s, bytes}`` (``bytes`` is
+    the dense view the gather materializes — page_bytes per page)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.transformer import TransformerConfig, gather_kv_pages
+    from ..obs.metrics import MetricsRegistry
+    from ..serving.pages import PAGE, PagePool
+
+    cfg = cfg or TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                   n_layers=2, d_ff=128,
+                                   max_len=max(lengths))
+    n_max = max(lengths) // PAGE
+    pool = PagePool(cfg, n_max, registry=MetricsRegistry())
+    pages = pool.alloc(n_max)
+    gather = jax.jit(gather_kv_pages)
+    out = []
+    for length in lengths:
+        if length % PAGE or length > cfg.max_len:
+            raise ValueError(
+                f"length {length} must be a multiple of PAGE={PAGE} "
+                f"and <= max_len={cfg.max_len}")
+        n = length // PAGE
+        tbl = jnp.asarray(np.asarray(pages[:n], np.int32))[None]
+        gather_s = measure_wallclock(
+            lambda tbl=tbl: gather(pool.pages, tbl), reps=reps)
+        out.append({"length": length, "gather_s": gather_s,
+                    "bytes": float(pool.page_bytes * n)})
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,57 @@ def pytest_configure(config):
         "markers",
         "slow: test exceeding 60 s on the CPU mesh; excluded from the "
         "tier-1 run (-m 'not slow'), exercised by the weekly tier")
+    config.addinivalue_line(
+        "markers",
+        "weekly: breadth tests (extra variant matrices, long property "
+        "drives) excluded from tier-1 like slow, but kept distinct so "
+        "the weekly tier can be selected precisely (-m 'slow or "
+        "weekly'); pytest_collection_modifyitems folds weekly into the "
+        "slow exclusion so `-m 'not slow'` needs no update")
+
+
+def pytest_collection_modifyitems(config, items):
+    # MARLIN_T1_SHARD=i/n splits the tier-1 suite into n stable shards
+    # by MODULE (jit caches are warmed per module; splitting inside a
+    # module would recompile shared fixtures in every shard). The hash
+    # is content-independent (module path CRC), so a shard assignment
+    # only moves when a file is added or renamed — never when tests
+    # within it change. Default 1/1 = everything, byte-identical to the
+    # un-sharded run.
+    import zlib
+
+    for item in items:
+        # ``weekly`` rides the slow exclusion: one `-m 'not slow'`
+        # invocation stays THE tier-1 command, and `-m 'slow or
+        # weekly'` selects the explicit weekly tier.
+        if item.get_closest_marker("weekly") \
+                and not item.get_closest_marker("slow"):
+            item.add_marker(pytest.mark.slow)
+
+    shard = os.environ.get("MARLIN_T1_SHARD", "").strip()
+    if not shard:
+        return
+    try:
+        idx_s, n_s = shard.split("/")
+        idx, n = int(idx_s), int(n_s)
+    except ValueError:
+        raise pytest.UsageError(
+            f"MARLIN_T1_SHARD must look like 'i/n' (1-based), got "
+            f"{shard!r}")
+    if not 1 <= idx <= n:
+        raise pytest.UsageError(
+            f"MARLIN_T1_SHARD index {idx} outside 1..{n}")
+    if n == 1:
+        return
+    keep, dropped = [], []
+    for item in items:
+        h = zlib.crc32(str(item.fspath).encode())
+        if h % n == idx - 1:
+            keep.append(item)
+        else:
+            dropped.append(item)
+    items[:] = keep
+    config.hook.pytest_deselected(items=dropped)
 
 
 @pytest.fixture(scope="session", autouse=True)

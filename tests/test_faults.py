@@ -410,6 +410,78 @@ class TestBitExactRecovery:
         _assert_exact_accounting(fe, reg, 4)
         assert fe.drain(30.0)
 
+    def test_kv_restore_crash_rebuilds_tier_and_readopts(self, model,
+                                                         tmp_path):
+        """Chaos plan firing MID-RESTORE (the host-tier scatter,
+        ISSUE 16): the successor rebuilds a FRESH host tier — in-memory
+        payloads discarded wholesale, the coherent crash story — while
+        the ``spill_dir``'s durable payload survives, so the REPLAYED
+        admission re-adopts the dead incarnation's spill from disk and
+        restores it bit-exactly (the fault is one-shot; the second
+        restore lands)."""
+        params, cfg = model
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, cfg.vocab, 32).astype(np.int32)
+
+        def prompt(i):
+            if i in (0, 3):  # the shared-prefix pair
+                return np.concatenate([shared, rng.integers(
+                    0, cfg.vocab, 8).astype(np.int32)])
+            return np.random.default_rng(50 + i).integers(
+                0, cfg.vocab, 40).astype(np.int32)
+
+        prompts = [prompt(i) for i in range(4)]
+        kw = dict(batch=2, round_steps=2, prefill_chunk=16)
+        gold = _golden(params, cfg, prompts, 4, kv_pages=7, **kw)
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="kv_restore")  # one-shot: first restore crashes
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, metrics_registry=reg,
+                            kv_pages=7, host_kv_bytes=1 << 22,
+                            host_kv_dir=str(tmp_path),
+                            restore_min_tokens=16, **kw)
+        crashed_pool, crashed_tier = eng.page_pool, eng.host_tier
+        fe = EngineFrontend(eng).start()
+        # Phased so the spill -> restore sequence is deterministic:
+        # req 0 stores the shared prefix; the churn pair's reservations
+        # force its eviction (spill, kv_pages=7 leaves no slack); req 3
+        # hits the spilled prefix and its admission restores — where
+        # the fault fires.
+        results = {}
+        for batch in ([0], [1, 2], [3]):
+            handles = [fe.submit(prompts[i], 4) for i in batch]
+            for h in handles:
+                results[h.request_id] = h.result(60.0)
+        faults.reset()
+        assert plan.total_fires() == 1  # the restore path really ran
+        assert fe.restarts == 1
+        for rid, r in results.items():
+            assert list(map(int, r.tokens)) == gold[rid], rid
+        _assert_exact_accounting(fe, reg, 4)
+        from marlin_tpu.obs import metrics as obs_metrics
+        assert obs_metrics.registry.counter(
+            "serving_faults_injected_total",
+            site="kv_restore").value >= 1
+        # The successor rebuilt BOTH storage layers from scratch.
+        succ = fe.engine
+        assert succ.page_pool is not crashed_pool
+        assert succ.host_tier is not crashed_tier
+        assert succ.host_tier.summary()["spill_dir"] == str(tmp_path)
+        # The torn restore left nothing behind: every device reference
+        # is a stored prefix's own pin (rows all retired).
+        stored = sum(len(e.pages)
+                     for e in succ.prefix_index._entries.values())
+        assert succ.page_pool.n_used == stored
+        # The replay went through the DURABLE half: the predecessor's
+        # spill file was adopted by the fresh tier and restored (the
+        # fresh incarnation never spilled anything itself first).
+        assert succ.prefix_index.adoptions >= 1
+        assert succ.host_tier.summary()["restores"] >= 1
+        assert any(f.endswith(".npz") for f in os.listdir(tmp_path))
+        restores = [e for e in fe.engine.runlog.events("restore")]
+        assert restores and all(e["bytes"] > 0 for e in restores)
+        assert fe.drain(30.0)
+
 
 # -- poison quarantine + fail closed ----------------------------------
 

@@ -625,8 +625,13 @@ class TestBaselineMetricConsistency:
         baseline blocks at once.)"""
         params, cfg = model
         reg = MetricsRegistry()
+        # Paged + host-tiered: the baseline's metrics_host_kv block
+        # references the tier's gauge/histogram series, which register
+        # at tier construction (count 0 until the first restore) — a
+        # tierless smoke would read them as stale.
         eng = ServingEngine(params, cfg, batch=2, round_steps=4,
-                            metrics_registry=reg)
+                            metrics_registry=reg, kv_pages=32,
+                            host_kv_bytes=1 << 20)
         fe = EngineFrontend(eng).start()
         # Streamed requests exercise the full phase surface, including
         # the frontend's stream_delivery slice.

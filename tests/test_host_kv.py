@@ -1,0 +1,627 @@
+"""Host-memory KV tier suite (serving/pages.HostKVTier, ISSUE 16,
+docs/serving.md §6): spill/restore of paged prefixes with a measured
+restore-vs-reprefill crossover.
+
+The acceptance claims, each pinned mechanically:
+
+* PAYLOAD EXACTNESS — a spill's host payload round-trips bit-identical
+  through memory AND through the durable ``spill_dir`` (bfloat16 pools
+  upcast to float32 on disk — value-exact — and the restore scatter
+  casts back).
+* STATE MACHINE — the index spills an entry only when its own pin is
+  the SOLE page reference; a restore re-pins exactly once (row alloc +
+  index rebind = refcount 2); forgotten/stale spilled entries leave no
+  refs behind.
+* ENGINE RESTORE — a tier-on engine drains bit-exactly vs a tier-off
+  engine under forced spill+restore cycles, the runlog carries metered
+  ``spill``/``restore`` events, and ``debug_snapshot`` grows the
+  ``host_tier`` block.
+* ADOPTION — two engines sharing a ``spill_dir`` exchange prefixes by
+  content key: what one replica spilled, the other restores without
+  ever having computed it (docs/fleet.md §prefix adoption).
+* SUCCESSOR — ``spawn_successor`` rebuilds a FRESH tier (wholesale
+  discard is the coherent crash story) with the host knobs carried,
+  and a shared ``spill_dir`` lets the successor re-adopt payloads the
+  dead incarnation computed.
+* COST MODEL — ``restore_cost`` prices the restore's bytes exactly as
+  ``admission_cost`` prices the hit-copy term, and
+  ``derive_kv_restore_min_tokens`` follows the repo's crossover
+  derivation contract (floor/ceiling clamps, log-log interpolation).
+* SLO GATE — ``bench.py --config serving_host_kv`` clears the
+  committed baseline's ``metrics_host_kv`` block end-to-end
+  (tools/slo_check.py --metrics-key): bit-exact across variants,
+  >= 5x capacity at equal device bytes, restore cheaper than
+  re-prefill at the longest measured hit, zero steady-state recompiles
+  in both arms.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.models.quant import kv_layer_keys
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.serving import ServingEngine
+from marlin_tpu.serving.pages import PAGE, HostKVTier, PagePool
+from marlin_tpu.serving.prefix import PagedPrefixIndex
+from marlin_tpu.serving.slots import restore_pages_into_pool
+from marlin_tpu.obs.runlog import RunLog
+from marlin_tpu.utils import cost_model as cm
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=128)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _pool(cfg, n_pages=8):
+    return PagePool(cfg, n_pages, registry=MetricsRegistry())
+
+
+def _filled_pages(pool, n, seed=3):
+    """Alloc ``n`` pages and scatter a random (but typed) payload into
+    them through the real restore primitive; returns (pages, payload)
+    — the payload a later spill must reproduce byte-for-byte."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    pages = pool.alloc(n)
+    payload = []
+    for layer in pool.pages:
+        nl = {}
+        for name in kv_layer_keys(layer):
+            shape = (n,) + layer[name].shape[1:]
+            dt = layer[name].dtype
+            if dt == np.dtype("int8"):
+                nl[name] = rng.integers(-127, 127, shape).astype(np.int8)
+            else:
+                nl[name] = rng.standard_normal(shape).astype(np.float32)
+        payload.append(nl)
+    pool.pages = restore_pages_into_pool(
+        pool.pages, payload,
+        jax.numpy.asarray(np.asarray(pages, np.int32)))
+    jax.block_until_ready(pool.pages)
+    # What the DEVICE holds (post-cast to the pool dtype) is the
+    # reference a spill must gather back exactly.
+    idx = np.asarray(pages, np.int32)
+    held = [{name: np.asarray(layer[name][idx])
+             for name in kv_layer_keys(layer)} for layer in pool.pages]
+    return pages, held
+
+
+def _payloads_equal(a, b):
+    for la, lb in zip(a, b):
+        for name in la:
+            x = np.asarray(la[name], np.float32)
+            y = np.asarray(lb[name], np.float32)
+            if not np.array_equal(x, y):
+                return False
+    return True
+
+
+class TestHostTierPayloads:
+    def test_spill_fetch_roundtrip_is_bit_identical(self):
+        cfg = _cfg()
+        pool = _pool(cfg)
+        tier = HostKVTier(pool, registry=pool.registry)
+        pages, held = _filled_pages(pool, 3)
+        tokens = np.arange(3 * PAGE, dtype=np.int32)
+        key, nbytes, dt = tier.spill(tokens, 3 * PAGE, pages)
+        assert nbytes == sum(a.nbytes for l in held for a in l.values())
+        got, got_bytes = tier.fetch(key)
+        assert got_bytes == nbytes
+        assert _payloads_equal(got, held)
+        assert dt >= 0.0
+
+    def test_spill_dir_roundtrip_survives_drop(self, tmp_path):
+        cfg = _cfg()
+        pool = _pool(cfg)
+        tier = HostKVTier(pool, registry=pool.registry,
+                          spill_dir=str(tmp_path))
+        pages, held = _filled_pages(pool, 2)
+        tokens = np.arange(2 * PAGE, dtype=np.int32)
+        key, _, _ = tier.spill(tokens, 2 * PAGE, pages)
+        tier.drop(key)  # memory gone; the dir file is the durable copy
+        assert tier.summary()["host_entries"] == 0
+        got, _ = tier.fetch(key)
+        assert got is not None and _payloads_equal(got, held)
+
+    def test_bfloat16_pool_roundtrips_exactly_through_disk(self, tmp_path):
+        # bf16 is not np.savez-native: the dir copy upcasts to float32
+        # (a value-exact superset) and the restore scatter casts back.
+        cfg = _cfg(dtype="bfloat16")
+        pool = _pool(cfg)
+        tier = HostKVTier(pool, registry=pool.registry,
+                          spill_dir=str(tmp_path))
+        pages, held = _filled_pages(pool, 2)
+        key, _, _ = tier.spill(np.arange(2 * PAGE, dtype=np.int32),
+                               2 * PAGE, pages)
+        tier.drop(key)
+        got, _ = tier.fetch(key)
+        assert got is not None and _payloads_equal(got, held)
+        # Scattered back into the pool, the bytes equal the originals.
+        pool.pages = restore_pages_into_pool(
+            pool.pages, got,
+            jax.numpy.asarray(np.asarray(pages, np.int32)))
+        idx = np.asarray(pages, np.int32)
+        back = [{n: np.asarray(l[n][idx]) for n in kv_layer_keys(l)}
+                for l in pool.pages]
+        assert _payloads_equal(back, held)
+
+    def test_int8_scales_travel_with_their_pages(self):
+        cfg = _cfg(kv_quant="int8")
+        pool = _pool(cfg)
+        tier = HostKVTier(pool, registry=pool.registry)
+        pages, held = _filled_pages(pool, 2)
+        key, _, _ = tier.spill(np.arange(2 * PAGE, dtype=np.int32),
+                               2 * PAGE, pages)
+        got, _ = tier.fetch(key)
+        names = {n for l in got for n in l}
+        assert {"k", "v", "ks", "vs"} <= names
+        assert _payloads_equal(got, held)
+
+    def test_budget_lru_drops_oldest_and_oversize_is_refused(self):
+        cfg = _cfg()
+        pool = _pool(cfg)
+        pages1, _ = _filled_pages(pool, 2, seed=1)
+        pages2, _ = _filled_pages(pool, 2, seed=2)
+        t1 = np.arange(2 * PAGE, dtype=np.int32)
+        t2 = np.arange(2 * PAGE, dtype=np.int32) + 1
+        # Learn one payload's exact size from an unbudgeted probe spill.
+        _, one_payload, _ = HostKVTier(
+            pool, registry=pool.registry).spill(t1, 2 * PAGE, pages1)
+        tier = HostKVTier(pool, budget_bytes=one_payload,
+                          registry=pool.registry)
+        k1, _, _ = tier.spill(t1, 2 * PAGE, pages1)
+        k2, _, _ = tier.spill(t2, 2 * PAGE, pages2)
+        assert tier.fetch(k1) is None  # LRU-dropped, no spill_dir
+        assert tier.fetch(k2) is not None
+        assert tier.summary()["host_drops"] == 1
+        # A payload that can NEVER fit is refused outright, not churned.
+        big = HostKVTier(pool, budget_bytes=1, registry=pool.registry)
+        assert big.spill(t1, 2 * PAGE, pages1) is None
+
+    def test_probe_finds_longest_prefix_and_content_key_is_stable(
+            self, tmp_path):
+        cfg = _cfg()
+        pool = _pool(cfg)
+        tier = HostKVTier(pool, registry=pool.registry,
+                          spill_dir=str(tmp_path))
+        pages, _ = _filled_pages(pool, 2)
+        tokens = np.arange(2 * PAGE, dtype=np.int32)
+        key, _, _ = tier.spill(tokens, 2 * PAGE, pages)
+        assert key == HostKVTier.key_for(tokens, 2 * PAGE)
+        prompt = np.concatenate([tokens, np.full(5, 63, np.int32)])
+        got_key, hit = tier.probe(prompt)
+        assert (got_key, hit) == (key, 2 * PAGE)
+        # A fresh tier over the same dir probes the FILE (adoption).
+        tier2 = HostKVTier(pool, registry=pool.registry,
+                           spill_dir=str(tmp_path))
+        assert tier2.probe(prompt) == (key, 2 * PAGE)
+        assert HostKVTier(pool, registry=pool.registry).probe(
+            prompt) == (None, 0)
+
+
+class TestIndexSpillTransitions:
+    def _setup(self, tmp_path=None, n_pages=8):
+        cfg = _cfg()
+        pool = _pool(cfg, n_pages)
+        tier = HostKVTier(
+            pool, registry=pool.registry,
+            spill_dir=str(tmp_path) if tmp_path is not None else None)
+        idx = PagedPrefixIndex(pool, registry=pool.registry,
+                               host_tier=tier)
+        return cfg, pool, tier, idx
+
+    def test_evict_spills_only_when_index_is_sole_holder(self):
+        cfg, pool, tier, idx = self._setup()
+        pages, _ = _filled_pages(pool, 2)
+        prompt = np.arange(2 * PAGE + 4, dtype=np.int32) % cfg.vocab
+        idx.store(prompt, pages)
+        pool.ref(pages)  # a live row still aliases the pages
+        before = pool.n_free
+        idx.evict_until_free(pool.n_free + 1)
+        # Referenced entry could NOT spill: it was removed outright.
+        assert tier.summary()["spills"] == 0
+        assert idx.summary()["prefix_entries"] == 0
+        # The alias ref is still live; pages are not free yet.
+        assert pool.n_free == before
+        pool.unref(pages)   # row retires its alias
+        pool.unref(pages)   # the original alloc ref
+        assert pool.n_free == 8
+
+    def test_spill_then_rebind_refcounts_exactly(self):
+        cfg, pool, tier, idx = self._setup()
+        pages, _ = _filled_pages(pool, 2)
+        prompt = np.arange(2 * PAGE + 4, dtype=np.int32) % cfg.vocab
+        assert idx.store(prompt, pages) == 2 * PAGE
+        pool.unref(pages)  # the storing row retired: index sole holder
+        idx.evict_until_free(pool.n_pages)
+        assert tier.summary()["spills"] == 1
+        assert all(pool.refcount(p) == 0 for p in pages)
+        s = idx.summary()
+        assert s["prefix_spilled_entries"] == 1
+        assert s["prefix_entries"] == 1  # spilled entries stay listed
+        # A hit on the spilled prefix: candidates surface it.
+        probe = np.concatenate([prompt, np.zeros(4, np.int32)])
+        res, hit, sp, sp_hit = idx.lookup_candidates(probe)
+        assert hit == 0 and sp is not None and sp_hit == 2 * PAGE
+        # Restore: fresh alloc (refcount 1) + rebind re-pins (== 2).
+        fresh = pool.alloc(2)
+        idx.rebind(sp, fresh)
+        assert all(pool.refcount(p) == 2 for p in fresh)
+        assert idx.summary()["prefix_spilled_entries"] == 0
+        res, hit = idx.lookup(probe)
+        assert hit == 2 * PAGE and list(res) == list(fresh)
+
+    def test_rebind_rejects_resident_entries_and_bad_page_counts(self):
+        cfg, pool, tier, idx = self._setup()
+        pages, _ = _filled_pages(pool, 2)
+        prompt = np.arange(2 * PAGE + 4, dtype=np.int32) % cfg.vocab
+        idx.store(prompt, pages)
+        (eid,) = idx._entries  # white-box: store returns length, not id
+        with pytest.raises(RuntimeError, match="state 'resident'"):
+            idx.rebind(eid, pages)
+        pool.unref(pages)
+        idx.evict_until_free(pool.n_pages)
+        short = pool.alloc(1)
+        with pytest.raises(ValueError, match="pages"):
+            idx.rebind(eid, short)
+
+    def test_forget_drops_stale_spilled_entry(self):
+        cfg, pool, tier, idx = self._setup()
+        pages, _ = _filled_pages(pool, 2)
+        prompt = np.arange(2 * PAGE + 4, dtype=np.int32) % cfg.vocab
+        idx.store(prompt, pages)
+        pool.unref(pages)
+        idx.evict_until_free(pool.n_pages)
+        eid = idx.lookup_candidates(
+            np.concatenate([prompt, np.zeros(4, np.int32)]))[2]
+        assert eid is not None
+        idx.forget(eid)
+        assert idx.summary()["prefix_entries"] == 0
+        assert idx.lookup_candidates(
+            np.concatenate([prompt, np.zeros(4, np.int32)]))[2] is None
+        idx.forget(eid)  # idempotent
+
+    def test_adopt_creates_spilled_entry_without_device_refs(
+            self, tmp_path):
+        cfg, pool, tier, idx = self._setup(tmp_path)
+        pages, _ = _filled_pages(pool, 2)
+        tokens = np.arange(2 * PAGE, dtype=np.int32)
+        key, _, _ = tier.spill(tokens, 2 * PAGE, pages)
+        eid = idx.adopt(tokens, 2 * PAGE, key)
+        assert eid is not None
+        assert idx.host_key_of(eid) == key
+        assert pool.n_free == pool.n_pages - 2  # no new refs taken
+        probe = np.concatenate([tokens, np.zeros(4, np.int32)])
+        assert idx.lookup_candidates(probe)[2] == eid
+        # Adopting under an existing COVERING entry is refused.
+        assert idx.adopt(tokens, 2 * PAGE, key) is None
+
+    def test_resident_store_dedupes_covered_spilled_entry(self):
+        cfg, pool, tier, idx = self._setup()
+        pages, _ = _filled_pages(pool, 2)
+        prompt = np.arange(2 * PAGE + 4, dtype=np.int32) % cfg.vocab
+        idx.store(prompt, pages)
+        pool.unref(pages)
+        idx.evict_until_free(pool.n_pages)
+        assert idx.summary()["prefix_spilled_entries"] == 1
+        # The same prefix re-prefilled and re-stored RESIDENT: the
+        # spilled twin is now redundant and must not linger.
+        fresh, _ = _filled_pages(pool, 2, seed=9)
+        idx.store(prompt, fresh)
+        s = idx.summary()
+        assert s["prefix_spilled_entries"] == 0
+        assert s["prefix_entries"] == 1
+
+
+class TestEngineRestore:
+    def _workload(self, cfg, eng):
+        rng = np.random.default_rng(5)
+        prefix = rng.integers(1, cfg.vocab, 48).astype(np.int32)
+        outs = []
+        p1 = np.concatenate([prefix, rng.integers(
+            1, cfg.vocab, 8).astype(np.int32)])
+        eng.submit(p1, 8)
+        outs.append([list(map(int, r.tokens)) for r in eng.run()])
+        for i in range(3):
+            q = np.random.default_rng(100 + i).integers(
+                1, cfg.vocab, 64).astype(np.int32)
+            eng.submit(q, 8)
+        outs.append(sorted(list(map(int, r.tokens)) for r in eng.run()))
+        p3 = np.concatenate([prefix, rng.integers(
+            1, cfg.vocab, 4).astype(np.int32)])
+        eng.submit(p3, 8)
+        outs.append([list(map(int, r.tokens)) for r in eng.run()])
+        return outs
+
+    def _engine(self, cfg, params, tier, tmp_path=None, **kw):
+        return ServingEngine(
+            params, cfg, batch=2, kv_pages=10, prefill_chunk=16,
+            prefix_sharing=True,
+            host_kv_bytes=(1 << 22) if tier else None,
+            host_kv_dir=(str(tmp_path) if tmp_path is not None
+                         else None),
+            restore_min_tokens=16 if (tier or tmp_path is not None)
+            else None, **kw)
+
+    def test_restore_is_bitexact_and_observable(self, tmp_path):
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        runlog = RunLog(maxlen=256,
+                        path=str(tmp_path / "runlog.jsonl"))
+        reg = MetricsRegistry()
+        eng = self._engine(cfg, params, tier=True,
+                           metrics_registry=reg, runlog=runlog)
+        on = self._workload(cfg, eng)
+        snap = eng.debug_snapshot()
+        eng.drain()
+        off = self._workload(
+            cfg, self._engine(cfg, params, tier=False))
+        assert on == off
+        # The host_tier debug block and the tier counters.
+        ht = snap["host_tier"]
+        assert ht["spills"] >= 1 and ht["restores"] >= 1
+        assert ht["restore_min_tokens"] == 16
+        assert reg.counter("serving_kv_spills_total").value >= 1
+        assert reg.counter("serving_kv_restores_total").value >= 1
+        hist = reg.histogram("serving_kv_restore_seconds").summary()
+        assert hist["count"] == ht["restores"]
+        # Metered runlog events: spill/restore carry bytes + latency.
+        spills = runlog.events("spill")
+        restores = runlog.events("restore")
+        assert spills and restores
+        assert all(e["bytes"] > 0 and e["spill_s"] >= 0 for e in spills)
+        assert all(e["bytes"] > 0 and e["restore_s"] >= 0
+                   for e in restores)
+        # Round events narrate the tier (runlog_report reads these).
+        rounds = runlog.events("round")
+        assert sum(e.get("spills", 0) for e in rounds) == ht["spills"]
+        assert sum(e.get("restores", 0) for e in rounds) == \
+            ht["restores"]
+
+    def test_crossover_gate_reprefills_short_hits(self):
+        # restore_min_tokens above every possible hit: the engine must
+        # NEVER restore (every spilled hit re-prefills) — the admission
+        # auto-pick respects the measured crossover.
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        eng = ServingEngine(
+            params, cfg, batch=2, kv_pages=10, prefill_chunk=16,
+            prefix_sharing=True, host_kv_bytes=1 << 22,
+            restore_min_tokens=cfg.max_len + 1)
+        on = self._workload(cfg, eng)
+        summ = eng.host_tier.summary()
+        eng.drain()
+        assert summ["spills"] >= 1 and summ["restores"] == 0
+        off = self._workload(
+            cfg, self._engine(cfg, params, tier=False))
+        assert on == off  # and the outputs still match exactly
+
+    def test_adoption_across_engines_sharing_a_spill_dir(self, tmp_path):
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        # Replica A computes, stores, and spills the shared prefix.
+        a = self._engine(cfg, params, tier=True, tmp_path=tmp_path)
+        outs_a = self._workload(cfg, a)
+        assert a.host_tier.summary()["spills"] >= 1
+        a.drain()
+        assert any(f.endswith(".npz") for f in os.listdir(tmp_path))
+        # Replica B never saw the prefix; it ADOPTS from the dir.
+        rng = np.random.default_rng(5)
+        prefix = rng.integers(1, cfg.vocab, 48).astype(np.int32)
+        b = self._engine(cfg, params, tier=True, tmp_path=tmp_path)
+        pb = np.concatenate([prefix, np.full(4, 7, np.int32)])
+        b.submit(pb, 8)
+        toks_b = [list(map(int, r.tokens)) for r in b.run()]
+        assert b.prefix_index.adoptions >= 1
+        assert b.host_tier.summary()["restores"] >= 1
+        b.drain()
+        # Reference: a bare engine computing the same request cold.
+        ref = ServingEngine(params, cfg, batch=2, kv_pages=10,
+                            prefill_chunk=16, prefix_sharing=True)
+        ref.submit(pb, 8)
+        toks_ref = [list(map(int, r.tokens)) for r in ref.run()]
+        ref.drain()
+        assert toks_b == toks_ref
+
+    def test_successor_rebuilds_fresh_tier_with_knobs_carried(
+            self, tmp_path):
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        eng = self._engine(cfg, params, tier=True, tmp_path=tmp_path)
+        self._workload(cfg, eng)
+        assert eng.host_tier.summary()["spills"] >= 1
+        succ = eng.spawn_successor()
+        # Fresh tier: the torn incarnation's host memory is discarded
+        # wholesale (coherent-by-construction), knobs carried.
+        s = succ.host_tier.summary()
+        assert s["host_entries"] == 0 and s["host_bytes"] == 0
+        assert s["spill_dir"] == str(tmp_path)
+        assert succ.restore_min_tokens == eng.restore_min_tokens
+        assert succ.host_kv_bytes == eng.host_kv_bytes
+        # The durable dir survives the crash: the successor adopts a
+        # prefix only its predecessor ever computed.
+        rng = np.random.default_rng(5)
+        prefix = rng.integers(1, cfg.vocab, 48).astype(np.int32)
+        succ.submit(np.concatenate(
+            [prefix, np.full(4, 9, np.int32)]), 8)
+        succ.run()
+        assert succ.prefix_index.adoptions >= 1
+        succ.drain()
+        eng.drain()
+
+    def test_knob_validation(self):
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        with pytest.raises(ValueError, match="kv_pages"):
+            ServingEngine(params, cfg, host_kv_bytes=1 << 20)
+        with pytest.raises(ValueError, match="prefix_sharing"):
+            ServingEngine(params, cfg, kv_pages=10,
+                          prefix_sharing=False, host_kv_bytes=1 << 20)
+        with pytest.raises(ValueError, match="restore_min_tokens"):
+            ServingEngine(params, cfg, kv_pages=10,
+                          restore_min_tokens=32)
+
+
+class TestRestoreCostModel:
+    def test_restore_cost_matches_admission_copy_pricing(self):
+        # The restore's byte term IS the hit-copy term admission_cost
+        # prices: admission_cost(s=h, hit=h) has no tail (zero FLOPs,
+        # zero streams) and only the 2*h*pos_bytes copy traffic left.
+        for kw in ({}, {"kv_quant": "int8"}, {"n_kv_heads": 1}):
+            cfg = _cfg(**kw)
+            for h in (0, 16, 64):
+                flops, byts = cm.restore_cost(cfg, h)
+                assert flops == 0.0
+                assert byts == cm.admission_cost(cfg, h, hit_len=h)[1]
+        with pytest.raises(ValueError):
+            cm.restore_cost(_cfg(), -1)
+
+    def test_restore_wins_beyond_crossover_in_the_model(self):
+        # Quadratic re-prefill FLOPs vs linear restore bytes: at SOME
+        # length the modeled re-prefill exceeds the restore transfer
+        # (unit-agnostic sanity — the measured sweep decides the real
+        # crossover).
+        cfg = _cfg()
+        ratio = []
+        for h in (64, 1024 * 16):
+            rp_flops, _ = cm.admission_cost(cfg, h)
+            _, rs_bytes = cm.restore_cost(cfg, h)
+            ratio.append(rs_bytes / rp_flops)
+        assert ratio[1] < ratio[0]  # restore's relative price falls
+
+    def test_derive_interpolates_the_unit_crossing(self):
+        pts = [{"length": 64, "restore_over_reprefill": 2.0},
+               {"length": 256, "restore_over_reprefill": 0.5}]
+        got = cm.derive_kv_restore_min_tokens(pts)
+        assert got == 128  # log-log midpoint of the 2.0 -> 0.5 crossing
+
+    def test_derive_clamps_floor_and_ceiling(self):
+        win = [{"length": 64, "restore_over_reprefill": 0.5},
+               {"length": 256, "restore_over_reprefill": 0.1}]
+        assert cm.derive_kv_restore_min_tokens(win) == 32
+        lose = [{"length": 64, "restore_over_reprefill": 3.0},
+                {"length": 256, "restore_over_reprefill": 1.5}]
+        assert cm.derive_kv_restore_min_tokens(lose) == 512
+        with pytest.raises(ValueError):
+            cm.derive_kv_restore_min_tokens([])
+        with pytest.raises(ValueError):
+            cm.derive_kv_restore_min_tokens(
+                [{"length": 64, "restore_over_reprefill": 0.0}])
+
+    def test_gather_tax_sweep_reports_monotone_bytes(self):
+        pts = cm.run_paged_gather_tax_sweep(lengths=(64, 128), reps=1)
+        assert [p["length"] for p in pts] == [64, 128]
+        assert pts[1]["bytes"] == 2 * pts[0]["bytes"]
+        assert all(p["gather_s"] >= 0 for p in pts)
+
+
+class TestHostKvSloSmoke:
+    def test_bench_serving_host_kv_line_and_slo_gate(self, tmp_path):
+        # End-to-end CI form: the whole serving_host_kv artifact
+        # through tools/slo_check.py --metrics-key metrics_host_kv
+        # against the committed baseline (docs/serving.md §6).
+        env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_RETRIES="1")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--config", "serving_host_kv"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=_REPO)
+        assert r.returncode == 0, r.stderr[-800:]
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        (line,) = [d for d in lines if d["metric"] == "serving_host_kv"]
+        assert line["bit_exact"] is True
+        assert line["bit_exact_spec"] is True
+        assert line["capacity_ratio"] >= 5.0
+        assert line["restore_vs_reprefill_at_max"] < 1.0
+        assert line["restore_min_tokens_measured"] >= 16
+        assert line["recompiles_after_warmup"] == 0
+        assert line["recompiles_after_warmup_off"] == 0
+        assert line["spills_on"] >= 1 and line["restores_on"] >= 1
+        m = line["metrics"]
+        assert m["counters"]["serving_kv_spills_total"] >= 1
+        assert m["counters"]["serving_kv_restores_total"] >= 1
+        assert m["gauges"]["serving_kv_host_bytes"] >= 1
+        assert m["histograms"]["serving_kv_restore_seconds"][
+            "count"] >= 1
+        artifact = tmp_path / "host_kv_artifact.jsonl"
+        artifact.write_text(r.stdout)
+        slo = subprocess.run(
+            [sys.executable, "tools/slo_check.py", str(artifact),
+             "--metrics-key", "metrics_host_kv"],
+            capture_output=True, text=True, timeout=60, cwd=_REPO)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "SLO OK" in slo.stdout
+
+
+class TestServerAndFleetPlumbing:
+    def test_fleet_config_forwards_host_tier_flags(self):
+        # FleetConfig -> replica argv: the tier knobs ride to every
+        # replica subprocess; a shared spill_dir is what makes
+        # cross-replica adoption (docs/fleet.md) reachable from the
+        # fleet surface. Unset knobs must stay OFF the argv (the server
+        # treats presence as the tier switch).
+        from marlin_tpu.fleet import FleetConfig
+
+        cfg = FleetConfig(kv_pages=8, host_kv_bytes=1 << 20,
+                          spill_dir="/tmp/spills",
+                          restore_min_tokens=48)
+        argv = cfg.replica_argv(0)
+        for flag, val in (("--host-kv-bytes", str(1 << 20)),
+                          ("--spill-dir", "/tmp/spills"),
+                          ("--restore-min-tokens", "48")):
+            assert argv[argv.index(flag) + 1] == val
+        plain = FleetConfig().replica_argv(0)
+        assert "--host-kv-bytes" not in plain
+        assert "--spill-dir" not in plain
+        assert "--restore-min-tokens" not in plain
+
+    def test_server_boots_tiered_and_debug_narrates(self, tmp_path):
+        # The argv surface end to end: a real server subprocess started
+        # with the tier flags must come up, narrate the tier in
+        # GET /debug/engine (host_budget_bytes + spill_dir + the
+        # restore_min_tokens knob), and still drain clean on SIGTERM.
+        import signal
+        import urllib.request
+
+        spill_dir = tmp_path / "spills"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "marlin_tpu.serving.server",
+             "--port", "0", "--force-cpu", "--d-model", "32",
+             "--n-layers", "2", "--vocab", "64", "--max-len", "64",
+             "--batch", "2", "--round-steps", "2", "--kv-pages", "12",
+             "--host-kv-bytes", str(1 << 20),
+             "--spill-dir", str(spill_dir),
+             "--restore-min-tokens", "16"],
+            cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("SERVING "), line
+            port = int(line.strip().split("port=")[1])
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/engine",
+                    timeout=30.0) as resp:
+                snap = json.loads(resp.read())
+            tier = snap["host_tier"]
+            assert tier["host_budget_bytes"] == 1 << 20
+            assert tier["spill_dir"] == str(spill_dir)
+            assert tier["restore_min_tokens"] == 16
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(60.0) == 0, proc.stderr.read()[-800:]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10.0)

@@ -35,9 +35,10 @@ import jax
 import jax.numpy as jnp
 
 from marlin_tpu.models import TransformerConfig, generate, init_params
+from marlin_tpu.obs.metrics import MetricsRegistry
 from marlin_tpu.serving import PAGE, PagePool, ServingEngine
 from marlin_tpu.serving.engine import _decode_round_paged
-from marlin_tpu.serving.pages import SINK_PAGE
+from marlin_tpu.serving.pages import SINK_PAGE, HostKVTier
 from marlin_tpu.serving.prefix import PagedPrefixIndex
 from marlin_tpu.serving.slots import prefill_chunk_into_row_paged
 
@@ -239,6 +240,159 @@ class TestRefcountProperty:
                     if shadow[p] == 0:
                         freed_total += 1
                 prompts.pop(gone)
+            check()
+
+    def test_spill_restore_interleavings_match_shadow_model(self):
+        """The PR 9 drive extended with the host tier's transitions
+        (ISSUE 16): eviction now SPILLS a sole-holder entry (pages
+        freed, payload parked host-side) and a restore re-pins freshly
+        allocated pages exactly once. Shadow invariants after every op:
+        refcounts match, the allocator never hands out a live page, a
+        spill only ever fires when the index's pin was the LAST
+        reference, and the tier's payload set mirrors the index's
+        spilled entries one-for-one."""
+        cfg = _cfg(d_model=8, n_heads=2, n_layers=1, d_ff=16, max_len=64)
+        reg = MetricsRegistry()
+        pool = PagePool(cfg, 12, registry=reg)
+        tier = HostKVTier(pool, registry=reg)
+        index = PagedPrefixIndex(pool, registry=reg, host_tier=tier)
+        rng = random.Random(4321)
+        shadow = {}           # page -> refcount
+        rows = {}             # row id -> held page list
+        resident = {}         # tokens-bytes -> page tuple
+        spilled = set()       # tokens-bytes of spilled entries
+        next_row = 0
+        freed_total = 0
+
+        def eid_of(key):
+            (eid,) = [e for e, ent in index._entries.items()
+                      if ent.tokens.tobytes() == key]
+            return eid
+
+        def check():
+            live = {p: n for p, n in shadow.items() if n > 0}
+            assert dict(pool._refs) == live
+            free = sorted(pool._free)
+            assert free == sorted(set(free))
+            assert set(free) == set(range(1, 13)) - set(live)
+            assert SINK_PAGE not in live and SINK_PAGE not in free
+            assert pool.frees == freed_total
+            # Every spilled entry's payload is really in the tier (the
+            # tier may hold MORE: a restore leaves the payload cached
+            # host-side — content-keyed, it stays valid — and only the
+            # host budget's LRU or a spilled-entry removal prunes it).
+            sp_keys = {ent.host_key
+                       for ent in index._entries.values()
+                       if ent.state == "spilled"}
+            assert sp_keys <= set(tier._entries.keys())
+            s = index.summary()
+            assert s["prefix_spilled_entries"] == len(spilled)
+
+        for step in range(500):
+            op = rng.choice(["admit", "admit", "store", "release",
+                             "release", "evict", "restore"])
+            if op == "admit":
+                n = rng.randint(1, 4)
+                use_alias = resident and rng.random() < 0.5
+                alias = []
+                if use_alias:
+                    key = rng.choice(sorted(resident))
+                    alias = list(resident[key])[:rng.randint(
+                        1, len(resident[key]))]
+                    pool.ref(alias)
+                    for p in alias:
+                        shadow[p] = shadow.get(p, 0) + 1
+                fresh = pool.alloc(n)
+                if fresh is None:
+                    if alias:
+                        pool.unref(alias)
+                        for p in alias:
+                            shadow[p] -= 1
+                            if shadow[p] == 0:
+                                freed_total += 1
+                else:
+                    for p in fresh:
+                        assert shadow.get(p, 0) == 0, \
+                            "allocator handed out a live page"
+                        shadow[p] = 1
+                    rows[next_row] = alias + fresh
+                    next_row += 1
+            elif op == "store" and rows:
+                row = rng.choice(sorted(rows))
+                pages = rows[row][:rng.randint(1, len(rows[row]))]
+                toks = np.asarray(
+                    [rng.randrange(997) for _ in
+                     range(len(pages) * PAGE)], np.int32)
+                stored = index.store(toks, pages)
+                if stored:
+                    key = toks.tobytes()
+                    resident[key] = tuple(pages[:stored // PAGE])
+                    for p in resident[key]:
+                        shadow[p] += 1
+            elif op == "release" and rows:
+                row = rng.choice(sorted(rows))
+                held = rows.pop(row)
+                pool.unref(held)
+                for p in held:
+                    shadow[p] -= 1
+                    if shadow[p] == 0:
+                        freed_total += 1
+            elif op == "evict" and index._entries:
+                before = {ent.tokens.tobytes(): (ent.state, ent.pages)
+                          for ent in index._entries.values()}
+                assert index.evict_lru()
+                after = {ent.tokens.tobytes(): ent.state
+                         for ent in index._entries.values()}
+                gone = set(before) - set(after)
+                if gone:
+                    # Removed outright: an aliased resident entry (no
+                    # spill while a row still references the pages) or
+                    # a spilled one (payload dropped with it).
+                    (k,) = gone
+                    state, pages = before[k]
+                    if state == "resident":
+                        assert any(shadow[p] > 1 for p in pages), \
+                            "sole-holder entry removed instead of spilled"
+                        for p in pages:
+                            shadow[p] -= 1
+                            if shadow[p] == 0:
+                                freed_total += 1
+                        resident.pop(k)
+                    else:
+                        spilled.discard(k)
+                else:
+                    # Spilled: only legal when the index held the LAST
+                    # reference on every page.
+                    (k,) = [k for k, st in after.items()
+                            if st == "spilled" and before[k][0]
+                            == "resident"]
+                    _, pages = before[k]
+                    for p in pages:
+                        assert shadow[p] == 1, \
+                            "spill fired with a live alias"
+                        shadow[p] = 0
+                        freed_total += 1
+                    resident.pop(k)
+                    spilled.add(k)
+            elif op == "restore" and spilled:
+                key = rng.choice(sorted(spilled))
+                eid = eid_of(key)
+                n = index._entries[eid].length // PAGE
+                fresh = pool.alloc(n)
+                if fresh is None:
+                    check()
+                    continue  # pool full: the engine would evict first
+                for p in fresh:
+                    assert shadow.get(p, 0) == 0, \
+                        "allocator handed out a live page"
+                    shadow[p] = 1  # the restoring row's reservation
+                index.rebind(eid, fresh)
+                for p in fresh:
+                    shadow[p] += 1  # the rebind re-pins exactly once
+                rows[next_row] = list(fresh)
+                next_row += 1
+                spilled.discard(key)
+                resident[key] = tuple(fresh)
             check()
 
 
@@ -496,3 +650,44 @@ class TestCapacity:
         assert eng.page_pool.alloc_failures == 0
         eng.run()
         assert eng.stats.n_completed == 6
+
+    def test_host_tier_keeps_5x_stored_prefixes_hittable(self):
+        """ISSUE 16's capacity done-bar at unit scope (bench.py
+        --config serving_host_kv sweeps the same drive): at EQUAL
+        device bytes, attaching the host tier keeps >= 5x as many
+        stored prefixes HITTABLE — resident entries answer from device,
+        spilled ones restore from the host payload — where the
+        tier-less index is bound by pool capacity alone."""
+        cfg = _cfg(max_len=64)
+        n_per = 2                 # 32-token prefixes -> 2 pages each
+        budget_pages = 2 * n_per  # device fits exactly 2 resident
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, cfg.vocab, n_per * PAGE + 4)
+                   .astype(np.int32) for _ in range(16)]
+
+        def hittable(tiered):
+            reg = MetricsRegistry()
+            pool = PagePool(cfg, budget_pages, registry=reg)
+            tier = HostKVTier(pool, budget_bytes=5 * pool.pool_bytes,
+                              registry=reg) if tiered else None
+            idx = PagedPrefixIndex(pool, registry=reg, host_tier=tier)
+            for p in prompts:  # one admit+store+retire per prefix
+                pages = pool.alloc(n_per)
+                if pages is None:
+                    idx.evict_until_free(n_per)
+                    pages = pool.alloc(n_per)
+                idx.store(p, pages)
+                pool.unref(pages)
+            n = 0
+            for p in prompts:
+                _, hit, sp, _ = idx.lookup_candidates(p)
+                if hit:
+                    n += 1
+                elif (sp is not None and tier is not None
+                      and tier.fetch(idx.host_key_of(sp)) is not None):
+                    n += 1  # restorable: the payload is really there
+            return n
+
+        plain, tiered = hittable(False), hittable(True)
+        assert plain == budget_pages // n_per  # device-bound: 2
+        assert tiered >= 5 * plain

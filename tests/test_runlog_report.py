@@ -287,6 +287,91 @@ class TestSyntheticRunlogs:
         assert [a for a in report3["anomalies"]
                 if a["kind"] == "queue_stall"]
 
+    def test_host_tier_rounds_are_narrated(self, rr, tmp_path):
+        # Host-memory KV tier (ISSUE 16, docs/serving.md §6): rounds
+        # from a tiered engine carry per-round spill/restore deltas and
+        # the host ledger — the report totals them and keeps the
+        # host-bytes watermark, so a sealed log answers "did the warm
+        # set earn its keep" offline. An untiered paged log must NOT
+        # grow the keys: their absence is how a reader tells the two
+        # configurations apart.
+        events = _clean_events()
+        events[0] = dict(events[0], kv_pages=8, prefix_sharing=True,
+                         host_kv_bytes=1 << 20)
+        for ev in events:
+            if ev["kind"] != "round":
+                continue
+            if ev["round"] == 0:
+                ev.update(pages_used=6, pages_free=2, pages_aliased=0,
+                          page_fragmentation=0.0, spills=2, restores=0,
+                          host_bytes=8192, host_entries=2)
+            else:
+                ev.update(pages_used=4, pages_free=4, pages_aliased=0,
+                          page_fragmentation=0.0, spills=0, restores=1,
+                          host_bytes=4096, host_entries=1)
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+        kp = report["rounds"]["kv_pages"]
+        assert kp["spills_total"] == 2
+        assert kp["restores_total"] == 1
+        assert kp["host_bytes_max"] == 8192
+        assert kp["host_bytes_last"] == 4096
+        assert kp["host_entries_max"] == 2
+        # Untiered paged log: page ledger narrated, no host-tier keys.
+        events2 = _clean_events()
+        events2[0] = dict(events2[0], kv_pages=8, prefix_sharing=True)
+        for ev in events2:
+            if ev["kind"] == "round":
+                ev.update(pages_used=4, pages_free=4, pages_aliased=0,
+                          page_fragmentation=0.0)
+        report2 = rr.build_report(rr.load_runlog(_write(tmp_path,
+                                                        events2)))
+        kp2 = report2["rounds"]["kv_pages"]
+        assert "pages_used_max" in kp2
+        assert "spills_total" not in kp2
+        assert "host_bytes_max" not in kp2
+
+    def test_restore_round_is_not_a_stall(self, rr, tmp_path):
+        # A round that admits nothing while ready work waits is legal
+        # when its admission slot went to a host-tier RESTORE — the
+        # scheduler was scattering a spilled prefix back into pages,
+        # not sitting idle (ISSUE 16). The identical pair with
+        # restores == 0 stays a provable queue_stall: the tier must not
+        # blind the detector.
+        stall_pair = [
+            {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "pages_used": 2, "pages_free": 6, "pages_aliased": 0,
+             "page_fragmentation": 0.0, "spills": 0, "restores": 0,
+             "host_bytes": 4096, "host_entries": 1},
+            {"kind": "round", "t": 0.107, "round": 3, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "pages_used": 4, "pages_free": 4, "pages_aliased": 0,
+             "page_fragmentation": 0.0, "spills": 0, "restores": 1,
+             "host_bytes": 4096, "host_entries": 1},
+        ]
+        events = _clean_events()
+        events[0] = dict(events[0], kv_pages=8, prefix_sharing=True,
+                         host_kv_bytes=1 << 20)
+        events[-1:-1] = stall_pair
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert not [a for a in report["anomalies"]
+                    if a["kind"] == "queue_stall"], report["anomalies"]
+        # Same pair, no restore: the stall is real.
+        events2 = _clean_events()
+        events2[0] = dict(events2[0], kv_pages=8, prefix_sharing=True,
+                          host_kv_bytes=1 << 20)
+        events2[-1:-1] = [dict(stall_pair[0]),
+                          dict(stall_pair[1], restores=0)]
+        report2 = rr.build_report(rr.load_runlog(_write(tmp_path,
+                                                        events2)))
+        assert [a for a in report2["anomalies"]
+                if a["kind"] == "queue_stall"], report2["anomalies"]
+
     def test_spec_rounds_narrated_and_low_acceptance_is_legal(
             self, rr, tmp_path):
         # Speculative rounds (docs/serving.md §7) carry the

@@ -250,6 +250,21 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
                 ev.get("page_fragmentation", 0.0) for ev in rounds),
             "fragmentation_last": rounds[-1].get("page_fragmentation"),
         }
+        # Host-tier narration (ISSUE 16, docs/serving.md §6): rounds
+        # from a tiered engine carry per-round spill/restore deltas and
+        # the host-bytes watermark — a sealed log answers "did the warm
+        # set actually earn its keep" offline.
+        spills = sum(ev.get("spills", 0) for ev in rounds)
+        restores = sum(ev.get("restores", 0) for ev in rounds)
+        if any("spills" in ev for ev in rounds):
+            out["kv_pages"].update(
+                spills_total=spills,
+                restores_total=restores,
+                host_bytes_max=max(ev.get("host_bytes", 0)
+                                   for ev in rounds),
+                host_bytes_last=rounds[-1].get("host_bytes"),
+                host_entries_max=max(ev.get("host_entries", 0)
+                                     for ev in rounds))
     # Speculative-decoding narration (docs/serving.md §7): rounds from
     # a spec engine carry the draft/verify ledger — totals, the
     # acceptance-rate trajectory, and the draft lengths the adaptive
@@ -361,7 +376,12 @@ def find_anomalies(events: List[dict], reqs: Dict[int, dict],
                     >= worst_pages
                     and cur.get("admitted", 0) == 0
                     and cur.get("prefilling", 0) == 0
-                    and cur.get("expired", 0) == 0):
+                    and cur.get("expired", 0) == 0
+                    # A host-tier restore IS scheduling work: the round
+                    # spent its admission slot scattering a spilled
+                    # prefix back into pages (ISSUE 16) — legal, never
+                    # a provable sit-on-ready-work stall.
+                    and cur.get("restores", 0) == 0):
                 anomalies.append({
                     "kind": "queue_stall", "round": cur.get("round"),
                     "queue_depth": prev.get("queue_depth"),
