@@ -959,3 +959,271 @@ def config_serving_host_kv():
         "tail_len": tail_len, "steps": steps, "prefill_chunk": chunk,
         "kv_pages": kv_pages, "d_model": d, "max_len": max_len,
     }
+
+
+def config_tenants():
+    """SLO-aware multi-tenant scheduler (serving/sched.py, docs/serving
+    .md §8): sched arm vs FIFO arm on a deterministic chat+batch+burst
+    mixed workload, measured three ways.
+
+    1. BIT-EXACTNESS: for plain / rope+GQA / int8 / speculative(greedy)
+       variants, a scheduler engine whose interactive request PREEMPTS
+       a decoding batch row (freeze -> host-tier spill -> thaw ->
+       resume) drains the same staggered workload as a FIFO engine that
+       never preempts. Every request's tokens must match exactly — a
+       preemption that moved a token would be a correctness bug — and
+       each sched arm must actually have preempted AND resumed (a
+       variant that never froze proves nothing). A chaos sub-arm re-runs
+       the plain variant under the supervised frontend with a
+       deterministic ``preempt_spill`` crash: the fault fires after the
+       victim is chosen and before its pages are gathered, the
+       supervisor rebuilds, and replay-from-scratch still produces
+       byte-identical outputs.
+    2. CHAT LATENCY UNDER CONTENTION: long batch-class jobs occupy
+       every row; interactive chat bursts arrive mid-decode. The
+       headline value is the chat queue-wait p99 RATIO (FIFO / sched),
+       measured in ROUNDS (submit round -> admission round — the
+       noise-free schedule-determined twin; wall-clock rides along).
+       Done-bar >= 3x: preemption must actually cut the chat tail, not
+       just reorder the queue.
+    3. BATCH COST: the batch class pays for the preemptions — its
+       throughput (batch-class tokens per round over the drain) must
+       stay >= 0.8x the FIFO arm's. A post-warmup CompileWatchdog pins
+       zero steady-state recompiles in BOTH arms (freeze/thaw reuse the
+       warmed restore buckets; the token-buffer restore pads to max_len
+       so it compiles exactly once).
+    tools/slo_check.py gates it from the committed baseline's
+    ``metrics_tenants`` block (tests/test_sched.py, tier-1)."""
+    import numpy as np
+
+    from marlin_tpu.models import TransformerConfig, init_params
+    from marlin_tpu.obs.watch import CompileWatchdog
+    from marlin_tpu.serving import (EngineFrontend, Scheduler,
+                                    ServingEngine, faults,
+                                    _decode_round_paged,
+                                    prefill_chunk_into_row_paged)
+    from marlin_tpu.serving.slots import (restore_pages_into_pool,
+                                          restore_row_tokens)
+
+    # -- bit-exactness matrix: preempted vs uninterrupted -------------
+    def bitexact_arm(cfg_kw, spec, sched):
+        vcfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=96,
+                                 **cfg_kw)
+        vparams = init_params(vcfg, seed=0)
+        eng = ServingEngine(
+            vparams, vcfg, batch=2, round_steps=4, seed=7,
+            kv_pages=24, host_kv_bytes=(1 << 24),
+            spec_draft_lens=(4,) if spec else None,
+            scheduler=Scheduler() if sched else None)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, vcfg.vocab, 9).astype(np.int32)
+                   for _ in range(3)]
+        kw = (lambda c: {"sched_class": c}) if sched else (lambda c: {})
+        # Two long batch-class jobs fill both rows; after three rounds
+        # an interactive request arrives and (sched arm) preempts one.
+        eng.submit(prompts[0], 40, request_id=0, **kw("batch"))
+        eng.submit(prompts[1], 40, request_id=1, **kw("batch"))
+        out = {}
+        for _ in range(3):
+            for r in eng.step():
+                out[r.request_id] = list(map(int, r.tokens))
+        eng.submit(prompts[2], 6, request_id=2, **kw("interactive"))
+        for _ in range(400):
+            for r in eng.step():
+                out[r.request_id] = list(map(int, r.tokens))
+            if len(out) == 3:
+                break
+        snap = eng.debug_sched() if sched else {}
+        eng.close()
+        return out, snap
+
+    variants = {
+        "plain": ({}, False),
+        "rope_gqa": ({"rope": True, "n_kv_heads": 1}, False),
+        "int8": ({"kv_quant": "int8"}, False),
+        "spec": ({}, True),
+    }
+    bit_exact = {}
+    for name, (kw, spec) in variants.items():
+        on, snap = bitexact_arm(kw, spec, sched=True)
+        off, _ = bitexact_arm(kw, spec, sched=False)
+        assert on == off, f"preemption moved tokens ({name})"
+        assert snap["preempts"] >= 1 and snap["resumes"] >= 1, \
+            f"variant {name} never exercised preemption: {snap}"
+        bit_exact[name] = True
+
+    # -- chaos sub-arm: crash at preempt_spill, supervised replay -----
+    def chaos_arm():
+        vcfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=96)
+        vparams = init_params(vcfg, seed=0)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, vcfg.vocab, 9).astype(np.int32)
+                   for _ in range(3)]
+        plan = faults.install(faults.FaultPlan())
+        crash = plan.add(site="preempt_spill", action="raise")
+        # Round throttle (mirrors tests/test_sched.py): the driver
+        # thread keeps decoding between the occupancy poll and the
+        # staggered submit; on a loaded box it can clear the batch
+        # jobs' occupancy window before the interactive request lands,
+        # and then nothing preempts. A 20 ms floor per round keeps the
+        # round clock coarser than the poll tick.
+        plan.add(site="decode_round", action="delay", delay_s=0.02,
+                 round_every=1, max_fires=1000)
+        try:
+            eng = ServingEngine(
+                vparams, vcfg, batch=2, round_steps=4, seed=7,
+                kv_pages=24, host_kv_bytes=(1 << 24),
+                scheduler=Scheduler())
+            fe = EngineFrontend(eng).start()
+            h0 = fe.submit(prompts[0], 40, request_id=0,
+                           sched_class="batch")
+            h1 = fe.submit(prompts[1], 40, request_id=1,
+                           sched_class="batch")
+            deadline = time.perf_counter() + 60.0
+            while (fe.engine.round_idx < 1
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)
+            h2 = fe.submit(prompts[2], 6, request_id=2,
+                           sched_class="interactive")
+            toks = {h.request_id: list(map(int, h.result(120.0).tokens))
+                    for h in (h0, h1, h2)}
+            restarts = fe.restarts
+            fe.drain(30.0)
+        finally:
+            faults.reset()
+        return toks, crash.fires, restarts
+
+    chaos_toks, chaos_fires, chaos_restarts = chaos_arm()
+    ref, _ = bitexact_arm({}, False, sched=False)
+    chaos_ok = chaos_toks == ref
+
+    # -- the contention drain: chat+batch+burst, both arms ------------
+    d = _sized("BENCH_TENANTS_D", 48)
+    batch = _sized("BENCH_TENANTS_B", 4)
+    round_steps = _sized("BENCH_TENANTS_ROUND", 4)
+    n_batch = _sized("BENCH_TENANTS_BATCH_REQS", 6)
+    batch_steps = _sized("BENCH_TENANTS_BATCH_STEPS", 96)
+    n_chat_bursts = _sized("BENCH_TENANTS_BURSTS", 6)
+    chat_per_burst = _sized("BENCH_TENANTS_BURST_N", 2)
+    chat_steps = _sized("BENCH_TENANTS_CHAT_STEPS", 8)
+    prompt_len = 12
+    max_len = 16 * (-(-(prompt_len + batch_steps + 8) // 16))
+    cfg = TransformerConfig(
+        vocab=128, d_model=d, n_heads=max(2, d // 24), n_layers=2,
+        d_ff=2 * d, max_len=max_len)
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(1)
+    batch_prompts = [rng.integers(1, cfg.vocab, prompt_len)
+                     .astype(np.int32) for _ in range(n_batch)]
+    chat_prompts = [rng.integers(1, cfg.vocab, prompt_len)
+                    .astype(np.int32)
+                    for _ in range(n_chat_bursts * chat_per_burst)]
+    be_prompts = [rng.integers(1, cfg.vocab, prompt_len)
+                  .astype(np.int32) for _ in range(2)]
+    # Submission schedule keyed on the ROUND INDEX — deterministic on
+    # any host: batch jobs up front, chat bursts arriving mid-decode,
+    # two best_effort stragglers in between.
+    bursts = {4 + 4 * i: chat_per_burst for i in range(n_chat_bursts)}
+
+    def run(sched: bool):
+        eng = ServingEngine(
+            params, cfg, batch=batch, round_steps=round_steps, seed=7,
+            kv_pages=batch * (max_len // 16) + 16,
+            host_kv_bytes=(1 << 26), max_pending=256,
+            scheduler=(Scheduler(max_preempts_per_round=2)
+                       if sched else None))
+        kw = (lambda c: {"sched_class": c}) if sched else (lambda c: {})
+        rid = iter(range(10_000))
+        for p in batch_prompts:
+            eng.submit(p, batch_steps, request_id=next(rid),
+                       **kw("batch"))
+        done, chat_ids, ci = {}, set(), 0
+        t0 = time.perf_counter()
+        for _ in range(4000):
+            if eng.round_idx == 2:
+                for p in be_prompts:
+                    eng.submit(p, 16, request_id=next(rid),
+                               **kw("best_effort"))
+            for _ in range(bursts.get(eng.round_idx, 0)):
+                r = eng.submit(chat_prompts[ci], chat_steps,
+                               request_id=next(rid),
+                               **kw("interactive"))
+                chat_ids.add(r)
+                ci += 1
+            for req in eng.step():
+                done[req.request_id] = req
+            if ci == len(chat_prompts) and len(done) == \
+                    n_batch + 2 + len(chat_prompts):
+                break
+        dt = time.perf_counter() - t0
+        rounds = eng.stats.n_rounds
+        snap = eng.debug_sched() if sched else {}
+        eng.close()
+        chat = [done[i] for i in sorted(chat_ids)]
+        waits = [r.admit_round - r.submit_round for r in chat]
+        wait_s = [max(0.0, r.admit_time - r.submit_time) for r in chat]
+        batch_tokens = sum(r.emitted for i, r in done.items()
+                           if i < n_batch)
+        return {
+            "chat_wait_rounds_p99": float(np.percentile(waits, 99)),
+            "chat_wait_rounds_mean": float(np.mean(waits)),
+            "chat_ttft_p99_s": float(np.percentile(wait_s, 99)),
+            "batch_tok_per_round": batch_tokens / max(rounds, 1),
+            "rounds": rounds, "wallclock_s": dt,
+            "preempts": snap.get("preempts", 0),
+            "resumes": snap.get("resumes", 0),
+        }
+
+    run(False)  # warmup: paged round + chunk buckets
+    run(True)   # warmup: freeze/thaw restore buckets
+    wd = CompileWatchdog()
+    wd.register("serving.decode_round_paged", _decode_round_paged)
+    wd.register("serving.prefill_chunk_into_row_paged",
+                prefill_chunk_into_row_paged)
+    wd.register("serving.kv_restore", restore_pages_into_pool)
+    wd.register("serving.row_tokens_restore", restore_row_tokens)
+    fifo = run(False)
+    rec_off = sum(r.new_compiles for r in wd.poll(rebaseline=True))
+    sch = run(True)
+    rec_on = sum(r.new_compiles for r in wd.poll(rebaseline=True))
+
+    wait_ratio = fifo["chat_wait_rounds_p99"] \
+        / max(sch["chat_wait_rounds_p99"], 0.5)
+    batch_ratio = sch["batch_tok_per_round"] \
+        / max(fifo["batch_tok_per_round"], 1e-9)
+    return {
+        "metric": "serving_tenants_sched",
+        "value": round(wait_ratio, 3), "unit": "x",
+        "vs_baseline": round(wait_ratio / 3.0, 3),
+        "bit_exact": all(bit_exact.values()),
+        "bit_exact_plain": bit_exact["plain"],
+        "bit_exact_rope_gqa": bit_exact["rope_gqa"],
+        "bit_exact_int8": bit_exact["int8"],
+        "bit_exact_spec": bit_exact["spec"],
+        "chaos_bit_exact": bool(chaos_ok),
+        "chaos_fault_fires": chaos_fires,
+        "chaos_engine_restarts": chaos_restarts,
+        "chat_wait_rounds_p99_fifo": fifo["chat_wait_rounds_p99"],
+        "chat_wait_rounds_p99_sched": sch["chat_wait_rounds_p99"],
+        "chat_wait_rounds_mean_fifo": fifo["chat_wait_rounds_mean"],
+        "chat_wait_rounds_mean_sched": sch["chat_wait_rounds_mean"],
+        "chat_ttft_p99_fifo_s": round(fifo["chat_ttft_p99_s"], 5),
+        "chat_ttft_p99_sched_s": round(sch["chat_ttft_p99_s"], 5),
+        "batch_tok_per_round_fifo": round(
+            fifo["batch_tok_per_round"], 3),
+        "batch_tok_per_round_sched": round(
+            sch["batch_tok_per_round"], 3),
+        "batch_throughput_ratio": round(batch_ratio, 3),
+        "preempts": sch["preempts"], "resumes": sch["resumes"],
+        "rounds_fifo": fifo["rounds"], "rounds_sched": sch["rounds"],
+        "wallclock_fifo_s": round(fifo["wallclock_s"], 4),
+        "wallclock_sched_s": round(sch["wallclock_s"], 4),
+        "recompiles_after_warmup": rec_on,
+        "recompiles_after_warmup_off": rec_off,
+        "batch_requests": n_batch, "batch_steps": batch_steps,
+        "chat_requests": len(chat_prompts), "chat_steps": chat_steps,
+        "bursts": n_chat_bursts, "d_model": d, "batch": batch,
+        "round_steps": round_steps, "max_len": max_len,
+    }

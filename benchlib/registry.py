@@ -37,6 +37,7 @@ CONFIGS = {
                 configs_trend.config_serving_paged],
     "serving_spec": [configs_trend.config_serving_spec],
     "serving_host_kv": [configs_trend.config_serving_host_kv],
+    "tenants": [configs_trend.config_tenants],
     "http": [configs_http.config_http],
     "fleet": [configs_fleet.config_fleet],
     "sweep": [configs_gemm.config_dispatch_sweep],
@@ -48,5 +49,6 @@ CONFIGS = {
 CONFIGS["all"] = [
     fns[0] for k, fns in CONFIGS.items()
     if k not in ("sweep", "attnsweep", "trend", "serving",
-                 "serving_spec", "serving_host_kv", "http", "fleet")
+                 "serving_spec", "serving_host_kv", "tenants", "http",
+                 "fleet")
 ]

@@ -50,6 +50,10 @@ class FleetConfig:
     host_kv_bytes: Optional[int] = None
     spill_dir: Optional[str] = None
     restore_min_tokens: Optional[int] = None
+    # SLO-aware scheduler (docs/serving.md §8): every replica runs the
+    # default interactive/batch/best_effort class table; the front door
+    # forwards each request's tenant/sched_class fields verbatim.
+    sched: bool = False
     # Per-replica (in-process) supervisor budget — PR 7's knobs.
     max_restarts: int = 3
     restart_window_s: float = 60.0
@@ -158,6 +162,8 @@ class FleetConfig:
         if self.restore_min_tokens is not None:
             argv += ["--restore-min-tokens",
                      str(self.restore_min_tokens)]
+        if self.sched:
+            argv += ["--sched"]
         runlog = self.replica_runlog(index, incarnation)
         if runlog is not None:
             argv += ["--runlog", runlog]

@@ -334,6 +334,26 @@ class _FleetHandler(BaseHTTPRequestHandler):
                       "fleet front door (id uniqueness across replicas "
                       "is its job); submit without one"}, route)
             return
+        # Scheduler fields (docs/serving.md §8) may ride as headers —
+        # X-Sched-Class / X-Tenant, for proxies that cannot rewrite the
+        # JSON body — with body fields winning. The front door forwards
+        # them verbatim and never validates the class: the class table
+        # lives in the replicas, whose 400 comes back through the proxy
+        # untouched.
+        hdr_cls = self.headers.get("X-Sched-Class")
+        if hdr_cls and body.get("sched_class") is None:
+            body["sched_class"] = hdr_cls
+        hdr_tenant = self.headers.get("X-Tenant")
+        if hdr_tenant and body.get("tenant") is None:
+            body["tenant"] = hdr_tenant
+        if body.get("sched_class"):
+            # Truncated label: the value is caller-supplied, and metric
+            # label cardinality must stay bounded even under abuse.
+            self.metrics.counter(
+                "fleet_requests_by_class_total",
+                cls=str(body["sched_class"])[:64],
+                help="front-door generate requests by scheduling "
+                     "class").inc()
         http_id = self.headers.get("X-Request-Id")
         try:
             decision = self.sup.router.route(prompt)

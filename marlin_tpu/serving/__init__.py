@@ -18,14 +18,18 @@ from .frontend import (EngineFailed, EngineFrontend, FrontendError,
 from .pages import PAGE, PagePool
 from .prefix import PagedPrefixIndex, PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
+from .sched import DEFAULT_CLASSES, ClassSpec, FrozenRow, Scheduler
 from .server import ServingHTTPServer, install_signal_handlers, serve
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
-                    prefill_chunk_into_row_paged, prefill_into_row)
+                    prefill_chunk_into_row_paged, prefill_into_row,
+                    restore_row_tokens)
 from .stats import (EngineStats, request_stats, static_completed_at_budget,
                     static_schedule_iters)
 
 __all__ = [
     "AdmissionQueue",
+    "ClassSpec",
+    "DEFAULT_CLASSES",
     "EngineFailed",
     "EngineFrontend",
     "EngineStateCorrupt",
@@ -35,6 +39,7 @@ __all__ = [
     "FaultSpec",
     "FrontendError",
     "FrontendRequest",
+    "FrozenRow",
     "PAGE",
     "PagePool",
     "PagedPrefixIndex",
@@ -44,6 +49,7 @@ __all__ = [
     "QueueClosed",
     "QueueFull",
     "Request",
+    "Scheduler",
     "ServingEngine",
     "ServingHTTPServer",
     "SlotManager",
@@ -55,6 +61,7 @@ __all__ = [
     "prefill_chunk_into_row_paged",
     "prefill_into_row",
     "request_stats",
+    "restore_row_tokens",
     "static_completed_at_budget",
     "static_schedule_iters",
 ]

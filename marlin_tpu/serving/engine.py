@@ -66,9 +66,10 @@ from . import faults
 from .pages import PAGE, HostKVTier, PagePool
 from .prefix import PagedPrefixIndex, PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, Request
+from .sched import FrozenRow, Scheduler
 from .slots import (SlotManager, pad_prompt_len, prefill_chunk_into_row,
                     prefill_chunk_into_row_paged, prefill_into_row,
-                    restore_pages_into_pool)
+                    restore_pages_into_pool, restore_row_tokens)
 from .stats import EngineStats
 
 
@@ -447,7 +448,8 @@ class ServingEngine:
                  spec_adaptive: bool = True,
                  host_kv_bytes: Optional[int] = None,
                  host_kv_dir: Optional[str] = None,
-                 restore_min_tokens: Optional[int] = None):
+                 restore_min_tokens: Optional[int] = None,
+                 scheduler: Optional[Scheduler] = None):
         if cfg.window:
             raise NotImplementedError(
                 "serving needs the dense slot==position cache "
@@ -603,7 +605,21 @@ class ServingEngine:
         self.prefix_sharing = bool(prefix_sharing)
         self.temperature = float(temperature)
         self.eos_id = eos_id
-        self.queue = AdmissionQueue(max_pending=max_pending)
+        # SLO-aware scheduling (serving/sched.py, ISSUE 17): a Scheduler
+        # replaces the queue's FIFO ORDER with priority classes + EDF +
+        # quotas; on a paged engine with a host tier it also unlocks
+        # PREEMPTION (freeze a low-priority decoding row at a round
+        # boundary, spill it through the host tier, resume bit-exactly
+        # — _preempt_row / _thaw_frozen). Without a scheduler every
+        # path below is bit-for-bit the FIFO engine.
+        self.scheduler = scheduler
+        self.queue = AdmissionQueue(max_pending=max_pending,
+                                    scheduler=scheduler)
+        # Deadline drops at pop time release engine-owned resources the
+        # queued request may still hold — today that is a preempted
+        # request's pinned host-tier row (the mid-reservation
+        # deadline-drop edge; test_sched.py pins the non-leak).
+        self.queue.on_expire = self._release_expired
         self.slots = SlotManager(batch)
         # Observability (docs/observability.md): host spans via the
         # process tracer (a DISABLED tracer's span is a no-op — the <5%
@@ -754,6 +770,30 @@ class ServingEngine:
             self._cache = init_kv_cache(cfg, batch,
                                         dtype=cfg.compute_dtype)  # donated-buffer
             self.stats.page_pool = None
+        # Preemption needs the full substrate: scheduler (policy),
+        # paged KV (page-granular freeze/free), host tier (somewhere
+        # for the frozen bytes to live). A scheduler on any other
+        # engine still provides class/EDF/quota ORDERING — it just
+        # never freezes anyone.
+        self._can_preempt = (scheduler is not None and self.paged
+                             and self.host_tier is not None
+                             and scheduler.max_preempts_per_round > 0)
+        if scheduler is not None and scheduler.metrics is None:
+            # Same first-attach binding as prefix_cache: the sched_*
+            # series land next to the engine's own mirrors.
+            scheduler.metrics = self.metrics
+        self._n_preempts = 0   # lifetime freeze count (this incarnation)
+        self._n_resumes = 0    # lifetime thaw count
+        self._preempts0 = 0    # last-seen totals, for round deltas
+        self._resumes0 = 0
+        self._preempt_budget = 0  # per-round freeze allowance
+        if self._can_preempt:
+            # The thaw's buffer write is its own jitted entry — ONE
+            # compile for the engine's lifetime (tokens are padded to
+            # max_len host-side), registered like every admission entry
+            # so steady-state preemption cannot hide a retrace.
+            self.watchdog.register("serving.row_tokens_restore",
+                                   restore_row_tokens)
         self._buf = jnp.zeros((batch, cfg.max_len), jnp.int32)  # donated-buffer
         self._filled = np.ones((batch,), np.int32)
         self._target = np.zeros((batch,), np.int32)
@@ -821,14 +861,20 @@ class ServingEngine:
                          spec_draft_lens=(list(self.spec_draft_lens)
                                           if self.spec else None),
                          spec_ngram=(self.spec_ngram
-                                     if self.spec else None))
+                                     if self.spec else None),
+                         sched=scheduler is not None,
+                         sched_classes=(
+                             [c.name for c in scheduler.by_rank]
+                             if scheduler is not None else None))
 
     # -- submission ---------------------------------------------------
 
     def submit(self, prompt, steps: int,
                deadline_rounds: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               request_id: Optional[int] = None) -> int:
+               request_id: Optional[int] = None,
+               tenant: Optional[str] = None,
+               sched_class: Optional[str] = None) -> int:
         """Queue one generation request; returns its request id.
 
         ``prompt`` is a host/device 1-D int array; ``steps`` tokens will
@@ -851,6 +897,13 @@ class ServingEngine:
         seed/params) reproduces the same bytes, which is what makes
         router failover byte-exact (docs/fleet.md). Explicit ids must
         not collide with a live or completed id still in the ledger.
+
+        ``tenant`` is an opaque caller label (debug/exemplar surfaces);
+        ``sched_class`` names a priority class and needs a scheduler —
+        it is resolved (and validated: unknown names raise ValueError,
+        the HTTP layer's 400) before anything is enqueued. Omitted, the
+        scheduler's default class applies. Neither moves a single
+        sampled bit: output stays f(prompt, steps, seed, request_id).
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         s = int(prompt.shape[0])
@@ -889,6 +942,15 @@ class ServingEngine:
                 f"pages > pool size {self.kv_pages} (prompt {s} + "
                 f"steps {steps} + overhang {self._spec_overhang} at "
                 f"{PAGE} tokens/page)")
+        if sched_class is not None and self.scheduler is None:
+            raise ValueError(
+                f"sched_class {sched_class!r} needs a scheduler "
+                "(ServingEngine(scheduler=...)); this engine admits "
+                "FIFO")
+        # Resolve BEFORE anything registers: an unknown class raises
+        # here and the submit leaves no trace (the 400 contract).
+        cls_name = (self.scheduler.resolve(sched_class).name
+                    if self.scheduler is not None else "")
         now = time.perf_counter()
         with self._submit_lock:
             if request_id is None:
@@ -906,7 +968,9 @@ class ServingEngine:
                 steps=int(steps), deadline_rounds=deadline_rounds,
                 deadline_time=(now + deadline_s
                                if deadline_s is not None else None),
-                submit_round=self.round_idx, submit_time=now)
+                submit_round=self.round_idx, submit_time=now,
+                tenant=(str(tenant) if tenant else "default"),
+                sched_class=cls_name)
             with self.tracer.span("serving.submit", scope=False,
                                   request_id=req.request_id):
                 # Raises Full/Closed BEFORE the id advances or the
@@ -921,7 +985,9 @@ class ServingEngine:
         self.runlog.emit("submit", request_id=req.request_id,
                          prompt_len=s, steps=int(steps),
                          round=self.round_idx,
-                         queue_depth=len(self.queue))
+                         queue_depth=len(self.queue),
+                         **({"sched_class": cls_name} if cls_name
+                            else {}))
         return req.request_id
 
     def close(self) -> None:
@@ -970,6 +1036,24 @@ class ServingEngine:
         req.admit_time = time.perf_counter()
         req.status = "active"
         self.stats.record_admission(req)
+        if self.scheduler is not None:
+            # First admission only (a thaw never re-enters here): the
+            # class queue-wait histogram + SLO-miss counter measure
+            # submit -> admission start, once per request.
+            self.scheduler.note_admitted(
+                req, req.admit_start_time - req.submit_time)
+
+    def _release_expired(self, req: Request) -> None:
+        """Queue ``on_expire`` hook: a request dropped for deadline at
+        pop time may still own engine-side resources — today, a
+        PREEMPTED request's pinned host-tier row entry. Release them or
+        the pinned-byte ledger leaks for the engine's lifetime (the
+        deadline-drop mid-reservation edge, ISSUE 17; regression pinned
+        in test_sched.py). Runs outside the queue lock."""
+        fz = req.frozen
+        if fz is not None and self.host_tier is not None:
+            self.host_tier.drop_row(fz.host_key)
+            req.frozen = None
 
     def _drop_expired(self, expired: List[Request]) -> None:
         for req in expired:
@@ -986,11 +1070,240 @@ class ServingEngine:
                 self.requests.pop(req.request_id, None)
             self._retired_pending.append(req)  # crash-safe until return
 
+    # -- preemption (serving/sched.py, ISSUE 17) ----------------------
+
+    def _class_occupancy(self) -> Dict[str, int]:
+        """Active + mid-prefill rows per class — the scheduler's quota
+        denominator. One locked scan (submit inserts concurrently)."""
+        occ: Dict[str, int] = {}
+        with self._submit_lock:
+            for row in self.slots.occupied_rows():
+                req = self.requests.get(self.slots.owner_of(row))
+                if req is not None and req.sched_class:
+                    occ[req.sched_class] = occ.get(req.sched_class,
+                                                   0) + 1
+        return occ
+
+    def _pop_ready(self):
+        """The admission loops' queue pop, with the class occupancy
+        threaded through in scheduler mode (quota discipline)."""
+        occ = self._class_occupancy() if self.scheduler is not None \
+            else None
+        return self.queue.pop_ready(self.round_idx, occupancy=occ)
+
+    def _pick_victim(self, requester_rank: int) -> Optional[Request]:
+        """The row to freeze for a rank-``requester_rank`` requester,
+        or None. Candidates are ACTIVE decoding rows (mid-prefill rows
+        are not freezable — their KV is incomplete and their job state
+        lives outside the freeze residue) with work remaining; the
+        scheduler orders them (strictly-lower-priority preemptible
+        classes, lowest priority / most remaining work first) and its
+        cost gate prices the freeze against letting the row finish."""
+        cands = []
+        with self._submit_lock:
+            for row in self.slots.occupied_rows():
+                if row in self._prefilling:
+                    continue
+                req = self.requests.get(self.slots.owner_of(row))
+                if req is None or not self._active[row]:
+                    continue
+                remaining = int(self._target[row] - self._filled[row])
+                if remaining <= 0:
+                    continue  # retiring at this boundary anyway
+                cands.append((req, remaining))
+        ordered = self.scheduler.victim_order(cands, requester_rank)
+        for req, remaining in ordered:
+            if self.scheduler.preempt_gate(
+                    self.cfg, int(self._filled[req.row]), remaining):
+                return req
+        if ordered:
+            self.scheduler.note_preempt_abort("cost_gate")
+        else:
+            self.scheduler.note_preempt_abort("no_victim")
+        return None
+
+    def _preempt_one(self, requester_rank: int) -> bool:
+        """Pick + freeze one victim for a blocked requester of
+        ``requester_rank``; spends one unit of the round's preemption
+        budget on success."""
+        victim = self._pick_victim(requester_rank)
+        if victim is None:
+            return False
+        if not self._preempt_row(victim):
+            return False
+        self._preempt_budget -= 1
+        return True
+
+    def _preempt_for_urgent(self) -> None:
+        """Slot-pressure preemption: while the batch is full and a
+        ``can_preempt``-class request heads its queue, freeze victims
+        (budget-bounded). A blocked urgent request waits at least one
+        more full round otherwise — with interactive SLOs of ~1s and
+        batch rows holding slots for hundreds of rounds, "blocked now"
+        IS the SLO-miss signal (docs/serving.md §8). Page-pressure
+        preemption lives in ``_admit_chunked``'s reservation-retry."""
+        while self._preempt_budget > 0 and self.slots.n_free == 0:
+            cand = self.queue.peek_urgent()
+            if cand is None:
+                return
+            rank = self.scheduler.classes[cand.sched_class].rank
+            if not self._preempt_one(rank):
+                return
+
+    def _preempt_row(self, req: Request) -> bool:
+        """Freeze one ACTIVE decoding row at this round boundary and
+        spill it through the host tier — the mechanism half of
+        preemption (the scheduler decided WHO).
+
+        The freeze residue is exactly what bit-exact resume needs
+        (sched.FrozenRow): the row's full page complement (live KV
+        bytes [0, filled-1) — the round-boundary coverage invariant —
+        plus dead-slot garbage that restores byte-identically and is
+        never read), the token buffer [0, filled), the per-request PRNG
+        stream position (advanced only on live samples, so restoring it
+        resumes the stream exactly), and the filled/target cursors.
+        Returns False — row untouched, victim keeps decoding — when the
+        host tier refuses the spill (budget) or a chaos fault fires
+        before the gather."""
+        row = req.row
+        f = int(self._filled[row])
+        host_key = f"row-{req.request_id}-{req.preempt_count}"
+        # Blame + fault site BEFORE the gather: a chaos crash here
+        # leaves the row intact and attributed — the supervisor replays
+        # the victim from scratch, bit-exact by the stream contract.
+        self._admitting_rid = req.request_id
+        faults.check("preempt_spill", round_idx=self.round_idx,
+                     request_id=req.request_id)
+        with self.tracer.span("serving.preempt", scope=False,
+                              request_id=req.request_id, row=row,
+                              filled=f):
+            # np.array, not device_get: the buffer is donation-aliased
+            # (same rule as _retire's fetch).
+            buf_host = np.array(self._buf)
+            tokens = buf_host[row, :f].copy()
+            pages = list(self._row_pages[row])
+            spilled = self.host_tier.spill_row(host_key, tokens, pages)
+        self._admitting_rid = None
+        if spilled is None:
+            self.scheduler.note_preempt_abort("host_budget")
+            return False
+        nbytes, spill_s = spilled
+        keys = self._keys[row].copy()
+        target = int(self._target[row])
+        # Release the device residency: every page reference this row
+        # held (aliased prefix pages stay live through the index; the
+        # rest return to the free list), the table back to the write
+        # sink, the row to the free-slot defaults (filled=1 over the
+        # stale buffer is well-defined dead state, target=0 keeps it
+        # done).
+        self.page_pool.unref(self._row_pages.pop(row))
+        self._row_slack.pop(row, None)
+        self._tables[row] = 0
+        self._active[row] = False
+        self._target[row] = 0
+        self._filled[row] = 1
+        self._keys[row] = 0
+        self.slots.release(row)
+        req.frozen = FrozenRow(host_key=host_key, filled=f,
+                               target=target, keys=keys,
+                               n_pages=len(pages), nbytes=nbytes,
+                               preempt_round=self.round_idx)
+        req.row = -1
+        req.status = "preempted"
+        req.preempt_count += 1
+        self._n_preempts += 1
+        self.stats.record_preempt(req)
+        self.scheduler.note_preempt(req)
+        # Back into its class heap under the ORIGINAL sequence: the
+        # victim resumes ahead of later arrivals of its class.
+        self.queue.push_front(req)
+        self.runlog.emit("preempt", request_id=req.request_id, row=row,
+                         round=self.round_idx, filled=f,
+                         pages=len(pages), bytes=nbytes,
+                         spill_s=round(spill_s, 6))
+        return True
+
+    def _thaw_frozen(self, req: Request) -> bool:
+        """Resume a preempted request: re-reserve its page complement,
+        scatter the pinned host payload back, restore the token buffer
+        row and the decode cursors/stream — after which the row is
+        byte-indistinguishable from one that never froze (test_sched.py
+        pins preempted == uninterrupted across variants). Returns False
+        (nothing claimed) under page pressure — the caller re-queues
+        and retries as pages retire."""
+        fz: FrozenRow = req.frozen
+        need = fz.n_pages
+        if self.page_pool.n_free < need and self.prefix_index is not None:
+            self.prefix_index.evict_until_free(need)
+        fresh = self.page_pool.alloc(need)
+        if fresh is None:
+            return False
+        fetched = self.host_tier.fetch_row(fz.host_key)
+        if fetched is None:
+            # Pinned and incarnation-local: a miss is an accounting
+            # bug, not a recoverable condition — refuse to fabricate.
+            self.page_pool.unref(fresh)
+            raise RuntimeError(
+                f"frozen-row payload {fz.host_key!r} missing for "
+                f"request {req.request_id} (pinned entries cannot be "
+                "evicted — refcount/drop discipline bug)")
+        payload, tokens, nbytes = fetched
+        # NOT restamping admit_start_time: the request already admitted
+        # once; its phase timeline stays contiguous (the frozen wait
+        # lands inside the decode phase, like rounds ridden frozen).
+        row = self.slots.acquire(req.request_id)
+        self._admitting_rid = req.request_id
+        faults.check("kv_restore", round_idx=self.round_idx,
+                     request_id=req.request_id)
+        t0 = time.perf_counter()
+        with self.tracer.span("serving.thaw", scope=False,
+                              request_id=req.request_id, row=row,
+                              filled=fz.filled), \
+                jax.transfer_guard("allow"):
+            # Sanctioned h2d: the payload push IS the restore. Pages
+            # scatter through the shared entry point (compile per page
+            # count, watchdog-held); the buffer row is one dedicated
+            # compile (tokens padded host-side to max_len).
+            self.page_pool.pages = restore_pages_into_pool(
+                self.page_pool.pages, payload,
+                jnp.asarray(np.asarray(fresh, np.int32)))
+            padded = np.zeros((self.cfg.max_len,), np.int32)
+            padded[:fz.filled] = tokens
+            self._buf = restore_row_tokens(self._buf, jnp.int32(row),
+                                           jnp.asarray(padded))
+            jax.block_until_ready(self.page_pool.pages)
+        dt = time.perf_counter() - t0
+        self._admitting_rid = None
+        table = self._tables[row]
+        table[:] = 0
+        table[:need] = fresh
+        self._row_pages[row] = [int(p) for p in fresh]
+        self._row_slack[row] = need * PAGE - (req.prompt_len + req.steps)
+        self._filled[row] = fz.filled
+        self._target[row] = fz.target
+        self._keys[row] = np.asarray(fz.keys, np.uint32)
+        self._active[row] = True
+        req.row = row
+        req.status = "active"
+        req.frozen = None
+        self.host_tier.drop_row(fz.host_key)
+        self.host_tier.record_row_restore(nbytes, dt)
+        self._n_resumes += 1
+        self.stats.record_resume(req)
+        self.scheduler.note_resume(req)
+        self.runlog.emit("resume", request_id=req.request_id, row=row,
+                         round=self.round_idx, filled=fz.filled,
+                         pages=need, bytes=nbytes,
+                         frozen_rounds=self.round_idx - fz.preempt_round,
+                         restore_s=round(dt, 6))
+        return True
+
     def _admit(self) -> List[Request]:
-        """Fill free slots from the queue (FIFO); returns timed-out
-        requests dropped on the way. Dispatches on the admission
-        discipline: the default ONE-SHOT flash prefill, or the CHUNKED
-        path (``prefill_chunk`` set) that also serves prefix reuse."""
+        """Fill free slots from the queue (FIFO, or the scheduler's
+        class/EDF order); returns timed-out requests dropped on the
+        way. Dispatches on the admission discipline: the default
+        ONE-SHOT flash prefill, or the CHUNKED path (``prefill_chunk``
+        set) that also serves prefix reuse and preemption."""
         if self.prefill_chunk is None:
             return self._admit_oneshot()
         return self._admit_chunked()
@@ -999,7 +1312,7 @@ class ServingEngine:
         expired: List[Request] = []
         while self.slots.n_free:
             faults.check("admission_pop", round_idx=self.round_idx)
-            req, dropped = self.queue.pop_ready(self.round_idx)
+            req, dropped = self._pop_ready()
             expired.extend(dropped)
             if req is None:
                 break
@@ -1053,18 +1366,34 @@ class ServingEngine:
         Sarathi-style interleaving, so a long cold prompt spreads its
         prefill across rounds instead of stalling the live batch."""
         expired: List[Request] = []
+        if self._can_preempt:
+            # Per-round freeze allowance, then the slot-pressure pass:
+            # a full batch with urgent work queued frees rows BEFORE
+            # the pop loop below runs.
+            self._preempt_budget = self.scheduler.max_preempts_per_round
+            self._preempt_for_urgent()
         while self.slots.n_free:
             faults.check("admission_pop", round_idx=self.round_idx)
-            req, dropped = self.queue.pop_ready(self.round_idx)
+            req, dropped = self._pop_ready()
             expired.extend(dropped)
             if req is None:
                 break
             if not self._start_prefill(req):
                 # Paged page pressure: the request's reservation did not
-                # fit even after evicting stored prefixes. It goes back
-                # to the queue HEAD (FIFO preserved, no stamps written)
-                # and admission stops — retires free pages, the next
-                # round retries.
+                # fit even after evicting stored prefixes. Page-pressure
+                # preemption: an urgent (can_preempt-class) requester
+                # may freeze a victim — whose pages return to the free
+                # list — and retry immediately; otherwise the request
+                # goes back to the queue HEAD (order preserved, no
+                # stamps written) and admission stops — retires free
+                # pages, the next round retries.
+                if (self._can_preempt and self._preempt_budget > 0
+                        and self.scheduler.classes[
+                            req.sched_class].can_preempt
+                        and self._preempt_one(self.scheduler.classes[
+                            req.sched_class].rank)):
+                    self.queue.push_front(req)
+                    continue
                 self.queue.push_front(req)
                 break
         # Snapshot under the lock (handler threads iterate _prefilling
@@ -1240,7 +1569,10 @@ class ServingEngine:
     def _start_prefill(self, req: Request) -> bool:
         """Claim a row and start a chunked admission. Returns False —
         nothing stamped or claimed — when the PAGED reservation cannot
-        be placed; True otherwise."""
+        be placed; True otherwise. A PREEMPTED request resumes through
+        the thaw path instead of re-prefilling (same return contract)."""
+        if req.frozen is not None:
+            return self._thaw_frozen(req)
         if self.paged:
             placed = self._reserve_pages(req)
             if placed is None:
@@ -1721,6 +2053,21 @@ class ServingEngine:
                     host_entries=ts["host_entries"])
                 self._host_spills0 = ts["spills"]
                 self._host_restores0 = ts["restores"]
+        sched_round_fields = {}
+        if self.scheduler is not None:
+            # Per-round freeze/thaw deltas (tools/runlog_report.py
+            # narrates them and — like restores — exempts such rounds
+            # from the stall detector: a freeze/thaw IS scheduling
+            # work).
+            sched_round_fields = dict(
+                preempts=self._n_preempts - self._preempts0,
+                resumes=self._n_resumes - self._resumes0)
+            self._preempts0 = self._n_preempts
+            self._resumes0 = self._n_resumes
+            if self.host_tier is not None:
+                sched_round_fields["host_row_bytes"] = \
+                    self.host_tier.summary()["host_row_bytes"]
+            self.scheduler.mirror_queued()
         faults.check("runlog_emit", round_idx=self.round_idx)
         self.runlog.emit(
             "round", round=self.round_idx, iters=int(iters),
@@ -1733,7 +2080,7 @@ class ServingEngine:
             round_s=round(time.perf_counter() - t_round0, 6),
             decode_s=round(decode_s, 6),
             drift_decode=round(self.stats.calibration.drift("decode"), 4),
-            **page_fields, **spec_fields)
+            **page_fields, **spec_fields, **sched_round_fields)
         self.round_idx += 1
         # Ownership transfers through the return below; the crash-
         # consistency copy is only needed while a raise could still
@@ -1859,7 +2206,15 @@ class ServingEngine:
             host_kv_bytes=self.host_kv_bytes,
             host_kv_dir=self.host_kv_dir,
             restore_min_tokens=(self.restore_min_tokens
-                                if self.host_kv else None))
+                                if self.host_kv else None),
+            # A FRESH scheduler with the same policy config and none of
+            # the crashed heap state: the supervisor re-pushes every
+            # captured request through requeue -> queue.restore, and
+            # reusing the old heaps would double-enqueue them. Frozen
+            # residues died with the incarnation (reset_for_requeue
+            # wipes them; the replay from scratch is bit-exact).
+            scheduler=(self.scheduler.spawn_successor()
+                       if self.scheduler is not None else None))
         eng._next_id = self._next_id
         eng.round_idx = self.round_idx + 1
         if self.spec:
@@ -1950,6 +2305,42 @@ class ServingEngine:
                 out["host_tier"] = dict(
                     self.host_tier.summary(),
                     restore_min_tokens=self.restore_min_tokens)
+        return out
+
+    def debug_sched(self) -> Optional[dict]:
+        """Scheduler state for ``GET /debug/sched``: the class table
+        (rank/quota/SLO/queue depth), per-class occupancy, lifetime
+        freeze/thaw counts, and every currently frozen request. None on
+        a FIFO engine (the HTTP layer maps that to 404). Same threading
+        contract as debug_snapshot: dict reads under ``_submit_lock``,
+        scalars racy by at most a round."""
+        if self.scheduler is None:
+            return None
+        out = self.scheduler.summary()
+        out["occupancy"] = self._class_occupancy()
+        out["can_preempt"] = self._can_preempt
+        out["preempts"] = self._n_preempts
+        out["resumes"] = self._n_resumes
+        frozen = []
+        with self._submit_lock:
+            for req in self.requests.values():
+                fz = req.frozen
+                if fz is None:
+                    continue
+                frozen.append({
+                    "request_id": req.request_id,
+                    "sched_class": req.sched_class,
+                    "tenant": req.tenant,
+                    "filled": fz.filled, "target": fz.target,
+                    "pages": fz.n_pages, "bytes": fz.nbytes,
+                    "preempt_round": fz.preempt_round,
+                    "preempt_count": req.preempt_count})
+        out["frozen"] = sorted(frozen,
+                               key=lambda d: d["request_id"])
+        if self.host_tier is not None:
+            ts = self.host_tier.summary()
+            out["host_rows"] = ts["host_rows"]
+            out["host_row_bytes"] = ts["host_row_bytes"]
         return out
 
     def debug_request(self, request_id: int) -> Optional[dict]:

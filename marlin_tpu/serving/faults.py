@@ -27,7 +27,12 @@ site                      fires inside
                           (``EngineFrontend._fanout``)
 ``runlog_emit``           the engine's per-round runlog emission
 ``kv_restore``            the host-tier restore scatter during a
-                          paged admission (``_bind_row_pages``)
+                          paged admission (``_bind_row_pages``) and a
+                          preemption thaw (``_thaw_frozen``)
+``preempt_spill``         the freeze half of a preemption — after the
+                          victim is chosen, before its live pages are
+                          gathered to the host tier
+                          (``_preempt_row``)
 ========================  ============================================
 
 Each site calls :func:`check` (raise or sleep) or :func:`corrupt`
@@ -65,7 +70,7 @@ from ..obs import metrics as obs_metrics
 
 SITES = ("decode_round", "prefill_chunk", "prefix_copy",
          "admission_pop", "stream_fanout", "runlog_emit",
-         "kv_restore")
+         "kv_restore", "preempt_spill")
 ACTIONS = ("raise", "delay", "corrupt")
 ENV_VAR = "MARLIN_FAULT_PLAN"
 

@@ -298,7 +298,9 @@ class EngineFrontend:
     def submit(self, prompt, steps: int,
                deadline_s: Optional[float] = None,
                stream: bool = False,
-               request_id: Optional[int] = None) -> FrontendRequest:
+               request_id: Optional[int] = None,
+               tenant: Optional[str] = None,
+               sched_class: Optional[str] = None) -> FrontendRequest:
         """Thread-safe submit; returns the request's handle.
 
         Registering the handle and enqueueing the request happen under
@@ -309,7 +311,9 @@ class EngineFrontend:
         call. ``QueueFull``/``QueueClosed``/``ValueError`` propagate to
         the caller (the HTTP 429/503/400 mapping). ``request_id``
         passes an explicit engine id through (fleet router ids —
-        engine.submit documents the byte-exactness contract)."""
+        engine.submit documents the byte-exactness contract);
+        ``tenant``/``sched_class`` ride through to the engine's
+        scheduler untouched (engine.submit validates the class)."""
         self._raise_if_fatal()
         # One lock hold also makes submission atomic vs the
         # supervisor's capture-and-swap: a request lands wholly in the
@@ -322,7 +326,9 @@ class EngineFrontend:
             # ever complete it.
             self._raise_if_fatal()
             rid = self.engine.submit(prompt, steps, deadline_s=deadline_s,
-                                     request_id=request_id)
+                                     request_id=request_id,
+                                     tenant=tenant,
+                                     sched_class=sched_class)
             handle = FrontendRequest(rid, stream=stream,
                                      submit_time=time.perf_counter())
             self._handles[rid] = handle
@@ -366,6 +372,11 @@ class EngineFrontend:
     def debug_request(self, request_id: int):
         """Per-request timeline for ``GET /debug/requests/<id>``."""
         return self.engine.debug_request(request_id)
+
+    def debug_sched(self):
+        """Scheduler state for ``GET /debug/sched``; None on a FIFO
+        engine (the server maps that to 404)."""
+        return self.engine.debug_sched()
 
     # -- the driver loop ----------------------------------------------
 

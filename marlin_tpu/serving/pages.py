@@ -270,6 +270,17 @@ class HostKVTier:
     wholesale discard is the coherent crash story); ``spill_dir`` files
     are the durable share and survive restarts.
 
+    Beyond stored prefixes, the tier holds PINNED ROW entries
+    (:meth:`spill_row` / :meth:`fetch_row` / :meth:`drop_row`) — the KV
+    payload + token buffer of a LIVE decoding row frozen by the
+    scheduler's preemption path (serving/sched.py, ISSUE 17). Pinned
+    entries count against ``budget_bytes`` but are NEVER LRU-evicted: a
+    frozen row must stay restorable bit-exactly, so under pressure the
+    tier evicts unpinned prefixes first and, failing that, REFUSES the
+    spill (the engine aborts the preemption; the victim keeps running).
+    Row entries are in-memory only — a frozen row is incarnation-local,
+    and a crash replays the request from scratch bit-exactly anyway.
+
     Thread-safety: the driver thread spills/fetches while HTTP handler
     threads read ``summary()`` — every mutation and reading scan holds
     ``_lock``. The gather reads the device pool OUTSIDE the lock (pool
@@ -288,10 +299,14 @@ class HostKVTier:
         self.spill_dir = spill_dir
         self._entries: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
         self._bytes = 0  # guarded-by: _lock (in-memory payload bytes)
+        self._rows: Dict[str, dict] = {}  # guarded-by: _lock (pinned)
+        self._row_bytes = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self.spills = 0
         self.restores = 0
         self.drops = 0
+        self.row_spills = 0
+        self.row_restores = 0
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
         with self._lock:
@@ -318,6 +333,13 @@ class HostKVTier:
         reg.gauge("serving_kv_host_entries",
                   help="spilled prefixes resident in host memory").set(
             len(self._entries))
+        reg.gauge("serving_kv_host_rows",
+                  help="preempted live rows pinned in host memory "
+                       "(serving/sched.py)").set(len(self._rows))
+        reg.gauge("serving_kv_host_row_bytes",
+                  help="bytes of pinned frozen-row payloads (counted "
+                       "against the host budget, never LRU-evicted)"
+                  ).set(self._row_bytes)
 
     # -- keys / payloads ----------------------------------------------
 
@@ -373,7 +395,8 @@ class HostKVTier:
             if old is not None:
                 self._bytes -= old["nbytes"]
             while (self.budget_bytes is not None and self._entries
-                   and self._bytes + nbytes > self.budget_bytes):
+                   and self._bytes + self._row_bytes + nbytes
+                   > self.budget_bytes):
                 _, ev = self._entries.popitem(last=False)  # host LRU
                 self._bytes -= ev["nbytes"]
                 self.drops += 1
@@ -381,6 +404,15 @@ class HostKVTier:
                     "serving_kv_host_drops_total",
                     help="spilled payloads dropped from host memory "
                          "under the host budget").inc()
+            if (self.budget_bytes is not None
+                    and self._bytes + self._row_bytes + nbytes
+                    > self.budget_bytes):
+                # Pinned frozen rows own the remaining budget and are
+                # not evictable — the prefix spill loses the contest
+                # (the spill_dir copy, if any, was still written: it is
+                # the durable share, not host memory).
+                self._mirror_locked()
+                return None
             self._entries[key] = {"payload": payload, "tokens": tok,
                                   "length": length, "nbytes": nbytes}
             self._bytes += nbytes
@@ -436,6 +468,95 @@ class HostKVTier:
         self.registry.counter(
             "serving_kv_restores_total",
             help="spilled prefixes restored into device pages").inc()
+        self.registry.histogram(
+            "serving_kv_restore_seconds",
+            help="host-to-device restore latency per restored "
+                 "prefix").observe(seconds)
+
+    # -- pinned frozen-row entries (preemption, serving/sched.py) -----
+
+    def spill_row(self, key: str, tokens, pages):
+        """Spill a LIVE row's KV pages + token buffer as a PINNED host
+        entry (the freeze half of preemption, engine._preempt_row).
+        ``key`` is the engine's per-freeze identity (request id +
+        preempt count — unique, unlike content keys: two freezes of one
+        request are distinct payloads). Evicts unpinned prefix entries
+        for room; returns None when pinned + unpinned bytes still
+        exceed the budget (the engine aborts the preemption cleanly —
+        refusal is the only safe answer, a frozen row can never be
+        dropped). Returns ``(nbytes, seconds)`` on success."""
+        t0 = time.perf_counter()
+        payload, nbytes = self._gather_payload(pages)
+        tok = np.ascontiguousarray(np.asarray(tokens, np.int32)).copy()
+        nbytes += tok.nbytes
+        with self._lock:
+            if key in self._rows:
+                raise RuntimeError(
+                    f"frozen-row key {key!r} already resident (freeze "
+                    "accounting bug: one freeze, one spill)")
+            if self.budget_bytes is not None:
+                while (self._entries
+                       and self._bytes + self._row_bytes + nbytes
+                       > self.budget_bytes):
+                    _, ev = self._entries.popitem(last=False)  # LRU
+                    self._bytes -= ev["nbytes"]
+                    self.drops += 1
+                    self.registry.counter(
+                        "serving_kv_host_drops_total",
+                        help="spilled payloads dropped from host "
+                             "memory under the host budget").inc()
+                if (self._bytes + self._row_bytes + nbytes
+                        > self.budget_bytes):
+                    self._mirror_locked()
+                    return None
+            self._rows[key] = {"payload": payload, "tokens": tok,
+                               "nbytes": nbytes}
+            self._row_bytes += nbytes
+            self.row_spills += 1
+            self.registry.counter(
+                "serving_kv_row_spills_total",
+                help="live decoding rows frozen and spilled to the "
+                     "host tier (preemption)").inc()
+            self._mirror_locked()
+        dt = time.perf_counter() - t0
+        if self.event_sink is not None:
+            self.event_sink("row_spill", key=key, bytes=nbytes,
+                            spill_s=round(dt, 6))
+        return nbytes, dt
+
+    def fetch_row(self, key: str):
+        """The pinned payload for ``key`` as ``(payload, tokens,
+        nbytes)``, or None if unknown (never silently dropped — a miss
+        here is a caller bug or a fresh incarnation). The entry stays
+        resident until :meth:`drop_row`; the thaw path drops only after
+        the device restore completed, so a mid-thaw crash loses
+        nothing."""
+        with self._lock:
+            ent = self._rows.get(key)
+            if ent is None:
+                return None
+            return ent["payload"], ent["tokens"], ent["nbytes"]
+
+    def drop_row(self, key: str) -> None:
+        """Release a pinned row entry: after a successful thaw, or when
+        the frozen request is dropped for deadline / poisoned (the
+        queue's ``on_expire`` hook — without this the pinned-byte
+        ledger leaks, test_sched.py regression)."""
+        with self._lock:
+            ent = self._rows.pop(key, None)
+            if ent is None:
+                return
+            self._row_bytes -= ent["nbytes"]
+            self._mirror_locked()
+
+    def record_row_restore(self, nbytes: int, seconds: float) -> None:
+        """Account one completed frozen-row restore (thaw)."""
+        with self._lock:
+            self.row_restores += 1
+        self.registry.counter(
+            "serving_kv_row_restores_total",
+            help="frozen rows restored into device pages (preemption "
+                 "resume)").inc()
         self.registry.histogram(
             "serving_kv_restore_seconds",
             help="host-to-device restore latency per restored "
@@ -506,9 +627,13 @@ class HostKVTier:
             return {
                 "host_entries": len(self._entries),
                 "host_bytes": self._bytes,
+                "host_rows": len(self._rows),
+                "host_row_bytes": self._row_bytes,
                 "host_budget_bytes": self.budget_bytes,
                 "spills": self.spills,
                 "restores": self.restores,
+                "row_spills": self.row_spills,
+                "row_restores": self.row_restores,
                 "host_drops": self.drops,
                 "spill_dir": self.spill_dir,
             }

@@ -1,10 +1,16 @@
-"""Admission queue for the serving engine: FIFO with backpressure.
+"""Admission queue for the serving engine: FIFO with backpressure,
+optionally delegating ORDER to a scheduler.
 
 The queue is the host-side half of continuous batching — requests wait
 here until the slot manager frees a batch row, then admit in strict FIFO
 order (iteration-level scheduling needs no priority machinery to beat
-static batching; arrival order is the fairness contract). Three policies
-live here so the engine stays a pure scheduling loop:
+static batching; arrival order is the fairness contract). With a
+:class:`~marlin_tpu.serving.sched.Scheduler` attached
+(``AdmissionQueue(scheduler=...)``) the ORDERING policy — priority
+classes, per-class quotas, EDF within class — is delegated to it while
+this module keeps owning backpressure, deadlines, drain, and
+thread-safety; without one, behavior is bit-for-bit the original FIFO.
+Three policies live here so the engine stays a pure scheduling loop:
 
 * **Backpressure** (``max_pending``): ``submit`` on a full queue raises
   :class:`QueueFull` instead of growing without bound — the caller (an
@@ -66,6 +72,24 @@ class Request:
     deadline_time: Optional[float] = None  # absolute perf_counter instant
     submit_round: int = 0
     submit_time: float = 0.0
+    # Multi-tenant scheduling fields (serving/sched.py). ``tenant`` is
+    # an opaque caller label that rides into metrics exemplars and
+    # debug surfaces; ``sched_class`` names the priority class (empty
+    # until a Scheduler resolves it — the FIFO path ignores both);
+    # ``sched_seq`` is the scheduler-assigned monotone arrival sequence
+    # (the EDF tie-break, assigned once so requeues keep their original
+    # FIFO position).
+    tenant: str = "default"
+    sched_class: str = ""
+    sched_seq: int = -1
+    # Preemption ledger (engine._preempt_row / thaw): while the request
+    # waits in the queue with status "preempted", ``frozen`` holds the
+    # sched.FrozenRow residue (decode cursor, PRNG stream position, and
+    # the host-tier row key its KV payload lives under); None
+    # otherwise. ``preempt_count`` survives resume — it is how many
+    # times this request has been frozen.
+    frozen: Optional[object] = None
+    preempt_count: int = 0
     # Engine-owned lifecycle fields:
     key: Optional[np.ndarray] = None  # (2,) uint32 per-request PRNG root,
     # derived at admission as fold_in(engine key, request_id) — fully
@@ -103,10 +127,12 @@ class Request:
     # spec_accepted holds exactly for speculative engines.
     spec_drafted: int = 0
     spec_accepted: int = 0
-    # pending -> active -> done | timeout; "poisoned" is the supervisor's
-    # terminal quarantine verdict (serving/frontend.py, docs/robustness
-    # .md): implicated in ``poison_after`` consecutive engine crashes,
-    # never requeued again.
+    # pending -> active -> done | timeout; "preempted" is the frozen
+    # detour (active -> preempted -> active, serving/sched.py);
+    # "poisoned" is the supervisor's terminal quarantine verdict
+    # (serving/frontend.py, docs/robustness.md): implicated in
+    # ``poison_after`` consecutive engine crashes, never requeued
+    # again.
     status: str = "pending"
     tokens: Optional[np.ndarray] = None
     # Crash-recovery ledger (supervised restart, serving/frontend.py):
@@ -195,14 +221,30 @@ class Request:
         self.spec_accepted = 0
         self.status = "pending"
         self.tokens = None
+        # A frozen residue dies with its engine incarnation: the host
+        # tier's pinned row entries are in-memory only (no spill_dir),
+        # so the successor replays this request FROM SCRATCH — which is
+        # bit-exact anyway by the per-request PRNG-stream contract.
+        self.frozen = None
 
 
 @dataclass
 class AdmissionQueue:
     """FIFO of :class:`Request` with backpressure and deadline drop;
-    safe under concurrent submitters (module docstring)."""
+    safe under concurrent submitters (module docstring).
+
+    ``scheduler`` (a :class:`~marlin_tpu.serving.sched.Scheduler`)
+    replaces the FIFO deque with per-class EDF heaps — ordering only;
+    caps, closed-check, and locking stay here. ``on_expire`` is the
+    engine's resource-release hook, called (outside the lock) for every
+    request dropped at pop time: a PREEMPTED request expiring in the
+    queue still owns a pinned host-tier row entry, which must be
+    released or the tier's pinned-byte ledger leaks (ISSUE 17
+    deadline-drop edge; test_sched.py pins the counter)."""
 
     max_pending: int = 64
+    scheduler: Optional[object] = None
+    on_expire: Optional[object] = None  # callable(Request) -> None
     _q: deque = field(default_factory=deque)  # guarded-by: _lock
     _closed: bool = False  # guarded-by: _lock
 
@@ -211,9 +253,14 @@ class AdmissionQueue:
 
         self._lock = threading.Lock()
 
+    def _pending_locked(self) -> int:  # marlint: holds=_lock
+        if self.scheduler is not None:
+            return len(self.scheduler)
+        return len(self._q)
+
     def __len__(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._pending_locked()
 
     @property
     def closed(self) -> bool:
@@ -226,37 +273,72 @@ class AdmissionQueue:
                 raise QueueClosed(
                     "queue is draining (close() was called); no new "
                     "requests")
-            if len(self._q) >= self.max_pending:
+            pending = self._pending_locked()
+            if pending >= self.max_pending:
                 raise QueueFull(
-                    f"{len(self._q)} pending requests >= max_pending "
+                    f"{pending} pending requests >= max_pending "
                     f"{self.max_pending}; retry after the engine drains")
-            self._q.append(req)
+            if self.scheduler is not None:
+                # Raises ValueError on an unknown class — nothing was
+                # enqueued, so the reject is clean.
+                self.scheduler.push(req)
+            else:
+                self._q.append(req)
 
-    def pop_ready(self, round_idx: int, now: Optional[float] = None):
-        """Next admissible request, honoring FIFO order and deadlines:
-        requests whose ``deadline_rounds`` round or ``deadline_time``
-        wall-clock instant has passed are marked ``timeout`` and
-        returned in ``expired`` (the engine records them as
-        completed-without-output). ``now`` defaults to
+    def pop_ready(self, round_idx: int, now: Optional[float] = None,
+                  occupancy=None):
+        """Next admissible request, honoring the ordering policy and
+        deadlines: requests whose ``deadline_rounds`` round or
+        ``deadline_time`` wall-clock instant has passed are marked
+        ``timeout`` and returned in ``expired`` (the engine records
+        them as completed-without-output). ``now`` defaults to
         ``time.perf_counter()`` — the clock ``deadline_time`` is set
-        against. Returns ``(request | None, expired_list)``."""
+        against. ``occupancy`` (``{class: active_rows}``) feeds the
+        scheduler's quota discipline and is ignored in FIFO mode.
+        Returns ``(request | None, expired_list)``."""
         expired = []
+        req = None
         if now is None:
             now = time.perf_counter()
         with self._lock:
-            while self._q:
-                req = self._q.popleft()
-                if ((req.deadline_rounds is not None
-                        and round_idx > req.deadline_rounds)
-                        or (req.deadline_time is not None
-                            and now > req.deadline_time)):
-                    req.status = "timeout"
-                    req.finish_round = round_idx
-                    req.finish_time = now  # closes the queue_wait phase
-                    expired.append(req)
-                    continue
-                return req, expired
-        return None, expired
+            if self.scheduler is not None:
+                req, expired = self.scheduler.pop(round_idx, now,
+                                                  occupancy)
+            else:
+                while self._q:
+                    cand = self._q.popleft()
+                    if ((cand.deadline_rounds is not None
+                            and round_idx > cand.deadline_rounds)
+                            or (cand.deadline_time is not None
+                                and now > cand.deadline_time)):
+                        cand.status = "timeout"
+                        cand.finish_round = round_idx
+                        cand.finish_time = now  # closes queue_wait
+                        expired.append(cand)
+                        continue
+                    req = cand
+                    break
+        # Resource release + metrics OUTSIDE the lock: the hook takes
+        # the host tier's lock and the metrics registry's — neither may
+        # nest under ours (single lock-order direction, marlint).
+        for r in expired:
+            if self.scheduler is not None:
+                self.scheduler.note_timeout(r)
+            if self.on_expire is not None:
+                self.on_expire(r)
+        return req, expired
+
+    def peek_urgent(self):
+        """Scheduler mode only: the queued request most entitled to
+        trigger a preemption (the earliest-deadline head among
+        ``can_preempt`` classes, in rank order) — without popping it.
+        None in FIFO mode or when no such request waits. The engine
+        reads this to decide whether a full batch should freeze a row
+        (engine._preempt_for_urgent)."""
+        with self._lock:
+            if self.scheduler is None:
+                return None
+            return self.scheduler.preempt_candidate(time.perf_counter())
 
     def push_front(self, req: Request) -> None:
         """Return a popped-but-unplaced request to the queue HEAD — the
@@ -264,9 +346,14 @@ class AdmissionQueue:
         fit leaves the request first in line; admission retries once
         retires free pages). Bypasses the caps like :meth:`restore`:
         the request was already accepted once, and its pop was a
-        scheduling probe, not a drop decision."""
+        scheduling probe, not a drop decision. In scheduler mode the
+        request re-enters its class heap under its ORIGINAL sequence,
+        which lands it back at (or near) the head it was popped from."""
         with self._lock:
-            self._q.appendleft(req)
+            if self.scheduler is not None:
+                self.scheduler.push(req)
+            else:
+                self._q.appendleft(req)
 
     def restore(self, req: Request) -> None:
         """Supervised-restart recovery path (serving/frontend.py):
@@ -278,7 +365,10 @@ class AdmissionQueue:
         FIFO fairness survives the restart. Never use this for new
         submissions; ``submit`` owns the backpressure contract."""
         with self._lock:
-            self._q.append(req)
+            if self.scheduler is not None:
+                self.scheduler.push(req)
+            else:
+                self._q.append(req)
 
     def close(self) -> None:
         """Stop accepting new work; queued requests still drain."""

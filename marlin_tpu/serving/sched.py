@@ -1,0 +1,400 @@
+"""SLO-aware multi-tenant scheduler: priority classes, per-class
+quotas, EDF admission order, and the preemption policy (ROADMAP item
+16, docs/serving.md §8).
+
+The queue's FIFO discipline treats every request identically — one
+tenant's long batch job admitted first occupies rows for hundreds of
+rounds while chat requests queue behind it. This module makes
+scheduling POLICY a first-class layer instead of an emergent property
+of arrival order:
+
+* **Priority classes** (:class:`ClassSpec`): named classes —
+  ``interactive`` / ``batch`` / ``best_effort`` by default — ordered by
+  ``rank`` (lower = more urgent), each carrying an optional concurrent-
+  row ``quota``, an optional queue-wait SLO (``slo_s``), whether its
+  ACTIVE rows may be frozen (``preemptible``), and whether its QUEUED
+  requests may trigger a freeze (``can_preempt``).
+* **EDF within class**: each class keeps an earliest-deadline-first
+  heap keyed by the request's EFFECTIVE deadline — the earlier of its
+  caller deadline (``deadline_time``) and its class SLO target
+  (``submit_time + slo_s``); requests with neither sort last, FIFO by
+  a monotone submission sequence (also the deterministic tie-break, so
+  equal deadlines admit in arrival order).
+* **Quota accounting, work-conserving**: :meth:`Scheduler.pop` scans
+  classes in rank order twice — first only classes under their quota,
+  then (nothing admissible under quota) every class again. A quota
+  therefore bounds a class's share only under CONTENTION; idle rows are
+  never parked to enforce it.
+* **Preemption policy** (:meth:`preempt_urgency`,
+  :meth:`victim_order`): when an admissible ``can_preempt`` request
+  cannot be placed, the engine may freeze a lower-priority decoding row
+  at a round boundary and spill it through the host KV tier
+  (engine._preempt_row — the mechanism lives there; THIS module only
+  decides who preempts whom). The cost gate is
+  ``utils.cost_model.preempt_beneficial``: modeled victim-remaining
+  traffic must exceed the spill+restore traffic, so a nearly finished
+  row is left to retire on its own.
+
+The scheduler owns no engine state and dispatches nothing: it is a
+pure policy object the :class:`~marlin_tpu.serving.queue.
+AdmissionQueue` delegates ordering to (``AdmissionQueue(scheduler=...)``
+— FIFO semantics are bit-for-bit unchanged when no scheduler is
+attached). Thread-safety is the queue's job; every Scheduler method is
+called under the queue's lock except the metrics recorders, which take
+no scheduler state.
+
+Bit-exactness: scheduling policy REORDERS requests, it never touches
+sampling. Engine output is f(prompt, steps, seed, request_id) — the
+per-request PRNG stream contract — so any admission order, any quota,
+and any preempt/resume cycle yields byte-identical per-request outputs
+(test_sched.py pins preempted == uninterrupted across variants).
+
+Observability (docs/observability.md §9): ``serving_sched_*`` series —
+``preemptions_total`` / ``resumes_total`` / ``preempt_aborts_total``
+counters, per-class ``queue_wait_seconds`` histograms, per-class
+``slo_miss_total`` counters, per-class queued gauges.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import cost_model as cm
+
+
+@dataclass(frozen=True)
+class ClassSpec:
+    """One priority class. ``rank`` orders classes (lower = more
+    urgent); ``quota`` bounds the class's CONCURRENT rows under
+    contention (None = unbounded); ``slo_s`` is the queue-wait SLO the
+    EDF key and the miss counters measure against (None = no SLO);
+    ``preemptible`` marks the class's ACTIVE rows freezable;
+    ``can_preempt`` lets its QUEUED requests trigger a freeze of a
+    strictly lower-priority (higher-rank) preemptible row."""
+
+    name: str
+    rank: int
+    quota: Optional[int] = None
+    slo_s: Optional[float] = None
+    preemptible: bool = True
+    can_preempt: bool = False
+
+    def __post_init__(self):
+        if not self.name or not self.name.isidentifier():
+            raise ValueError(
+                f"class name must be a non-empty identifier, got "
+                f"{self.name!r}")
+        if self.quota is not None and self.quota < 1:
+            raise ValueError(
+                f"quota must be >= 1 or None, got {self.quota}")
+        if self.slo_s is not None and self.slo_s <= 0:
+            raise ValueError(
+                f"slo_s must be > 0 or None, got {self.slo_s}")
+
+
+# The default taxonomy (ISSUE 17): interactive chat traffic preempts,
+# is never itself frozen, and carries the tight SLO; batch work is
+# preemptible bulk throughput; best_effort absorbs whatever is left.
+DEFAULT_CLASSES: Tuple[ClassSpec, ...] = (
+    ClassSpec("interactive", rank=0, quota=None, slo_s=1.0,
+              preemptible=False, can_preempt=True),
+    ClassSpec("batch", rank=1, quota=None, slo_s=None,
+              preemptible=True, can_preempt=False),
+    ClassSpec("best_effort", rank=2, quota=None, slo_s=None,
+              preemptible=True, can_preempt=False),
+)
+
+
+@dataclass
+class FrozenRow:
+    """The host-side residue of a preempted decoding row — everything a
+    bit-exact resume needs beyond the KV payload the host tier holds
+    under ``host_key`` (engine._preempt_row serializes, _thaw_frozen
+    restores):
+
+    * ``filled`` / ``target``: the row's decode cursor and extent at the
+      freeze boundary (buffer holds tokens [0, filled); KV slots
+      [0, filled - 1) are written — the round-boundary coverage
+      invariant engine.py §freeze documents).
+    * ``keys``: the row's per-request PRNG stream position ((2,) uint32
+      — advanced only on live iterations, so restoring it resumes the
+      sampling stream exactly where the freeze cut it).
+    * ``n_pages`` / ``nbytes``: the page complement to re-reserve and
+      the payload size the tier accounted.
+    """
+
+    host_key: str
+    filled: int
+    target: int
+    keys: np.ndarray  # (2,) uint32 decode-stream position
+    n_pages: int
+    nbytes: int
+    preempt_round: int
+
+
+class Scheduler:
+    """Priority-class EDF admission policy (module docstring).
+
+    ``preempt_margin``: safety factor on the cost gate — a freeze must
+    look at least this many times cheaper (in modeled bytes) than
+    letting the victim run; <= 0 disables preemption outright.
+    ``max_preempts_per_round`` bounds how many rows one round may
+    freeze (a burst must not churn the whole batch at once)."""
+
+    def __init__(self, classes: Sequence[ClassSpec] = DEFAULT_CLASSES,
+                 default_class: Optional[str] = None,
+                 preempt_margin: float = 1.0,
+                 max_preempts_per_round: int = 1,
+                 registry=None):
+        specs = list(classes)
+        if not specs:
+            raise ValueError("at least one class is required")
+        names = [c.name for c in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate class names in {names}")
+        if len({c.rank for c in specs}) != len(specs):
+            raise ValueError(
+                f"class ranks must be unique, got "
+                f"{[(c.name, c.rank) for c in specs]}")
+        self.classes: Dict[str, ClassSpec] = {c.name: c for c in specs}
+        self.by_rank: List[ClassSpec] = sorted(specs,
+                                               key=lambda c: c.rank)
+        self.default_class = (default_class if default_class is not None
+                              else self.by_rank[0].name)
+        if self.default_class not in self.classes:
+            raise ValueError(
+                f"default_class {self.default_class!r} is not one of "
+                f"{sorted(self.classes)}")
+        if max_preempts_per_round < 0:
+            raise ValueError(
+                f"max_preempts_per_round must be >= 0, got "
+                f"{max_preempts_per_round}")
+        self.preempt_margin = float(preempt_margin)
+        self.max_preempts_per_round = int(max_preempts_per_round)
+        self.metrics = registry
+        # Per-class EDF heap: (effective_deadline, seq, Request). The
+        # queue's lock guards these (every mutator is called under it).
+        self._heaps: Dict[str, list] = {c.name: [] for c in specs}
+        self._seq = 0
+
+    # -- class resolution ---------------------------------------------
+
+    def resolve(self, name: Optional[str]) -> ClassSpec:
+        """The ClassSpec for ``name`` (None = the default class);
+        unknown names raise ValueError at submit time, where the HTTP
+        layer maps it to a 400."""
+        if name is None:
+            return self.classes[self.default_class]
+        spec = self.classes.get(name)
+        if spec is None:
+            raise ValueError(
+                f"unknown scheduling class {name!r}; expected one of "
+                f"{sorted(self.classes)}")
+        return spec
+
+    def effective_deadline(self, req) -> float:
+        """The EDF key: the earlier of the caller deadline and the
+        class SLO target; +inf when the request carries neither (sorts
+        last, FIFO by sequence)."""
+        spec = self.classes[req.sched_class]
+        dl = math.inf
+        if req.deadline_time is not None:
+            dl = float(req.deadline_time)
+        if spec.slo_s is not None:
+            dl = min(dl, req.submit_time + spec.slo_s)
+        return dl
+
+    # -- heap surface (called under the AdmissionQueue lock) ----------
+
+    def push(self, req) -> None:
+        """Enqueue; assigns the monotone sequence on first contact so a
+        re-push (page-pressure probe, preemption requeue, supervised
+        restore) keeps its original FIFO tie-break position."""
+        spec = self.resolve(req.sched_class)
+        req.sched_class = spec.name
+        if req.sched_seq < 0:
+            req.sched_seq = self._seq
+            self._seq += 1
+        heapq.heappush(self._heaps[spec.name],
+                       (self.effective_deadline(req), req.sched_seq,
+                        req))
+
+    def __len__(self) -> int:
+        return sum(len(h) for h in self._heaps.values())
+
+    def queued_by_class(self) -> Dict[str, int]:
+        return {name: len(h) for name, h in self._heaps.items()}
+
+    def _expired(self, req, round_idx: int, now: float) -> bool:
+        return ((req.deadline_rounds is not None
+                 and round_idx > req.deadline_rounds)
+                or (req.deadline_time is not None
+                    and now > req.deadline_time))
+
+    def pop(self, round_idx: int, now: float,
+            occupancy: Optional[Dict[str, int]] = None):
+        """Next admissible request under the quota discipline, dropping
+        expired ones (status ``timeout``) along the way — the
+        scheduler-mode body of ``AdmissionQueue.pop_ready``. Two passes
+        in rank order: classes under quota first, then every class
+        (work conservation — see module docstring). Returns
+        ``(request | None, expired_list)``."""
+        occupancy = occupancy or {}
+        expired: list = []
+        for enforce_quota in (True, False):
+            for spec in self.by_rank:
+                if enforce_quota and spec.quota is not None \
+                        and occupancy.get(spec.name, 0) >= spec.quota:
+                    continue
+                heap = self._heaps[spec.name]
+                while heap:
+                    _, _, req = heap[0]
+                    if self._expired(req, round_idx, now):
+                        heapq.heappop(heap)
+                        req.status = "timeout"
+                        req.finish_round = round_idx
+                        req.finish_time = now
+                        expired.append(req)
+                        continue
+                    if enforce_quota or spec.quota is None:
+                        heapq.heappop(heap)
+                        return req, expired
+                    # Second pass, quota'd class: admissible only
+                    # because nothing under-quota was — take it.
+                    heapq.heappop(heap)
+                    return req, expired
+        return None, expired
+
+    # -- preemption policy --------------------------------------------
+
+    def preempt_candidate(self, now: float):
+        """The queued request most entitled to trigger a freeze: the
+        earliest-effective-deadline head among ``can_preempt`` classes,
+        in rank order (rank wins over deadline across classes — a
+        best_effort deadline must not preempt ahead of interactive
+        work). None when no can_preempt work is queued."""
+        for spec in self.by_rank:
+            if not spec.can_preempt:
+                continue
+            heap = self._heaps[spec.name]
+            if heap:
+                return heap[0][2]
+        return None
+
+    def victim_order(self, candidates, requester_rank: int):
+        """Deterministic victim preference among active (req,
+        remaining_steps) pairs: strictly lower-priority preemptible
+        classes only, lowest priority first, most remaining work first
+        (maximizes the wait the freeze saves), newest id last as the
+        tie-break. Returns the sorted eligible list."""
+        eligible = []
+        for req, remaining in candidates:
+            spec = self.classes.get(req.sched_class)
+            if spec is None or not spec.preemptible:
+                continue
+            if spec.rank <= requester_rank:
+                continue
+            eligible.append((spec.rank, remaining, req))
+        eligible.sort(key=lambda t: (-t[0], -t[1], -t[2].request_id))
+        return [(req, remaining) for _, remaining, req in eligible]
+
+    def preempt_gate(self, cfg, row_len: int,
+                     remaining_steps: int) -> bool:
+        """The cost gate on one candidate freeze (module docstring):
+        modeled victim-remaining decode traffic must beat the
+        spill+restore traffic by ``preempt_margin``."""
+        if self.preempt_margin <= 0:
+            return False
+        return cm.preempt_beneficial(cfg, row_len, remaining_steps,
+                                     margin=self.preempt_margin)
+
+    # -- metrics (engine-called; no scheduler state touched) ----------
+
+    def _counter(self, name: str, help_: str, **labels):
+        if self.metrics is not None:
+            self.metrics.counter(name, help=help_, **labels).inc()
+
+    def note_admitted(self, req, queue_wait_s: float) -> None:
+        """Record the class queue-wait histogram and the SLO-miss
+        counter at FIRST admission (the engine calls this from
+        record_admission's site; preemption resumes never re-record —
+        the wait was already measured once)."""
+        if self.metrics is None:
+            return
+        self.metrics.histogram(
+            "serving_sched_queue_wait_seconds", cls=req.sched_class,
+            help="queue wait (submit -> admission start) by class",
+        ).observe(max(0.0, queue_wait_s), exemplar=str(req.request_id))
+        spec = self.classes.get(req.sched_class)
+        if spec is not None and spec.slo_s is not None \
+                and queue_wait_s > spec.slo_s:
+            self._counter("serving_sched_slo_miss_total",
+                          "admissions (or drops) past the class "
+                          "queue-wait SLO", cls=req.sched_class)
+
+    def note_timeout(self, req) -> None:
+        """A deadline drop is always an SLO miss for a class that has
+        one (the request never admitted at all)."""
+        spec = self.classes.get(req.sched_class)
+        if spec is not None and spec.slo_s is not None:
+            self._counter("serving_sched_slo_miss_total",
+                          "admissions (or drops) past the class "
+                          "queue-wait SLO", cls=req.sched_class)
+
+    def note_preempt(self, req) -> None:
+        self._counter("serving_sched_preemptions_total",
+                      "decoding rows frozen and spilled to the host "
+                      "tier to admit higher-priority work",
+                      cls=req.sched_class)
+
+    def note_resume(self, req) -> None:
+        self._counter("serving_sched_resumes_total",
+                      "preempted rows restored bit-exactly from the "
+                      "host tier", cls=req.sched_class)
+
+    def note_preempt_abort(self, reason: str) -> None:
+        self._counter("serving_sched_preempt_aborts_total",
+                      "freezes abandoned cleanly (host budget, no "
+                      "eligible victim, cost gate)", reason=reason)
+
+    def mirror_queued(self) -> None:
+        """Refresh the per-class queued-depth gauges (engine round
+        epilogue; reads are snapshot-consistent enough for gauges)."""
+        if self.metrics is None:
+            return
+        for name, h in self._heaps.items():
+            self.metrics.gauge(
+                "serving_sched_class_queued",
+                help="requests waiting in the class's EDF heap",
+                cls=name).set(float(len(h)))
+
+    def spawn_successor(self) -> "Scheduler":
+        """A fresh scheduler carrying this one's POLICY (classes,
+        default, margins) and none of its heap state — the supervised-
+        restart companion of ``ServingEngine.spawn_successor``: the
+        supervisor re-pushes every captured request itself, so reusing
+        the crashed heaps would double-enqueue them."""
+        return Scheduler(
+            classes=self.by_rank, default_class=self.default_class,
+            preempt_margin=self.preempt_margin,
+            max_preempts_per_round=self.max_preempts_per_round,
+            registry=self.metrics)
+
+    def summary(self) -> dict:
+        """JSON-able snapshot for ``/debug/sched``."""
+        return {
+            "classes": [
+                {"name": c.name, "rank": c.rank, "quota": c.quota,
+                 "slo_s": c.slo_s, "preemptible": c.preemptible,
+                 "can_preempt": c.can_preempt,
+                 "queued": len(self._heaps[c.name])}
+                for c in self.by_rank],
+            "default_class": self.default_class,
+            "preempt_margin": self.preempt_margin,
+            "max_preempts_per_round": self.max_preempts_per_round,
+        }

@@ -6,7 +6,8 @@ ThreadingHTTPServer`` (one thread per connection) over the
 dependencies beyond the stdlib. Endpoints (docs/frontend.md):
 
 * ``POST /v1/generate`` — body ``{"prompt": [ints], "steps": n,
-  "deadline_s": t?, "stream": bool?}``. Blocking form returns one JSON
+  "deadline_s": t?, "stream": bool?, "tenant": s?,
+  "sched_class": s?}``. Blocking form returns one JSON
   object with the full ``tokens`` array; ``stream: true`` returns
   Server-Sent Events (``text/event-stream``, chunked), one ``data:``
   event per round's newly generated tokens and a terminal ``done``
@@ -31,6 +32,9 @@ dependencies beyond the stdlib. Endpoints (docs/frontend.md):
 * ``GET /debug/requests/<id>`` — one request's phase timeline (live:
   phases so far; completed: the ledger record), with its tail-exemplar
   span tree attached when the tracer retained one.
+* ``GET /debug/sched`` — the scheduler's class table, per-class queue
+  depths and occupancy, and every frozen (preempted) request; 404 on a
+  FIFO engine (docs/serving.md §8).
 * ``GET /debug/trace`` — Chrome/Perfetto trace-event JSON of the
   process tracer's buffer (``?exemplars=1``: only the slowest-k
   exemplar traces).
@@ -148,6 +152,15 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/engine":
             self._send_json(200, self.frontend.debug_engine(),
                             "/debug/engine")
+        elif path == "/debug/sched":
+            info = self.frontend.debug_sched()
+            if info is None:
+                self._send_json(
+                    404, {"error": "no scheduler on this engine (FIFO "
+                          "admission; start with --sched)"},
+                    "/debug/sched")
+            else:
+                self._send_json(200, info, "/debug/sched")
         elif path.startswith("/debug/requests/"):
             route = "/debug/requests"
             try:
@@ -194,6 +207,13 @@ class _Handler(BaseHTTPRequestHandler):
             # byte-exact on any peer (engine.submit's contract).
             request_id = (None if body.get("request_id") is None
                           else int(body["request_id"]))
+            # Scheduler fields (docs/serving.md §8): tenant is a free
+            # label; sched_class must name a configured class — the
+            # engine validates it (ValueError → the 400 arm below).
+            tenant = (None if body.get("tenant") is None
+                      else str(body["tenant"]))
+            sched_class = (None if body.get("sched_class") is None
+                           else str(body["sched_class"]))
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"}, route)
@@ -205,7 +225,8 @@ class _Handler(BaseHTTPRequestHandler):
                                          http_id=http_id or ""):
                 handle = self.frontend.submit(
                     prompt, steps, deadline_s=deadline_s, stream=stream,
-                    request_id=request_id)
+                    request_id=request_id, tenant=tenant,
+                    sched_class=sched_class)
         except QueueFull as e:
             self._send_json(429, {"error": str(e)}, route,
                             headers={"Retry-After": RETRY_AFTER_S})
@@ -487,6 +508,12 @@ def main(argv=None) -> int:
                    help="minimum extra hit depth (tokens) before a "
                         "restore beats re-prefill; default from the "
                         "measured cost-model crossover")
+    p.add_argument("--sched", action="store_true",
+                   help="SLO-aware scheduler (serving/sched.py): the "
+                        "default interactive/batch/best_effort class "
+                        "table with EDF admission; preemption engages "
+                        "when --kv-pages and --host-kv-bytes are also "
+                        "set")
     p.add_argument("--max-restarts", type=int, default=3,
                    help="supervisor restart budget before fail-closed")
     p.add_argument("--restart-window-s", type=float, default=60.0,
@@ -510,6 +537,7 @@ def main(argv=None) -> int:
 
     from ..models import TransformerConfig, init_params
     from ..obs.runlog import RunLog
+    from .sched import Scheduler
 
     cfg = TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
@@ -544,7 +572,8 @@ def main(argv=None) -> int:
                    **({"host_kv_dir": args.spill_dir}
                       if args.spill_dir is not None else {}),
                    **({"restore_min_tokens": args.restore_min_tokens}
-                      if args.restore_min_tokens is not None else {}))
+                      if args.restore_min_tokens is not None else {}),
+                   **({"scheduler": Scheduler()} if args.sched else {}))
     drained = install_signal_handlers(server)
     print(f"SERVING host={args.host} port={server.port}", flush=True)
     try:

@@ -287,6 +287,27 @@ def restore_pages_into_pool(pool, payload, pages):
     return out
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+@jax.named_scope("marlin.serving.row_tokens_restore")
+def restore_row_tokens(buf, row, tokens):
+    """Overwrite row ``row`` of the (donated) token buffer with a
+    thawed request's saved tokens — the buffer half of a preemption
+    resume (engine thaw path; the KV half is
+    :func:`restore_pages_into_pool`).
+
+    ``tokens`` is the frozen row's saved buffer padded to the full
+    ``max_len`` width on the host (zeros past ``filled`` — exactly the
+    layout the freeze captured, since positions past ``filled`` were
+    already zero/dead state). ``row`` and ``tokens`` are traced, so
+    this is ONE compile for the engine's lifetime — no per-length
+    bucket axis, the pad happens host-side.
+
+    Bit-exactness: the bytes written are the bytes the freeze read;
+    together with the restored pages, keys, and fill cursor the row is
+    indistinguishable from one that never froze."""
+    return buf.at[row].set(tokens.astype(buf.dtype))
+
+
 class SlotManager:
     """Host-side request -> batch-row bookkeeping for the engine.
 

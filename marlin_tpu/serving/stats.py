@@ -169,6 +169,12 @@ class EngineStats:
     # span restarts.
     n_recovered: int = 0    # requests requeued into a successor engine
     n_quarantined: int = 0  # requests failed closed as poisoned
+    # Preemption ledger (scheduler engines only, serving/sched.py;
+    # docs/serving.md §8): rows frozen to the host KV tier mid-decode
+    # and rows thawed back. resumed <= preempted always; the difference
+    # is rows currently frozen plus frozen rows dropped for deadline.
+    n_preempted: int = 0
+    n_resumed: int = 0
     # Speculative-round acceptance ledger (docs/serving.md §7; zero in
     # non-speculative engines). Totals are lifetime-exact; the EWMA
     # (CostCalibration's alpha discipline) is what the acceptance-
@@ -304,6 +310,28 @@ class EngineStats:
         """Point-in-time copy of the quarantine ledger, any thread."""
         with self._lock:
             return list(self.quarantined)
+
+    def record_preempt(self, req) -> None:
+        """One live row frozen to the host KV tier so a higher-class
+        request could take its slot or pages (engine._preempt_row).
+        The request is requeued, not finished — no phase observation
+        here; its eventual completion carries the whole timeline."""
+        self.n_preempted += 1
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_preempted_total",
+                help="live rows frozen to the host KV tier by the "
+                     "scheduler (bit-exact preemption)").inc()
+
+    def record_resume(self, req) -> None:
+        """One frozen request thawed back onto a device row
+        (engine._thaw_frozen) — the restore half of a preemption."""
+        self.n_resumed += 1
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_resumed_total",
+                help="frozen requests restored onto a device row and "
+                     "resumed bit-exactly").inc()
 
     def record_round(self, round_idx: int, iters: int, occupied: int,
                      live_iters: int) -> None:
@@ -495,6 +523,11 @@ class EngineStats:
                 "recovered": self.n_recovered,
                 "quarantined": self.n_quarantined,
                 "quarantine": self.quarantine_snapshot(),
+            })
+        if self.n_preempted:
+            out.update({
+                "preempted": self.n_preempted,
+                "resumed": self.n_resumed,
             })
         if self.n_prefix_hits or self.n_prefix_misses:
             out.update({

@@ -53,6 +53,7 @@ __all__ = [
     "run_svd_mode_crossover_sweep", "SVD_CROSSOVER_GRID",
     "derive_svd_local_eigs_max",
     "restore_cost", "KV_RESTORE_MIN_TOKENS_DEFAULT",
+    "preempt_cost", "preempt_beneficial",
     "run_kv_restore_crossover_sweep", "KV_RESTORE_LENGTHS",
     "derive_kv_restore_min_tokens",
     "run_paged_gather_tax_sweep", "GATHER_TAX_LENGTHS",
@@ -325,6 +326,50 @@ def restore_cost(cfg, hit_len: int,
     else:
         pos_bytes = float(pos_elems * param_itemsize)
     return 0.0, float(2.0 * hit_len * pos_bytes)
+
+
+def preempt_cost(cfg, row_len: int,
+                 param_itemsize: int = 4) -> Tuple[float, float]:
+    """(flops, bytes) of one full preemption round-trip of a live row
+    holding ``row_len`` positions: freeze (d2h gather of the row's page
+    complement into the host tier) plus the later thaw (h2d scatter
+    back). Zero FLOPs — a preemption recomputes nothing, that is the
+    whole point of the bit-exact freeze — and each direction moves the
+    same per-position cache bytes :func:`restore_cost` prices, so the
+    round trip is exactly twice a restore of the same length."""
+    _, one_way = restore_cost(cfg, row_len, param_itemsize=param_itemsize)
+    return 0.0, float(2.0 * one_way)
+
+
+def preempt_beneficial(cfg, row_len: int, victim_remaining_steps: int,
+                       margin: float = 1.0,
+                       param_itemsize: int = 4) -> bool:
+    """Should the scheduler freeze this victim, or let it run out?
+
+    The alternative to preempting is WAITING: the urgent request sits
+    queued while the victim decodes its remaining steps, each step
+    streaming the parameters and the cache
+    (:func:`decode_step_cost` at batch 1 — the marginal occupant's
+    share). Preempting instead pays the freeze+thaw round trip
+    (:func:`preempt_cost`) plus, implicitly, the victim's own added
+    latency. Freeze when the remaining-decode traffic exceeds
+    ``margin`` times the move traffic — i.e. the victim still owes
+    enough work that displacing it buys real time. ``margin`` scales
+    conservatism: >1 demands a clearer win (Scheduler.preempt_margin);
+    <= 0 is handled upstream as "gate disabled".
+
+    Both sides are priced in BYTES on the decode roofline (decode is
+    HBM-bound; the d2h/h2d move is bandwidth-bound too), so the ratio
+    survives not knowing the two links' absolute speeds — the same
+    first-order argument restore-vs-reprefill makes."""
+    if victim_remaining_steps <= 0:
+        return False
+    quant_weights = bool(getattr(cfg, "quantize", False))
+    _, step_bytes = decode_step_cost(cfg, 1, param_itemsize=param_itemsize,
+                                     quant_weights=quant_weights)
+    _, move_bytes = preempt_cost(cfg, row_len,
+                                 param_itemsize=param_itemsize)
+    return victim_remaining_steps * step_bytes > margin * move_bytes
 
 
 def spec_round_cost(cfg, batch: int, draft_len: int,

@@ -499,6 +499,46 @@ def _post_drain(port, index, restart=False):
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+class TestFleetSchedHeaders:
+    def test_front_door_forwards_class_headers(self, fleet_factory):
+        """Scheduler fields at the fleet tier (docs/serving.md §8):
+        X-Sched-Class / X-Tenant headers fill missing body fields (body
+        wins), the front door counts admissions by class, and an
+        unknown class comes back as the REPLICA's 400 through the proxy
+        — the class table lives in the replicas, never the router."""
+        server = fleet_factory(n_replicas=1, kv_pages=32, sched=True)
+        port = server.port
+
+        st, data, _ = _post(port, {"prompt": [1, 2, 3, 4], "steps": 3},
+                            headers={"X-Sched-Class": "interactive",
+                                     "X-Tenant": "acme"})
+        assert st == 200, data
+        assert json.loads(data)["status"] == "done"
+
+        # Body field wins: the bogus header class must be ignored.
+        st, data, _ = _post(port, {"prompt": [1, 2, 3, 4], "steps": 3,
+                                   "sched_class": "batch",
+                                   "tenant": "acme"},
+                            headers={"X-Sched-Class": "gold"})
+        assert st == 200, data
+
+        # Unknown class: the replica's 400 is forwarded untouched.
+        st, data, _ = _post(port, {"prompt": [1, 2, 3, 4], "steps": 3},
+                            headers={"X-Sched-Class": "gold"})
+        assert st == 400
+        assert b"unknown scheduling class" in data
+
+        st, data = _get(port, "/metrics")
+        assert st == 200
+        text = data.decode()
+        assert 'fleet_requests_by_class_total{cls="interactive"} 1' \
+            in text, text[:2000]
+        assert 'fleet_requests_by_class_total{cls="batch"} 1' in text
+        # The rejected "gold" request still counted at the front door
+        # (the counter measures demand by class, not admissions).
+        assert 'fleet_requests_by_class_total{cls="gold"} 1' in text
+
+
 class TestFleetBenchSmoke:
     def test_bench_fleet_line_and_slo_gate(self, tmp_path):
         """`bench.py --config fleet` end to end at the default knobs:

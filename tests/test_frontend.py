@@ -38,7 +38,8 @@ from marlin_tpu.models import TransformerConfig, init_params
 from marlin_tpu.obs.metrics import MetricsRegistry
 from marlin_tpu.obs.runlog import RunLog
 from marlin_tpu.serving import (AdmissionQueue, EngineFrontend, QueueClosed,
-                                QueueFull, Request, ServingEngine, serve)
+                                QueueFull, Request, Scheduler, ServingEngine,
+                                serve)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -629,9 +630,14 @@ class TestBaselineMetricConsistency:
         # references the tier's gauge/histogram series, which register
         # at tier construction (count 0 until the first restore) — a
         # tierless smoke would read them as stale.
+        # Scheduled, too: the metrics_tenants block references the
+        # per-class queue-wait histogram, which records at first
+        # admission only when a scheduler is attached (requests land in
+        # the default interactive class here).
         eng = ServingEngine(params, cfg, batch=2, round_steps=4,
                             metrics_registry=reg, kv_pages=32,
-                            host_kv_bytes=1 << 20)
+                            host_kv_bytes=1 << 20,
+                            scheduler=Scheduler())
         fe = EngineFrontend(eng).start()
         # Streamed requests exercise the full phase surface, including
         # the frontend's stream_delivery slice.

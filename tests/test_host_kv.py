@@ -192,6 +192,47 @@ class TestHostTierPayloads:
         big = HostKVTier(pool, budget_bytes=1, registry=pool.registry)
         assert big.spill(t1, 2 * PAGE, pages1) is None
 
+    def test_pinned_rows_survive_budget_pressure_and_evict_prefixes(
+            self):
+        # Frozen-row entries (ISSUE 17, serving/sched.py): pinned rows
+        # count against the budget but are NEVER LRU-evicted — under
+        # pressure the tier evicts unpinned prefixes first, and when
+        # pinned bytes alone exceed the budget the spill is REFUSED
+        # (the engine aborts the preemption; a frozen row can never be
+        # silently dropped). A duplicate freeze key is an accounting
+        # bug and raises.
+        cfg = _cfg()
+        pool = _pool(cfg)
+        pages1, held1 = _filled_pages(pool, 2, seed=1)
+        pages2, _ = _filled_pages(pool, 2, seed=2)
+        t1 = np.arange(2 * PAGE, dtype=np.int32)
+        probe = HostKVTier(pool, registry=pool.registry)
+        _, one_payload, _ = probe.spill(t1, 2 * PAGE, pages1)
+        row_bytes = one_payload + t1.nbytes
+        tier = HostKVTier(pool, budget_bytes=row_bytes + one_payload,
+                          registry=pool.registry)
+        k_prefix, _, _ = tier.spill(t1, 2 * PAGE, pages1)
+        res = tier.spill_row("row-0-0", t1, pages1)
+        assert res is not None and res[0] == row_bytes
+        # Second pinned row: the unpinned prefix is evicted for room,
+        # then the pinned ledger alone busts the budget -> refusal.
+        assert tier.spill_row("row-1-0", t1, pages2) is None
+        assert tier.fetch(k_prefix) is None  # prefix was sacrificed
+        summ = tier.summary()
+        assert summ["host_rows"] == 1
+        assert summ["host_row_bytes"] == row_bytes
+        # The pinned payload itself is intact and bit-identical.
+        payload, toks, nbytes = tier.fetch_row("row-0-0")
+        assert nbytes == row_bytes
+        assert _payloads_equal(payload, held1)
+        assert np.array_equal(toks, t1)
+        with pytest.raises(RuntimeError, match="one freeze, one spill"):
+            tier.spill_row("row-0-0", t1, pages2)
+        tier.drop_row("row-0-0")
+        assert tier.fetch_row("row-0-0") is None
+        assert tier.summary()["host_row_bytes"] == 0
+        tier.drop_row("row-0-0")  # idempotent
+
     def test_probe_finds_longest_prefix_and_content_key_is_stable(
             self, tmp_path):
         cfg = _cfg()

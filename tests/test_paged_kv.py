@@ -246,11 +246,17 @@ class TestRefcountProperty:
         """The PR 9 drive extended with the host tier's transitions
         (ISSUE 16): eviction now SPILLS a sole-holder entry (pages
         freed, payload parked host-side) and a restore re-pins freshly
-        allocated pages exactly once. Shadow invariants after every op:
-        refcounts match, the allocator never hands out a live page, a
-        spill only ever fires when the index's pin was the LAST
-        reference, and the tier's payload set mirrors the index's
-        spilled entries one-for-one."""
+        allocated pages exactly once. Further extended with the
+        scheduler's LIVE-ROW transitions (ISSUE 17): a freeze spills a
+        row's whole page complement as a PINNED host entry (pages
+        freed, payload + tokens parked under the freeze key), a thaw
+        re-reserves the complement, fetches the pinned payload and
+        drops it — exactly once each way. Shadow invariants after
+        every op: refcounts match, the allocator never hands out a
+        live page, a spill only ever fires when the index's pin was
+        the LAST reference, the tier's payload set mirrors the index's
+        spilled entries one-for-one, and the tier's pinned-row set
+        (and its byte ledger) mirrors the shadow's frozen rows."""
         cfg = _cfg(d_model=8, n_heads=2, n_layers=1, d_ff=16, max_len=64)
         reg = MetricsRegistry()
         pool = PagePool(cfg, 12, registry=reg)
@@ -261,7 +267,9 @@ class TestRefcountProperty:
         rows = {}             # row id -> held page list
         resident = {}         # tokens-bytes -> page tuple
         spilled = set()       # tokens-bytes of spilled entries
+        frozen = {}           # freeze key -> (n_pages, nbytes)
         next_row = 0
+        n_freezes = 0
         freed_total = 0
 
         def eid_of(key):
@@ -287,10 +295,19 @@ class TestRefcountProperty:
             assert sp_keys <= set(tier._entries.keys())
             s = index.summary()
             assert s["prefix_spilled_entries"] == len(spilled)
+            # Pinned frozen rows: the tier's row set and byte ledger
+            # mirror the shadow exactly — a freeze that leaked its
+            # entry (or a thaw that forgot drop_row) shows up here.
+            ts = tier.summary()
+            assert set(tier._rows) == set(frozen)
+            assert ts["host_rows"] == len(frozen)
+            assert ts["host_row_bytes"] == sum(
+                nb for _, nb in frozen.values())
 
         for step in range(500):
             op = rng.choice(["admit", "admit", "store", "release",
-                             "release", "evict", "restore"])
+                             "release", "evict", "restore",
+                             "freeze", "thaw"])
             if op == "admit":
                 n = rng.randint(1, 4)
                 use_alias = resident and rng.random() < 0.5
@@ -393,6 +410,48 @@ class TestRefcountProperty:
                 next_row += 1
                 spilled.discard(key)
                 resident[key] = tuple(fresh)
+            elif op == "freeze" and rows:
+                # A live row's whole complement spills as a PINNED
+                # entry; the row's references drop (the engine frees
+                # the pages after the gather). Aliased pages survive
+                # in their other holders — the gather copied the KV.
+                row = rng.choice(sorted(rows))
+                held = rows.pop(row)
+                key = f"frz-{n_freezes}"
+                n_freezes += 1
+                toks = np.asarray(
+                    [rng.randrange(997) for _ in
+                     range(len(held) * PAGE)], np.int32)
+                res = tier.spill_row(key, toks, held)
+                assert res is not None  # no budget: never refused
+                nbytes, _ = res
+                pool.unref(held)
+                for p in held:
+                    shadow[p] -= 1
+                    if shadow[p] == 0:
+                        freed_total += 1
+                frozen[key] = (len(held), nbytes)
+            elif op == "thaw" and frozen:
+                key = rng.choice(sorted(frozen))
+                n, nbytes = frozen[key]
+                fresh = pool.alloc(n)
+                if fresh is None:
+                    check()
+                    continue  # pool full: the engine keeps it frozen
+                for p in fresh:
+                    assert shadow.get(p, 0) == 0, \
+                        "allocator handed out a live page"
+                    shadow[p] = 1  # the thawed row's reservation
+                got = tier.fetch_row(key)
+                assert got is not None, "pinned row vanished"
+                _, got_toks, got_bytes = got
+                assert got_bytes == nbytes
+                assert len(got_toks) == n * PAGE
+                tier.drop_row(key)
+                assert tier.fetch_row(key) is None  # dropped once
+                rows[next_row] = list(fresh)
+                next_row += 1
+                frozen.pop(key)
             check()
 
 

@@ -372,6 +372,89 @@ class TestSyntheticRunlogs:
         assert [a for a in report2["anomalies"]
                 if a["kind"] == "queue_stall"], report2["anomalies"]
 
+    def test_preemption_rounds_are_narrated(self, rr, tmp_path):
+        # Scheduler preemption (ISSUE 17, docs/serving.md §8): preempt/
+        # resume events carry the freeze/thaw ledger — the report
+        # totals them, names the frozen requests, and keeps the
+        # frozen-residency and payload watermarks. Preemption is
+        # POLICY: a clean preempting log reports ok. A scheduler-free
+        # log must NOT grow the block.
+        events = _clean_events()
+        events[0] = dict(events[0], kv_pages=8, sched=True)
+        events[-1:-1] = [
+            {"kind": "preempt", "t": 0.051, "request_id": 1, "row": 1,
+             "round": 1, "filled": 28, "pages": 2, "bytes": 8192,
+             "spill_s": 0.002},
+            {"kind": "round", "t": 0.06, "round": 2, "iters": 4,
+             "occupied": 2, "live_iters": 8, "admitted": 1,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 1, "wasted_row_iters": 0,
+             "preempts": 1, "resumes": 0, "host_row_bytes": 8192},
+            {"kind": "resume", "t": 0.07, "request_id": 1, "row": 0,
+             "round": 4, "filled": 28, "pages": 2, "bytes": 8192,
+             "frozen_rounds": 3, "restore_s": 0.001},
+            {"kind": "round", "t": 0.08, "round": 4, "iters": 4,
+             "occupied": 2, "live_iters": 8, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 0, "wasted_row_iters": 0,
+             "preempts": 0, "resumes": 1, "host_row_bytes": 0},
+        ]
+        report = rr.build_report(rr.load_runlog(_write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+        pre = report["rounds"]["preemption"]
+        assert pre["preempts_total"] == 1
+        assert pre["resumes_total"] == 1
+        assert pre["preempted_requests"] == [1]
+        assert pre["frozen_bytes_max"] == 8192
+        assert pre["host_row_bytes_max"] == 8192
+        assert pre["frozen_rounds_max"] == 3
+        assert pre["spill_s_max"] == 0.002
+        assert pre["restore_s_max"] == 0.001
+        assert str(pre["preempted_requests"]) in rr._human(report)
+        # A scheduler-free log: no preemption block at all.
+        report2 = rr.build_report(rr.load_runlog(
+            _write(tmp_path, _clean_events())))
+        assert "preemption" not in report2["rounds"]
+
+    def test_preempt_round_is_not_a_stall(self, rr, tmp_path):
+        # A round that admits nothing while ready work waits is legal
+        # when its admission slot went to a FREEZE or a THAW — the
+        # engine was moving KV state for the scheduler's priority
+        # decision, not sitting idle (ISSUE 17, the restore-round rule
+        # one subsystem up). The identical pair with zero freeze/thaw
+        # deltas stays a provable queue_stall.
+        stall_pair = [
+            {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "preempts": 0, "resumes": 0},
+            {"kind": "round", "t": 0.107, "round": 3, "iters": 4,
+             "occupied": 1, "live_iters": 4, "admitted": 0,
+             "retired": 0, "expired": 0, "prefilling": 0,
+             "queue_depth": 3, "wasted_row_iters": 4,
+             "preempts": 1, "resumes": 0},
+        ]
+        for exempt_field in ("preempts", "resumes"):
+            events = _clean_events()
+            pair = [dict(stall_pair[0]),
+                    dict(stall_pair[1], preempts=0, resumes=0)]
+            pair[1][exempt_field] = 1
+            events[-1:-1] = pair
+            report = rr.build_report(
+                rr.load_runlog(_write(tmp_path, events)))
+            assert not [a for a in report["anomalies"]
+                        if a["kind"] == "queue_stall"], \
+                (exempt_field, report["anomalies"])
+        # Same pair, no freeze/thaw: the stall is real.
+        events2 = _clean_events()
+        events2[-1:-1] = [dict(stall_pair[0]),
+                          dict(stall_pair[1], preempts=0)]
+        report2 = rr.build_report(rr.load_runlog(_write(tmp_path,
+                                                        events2)))
+        assert [a for a in report2["anomalies"]
+                if a["kind"] == "queue_stall"], report2["anomalies"]
+
     def test_spec_rounds_narrated_and_low_acceptance_is_legal(
             self, rr, tmp_path):
         # Speculative rounds (docs/serving.md §7) carry the

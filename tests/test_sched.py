@@ -1,0 +1,682 @@
+"""serving/sched.py — the SLO-aware multi-tenant scheduler (ISSUE 17,
+docs/serving.md §8).
+
+Four layers, each pinned:
+
+* POLICY UNITS (no engine): ClassSpec/Scheduler validation, EDF
+  ordering within a class, rank precedence across classes, quota
+  accounting that stays work-conserving, expiry at pop time, the
+  preemption candidate/victim/cost-gate policy, and the metrics
+  recorders — all on the pure policy object.
+* PREEMPTION MECHANISM (real engines): an interactive arrival freezes
+  a decoding batch row at a round boundary, spills it through the host
+  tier, resumes it, and every request's output is byte-identical to a
+  FIFO engine that never preempted (plain in tier-1; rope+GQA / int8 /
+  speculative-greedy variants under -m slow — the bench's bit-exact
+  matrix runs all four in the SLO smoke below). Clean aborts (cost
+  gate, host budget) leave outputs untouched; a frozen request dropped
+  for deadline releases its pinned host row (the reservation-leak
+  regression); the runlog/metrics/debug surfaces narrate every freeze.
+* CHAOS: a deterministic ``preempt_spill`` crash under the supervised
+  frontend replays from scratch to the same bytes (the fault fires
+  after the victim is chosen and BEFORE its pages move, so the crashed
+  incarnation loses nothing it can't recompute).
+* CI FORM: ``bench.py --config tenants`` through tools/slo_check.py
+  ``--metrics-key metrics_tenants`` (chat-tail improvement >= 3x,
+  batch cost <= 20%, zero steady-state recompiles in both arms), plus
+  the server/fleet argv plumbing (``--sched``, ``/debug/sched``,
+  tenant/sched_class POST fields and their 400 mapping).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.obs.runlog import RunLog
+from marlin_tpu.serving import (DEFAULT_CLASSES, ClassSpec,
+                                EngineFrontend, Scheduler, ServingEngine,
+                                faults)
+from marlin_tpu.serving.queue import Request
+from marlin_tpu.utils import cost_model as cm
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=96)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _req(rid, cls="", submit=0.0, deadline_time=None,
+         deadline_rounds=None):
+    return Request(request_id=rid,
+                   prompt=np.zeros(4, np.int32), steps=4,
+                   deadline_time=deadline_time,
+                   deadline_rounds=deadline_rounds,
+                   submit_time=submit, sched_class=cls)
+
+
+class TestClassSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="identifier"):
+            ClassSpec("", rank=0)
+        with pytest.raises(ValueError, match="identifier"):
+            ClassSpec("no-dashes", rank=0)
+        with pytest.raises(ValueError, match="quota"):
+            ClassSpec("a", rank=0, quota=0)
+        with pytest.raises(ValueError, match="slo_s"):
+            ClassSpec("a", rank=0, slo_s=0.0)
+
+    def test_default_taxonomy(self):
+        by_name = {c.name: c for c in DEFAULT_CLASSES}
+        assert set(by_name) == {"interactive", "batch", "best_effort"}
+        it = by_name["interactive"]
+        assert it.rank == 0 and it.can_preempt and not it.preemptible
+        assert it.slo_s == 1.0
+        assert by_name["batch"].preemptible
+        assert not by_name["batch"].can_preempt
+
+
+class TestSchedulerPolicy:
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="at least one"):
+            Scheduler(classes=())
+        with pytest.raises(ValueError, match="duplicate class names"):
+            Scheduler(classes=(ClassSpec("a", 0), ClassSpec("a", 1)))
+        with pytest.raises(ValueError, match="ranks must be unique"):
+            Scheduler(classes=(ClassSpec("a", 0), ClassSpec("b", 0)))
+        with pytest.raises(ValueError, match="default_class"):
+            Scheduler(default_class="gold")
+        with pytest.raises(ValueError, match="max_preempts_per_round"):
+            Scheduler(max_preempts_per_round=-1)
+
+    def test_resolve_default_and_unknown(self):
+        s = Scheduler()
+        assert s.resolve(None).name == "interactive"  # lowest rank
+        assert s.resolve("batch").name == "batch"
+        with pytest.raises(ValueError, match="unknown scheduling class"):
+            s.resolve("gold")
+
+    def test_edf_orders_within_class(self):
+        # batch has no SLO, so the caller deadline alone is the EDF
+        # key; deadline-less requests sort last, FIFO among themselves.
+        s = Scheduler()
+        for r in (_req(0, "batch"), _req(1, "batch", deadline_time=50.0),
+                  _req(2, "batch", deadline_time=20.0),
+                  _req(3, "batch")):
+            s.push(r)
+        order = []
+        while len(s):
+            req, expired = s.pop(0, now=0.0)
+            assert expired == []
+            order.append(req.request_id)
+        assert order == [2, 1, 0, 3]
+
+    def test_class_slo_caps_the_effective_deadline(self):
+        # interactive's slo_s=1.0 beats a lazy caller deadline: the
+        # submit+slo target is what EDF sorts by.
+        s = Scheduler()
+        early = _req(0, "interactive", submit=5.0)       # target 6.0
+        capped = _req(1, "interactive", submit=0.0,
+                      deadline_time=100.0)               # target 1.0
+        assert s.effective_deadline(early) == 6.0
+        assert s.effective_deadline(capped) == 1.0
+
+    def test_rank_beats_deadline_across_classes(self):
+        s = Scheduler()
+        s.push(_req(0, "batch", deadline_time=0.5))  # urgent but rank 1
+        s.push(_req(1, "interactive", submit=10.0))  # target 11.0
+        req, _ = s.pop(0, now=0.0)
+        assert req.request_id == 1
+
+    def test_quota_bounds_only_under_contention(self):
+        classes = (ClassSpec("gold", 0, quota=1, can_preempt=True),
+                   ClassSpec("bulk", 1))
+        s = Scheduler(classes=classes)
+        s.push(_req(0, "gold"))
+        s.push(_req(1, "bulk"))
+        # gold at quota: the first pass skips it, bulk admits.
+        req, _ = s.pop(0, now=0.0, occupancy={"gold": 1})
+        assert req.request_id == 1
+        # Nothing else admissible: work conservation hands gold out
+        # anyway rather than parking an idle row (second pass).
+        req, _ = s.pop(0, now=0.0, occupancy={"gold": 1})
+        assert req.request_id == 0
+        # Under quota, gold admits in rank order as usual.
+        s.push(_req(2, "gold"))
+        s.push(_req(3, "bulk"))
+        req, _ = s.pop(0, now=0.0, occupancy={"gold": 0})
+        assert req.request_id == 2
+
+    def test_pop_drops_expired_with_timeout_status(self):
+        # Request 0 expires by wall clock, request 2 by round budget
+        # (its future deadline_time keeps it AHEAD of the deadline-less
+        # request 1 in the EDF heap, so the scan reaches it).
+        s = Scheduler()
+        s.push(_req(0, "batch", deadline_time=1.0))
+        s.push(_req(1, "batch"))
+        s.push(_req(2, "batch", deadline_time=10.0, deadline_rounds=3))
+        req, expired = s.pop(round_idx=5, now=2.0)
+        assert req.request_id == 1
+        assert sorted(r.request_id for r in expired) == [0, 2]
+        assert all(r.status == "timeout" for r in expired)
+        assert all(r.finish_round == 5 for r in expired)
+        assert len(s) == 0
+
+    def test_push_assigns_sequence_once(self):
+        # A re-push (page-pressure probe, preemption requeue) keeps its
+        # original FIFO position among equal deadlines.
+        s = Scheduler()
+        first = _req(0, "batch")
+        s.push(first)
+        s.push(_req(1, "batch"))
+        popped, _ = s.pop(0, now=0.0)
+        assert popped is first and first.sched_seq == 0
+        s.push(first)  # requeue: seq survives, so it pops FIRST again
+        assert first.sched_seq == 0
+        again, _ = s.pop(0, now=0.0)
+        assert again is first
+
+    def test_preempt_candidate_rank_order(self):
+        s = Scheduler()
+        assert s.preempt_candidate(now=0.0) is None
+        s.push(_req(0, "batch"))
+        assert s.preempt_candidate(now=0.0) is None  # cannot preempt
+        it = _req(1, "interactive")
+        s.push(it)
+        assert s.preempt_candidate(now=0.0) is it
+        # Peeking must not pop: the head stays queued.
+        assert len(s) == 2
+
+    def test_victim_order_prefers_lowest_priority_most_work(self):
+        s = Scheduler()
+        cands = [(_req(0, "batch"), 30), (_req(1, "batch"), 90),
+                 (_req(2, "best_effort"), 5),
+                 (_req(3, "interactive"), 99)]
+        order = s.victim_order(cands, requester_rank=0)
+        # interactive is non-preemptible and not strictly lower
+        # priority; best_effort (lowest priority) leads despite the
+        # least remaining work; then batch, most-remaining first.
+        assert [r.request_id for r, _ in order] == [2, 1, 0]
+        # A batch-rank requester may only displace best_effort.
+        order = s.victim_order(cands, requester_rank=1)
+        assert [r.request_id for r, _ in order] == [2]
+        # Equal class and remaining: larger id (newest) first, so the
+        # longest-running victim is spared deterministically.
+        tie = s.victim_order([(_req(7, "batch"), 30),
+                              (_req(4, "batch"), 30)], requester_rank=0)
+        assert [r.request_id for r, _ in tie] == [7, 4]
+
+    def test_spawn_successor_carries_policy_not_heaps(self):
+        classes = (ClassSpec("gold", 0, quota=2, can_preempt=True),
+                   ClassSpec("bulk", 3))
+        s = Scheduler(classes=classes, default_class="bulk",
+                      preempt_margin=2.5, max_preempts_per_round=4)
+        s.push(_req(0, "gold"))
+        succ = s.spawn_successor()
+        assert len(succ) == 0  # fresh heaps: no double-enqueue
+        assert succ.default_class == "bulk"
+        assert succ.preempt_margin == 2.5
+        assert succ.max_preempts_per_round == 4
+        assert [c.name for c in succ.by_rank] == ["gold", "bulk"]
+        assert len(s) == 1  # the crashed heap is untouched
+
+    def test_summary_and_queued_by_class(self):
+        s = Scheduler()
+        s.push(_req(0, "batch"))
+        s.push(_req(1, "batch"))
+        assert s.queued_by_class() == {"interactive": 0, "batch": 2,
+                                       "best_effort": 0}
+        summ = s.summary()
+        assert summ["default_class"] == "interactive"
+        assert [c["name"] for c in summ["classes"]] == \
+            ["interactive", "batch", "best_effort"]
+        (batch,) = [c for c in summ["classes"] if c["name"] == "batch"]
+        assert batch["queued"] == 2 and batch["preemptible"] is True
+
+    def test_metrics_recorders(self):
+        reg = MetricsRegistry()
+        s = Scheduler(registry=reg)
+        s.note_admitted(_req(0, "interactive"), queue_wait_s=0.2)
+        s.note_admitted(_req(1, "interactive"), queue_wait_s=5.0)
+        hist = reg.histogram("serving_sched_queue_wait_seconds",
+                             cls="interactive").summary()
+        assert hist["count"] == 2
+        # Only the 5.0 s wait missed the 1.0 s SLO; a timeout drop is
+        # always a miss for an SLO'd class and never for a bare one.
+        s.note_timeout(_req(2, "interactive"))
+        s.note_timeout(_req(3, "batch"))
+        assert reg.counter("serving_sched_slo_miss_total",
+                           cls="interactive").value == 2
+        s.note_preempt(_req(4, "batch"))
+        s.note_resume(_req(4, "batch"))
+        s.note_preempt_abort("cost_gate")
+        assert reg.counter("serving_sched_preemptions_total",
+                           cls="batch").value == 1
+        assert reg.counter("serving_sched_resumes_total",
+                           cls="batch").value == 1
+        assert reg.counter("serving_sched_preempt_aborts_total",
+                           reason="cost_gate").value == 1
+        s.push(_req(5, "batch"))
+        s.mirror_queued()
+        assert reg.gauge("serving_sched_class_queued",
+                         cls="batch").value == 1.0
+
+
+class TestPreemptCostModel:
+    def test_preempt_cost_is_round_trip_restore(self):
+        cfg = _cfg()
+        _, one_way = cm.restore_cost(cfg, 64)
+        flops, rt = cm.preempt_cost(cfg, 64)
+        assert flops == 0.0 and rt == 2.0 * one_way
+
+    def test_beneficial_monotone_in_remaining_work(self):
+        cfg = _cfg()
+        assert not cm.preempt_beneficial(cfg, 64, 0)
+        assert not cm.preempt_beneficial(cfg, 64, -3)
+        # A tiny model's decode step is weight-dominated: a handful of
+        # remaining steps already outweighs moving a short row twice.
+        assert cm.preempt_beneficial(cfg, 16, 1000)
+        # Raising the margin flips the same freeze back to "let it
+        # finish": conservatism scales, the model does not change.
+        assert cm.preempt_beneficial(cfg, 64, 4096, margin=1.0)
+        assert not cm.preempt_beneficial(cfg, 64, 4096, margin=1e9)
+
+    def test_gate_disabled_by_nonpositive_margin(self):
+        s = Scheduler(preempt_margin=0.0)
+        assert not s.preempt_gate(_cfg(), 64, 10_000)
+        s2 = Scheduler(preempt_margin=1.0)
+        assert s2.preempt_gate(_cfg(), 16, 10_000)
+
+
+# -- the preemption mechanism on real engines --------------------------
+
+_VARIANTS = {
+    "plain": ({}, False),
+    "rope_gqa": ({"rope": True, "n_kv_heads": 1}, False),
+    "int8": ({"kv_quant": "int8"}, False),
+    "spec": ({}, True),
+}
+
+
+def _staggered_run(cfg_kw, spec, sched, *, scheduler=None, steps0=40,
+                   steps1=40, deadline_rounds=None, host_kv_bytes=1 << 24,
+                   **engine_kw):
+    """The canonical preemption workload: two long batch-class jobs
+    fill both rows, three rounds pass, an interactive request arrives
+    (sched arm: preempts a victim). Returns ({rid: tokens}, statuses,
+    engine-or-None debug snapshot)."""
+    cfg = _cfg(**cfg_kw)
+    params = init_params(cfg, seed=0)
+    eng = ServingEngine(
+        params, cfg, batch=2, round_steps=4, seed=7, kv_pages=24,
+        host_kv_bytes=host_kv_bytes,
+        spec_draft_lens=(4,) if spec else None,
+        scheduler=(scheduler if scheduler is not None
+                   else (Scheduler() if sched else None)), **engine_kw)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab, 9).astype(np.int32)
+               for _ in range(3)]
+    kw = (lambda c: {"sched_class": c}) if sched else (lambda c: {})
+    eng.submit(prompts[0], steps0, request_id=0,
+               deadline_rounds=deadline_rounds, **kw("batch"))
+    eng.submit(prompts[1], steps1, request_id=1, **kw("batch"))
+    out, status = {}, {}
+    for _ in range(3):
+        for r in eng.step():
+            out[r.request_id] = list(map(int, r.tokens))
+            status[r.request_id] = r.status
+    eng.submit(prompts[2], 6, request_id=2, **kw("interactive"))
+    for _ in range(400):
+        for r in eng.step():
+            out[r.request_id] = (list(map(int, r.tokens))
+                                 if r.tokens is not None else None)
+            status[r.request_id] = r.status
+        if len(out) == 3:
+            break
+    snap = eng.debug_sched() if eng.scheduler is not None else None
+    host = eng.host_tier.summary() if eng.host_tier is not None else {}
+    eng.close()
+    return out, status, snap, host
+
+
+class TestBitExactPreemption:
+    # Tier-1 wall-clock budget (ROADMAP 9): plain in tier-1; the other
+    # variants compile their own kernels and ride under -m slow (the
+    # SLO smoke's bench run covers all four in-subprocess regardless).
+    @pytest.mark.parametrize("name", ["plain"] + [
+        pytest.param(v, marks=pytest.mark.slow)
+        for v in ("rope_gqa", "int8", "spec")])
+    def test_preempted_equals_uninterrupted(self, name):
+        cfg_kw, spec = _VARIANTS[name]
+        on, st_on, snap, host = _staggered_run(cfg_kw, spec, sched=True)
+        off, st_off, _, _ = _staggered_run(cfg_kw, spec, sched=False)
+        assert on == off, f"preemption moved tokens ({name})"
+        assert st_on == {0: "done", 1: "done", 2: "done"}
+        assert snap["preempts"] >= 1 and snap["resumes"] >= 1, \
+            f"variant {name} never exercised preemption: {snap}"
+        # Every pinned row drained: freeze/thaw accounting is closed.
+        assert host["host_rows"] == 0
+        assert host["host_row_bytes"] == 0
+
+    def test_cost_gate_abort_is_clean(self):
+        # preempt_margin <= 0 disables the gate: the interactive
+        # request WAITS (no freeze), outputs still match FIFO, and the
+        # abort is recorded with its reason.
+        reg = MetricsRegistry()
+        sched = Scheduler(preempt_margin=0.0, registry=reg)
+        on, _, snap, _ = _staggered_run({}, False, sched=True,
+                                        scheduler=sched,
+                                        metrics_registry=reg)
+        off, _, _, _ = _staggered_run({}, False, sched=False)
+        assert on == off
+        assert snap["preempts"] == 0 and snap["resumes"] == 0
+        assert reg.counter("serving_sched_preempt_aborts_total",
+                           reason="cost_gate").value >= 1
+
+    def test_host_budget_refusal_aborts_preemption(self):
+        # A host budget too small for one frozen row: spill_row
+        # refuses, the victim keeps decoding, outputs match FIFO.
+        reg = MetricsRegistry()
+        sched = Scheduler(registry=reg)
+        on, _, snap, host = _staggered_run({}, False, sched=True,
+                                           scheduler=sched,
+                                           host_kv_bytes=4096,
+                                           metrics_registry=reg)
+        off, _, _, _ = _staggered_run({}, False, sched=False)
+        assert on == off
+        assert snap["preempts"] == 0
+        assert host["host_rows"] == 0 and host["host_row_bytes"] == 0
+        assert reg.counter("serving_sched_preempt_aborts_total",
+                           reason="host_budget").value >= 1
+
+    def test_frozen_request_dropped_for_deadline_releases_row(self):
+        # The reservation-leak regression (queue.on_expire ->
+        # engine._release_expired -> host_tier.drop_row): request 0 is
+        # frozen mid-decode, its round deadline passes while it waits
+        # in the queue, and the drop must release the pinned host row
+        # — without the hook the pinned-byte ledger leaks forever.
+        out, status, snap, host = _staggered_run(
+            {}, False, sched=True, steps0=50, steps1=30,
+            deadline_rounds=4)
+        # steps0 > steps1 makes request 0 the deterministic victim
+        # (victim_order: most remaining work first).
+        assert status[0] == "timeout"
+        assert status[1] == "done" and status[2] == "done"
+        assert snap["preempts"] >= 1
+        assert snap["resumes"] == 0  # it never thawed: it expired
+        assert host["host_rows"] == 0, "pinned row leaked on expiry"
+        assert host["host_row_bytes"] == 0
+
+    def test_preemption_is_observable(self, tmp_path):
+        # One preempting drain, every narration surface checked: the
+        # runlog's preempt/resume events and per-round deltas, the
+        # sched counters, the row-spill counters, the engine ledger,
+        # and the offline analyzer's preemption block (which must not
+        # flag the freeze/thaw rounds as stalls).
+        reg = MetricsRegistry()
+        runlog = RunLog(maxlen=4096,
+                        path=str(tmp_path / "runlog.jsonl"))
+        sched = Scheduler(registry=reg)
+        out, _, snap, _ = _staggered_run(
+            {}, False, sched=True, scheduler=sched,
+            metrics_registry=reg, runlog=runlog)
+        assert snap["preempts"] >= 1 and snap["resumes"] >= 1
+        frz = runlog.events("preempt")
+        thaw = runlog.events("resume")
+        assert len(frz) == snap["preempts"]
+        assert len(thaw) == snap["resumes"]
+        assert all(e["bytes"] > 0 and e["spill_s"] >= 0
+                   and e["filled"] > 0 and e["pages"] >= 1
+                   for e in frz)
+        assert all(e["frozen_rounds"] >= 1 and e["restore_s"] >= 0
+                   for e in thaw)
+        rounds = runlog.events("round")
+        assert sum(e.get("preempts", 0) for e in rounds) == \
+            snap["preempts"]
+        assert sum(e.get("resumes", 0) for e in rounds) == \
+            snap["resumes"]
+        assert reg.counter("serving_sched_preemptions_total",
+                           cls="batch").value == snap["preempts"]
+        assert reg.counter("serving_kv_row_spills_total").value == \
+            snap["preempts"]
+        assert reg.counter("serving_kv_row_restores_total").value == \
+            snap["resumes"]
+        assert reg.counter("serving_preempted_total").value == \
+            snap["preempts"]
+        assert reg.counter("serving_resumed_total").value == \
+            snap["resumes"]
+        assert reg.histogram("serving_sched_queue_wait_seconds",
+                             cls="interactive").summary()["count"] >= 1
+        # The offline analyzer narrates and does not cry stall.
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import runlog_report as rr
+        finally:
+            sys.path.pop(0)
+        report = rr.build_report(
+            rr.load_runlog(str(tmp_path / "runlog.jsonl")))
+        pre = report["rounds"]["preemption"]
+        assert pre["preempts_total"] == snap["preempts"]
+        assert pre["resumes_total"] == snap["resumes"]
+        assert pre["frozen_rounds_max"] >= 1
+        assert not [a for a in report["anomalies"]
+                    if a["kind"] == "queue_stall"], report["anomalies"]
+
+    def test_debug_sched_surfaces_frozen_rows(self):
+        # Catch the scheduler mid-freeze: /debug/sched's engine half
+        # must name the frozen request with its cursor and payload.
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            seed=7, kv_pages=24,
+                            host_kv_bytes=1 << 24,
+                            scheduler=Scheduler())
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, cfg.vocab, 9).astype(np.int32)
+                   for _ in range(3)]
+        eng.submit(prompts[0], 40, request_id=0, sched_class="batch",
+                   tenant="bulk-co")
+        eng.submit(prompts[1], 40, request_id=1, sched_class="batch",
+                   tenant="bulk-co")
+        for _ in range(3):
+            eng.step()
+        eng.submit(prompts[2], 6, request_id=2,
+                   sched_class="interactive", tenant="chat-co")
+        seen_frozen = None
+        for _ in range(50):
+            eng.step()
+            snap = eng.debug_sched()
+            if snap["frozen"]:
+                seen_frozen = snap
+                break
+        assert seen_frozen is not None, "never observed a frozen row"
+        (fz,) = seen_frozen["frozen"]
+        assert fz["sched_class"] == "batch"
+        assert fz["tenant"] == "bulk-co"
+        assert fz["filled"] > 0 and fz["bytes"] > 0
+        assert fz["preempt_count"] == 1
+        assert seen_frozen["host_rows"] == 1
+        assert seen_frozen["host_row_bytes"] == fz["bytes"]
+        assert seen_frozen["can_preempt"] is True
+        # And a scheduler-free engine has no sched surface at all.
+        eng.close()
+        plain = ServingEngine(init_params(_cfg(), seed=0), _cfg(),
+                              batch=2, kv_pages=24)
+        assert plain.debug_sched() is None
+        plain.close()
+
+
+class TestChaosPreemptSpill:
+    def test_crash_at_preempt_spill_replays_bitexact(self):
+        # The fault fires after the victim is chosen and BEFORE its
+        # pages are gathered: the crashed incarnation never moved KV,
+        # the supervisor rebuilds (fresh scheduler heaps via
+        # spawn_successor), and replay-from-scratch produces the same
+        # bytes as an undisturbed FIFO drain.
+        cfg = _cfg()
+        params = init_params(cfg, seed=0)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, cfg.vocab, 9).astype(np.int32)
+                   for _ in range(3)]
+        plan = faults.install(faults.FaultPlan())
+        crash = plan.add(site="preempt_spill", action="raise")
+        # Round throttle: the driver thread keeps decoding between the
+        # occupancy poll below and the staggered submit, and with warm
+        # jit caches a loaded 1-core CI box can blow through the batch
+        # jobs' whole occupancy window in one scheduling hiccup — then
+        # nothing is left to preempt and the fault never fires. A 20 ms
+        # floor per round keeps the round clock ~2x coarser than the
+        # poll tick, making the stagger deterministic.
+        plan.add(site="decode_round", action="delay", delay_s=0.02,
+                 round_every=1, max_fires=1000)
+        try:
+            eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                                seed=7, kv_pages=24,
+                                host_kv_bytes=1 << 24,
+                                scheduler=Scheduler())
+            fe = EngineFrontend(eng).start()
+            h0 = fe.submit(prompts[0], 40, request_id=0,
+                           sched_class="batch")
+            h1 = fe.submit(prompts[1], 40, request_id=1,
+                           sched_class="batch")
+            deadline = time.perf_counter() + 60.0
+            while (fe.engine.round_idx < 1
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)
+            h2 = fe.submit(prompts[2], 6, request_id=2,
+                           sched_class="interactive")
+            toks = {h.request_id:
+                    list(map(int, h.result(120.0).tokens))
+                    for h in (h0, h1, h2)}
+            assert crash.fires == 1
+            assert fe.restarts == 1
+            fe.drain(30.0)
+        finally:
+            faults.reset()
+        ref, _, _, _ = _staggered_run({}, False, sched=False)
+        assert toks == ref
+
+
+class TestSchedSloSmoke:
+    def test_bench_tenants_line_and_slo_gate(self, tmp_path):
+        # End-to-end CI form: the whole tenants artifact (bit-exact
+        # matrix + chaos arm + contention drain) through
+        # tools/slo_check.py --metrics-key metrics_tenants against the
+        # committed baseline (docs/serving.md §8).
+        env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_RETRIES="1")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--config", "tenants"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=_REPO)
+        assert r.returncode == 0, r.stderr[-800:]
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        (line,) = [d for d in lines
+                   if d["metric"] == "serving_tenants_sched"]
+        assert line["bit_exact"] is True
+        assert line["bit_exact_spec"] is True
+        assert line["chaos_bit_exact"] is True
+        assert line["chaos_fault_fires"] >= 1
+        assert line["chaos_engine_restarts"] >= 1
+        assert line["value"] >= 3.0  # chat p99 wait-rounds improvement
+        assert line["batch_throughput_ratio"] >= 0.8
+        assert line["preempts"] >= 1 and line["resumes"] >= 1
+        assert line["recompiles_after_warmup"] == 0
+        assert line["recompiles_after_warmup_off"] == 0
+        m = line["metrics"]
+        assert m["counters"]["serving_kv_row_spills_total"] >= 1
+        assert m["counters"]["serving_kv_row_restores_total"] >= 1
+        assert m["histograms"][
+            'serving_sched_queue_wait_seconds{cls="interactive"}'][
+            "count"] >= 1
+        artifact = tmp_path / "tenants_artifact.jsonl"
+        artifact.write_text(r.stdout)
+        slo = subprocess.run(
+            [sys.executable, "tools/slo_check.py", str(artifact),
+             "--metrics-key", "metrics_tenants"],
+            capture_output=True, text=True, timeout=60, cwd=_REPO)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "SLO OK" in slo.stdout
+
+
+class TestServerPlumbing:
+    def _boot(self, *extra):
+        return subprocess.Popen(
+            [sys.executable, "-m", "marlin_tpu.serving.server",
+             "--port", "0", "--force-cpu", "--d-model", "32",
+             "--n-layers", "1", "--vocab", "64", "--max-len", "64",
+             "--batch", "2", "--round-steps", "2", "--kv-pages", "12",
+             "--host-kv-bytes", str(1 << 20), *extra],
+            cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+    def test_sched_server_surface_end_to_end(self):
+        # --sched end to end: /debug/sched narrates the class table,
+        # POST carries tenant/sched_class, an unknown class maps to
+        # 400, and the drain still seals clean on SIGTERM.
+        proc = self._boot("--sched")
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("SERVING "), line
+            port = int(line.strip().split("port=")[1])
+            base = f"http://127.0.0.1:{port}"
+            with urllib.request.urlopen(f"{base}/debug/sched",
+                                        timeout=30.0) as resp:
+                snap = json.loads(resp.read())
+            assert [c["name"] for c in snap["classes"]] == \
+                ["interactive", "batch", "best_effort"]
+            assert snap["default_class"] == "interactive"
+            body = json.dumps({"prompt": list(range(1, 9)), "steps": 4,
+                               "tenant": "acme",
+                               "sched_class": "interactive"}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/generate", data=body,
+                    method="POST"), timeout=60.0) as resp:
+                out = json.loads(resp.read())
+            assert out["status"] == "done" and len(out["tokens"]) == 4
+            bad = json.dumps({"prompt": [1, 2], "steps": 2,
+                              "sched_class": "gold"}).encode()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/generate", data=bad,
+                    method="POST"), timeout=30.0)
+            assert err.value.code == 400
+            assert "unknown scheduling class" in \
+                err.value.read().decode()
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(60.0) == 0, proc.stderr.read()[-800:]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10.0)
+
+    def test_schedless_server_404s_debug_sched(self):
+        proc = self._boot()
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("SERVING "), line
+            port = int(line.strip().split("port=")[1])
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/sched",
+                    timeout=30.0)
+            assert err.value.code == 404
+            assert "--sched" in err.value.read().decode()
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(60.0) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10.0)

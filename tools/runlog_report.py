@@ -290,6 +290,42 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
                                   if ev.get("draft_len") is not None}),
             "draft_len_last": spec[-1].get("draft_len"),
         }
+    # Preemption narration (ISSUE 17, docs/serving.md §8): a scheduler
+    # engine emits a ``preempt`` event per freeze (pages/bytes moved to
+    # the host row tier, spill seconds) and a ``resume`` event per thaw
+    # (rounds spent frozen, restore seconds), plus per-round
+    # freeze/thaw deltas on the round events. A sealed log answers
+    # "who got frozen, for how long, and what did the moves cost"
+    # offline — preemption is POLICY, never an anomaly.
+    frz = [ev for ev in events if ev["kind"] == "preempt"]
+    thaw = [ev for ev in events if ev["kind"] == "resume"]
+    if frz or thaw or any(ev.get("preempts") for ev in rounds):
+        frozen_rounds = [ev.get("frozen_rounds", 0) for ev in thaw]
+        pre = {
+            "preempts_total": len(frz) or sum(
+                ev.get("preempts", 0) for ev in rounds),
+            "resumes_total": len(thaw) or sum(
+                ev.get("resumes", 0) for ev in rounds),
+            "preempted_requests": sorted(
+                {ev.get("request_id") for ev in frz}),
+            "frozen_bytes_max": max(
+                (ev.get("bytes", 0) for ev in frz), default=0),
+            "host_row_bytes_max": max(
+                (ev.get("host_row_bytes", 0) for ev in rounds),
+                default=0),
+        }
+        if frozen_rounds:
+            pre["frozen_rounds_max"] = max(frozen_rounds)
+            pre["frozen_rounds_mean"] = round(
+                sum(frozen_rounds) / len(frozen_rounds), 2)
+        spill_s = [ev["spill_s"] for ev in frz if "spill_s" in ev]
+        restore_s = [ev["restore_s"] for ev in thaw
+                     if "restore_s" in ev]
+        if spill_s:
+            pre["spill_s_max"] = round(max(spill_s), 6)
+        if restore_s:
+            pre["restore_s_max"] = round(max(restore_s), 6)
+        out["preemption"] = pre
     return out
 
 
@@ -381,7 +417,13 @@ def find_anomalies(events: List[dict], reqs: Dict[int, dict],
                     # spent its admission slot scattering a spilled
                     # prefix back into pages (ISSUE 16) — legal, never
                     # a provable sit-on-ready-work stall.
-                    and cur.get("restores", 0) == 0):
+                    and cur.get("restores", 0) == 0
+                    # So is a freeze or a thaw (ISSUE 17): a round that
+                    # preempted a victim or resumed a frozen row spent
+                    # its slot moving KV state for the scheduler's
+                    # priority decision, not sitting on ready work.
+                    and cur.get("preempts", 0) == 0
+                    and cur.get("resumes", 0) == 0):
                 anomalies.append({
                     "kind": "queue_stall", "round": cur.get("round"),
                     "queue_depth": prev.get("queue_depth"),
@@ -671,6 +713,17 @@ def _human(report: dict) -> str:
                 f"{sp['accept_rate_mean']}, min {sp['accept_rate_min']}"
                 f"), draft_len {sp['draft_lens']} "
                 f"(last {sp['draft_len_last']})")
+        pre = r.get("preemption")
+        if pre:
+            line = (f"preemption: {pre['preempts_total']} freeze(s), "
+                    f"{pre['resumes_total']} thaw(s) across request(s) "
+                    f"{pre['preempted_requests']}, max frozen payload "
+                    f"{pre['frozen_bytes_max']} bytes")
+            if "frozen_rounds_max" in pre:
+                line += (f", frozen {pre['frozen_rounds_mean']} "
+                         f"round(s) mean / {pre['frozen_rounds_max']} "
+                         f"max")
+            lines.append(line)
     if report["phase_sum_checked"]:
         lines.append(
             f"phase sums: {report['phase_sum_checked']} checked, max "
