@@ -190,12 +190,19 @@ def config_fleet():
     try:
         for n in (1, n_max):
             arm_dir = os.path.join(runlog_root, f"arm{n}")
+            # Tracing ON in BOTH arms (symmetric overhead; responses
+            # stay byte-identical by the X-Trace-Context contract):
+            # the front door head-samples 1/4 and every SLO-breached/
+            # errored request is tail-kept regardless; per-process
+            # Chrome exports land at drain for the stitch below.
+            trace_dir = os.path.join(arm_dir, "traces")
             cfg = FleetConfig(
                 n_replicas=n, d_model=d, n_layers=n_layers,
                 n_heads=max(2, d // 16), vocab=vocab, max_len=max_len,
                 batch=batch, round_steps=round_steps, max_pending=256,
                 temperature=temperature, seed=0, kv_pages=kv_pages,
-                runlog_dir=arm_dir)
+                runlog_dir=arm_dir, trace=True, trace_sample=0.25,
+                trace_export_dir=trace_dir)
             server = serve_fleet(cfg).start_background()
             port = server.port
             client = sc.ServingClient(port=port, timeout=300.0)
@@ -292,6 +299,23 @@ def config_fleet():
                         time.sleep(0.25)
                     else:
                         drain["ok"] = False
+                # Front-door exemplar BEFORE drain: the slowest kept
+                # trace's fleet.request span is the fleet hop the
+                # metrics block surfaces (request_id + trace_id — the
+                # Perfetto join key).
+                ex_doc = json.loads(
+                    client._get("/debug/trace?exemplars=1")[1])
+                fd_span = next(
+                    (ev for ev in ex_doc.get("traceEvents", [])
+                     if ev.get("name") == "fleet.request"), None)
+                if fd_span is not None:
+                    arm["trace_exemplar"] = {
+                        "request_id":
+                            fd_span["args"].get("request_id"),
+                        "trace_id": fd_span["args"].get("trace_id"),
+                        "dur_ms": round(fd_span.get("dur", 0.0)
+                                        / 1000.0, 3),
+                    }
                 arm["bitexact"] = golden_check(pairs)
             finally:
                 server.begin_drain(120.0)
@@ -311,6 +335,19 @@ def config_fleet():
             merged = rr.build_fleet_report(entries)
             arm["runlog_ok"] = bool(merged["ok"])
             arm["runlog_unique_ids"] = merged["n_unique_request_ids"]
+            # Stitch the arm's per-process exports into one fleet
+            # timeline and self-check it — the docs/observability.md
+            # §10 acceptance (zero dangling parent/flow links) as a
+            # live artifact field, not only a test.
+            ts = _load_tool("trace_stitch")
+            trace_paths = sorted(glob.glob(
+                os.path.join(trace_dir, "*.trace.json")))
+            stitched = ts.stitch([(p, ts.load_trace(p))
+                                  for p in trace_paths])
+            problems = ts.check(stitched)
+            arm["trace_processes"] = stitched["metadata"]["n_processes"]
+            arm["trace_stitched_events"] = len(stitched["traceEvents"])
+            arm["trace_stitch_ok"] = not problems
             arms[n] = arm
     finally:
         shutil.rmtree(runlog_root, ignore_errors=True)
@@ -320,12 +357,13 @@ def config_fleet():
     bitexact = a1["bitexact"] and aN["bitexact"]
     recompiles = a1["recompiles"] + aN["recompiles"]
     hit_ratio = aN["hit_rate"] / max(a1["hit_rate"], 1e-9)
+    trace_ok = a1["trace_stitch_ok"] and aN["trace_stitch_ok"]
     return {
         "metric": "serving_fleet_scaling",
         "value": round(scaling, 3),
         "unit": "x_modeled",
         "vs_baseline": 1.0 if (bitexact and recompiles == 0
-                               and drain["ok"]) else 0.0,
+                               and drain["ok"] and trace_ok) else 0.0,
         "n_replicas": n_max,
         "modeled_capacity_scaling": round(scaling, 3),
         "modeled_iters_single": a1["iters_total"],
@@ -349,6 +387,15 @@ def config_fleet():
         "drain_restart_incarnation": drain["incarnation"],
         "runlog_ok": bool(a1["runlog_ok"] and aN["runlog_ok"]),
         "runlog_unique_ids": aN["runlog_unique_ids"],
+        # Distributed-tracing ride-along (docs/observability.md §10):
+        # per-process exports stitched into one Perfetto timeline and
+        # self-checked, plus the front door's slowest kept trace (the
+        # fleet hop: request_id + trace_id join key).
+        "trace_stitch_ok": bool(trace_ok),
+        "trace_processes": aN["trace_processes"],
+        "trace_stitched_events": aN["trace_stitched_events"],
+        **({"trace_exemplar": aN["trace_exemplar"]}
+           if aN.get("trace_exemplar") else {}),
         "n_families": n_families, "members_per_family": members,
         "steps": steps, "batch": batch, "round_steps": round_steps,
         "kv_pages": kv_pages, "depth_per_replica": depth, "d_model": d,

@@ -164,6 +164,7 @@ def config_serving():
     import numpy as np
 
     from marlin_tpu.models import TransformerConfig, generate, init_params
+    from marlin_tpu.obs import distributed as obs_dtrace
     from marlin_tpu.obs import metrics as obs_metrics
     from marlin_tpu.obs import trace as obs_trace
     from marlin_tpu.obs.watch import CompileWatchdog
@@ -209,7 +210,9 @@ def config_serving():
     wd.register("serving.prefill_into_row", prefill_into_row)
     tracer = obs_trace.tracer
     was_enabled = tracer.enabled
+    was_exemplar_k = tracer.exemplar_k
     tracer.reset()
+    tracer.exemplar_k = 4  # retain tail exemplars for the block below
     tracer.enable()
     try:
         eng, dt_cont = run_continuous()
@@ -221,6 +224,21 @@ def config_serving():
         tempfile.gettempdir(), "marlin_serving_trace.json")
     n_trace_events = len(tracer.events())
     tracer.export(trace_path)
+    # Slowest retained exemplar + the trace_id its request WOULD carry
+    # behind the fleet front door (obs/distributed.py derives trace
+    # ids deterministically from the request id, so the standalone
+    # bench and a fleet run narrate the same join key).
+    exemplars = tracer.exemplars()
+    tracer.exemplar_k = was_exemplar_k
+    trace_exemplar = None
+    if exemplars:
+        ex = exemplars[0]
+        trace_exemplar = {
+            "request_id": ex["request_id"],
+            "trace_id": obs_dtrace.trace_id_for(ex["request_id"]),
+            "total_s": round(ex["total_s"], 6),
+            "spans": len(ex["spans"]),
+        }
 
     def run_static():
         t0 = time.perf_counter()
@@ -301,6 +319,8 @@ def config_serving():
         "engine_restarts": int(obs_metrics.registry.counter(
             "serving_engine_restarts_total").value),
         "trace_path": trace_path, "trace_events": n_trace_events,
+        **({"trace_exemplar": trace_exemplar}
+           if trace_exemplar is not None else {}),
     }
 
 

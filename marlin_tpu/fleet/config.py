@@ -88,6 +88,18 @@ class FleetConfig:
     drain_timeout_s: float = 60.0
     request_timeout_s: float = 300.0
 
+    # -- distributed tracing (docs/observability.md §10) ---------------
+    # trace=True enables the tracer fleet-wide: the front door mints
+    # X-Trace-Context (head sampling drawn ONCE there, at trace_sample)
+    # and every replica joins the caller's trace; trace_flight sizes
+    # each process's last-K flight-recorder ring; trace_export_dir
+    # collects per-process Chrome exports at drain (frontdoor.trace.
+    # json + replica<i>[.r<n>].trace.json) for tools/trace_stitch.py.
+    trace: bool = False
+    trace_sample: float = 1.0
+    trace_flight: int = 16
+    trace_export_dir: Optional[str] = None
+
     # -- plumbing ------------------------------------------------------
     # Directory for per-replica runlogs (replica<i>.jsonl) + the
     # router's own runlog (router.jsonl); None = no runlogs.
@@ -106,6 +118,10 @@ class FleetConfig:
             raise ValueError(
                 f"min_ready must be in [1, n_replicas], got "
                 f"{self.min_ready} with n_replicas={self.n_replicas}")
+        if not 0.0 < self.trace_sample <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in (0, 1], got "
+                f"{self.trace_sample}")
 
     # -- derived -------------------------------------------------------
 
@@ -127,6 +143,24 @@ class FleetConfig:
         if self.runlog_dir is None:
             return None
         return os.path.join(self.runlog_dir, "router.jsonl")
+
+    def replica_trace(self, index: int,
+                      incarnation: int = 0) -> Optional[str]:
+        """Per-INCARNATION Chrome trace export path (same doctrine as
+        :meth:`replica_runlog` — a respawned replica's clock epoch is
+        fresh, so its export must be a fresh file the stitcher aligns
+        as its own process)."""
+        if self.trace_export_dir is None:
+            return None
+        stem = (f"replica{index}.trace.json" if incarnation == 0
+                else f"replica{index}.r{incarnation}.trace.json")
+        return os.path.join(self.trace_export_dir, stem)
+
+    def frontdoor_trace(self) -> Optional[str]:
+        if self.trace_export_dir is None:
+            return None
+        return os.path.join(self.trace_export_dir,
+                            "frontdoor.trace.json")
 
     def replica_argv(self, index: int,
                      incarnation: int = 0) -> List[str]:
@@ -167,6 +201,23 @@ class FleetConfig:
         runlog = self.replica_runlog(index, incarnation)
         if runlog is not None:
             argv += ["--runlog", runlog]
+        if self.trace:
+            # The request keep/drop draw happens once at the front door
+            # and rides in on X-Trace-Context — the replica's root span
+            # takes it as an explicit ``sampled=`` override, so the
+            # LOCAL rate forwarded here never touches routed requests.
+            # It governs only locally-rooted spans: the engine's round
+            # timeline (which at rate 1.0 would record every decode
+            # round and pay span cost per round — the fleet-path <=5%
+            # overhead pin in tests/test_trace_dist.py holds because
+            # rounds sample at the same 1/N as requests) and direct-to-
+            # replica requests that arrive without a trace context.
+            argv += ["--trace", "--trace-sample",
+                     str(self.trace_sample), "--trace-flight-k",
+                     str(self.trace_flight)]
+            trace_path = self.replica_trace(index, incarnation)
+            if trace_path is not None:
+                argv += ["--trace-export", trace_path]
         return argv
 
     def replica_environ(self, index: int) -> Dict[str, str]:

@@ -91,6 +91,15 @@ class Replica:
         with self._lock:
             return len(self._restart_times)
 
+    @property
+    def trace_path(self) -> Optional[str]:
+        """THIS incarnation's Chrome trace export path (written by the
+        replica at drain when ``FleetConfig.trace_export_dir`` is set)
+        — what the bench/tests hand to tools/trace_stitch.py."""
+        with self._lock:
+            return self.config.replica_trace(self.index,
+                                             self._incarnation)
+
     def status(self) -> dict:
         with self._lock:
             return {
@@ -102,6 +111,8 @@ class Replica:
                 "incarnation": self._incarnation,
                 "restarts_in_window": len(self._restart_times),
                 "max_restarts": self.config.replica_max_restarts,
+                "trace_path": self.config.replica_trace(
+                    self.index, self._incarnation),
             }
 
     def _emit(self, kind: str, **fields) -> None:

@@ -252,16 +252,26 @@ class ProxyAttemptFailed(Exception):
 def proxy_submit(router: PrefixAffinityRouter,
                  decision: RouteDecision, payload: bytes,
                  http_id: Optional[str],
-                 timeout: float) -> Tuple[http.client.HTTPConnection,
-                                          http.client.HTTPResponse,
-                                          int]:
+                 timeout: float,
+                 extra_headers: Optional[Dict[str, str]] = None,
+                 ) -> Tuple[http.client.HTTPConnection,
+                            http.client.HTTPResponse,
+                            int]:
     """POST ``payload`` to the decided replica, failing over on
     connect errors and pre-acceptance rejections (429/503 — the
     replica registered nothing, so the replay is byte-exact under the
     request-id contract). Returns ``(conn, resp, replica_index)`` with
     the response UNREAD — the caller streams or reads it and must close
     ``conn``. Raises :class:`ProxyAttemptFailed` when every healthy
-    candidate rejected."""
+    candidate rejected.
+
+    ``extra_headers`` (the front door's X-Trace-Context mint) are
+    forwarded verbatim on EVERY attempt: a failover replay must carry
+    the same trace context as the first attempt, so the trace follows
+    the request to whichever replica finally accepts it. The caller's
+    ``X-Request-Id`` likewise rides as correlation only — the replica
+    keys everything on the body's router-assigned ``request_id``
+    (body-wins precedence, serving/server.py)."""
     tried = set()
     last: Optional[ProxyAttemptFailed] = None
     while True:
@@ -279,6 +289,8 @@ def proxy_submit(router: PrefixAffinityRouter,
             headers = {"Content-Type": "application/json"}
             if http_id:
                 headers["X-Request-Id"] = http_id
+            if extra_headers:
+                headers.update(extra_headers)
             try:
                 conn.request("POST", "/v1/generate", payload, headers)
                 resp = conn.getresponse()

@@ -19,6 +19,12 @@ Endpoints:
   (the fleet-level quorum a load balancer keys on).
 * ``GET /fleet/status`` — per-replica state/port/outstanding plus the
   router's counters (also how tests/bench find replica ports).
+* ``GET /debug/trace`` — the front door's own trace buffer
+  (``?exemplars=1`` / ``?flight=1`` like a replica's); with tracing on
+  (``FleetConfig.trace``) the front door mints ``X-Trace-Context`` per
+  request — head sampling drawn ONCE here, honored by every replica —
+  and exports ``frontdoor.trace.json`` at drain for
+  ``tools/trace_stitch.py`` (docs/observability.md §10).
 * ``POST /fleet/drain/<i>`` (``?restart=1``) — begin the drain of one
   replica on a helper thread (202; poll ``/fleet/status``): the
   drain-under-load drill. The router stops routing to it immediately;
@@ -31,17 +37,22 @@ SIGTERM drains every replica, then the listener, then exits 0.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import threading
+import time
+import urllib.parse
 from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 import numpy as np
 
+from ..obs import distributed as dtrace
 from ..obs.metrics import MetricsRegistry
 from ..obs.runlog import RunLog
+from ..obs.trace import Tracer
 from .config import FleetConfig
 from .replica import Replica
 from .router import (NoHealthyReplica, PrefixAffinityRouter,
@@ -83,13 +94,26 @@ class FleetSupervisor:
     """
 
     def __init__(self, config: FleetConfig,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         self.config = config
         self.registry = registry or MetricsRegistry()
         if config.runlog_dir is not None:
             import os
             os.makedirs(config.runlog_dir, exist_ok=True)
+        if config.trace_export_dir is not None:
+            import os
+            os.makedirs(config.trace_export_dir, exist_ok=True)
         self.runlog = RunLog(path=config.router_runlog())
+        # Front-door tracer (docs/observability.md §10): head sampling
+        # for the WHOLE fleet is drawn here, once per request; replicas
+        # honor the verdict via X-Trace-Context. Disabled (free) unless
+        # config.trace.
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=config.trace, sample_rate=config.trace_sample,
+            exemplar_k=8, flight_k=config.trace_flight)
+        if config.trace_export_dir is not None:
+            self.tracer.crash_dump_path = config.frontdoor_trace()
         self.replicas: List[Replica] = [
             Replica(i, config, runlog=self.runlog)
             for i in range(config.n_replicas)]
@@ -187,6 +211,12 @@ class FleetSupervisor:
         for r in self.replicas:
             r.begin_drain()
         ok = all(r.wait_drained(timeout) for r in self.replicas)
+        path = self.config.frontdoor_trace()
+        if path is not None and self.tracer.enabled:
+            # Replicas exported their own traces on drain (serving/
+            # server.py --trace-export); the front door's goes next to
+            # them for tools/trace_stitch.py.
+            self.tracer.export(path)
         self.runlog.emit("fleet_drain_complete", ok=ok)
         self.runlog.flush()
         return ok
@@ -304,6 +334,16 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 headers=None if ready else {"Retry-After": RETRY_AFTER_S})
         elif path == "/fleet/status":
             self._send_json(200, self.sup.status(), "/fleet/status")
+        elif path == "/debug/trace":
+            query = self.path.partition("?")[2]
+            params = urllib.parse.parse_qs(query)
+            if params.get("exemplars", ["0"])[-1] == "1":
+                doc = self.sup.tracer.exemplar_trace()
+            elif params.get("flight", ["0"])[-1] == "1":
+                doc = self.sup.tracer.flight_trace()
+            else:
+                doc = self.sup.tracer.to_chrome_trace()
+            self._send_json(200, doc, "/debug/trace")
         else:
             self._send_json(404, {"error": f"no route {path}"}, path)
 
@@ -362,53 +402,102 @@ class _FleetHandler(BaseHTTPRequestHandler):
                             headers={"Retry-After": RETRY_AFTER_S})
             return
         body["request_id"] = decision.request_id
+        # Distributed trace mint (docs/observability.md §10): ONE head-
+        # sampling draw per request, spent here; the verdict and the
+        # derived trace id ride to the replica in X-Trace-Context so
+        # the trace is kept or dropped coherently fleet-wide. Disabled
+        # tracer = no header at all (replicas behave standalone and
+        # responses stay byte-identical to an untraced fleet).
+        tracer = self.sup.tracer
+        ctx = None
+        extra_headers = None
+        if tracer.enabled:
+            ctx = dtrace.mint(decision.request_id,
+                              tracer.head_sample())
+            extra_headers = {dtrace.TRACE_HEADER: ctx.to_header()}
+            self.sup.runlog.emit(
+                "fleet_trace", request_id=decision.request_id,
+                trace_id=ctx.trace_id, sampled=ctx.sampled,
+                replica=decision.replica_index,
+                **({"http_id": http_id} if http_id is not None
+                   else {}))
         payload = json.dumps(body).encode()
+        t0 = time.perf_counter()
+        final_status = None
+        span_cm = (tracer.span(
+            "fleet.request", scope=False, sampled=ctx.sampled,
+            request_id=decision.request_id, trace_id=ctx.trace_id,
+            replica=decision.replica_index)
+            if ctx is not None else contextlib.nullcontext())
         try:
-            try:
-                conn, resp, idx = proxy_submit(
-                    self.sup.router, decision, payload, http_id,
-                    self.server.request_timeout_s)
-            except ProxyAttemptFailed as e:
-                if e.status is not None:
-                    # Every healthy replica rejected (draining fleet or
-                    # full queues): forward the last rejection verbatim.
-                    self._forward_body(e.status, e.body, e.headers,
-                                       route, decision)
-                else:
-                    self._send_json(
-                        503, {"error": f"no replica reachable: {e}"},
-                        route, headers={"Retry-After": RETRY_AFTER_S})
-                return
-            try:
-                ctype = resp.getheader("Content-Type", "")
-                if stream and resp.status == 200 \
-                        and "text/event-stream" in ctype:
-                    self._forward_stream(resp, idx, route, decision)
-                else:
-                    try:
-                        payload_out = resp.read()
-                    except (OSError, HTTPException):
-                        # Replica lost AFTER accepting, before the
-                        # blocking response landed. Not auto-replayed
-                        # here (the router only replays pre-acceptance
-                        # failures); a client retry with a fresh submit
-                        # is byte-safe — the dead replica delivers
-                        # nothing and ids never reuse.
+            with span_cm:
+                try:
+                    conn, resp, idx = proxy_submit(
+                        self.sup.router, decision, payload, http_id,
+                        self.server.request_timeout_s,
+                        extra_headers=extra_headers)
+                except ProxyAttemptFailed as e:
+                    if e.status is not None:
+                        # Every healthy replica rejected (draining
+                        # fleet or full queues): forward the last
+                        # rejection verbatim.
+                        final_status = self._forward_body(
+                            e.status, e.body, e.headers, route,
+                            decision)
+                    else:
                         self._send_json(
-                            502, {"error": "replica lost mid-request; "
-                                  "retry is safe (no bytes were "
-                                  "delivered)",
-                                  "request_id": decision.request_id},
+                            503,
+                            {"error": f"no replica reachable: {e}"},
                             route,
                             headers={"Retry-After": RETRY_AFTER_S})
-                        return
-                    self._forward_body(resp.status, payload_out,
-                                       resp.getheaders(), route,
-                                       decision, replica=idx)
-            finally:
-                conn.close()
+                        final_status = 503
+                    return
+                try:
+                    ctype = resp.getheader("Content-Type", "")
+                    if stream and resp.status == 200 \
+                            and "text/event-stream" in ctype:
+                        final_status = self._forward_stream(
+                            resp, idx, route, decision)
+                    else:
+                        try:
+                            payload_out = resp.read()
+                        except (OSError, HTTPException):
+                            # Replica lost AFTER accepting, before the
+                            # blocking response landed. Not auto-
+                            # replayed here (the router only replays
+                            # pre-acceptance failures); a client retry
+                            # with a fresh submit is byte-safe — the
+                            # dead replica delivers nothing and ids
+                            # never reuse.
+                            self._send_json(
+                                502,
+                                {"error": "replica lost mid-request; "
+                                 "retry is safe (no bytes were "
+                                 "delivered)",
+                                 "request_id": decision.request_id},
+                                route,
+                                headers={"Retry-After": RETRY_AFTER_S})
+                            final_status = 502
+                            return
+                        final_status = self._forward_body(
+                            resp.status, payload_out,
+                            resp.getheaders(), route, decision,
+                            replica=idx)
+                finally:
+                    conn.close()
         finally:
             self.sup.router.release(decision)
+            if ctx is not None:
+                # Front-door tail retention: keep the hop's trace when
+                # the client saw an error (or nothing at all) — the
+                # same doctrine as the engine's finish hook.
+                err = final_status is None or final_status >= 400
+                tracer.finish_request(
+                    decision.request_id, time.perf_counter() - t0,
+                    keep=err,
+                    reason=("" if not err else
+                            f"status_{final_status}" if final_status
+                            else "aborted"))
 
     _FORWARD_HEADERS = ("Content-Type", "X-Request-Id",
                         "X-Engine-Request-Id", "Retry-After")
@@ -426,9 +515,10 @@ class _FleetHandler(BaseHTTPRequestHandler):
         return out
 
     def _forward_body(self, status, body, headers, route, decision,
-                      replica=None) -> None:
+                      replica=None) -> int:
         """Blocking path: replica response forwarded verbatim (status +
-        body bytes + id headers) — byte-transparent by construction."""
+        body bytes + id headers) — byte-transparent by construction.
+        Returns the status for the trace-retention verdict."""
         hdrs = self._id_headers(headers, decision, replica)
         self.send_response(status)
         for k, v in hdrs.items():
@@ -437,12 +527,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
         self._count(route, status)
+        return status
 
-    def _forward_stream(self, resp, replica, route, decision) -> None:
+    def _forward_stream(self, resp, replica, route, decision) -> int:
         """SSE path: re-chunk the replica's decoded stream line by
         line. The concatenated payload equals the replica's payload
         byte for byte (the exactness tests rely on it); only transfer
-        framing is re-done."""
+        framing is re-done. Returns the effective code (499 = broken
+        stream) for the trace-retention verdict."""
         self.send_response(200)
         for k, v in self._id_headers(resp.getheaders(), decision,
                                      replica).items():
@@ -473,6 +565,7 @@ class _FleetHandler(BaseHTTPRequestHandler):
             except OSError:
                 pass
         self._count(route, code)
+        return code
 
     def _chunk(self, payload: bytes) -> None:
         self.wfile.write(f"{len(payload):x}\r\n".encode() + payload
@@ -632,6 +725,19 @@ def main(argv=None) -> int:
     p.add_argument("--no-affinity", action="store_true")
     p.add_argument("--runlog-dir", default=None,
                    help="per-replica + router runlog JSONL directory")
+    p.add_argument("--trace", action="store_true",
+                   help="fleet-wide distributed tracing: the front "
+                        "door mints X-Trace-Context, replicas join "
+                        "the caller's trace (docs/observability.md)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fleet-wide head sampling rate, drawn once at "
+                        "the front door (e.g. 0.015625 = 1/64)")
+    p.add_argument("--trace-flight", type=int, default=16,
+                   help="per-process flight-recorder ring size")
+    p.add_argument("--trace-export-dir", default=None,
+                   help="directory for per-process Chrome trace "
+                        "exports at drain (stitch with "
+                        "tools/trace_stitch.py)")
     args = p.parse_args(argv)
 
     config = FleetConfig(
@@ -643,7 +749,10 @@ def main(argv=None) -> int:
         seed=args.seed, kv_pages=args.kv_pages,
         prefill_chunk=args.prefill_chunk, min_ready=args.min_ready,
         replica_max_restarts=args.replica_max_restarts,
-        affinity=not args.no_affinity, runlog_dir=args.runlog_dir)
+        affinity=not args.no_affinity, runlog_dir=args.runlog_dir,
+        trace=args.trace, trace_sample=args.trace_sample,
+        trace_flight=args.trace_flight,
+        trace_export_dir=args.trace_export_dir)
     server = serve_fleet(config)
     drained = install_signal_handlers(server)
     print(f"FLEET host={args.host} port={server.port} "

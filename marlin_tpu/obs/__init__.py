@@ -13,11 +13,17 @@ sized for the serving stack PR 2 started:
 * :mod:`.watch`   — compile/retrace watchdog (``_cache_size`` polling +
   ``jax.monitoring`` listeners) and the scoped transfer guard.
 * :mod:`.runlog`  — bounded structured JSONL event log for the engine.
+* :mod:`.distributed` — fleet-wide trace-context propagation: the
+  ``X-Trace-Context`` wire format minted at the fleet front door and
+  decoded by replicas, with trace ids derived deterministically from
+  router-minted request ids. ``tools/trace_stitch.py`` merges the
+  per-process exports into one timeline.
 
 See docs/observability.md.
 """
 
-from . import metrics, runlog, trace, watch
+from . import distributed, metrics, runlog, trace, watch
+from .distributed import TraceContext
 from .metrics import MetricsRegistry, registry
 from .runlog import RunLog
 from .trace import Tracer, tracer
@@ -29,7 +35,9 @@ __all__ = [
     "MetricsRegistry",
     "RetraceError",
     "RunLog",
+    "TraceContext",
     "Tracer",
+    "distributed",
     "metrics",
     "no_transfers",
     "registry",

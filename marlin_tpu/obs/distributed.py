@@ -1,0 +1,107 @@
+"""Fleet-wide distributed tracing: trace-context propagation.
+
+Dapper's missing piece for the multi-process fleet (PAPERS.md): a
+request that crosses the front door, a replica, and a crash replay must
+carry ONE trace identity, with the keep/drop sampling decision made
+once — at the front door — and honored everywhere. This module is the
+wire format; the runtime halves live next door:
+
+* the front door (fleet/server.py) draws ``tracer.head_sample()`` once,
+  mints a :class:`TraceContext` with :func:`mint`, and forwards it as
+  the ``X-Trace-Context`` header (fleet/router.py ``proxy_submit``);
+* the replica (serving/server.py) decodes the header with :func:`parse`
+  and opens its root span with ``sampled=ctx.sampled`` so replica-side
+  engine spans join (or vanish with) the caller's trace coherently;
+* ``tools/trace_stitch.py`` merges the per-process Chrome exports into
+  one fleet timeline, matching front-door and replica spans on the
+  request id both sides logged.
+
+The header is W3C-traceparent-shaped (``00-{trace_id}-{span_id}-{fl}``,
+32-hex trace id, 16-hex parent span id, ``01``/``00`` sampled flag) but
+ids are DERIVED, not random: serving code may not draw entropy (the
+marlint deterministic-serving rule — replayed requests must re-produce
+byte-identical runs), so :func:`trace_id_for` hashes the router-minted
+request id, which is already globally unique within a fleet run. Two
+runs of the same workload therefore mint the same trace ids — a feature
+for diffing timelines, not a bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import re
+from typing import Optional
+
+# Header name on the fleet wire; shaped like W3C `traceparent` but
+# namespaced X- because the ids are deterministic, not 128-bit random.
+TRACE_HEADER = "X-Trace-Context"
+
+_VERSION = "00"
+_HEADER_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def trace_id_for(request_id) -> str:
+    """32-hex trace id derived from the router-minted request id — the
+    one id both the front door and the replica can compute, so runlogs,
+    bench metrics, and the stitcher agree without a side channel."""
+    digest = hashlib.sha1(
+        f"marlin-trace:{request_id}".encode("utf-8")).hexdigest()
+    return digest[:32]
+
+
+def span_id_for(trace_id: str, name: str) -> str:
+    """16-hex span id derived from (trace_id, span name)."""
+    digest = hashlib.sha1(
+        f"{trace_id}:{name}".encode("utf-8")).hexdigest()
+    return digest[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One hop's view of a distributed trace: the fleet-wide trace id,
+    the caller's span id (the remote parent), and the sampling verdict
+    drawn once at the front door."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool
+
+    def to_header(self) -> str:
+        flag = "01" if self.sampled else "00"
+        return f"{_VERSION}-{self.trace_id}-{self.span_id}-{flag}"
+
+
+def mint(request_id, sampled: bool) -> TraceContext:
+    """Front-door mint: derive the trace id from the router-assigned
+    request id and parent replica-side spans under the front door's
+    ``fleet.request`` span."""
+    trace_id = trace_id_for(request_id)
+    return TraceContext(
+        trace_id=trace_id,
+        span_id=span_id_for(trace_id, "fleet.request"),
+        sampled=bool(sampled),
+    )
+
+
+def parse(header: Optional[str]) -> Optional[TraceContext]:
+    """Decode an ``X-Trace-Context`` header; tolerant — a missing,
+    malformed, or future-versioned header yields None (the replica then
+    traces standalone, exactly the pre-fleet behavior) rather than a
+    rejected request."""
+    if not header:
+        return None
+    m = _HEADER_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version != _VERSION:
+        return None  # future-versioned header: trace standalone
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # all-zero ids are invalid per W3C traceparent
+    try:
+        sampled = bool(int(flags, 16) & 0x01)
+    except ValueError:  # pragma: no cover — regex already guarantees hex
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id, sampled=sampled)

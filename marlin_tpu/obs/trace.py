@@ -26,6 +26,19 @@ reservoir (a min-heap keyed on total latency) or are dropped whole.
 ``serving_ttft_seconds``'s bucket exemplars (obs/metrics.py) carry the
 matching request ids, so a bad histogram bucket points at a retained
 trace. docs/observability.md §7 documents the retention policy.
+
+TAIL-BASED RETENTION + FLIGHT RECORDER (PR 18): head sampling at 1/N is
+blind to exactly the requests the SLO gates flag, so
+:meth:`finish_request` takes a ``keep`` verdict from the owner (the
+serving engine decides: SLO breach, error, preemption, crash replay,
+restore) and promotes the request's staged spans into the main event
+buffer even when the head draw dropped the trace. ``flight_k > 0``
+additionally keeps a ring of the last K FINISHED request traces
+regardless of either decision — the flight recorder dumped by
+``GET /debug/trace?flight=1`` and by :meth:`incident` on a crash.
+Distributed propagation (X-Trace-Context minting/parsing, stitching)
+lives in obs/distributed.py; the ``sampled=`` override on :meth:`span`
+is how a replica honors the front door's fleet-wide sampling decision.
 """
 
 from __future__ import annotations
@@ -67,12 +80,15 @@ class Tracer:
     at sampled rates too (tests/test_obs.py)."""
 
     def __init__(self, enabled: bool = False, max_events: int = 100_000,
-                 sample_rate: float = 1.0, exemplar_k: int = 0):
+                 sample_rate: float = 1.0, exemplar_k: int = 0,
+                 flight_k: int = 0):
         if not 0.0 < sample_rate <= 1.0:
             raise ValueError(
                 f"sample_rate must be in (0, 1], got {sample_rate}")
         if exemplar_k < 0:
             raise ValueError(f"exemplar_k must be >= 0, got {exemplar_k}")
+        if flight_k < 0:
+            raise ValueError(f"flight_k must be >= 0, got {flight_k}")
         self._enabled = bool(enabled)
         self._events: deque = deque(maxlen=max_events)  # guarded-by: _lock
         self._lock = threading.Lock()
@@ -89,8 +105,26 @@ class Tracer:
         # (total_s, seq, id, spans); seq tiebreak — spans never compare.
         self._exemplar_heap: List[tuple] = []  # guarded-by: _lock
         self._exemplar_seq = 0  # guarded-by: _lock
-        self._staged: "OrderedDict[str, List[dict]]" = \
+        # Staged entries are (event, head_kept) pairs: head_kept records
+        # whether the span already landed in _events at record time, so
+        # a tail-retention keep promotes only the missing spans (no
+        # duplicates when a trace was head-sampled AND tail-kept).
+        self._staged: "OrderedDict[str, List[tuple]]" = \
             OrderedDict()  # guarded-by: _lock
+        # Flight recorder: ring of the last K finished request traces,
+        # independent of head sampling and the tail-keep verdict — the
+        # "what just happened" buffer a crash or SLO-breach hook dumps.
+        self.flight_k = int(flight_k)
+        self._flight: deque = deque(maxlen=self.flight_k)  # guarded-by: _lock
+        # Requests finish_request tail-kept, so spans that close AFTER
+        # the verdict (the HTTP root wraps the engine's whole request
+        # lifecycle) can still join the kept trace via
+        # promote_request. Bounded like the staging area.
+        self._kept_rids: "OrderedDict[str, bool]" = \
+            OrderedDict()  # guarded-by: _lock
+        # When set (serving/server.py --trace-export), incident() dumps
+        # the flight ring next to this path on a crash.
+        self.crash_dump_path: Optional[str] = None
 
     # -- switches -----------------------------------------------------
 
@@ -111,6 +145,8 @@ class Tracer:
             self._exemplar_heap.clear()
             self._exemplar_seq = 0
             self._staged.clear()
+            self._flight.clear()
+            self._kept_rids.clear()
         self._epoch_ns = time.perf_counter_ns()
 
     # -- recording ----------------------------------------------------
@@ -135,8 +171,17 @@ class Tracer:
         r = self.sample_rate
         return int(i * r) != int((i - 1) * r)
 
+    def head_sample(self) -> bool:
+        """Draw one root-sampling decision WITHOUT opening a span — how
+        the fleet front door decides keep/drop once per request before
+        minting the X-Trace-Context header, then passes the same verdict
+        to its own span via ``sampled=`` so the decision is spent
+        exactly once fleet-wide."""
+        return self._sample_root()
+
     @contextlib.contextmanager
-    def span(self, name: str, *, scope: bool = True, **attrs):
+    def span(self, name: str, *, scope: bool = True,
+             sampled: Optional[bool] = None, **attrs):
         """Record one nested span; mirrors into ``TraceAnnotation`` (host
         timeline of a live ``jax.profiler`` trace) and — with
         ``scope=True`` — ``jax.named_scope`` (HLO op names of anything
@@ -145,7 +190,10 @@ class Tracer:
         name-stack push costs ~5 us/span and names nothing there — the
         jitted entry points carry their own module-level named scopes.
         No-op when disabled; a root span losing the ``sample_rate`` draw
-        drops its whole trace (class docstring)."""
+        drops its whole trace (class docstring). ``sampled=`` (roots
+        only) overrides the local draw with a decision made elsewhere —
+        a replica honoring the front door's X-Trace-Context flag keeps
+        or drops the trace coherently with the rest of the fleet."""
         if not self._enabled:
             yield
             return
@@ -153,15 +201,18 @@ class Tracer:
         if stack:
             parent, kept = stack[-1][0], stack[-1][1]
         else:
-            parent, kept = None, self._sample_root()
+            parent = None
+            kept = self._sample_root() if sampled is None else bool(sampled)
         stack.append((name, kept))
-        # Exemplar candidates bypass the sampling decision: a request-id-
-        # attributed span must exist even in a sampling-dropped trace,
-        # because finish_request may promote that request to the
-        # slowest-k reservoir (module docstring). Per-request spans are
-        # low-rate (submit/admit/chunks, never per-iteration), so the
-        # extra clock reads stay inside the <=5% overhead pin.
-        stage = bool(self.exemplar_k) and "request_id" in attrs
+        # Exemplar/tail candidates bypass the sampling decision: a
+        # request-id-attributed span must exist even in a sampling-
+        # dropped trace, because finish_request may promote that request
+        # to the slowest-k reservoir, the flight ring, or — tail-based
+        # retention — the main buffer (module docstring). Per-request
+        # spans are low-rate (submit/admit/chunks, never per-iteration),
+        # so the extra clock reads stay inside the <=5% overhead pin.
+        stage = bool(self.exemplar_k or self.flight_k) \
+            and "request_id" in attrs
         if not kept and not stage:  # dropped trace: bookkeeping only
             try:
                 yield
@@ -199,7 +250,7 @@ class Tracer:
                 if kept:
                     self._events.append(ev)
                 if stage:
-                    self._stage_locked(str(attrs["request_id"]), ev)
+                    self._stage_locked(str(attrs["request_id"]), ev, kept)
 
     def trace(self, fn=None, *, name: Optional[str] = None):
         """Decorator form of :meth:`span`."""
@@ -218,13 +269,14 @@ class Tracer:
 
     # -- tail exemplars -----------------------------------------------
 
-    def _stage_locked(self, request_id: str, ev: dict) -> None:  # marlint: holds=_lock
+    def _stage_locked(self, request_id: str, ev: dict,
+                      head_kept: bool) -> None:  # marlint: holds=_lock
         lst = self._staged.get(request_id)
         if lst is None:
             while len(self._staged) >= _EXEMPLAR_STAGING_CAP:
                 self._staged.popitem(last=False)  # oldest orphan out
             lst = self._staged[request_id] = []
-        lst.append(ev)
+        lst.append((ev, head_kept))
 
     def span_from_stamps(self, name: str, t0_s: float, t1_s: float,
                          **attrs) -> dict:
@@ -244,19 +296,61 @@ class Tracer:
         }
 
     def finish_request(self, request_id, total_s: float,
-                       extra_spans: Optional[List[dict]] = None) -> bool:
-        """Close a request's exemplar candidacy: its staged spans (plus
-        ``extra_spans``, e.g. synthesized phase segments) enter the
-        slowest-k reservoir if ``total_s`` ranks among the k slowest
-        requests seen, else are dropped whole. Returns True when
-        retained. No-op (False) with ``exemplar_k == 0``; cost per
-        request is one dict pop and at most one heap op."""
+                       extra_spans: Optional[List[dict]] = None,
+                       keep: bool = False, reason: str = "") -> bool:
+        """Close a request's retention candidacy. Its staged spans (plus
+        ``extra_spans``, e.g. synthesized phase segments) go three ways:
+        (1) ``keep=True`` — TAIL-BASED RETENTION — promotes the spans the
+        head draw dropped into the main event buffer (parents that don't
+        resolve within the request's own span set are stripped so the
+        export never dangles); (2) with ``flight_k`` the full span list
+        enters the last-K flight ring regardless of either sampling
+        decision; (3) with ``exemplar_k`` it enters the slowest-k
+        reservoir if ``total_s`` ranks. Returns True when retained by
+        any of the three. Cost per request stays one dict pop plus at
+        most one heap op and one ring append."""
         rid = str(request_id)
         with self._lock:
-            spans = self._staged.pop(rid, [])
-            if not self.exemplar_k:
+            staged = self._staged.pop(rid, [])
+            if not (self.exemplar_k or self.flight_k or keep):
                 return False
-            spans = spans + list(extra_spans or [])
+            spans = [ev for ev, _ in staged] + list(extra_spans or [])
+            retained = False
+            if keep:
+                # Remember the verdict: request-attributed spans that
+                # close AFTER this call (the HTTP root wraps the whole
+                # engine lifecycle) join the kept trace through
+                # promote_request.
+                self._kept_rids[rid] = True
+                while len(self._kept_rids) > _EXEMPLAR_STAGING_CAP:
+                    self._kept_rids.popitem(last=False)
+            if keep and spans:
+                # Tail promotion: only the spans the head draw dropped
+                # (extra_spans are synthesized, never in _events).
+                missing = [ev for ev, head_kept in staged
+                           if not head_kept] + list(extra_spans or [])
+                own_names = {ev["name"] for ev in spans}
+                live_names = {e["name"] for e in self._events}
+                for ev in missing:
+                    parent = ev.get("args", {}).get("parent")
+                    if parent is not None and parent not in own_names \
+                            and parent not in live_names:
+                        ev = dict(ev, args={k: v for k, v
+                                            in ev["args"].items()
+                                            if k != "parent"})
+                    self._events.append(ev)
+                retained = True
+            if self.flight_k:
+                self._flight.append({
+                    "request_id": rid,
+                    "total_s": float(total_s),
+                    "kept": bool(keep),
+                    "reason": reason,
+                    "spans": spans,
+                })
+                retained = True
+            if not self.exemplar_k:
+                return retained
             entry = (float(total_s), self._exemplar_seq, rid, spans)
             self._exemplar_seq += 1
             if len(self._exemplar_heap) < self.exemplar_k:
@@ -265,7 +359,46 @@ class Tracer:
             if entry[0] > self._exemplar_heap[0][0]:
                 heapq.heapreplace(self._exemplar_heap, entry)
                 return True
+            return retained
+
+    def promote_request(self, request_id) -> bool:
+        """Late-span promotion: the engine's tail verdict lands at
+        retire/drop time, BEFORE the HTTP root span wrapping the whole
+        request closes — so the root (and the respond span) re-enter
+        the staging area after finish_request already popped it. The
+        handler calls this once the root has closed: if the request was
+        tail-kept, the freshly staged head-dropped spans are promoted
+        (same dangling-parent strip as finish_request) and appended to
+        the request's flight/exemplar span lists so those exports are
+        complete too. Pops the staging entry either way (no orphan
+        growth); no-op for head-sampled or dropped requests."""
+        if not self._enabled:
             return False
+        rid = str(request_id)
+        with self._lock:
+            staged = self._staged.pop(rid, [])
+            if rid not in self._kept_rids:
+                return False
+            missing = [ev for ev, head_kept in staged if not head_kept]
+            if not missing:
+                return False
+            own_names = {ev["name"] for ev, _ in staged}
+            live_names = {e["name"] for e in self._events}
+            for ev in missing:
+                parent = ev.get("args", {}).get("parent")
+                if parent is not None and parent not in own_names \
+                        and parent not in live_names:
+                    ev = dict(ev, args={k: v for k, v
+                                        in ev["args"].items()
+                                        if k != "parent"})
+                self._events.append(ev)
+            for entry in self._flight:
+                if entry["request_id"] == rid:
+                    entry["spans"].extend(missing)
+            for _, _, heap_rid, spans in self._exemplar_heap:
+                if heap_rid == rid:
+                    spans.extend(missing)
+            return True
 
     def exemplars(self) -> List[dict]:
         """Retained tail exemplars, slowest first:
@@ -277,11 +410,68 @@ class Tracer:
 
     def exemplar_trace(self) -> Dict[str, Any]:
         """Chrome/Perfetto trace-event doc of ONLY the retained
-        exemplars' spans (``GET /debug/trace?exemplars=1``)."""
+        exemplars' spans (``GET /debug/trace?exemplars=1``). Parent
+        links that don't resolve within the doc are stripped so the
+        export is stitchable/loadable on its own."""
         evs: List[dict] = []
         for ex in self.exemplars():
             evs.extend(ex["spans"])
-        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+        return {"traceEvents": strip_dangling_parents(evs),
+                "displayTimeUnit": "ms"}
+
+    # -- distributed-trace links + flight recorder --------------------
+
+    def link_span(self, name: str, **attrs) -> Optional[dict]:
+        """Record one instantaneous link event OUTSIDE the sampling
+        draw — always kept, and staged when it carries a ``request_id``.
+        Used for rare causal markers a dropped trace must still show:
+        the ``serving.replayed`` link re-attaching a crash-replayed
+        request to its original trace (frontend.py), quarantine marks.
+        Returns the event (None when disabled)."""
+        if not self._enabled:
+            return None
+        now_s = time.perf_counter()
+        ev = self.span_from_stamps(name, now_s, now_s, **attrs)
+        with self._lock:
+            self._events.append(ev)
+            if (self.exemplar_k or self.flight_k) and "request_id" in attrs:
+                self._stage_locked(str(attrs["request_id"]), ev, True)
+        return ev
+
+    def flight_recorder(self) -> List[dict]:
+        """The last-K finished request traces, oldest first:
+        ``[{request_id, total_s, kept, reason, spans}, ...]``."""
+        with self._lock:
+            return list(self._flight)
+
+    def flight_trace(self) -> Dict[str, Any]:
+        """Chrome/Perfetto trace-event doc of the flight ring
+        (``GET /debug/trace?flight=1`` and crash dumps)."""
+        evs: List[dict] = []
+        for entry in self.flight_recorder():
+            evs.extend(entry["spans"])
+        return {"traceEvents": strip_dangling_parents(evs),
+                "displayTimeUnit": "ms"}
+
+    def incident(self, tag: str, **attrs) -> Optional[str]:
+        """Crash/SLO-breach hook: record a ``trace.incident`` link event
+        and, when ``crash_dump_path`` is set, dump the flight ring to
+        ``<crash_dump_path>.incident.json`` (last incident wins — it is
+        a flight recorder, not an archive). Returns the dump path when
+        written."""
+        if not self._enabled:
+            return None
+        self.link_span("trace.incident", incident=tag, **attrs)
+        path = self.crash_dump_path
+        if not path:
+            return None
+        dump = str(path) + ".incident.json"
+        try:
+            with open(dump, "w") as f:
+                json.dump(self.flight_trace(), f, default=str)
+        except OSError:
+            return None  # a failing dump must never take down serving
+        return dump
 
     # -- export -------------------------------------------------------
 
@@ -298,6 +488,23 @@ class Tracer:
         with open(path, "w") as f:
             json.dump(self.to_chrome_trace(), f, default=str)
         return path
+
+
+def strip_dangling_parents(events: List[dict]) -> List[dict]:
+    """Return copies of ``events`` with any ``args.parent`` that names a
+    span absent from the set dropped — partial exports (exemplars, the
+    flight ring, tail-kept traces) must load in Perfetto with zero
+    dangling parent links even though their enclosing non-request spans
+    (serving.round, ...) were not retained."""
+    names = {ev.get("name") for ev in events}
+    out: List[dict] = []
+    for ev in events:
+        parent = ev.get("args", {}).get("parent")
+        if parent is not None and parent not in names:
+            ev = dict(ev, args={k: v for k, v in ev["args"].items()
+                                if k != "parent"})
+        out.append(ev)
+    return out
 
 
 # Process-default tracer: the serving engine, generate(), and the bench

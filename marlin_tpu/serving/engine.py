@@ -1062,7 +1062,7 @@ class ServingEngine:
                              round=self.round_idx,
                              deadline_rounds=req.deadline_rounds,
                              wait_s=req.finish_time - req.submit_time)
-            self._finish_exemplar(req)
+            self._finish_trace(req)
             # Same ownership transfer as retirement: timed-out requests
             # go back to the caller, not into an ever-growing dict (the
             # lock pairs the delete with submit()'s insert).
@@ -1288,6 +1288,7 @@ class ServingEngine:
         req.frozen = None
         self.host_tier.drop_row(fz.host_key)
         self.host_tier.record_row_restore(nbytes, dt)
+        req.restores += 1
         self._n_resumes += 1
         self.stats.record_resume(req)
         self.scheduler.note_resume(req)
@@ -1538,6 +1539,7 @@ class ServingEngine:
             dt = time.perf_counter() - t0
             self.prefix_index.rebind(restore["eid"], res_pages)
             self.host_tier.record_restore(restore["nbytes"], dt)
+            req.restores += 1
             self._host_tier_event(
                 "restore", request_id=req.request_id,
                 length=restore["hit"], bytes=restore["nbytes"],
@@ -1814,7 +1816,7 @@ class ServingEngine:
                 rounds=req.finish_round - req.admit_round + 1,
                 phases={k: round(v, 6)
                         for k, v in req.phases().items()})
-            self._finish_exemplar(req)
+            self._finish_trace(req)
             # Ownership of a finished request transfers to the caller
             # (step()/run() return it); holding it here would grow host
             # memory without bound on a long-running server — the queue
@@ -1825,14 +1827,39 @@ class ServingEngine:
             finished.append(req)
         return finished
 
-    def _finish_exemplar(self, req: Request) -> None:
-        """Close a retired/timed-out request's tail-exemplar candidacy:
-        synthesize its contiguous phase segments as trace events and let
-        the tracer's slowest-k reservoir decide (obs/trace.py). A
-        tracer without exemplar retention makes this one attribute
-        read."""
+    def _trace_retention_reasons(self, req: Request) -> List[str]:
+        """TAIL-BASED RETENTION verdict (docs/observability.md §10):
+        why this request's full trace must survive the head-sampling
+        draw — it is exactly the requests the SLO gates flag that 1/N
+        sampling is blind to. Empty list = no forced keep."""
+        reasons: List[str] = []
+        if req.status != "done":
+            reasons.append(req.status or "error")  # timeout / poisoned
+        if req.preempt_count:
+            reasons.append("preempted")
+        if req.restores:
+            reasons.append("restored")
+        if req.crash_count or req.requeues:
+            reasons.append("crash")
+        if self.scheduler is not None and req.sched_class \
+                and req.admit_start_time:
+            spec = self.scheduler.classes.get(req.sched_class)
+            slo = getattr(spec, "slo_s", None)
+            if slo is not None \
+                    and req.admit_start_time - req.submit_time > slo:
+                reasons.append("slo_breach")
+        return reasons
+
+    def _finish_trace(self, req: Request) -> None:
+        """Close a retired/timed-out request's trace candidacy:
+        synthesize its contiguous phase segments as trace events, decide
+        tail-based retention (_trace_retention_reasons), and let the
+        tracer's three sinks — tail promotion into the main buffer, the
+        flight ring, the slowest-k exemplar reservoir — take it
+        (obs/trace.py). A tracer without exemplar/flight retention
+        makes this one attribute read."""
         tr_ = self.tracer
-        if not (tr_.enabled and tr_.exemplar_k):
+        if not (tr_.enabled and (tr_.exemplar_k or tr_.flight_k)):
             return
         spans = []
         rid = req.request_id
@@ -1856,7 +1883,11 @@ class ServingEngine:
                 "serving.phase.queue_wait", req.submit_time,
                 req.finish_time, request_id=rid, status="timeout"))
         total = max(0.0, req.finish_time - req.submit_time)
-        tr_.finish_request(rid, total, extra_spans=spans)
+        reasons = self._trace_retention_reasons(req)
+        if reasons:
+            self.stats.record_trace_kept(reasons)
+        tr_.finish_request(rid, total, extra_spans=spans,
+                           keep=bool(reasons), reason=",".join(reasons))
 
     def step(self) -> List[Request]:
         """One scheduling round: admit into free rows, decode one

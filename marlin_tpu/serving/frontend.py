@@ -460,6 +460,7 @@ class EngineFrontend:
         self._undelivered = []
         poisoned: List = []
         poisoned_handles: List = []
+        replayed: List = []
         err: Optional[EngineFailed] = None
         # 2+3. Restart budget and capture + swap, atomic vs submit()
         #    (same lock): a concurrent submission lands wholly in the
@@ -532,6 +533,7 @@ class EngineFrontend:
                              if r.request_id not in poison_ids]
                 new_eng = eng.spawn_successor()
                 new_eng.requeue(survivors, crash_time=now)
+                replayed = survivors
                 self.engine = new_eng
                 self.restarts += 1
                 self.metrics.counter(
@@ -541,9 +543,25 @@ class EngineFrontend:
                 poisoned_handles = [
                     self._handles.pop(r.request_id, None)
                     for r in poisoned]
+        tr_ = eng.tracer  # spawn_successor carries the same tracer
         if fail_closed:
+            if tr_.enabled:
+                tr_.incident("engine_failed", error=type(exc).__name__)
             self._abandon(err)
             return False
+        # Re-attach replayed requests to their original (possibly
+        # fleet-minted) trace: an explicit link span, recorded outside
+        # the sampling draw and staged by request id, marks the crash
+        # replay on the SAME trace — and the crash hook dumps the
+        # flight ring while the evidence is fresh (obs/trace.py).
+        if tr_.enabled:
+            for r in replayed:
+                tr_.link_span("serving.replayed",
+                              request_id=r.request_id,
+                              crash_count=r.crash_count,
+                              requeues=r.requeues, link="replayed")
+            tr_.incident("engine_crash", error=type(exc).__name__,
+                         replayed=len(replayed))
         # 4. Quarantine verdicts, outside the lock (event sets + queue
         #    puts only).
         for req, h in zip(poisoned, poisoned_handles):
@@ -555,6 +573,16 @@ class EngineFrontend:
                 "quarantine", request_id=req.request_id,
                 crash_count=req.crash_count,
                 error=f"{type(exc).__name__}: {exc}")
+            # A quarantined request never reaches the engine's finish
+            # hook — close its trace here, force-kept (errored).
+            if tr_.enabled and (tr_.exemplar_k or tr_.flight_k):
+                tr_.link_span("serving.quarantined",
+                              request_id=req.request_id,
+                              crash_count=req.crash_count)
+                self.engine.stats.record_trace_kept(["poisoned"])
+                tr_.finish_request(
+                    req.request_id, max(0.0, now - req.submit_time),
+                    keep=True, reason="poisoned")
             if h is not None:
                 h._fail(perr)
         self._wake.set()  # recovered work is ready to schedule

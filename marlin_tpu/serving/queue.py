@@ -90,6 +90,11 @@ class Request:
     # times this request has been frozen.
     frozen: Optional[object] = None
     preempt_count: int = 0
+    # Host-KV restore ledger (engine._bind_entry_pages / thaw): how many
+    # times this request's KV pages came back from the host spill tier.
+    # Tail-based trace retention (obs/trace.py) keeps any trace that
+    # crossed a restore, so the marker must survive requeues.
+    restores: int = 0
     # Engine-owned lifecycle fields:
     key: Optional[np.ndarray] = None  # (2,) uint32 per-request PRNG root,
     # derived at admission as fold_in(engine key, request_id) — fully

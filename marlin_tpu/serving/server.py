@@ -37,7 +37,15 @@ dependencies beyond the stdlib. Endpoints (docs/frontend.md):
   FIFO engine (docs/serving.md §8).
 * ``GET /debug/trace`` — Chrome/Perfetto trace-event JSON of the
   process tracer's buffer (``?exemplars=1``: only the slowest-k
-  exemplar traces).
+  exemplar traces; ``?flight=1``: the flight-recorder ring of the last
+  K finished request traces).
+
+Distributed tracing (docs/observability.md §10): a forwarded
+``X-Trace-Context`` header (minted at the fleet front door,
+obs/distributed.py) turns the handler into a remote-parent root span —
+replica spans join the caller's trace and honor its sampled flag; the
+``--trace*`` CLI flags size the tracer, ``--trace-export`` writes the
+per-process Chrome export after drain for ``tools/trace_stitch.py``.
 
 Every generate response carries a ``timing`` block — the request's
 per-phase latency attribution (queue_wait/admit/decode summing exactly
@@ -77,11 +85,13 @@ import json
 import threading
 import time
 import urllib.parse
+import contextlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from ..obs import distributed as dtrace
 from . import faults
 from .frontend import (EngineFrontend, FrontendError, PoisonedRequest)
 from .queue import QueueClosed, QueueFull
@@ -179,9 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, info, route)
         elif path == "/debug/trace":
             params = urllib.parse.parse_qs(query)
-            doc = (self.server.tracer.exemplar_trace()
-                   if params.get("exemplars", ["0"])[-1] == "1"
-                   else self.server.tracer.to_chrome_trace())
+            if params.get("exemplars", ["0"])[-1] == "1":
+                doc = self.server.tracer.exemplar_trace()
+            elif params.get("flight", ["0"])[-1] == "1":
+                doc = self.server.tracer.flight_trace()
+            else:
+                doc = self.server.tracer.to_chrome_trace()
             self._send_json(200, doc, "/debug/trace")
         else:
             self._send_json(404, {"error": f"no route {path}"}, path)
@@ -219,38 +232,77 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request: {e}"}, route)
             return
         http_id = self.headers.get("X-Request-Id")
-        try:
-            with self.server.tracer.span("http.request", scope=False,
-                                         route=route,
-                                         http_id=http_id or ""):
-                handle = self.frontend.submit(
-                    prompt, steps, deadline_s=deadline_s, stream=stream,
-                    request_id=request_id, tenant=tenant,
-                    sched_class=sched_class)
-        except QueueFull as e:
-            self._send_json(429, {"error": str(e)}, route,
-                            headers={"Retry-After": RETRY_AFTER_S})
-            return
-        except (QueueClosed, FrontendError) as e:
-            self._send_json(503, {"error": str(e)}, route,
-                            headers={"Retry-After": RETRY_AFTER_S})
-            return
-        except ValueError as e:
-            self._send_json(400, {"error": str(e)}, route)
-            return
-        # The id echo: the caller's X-Request-Id comes back verbatim
-        # when sent; the engine id always travels (it is the key the
-        # runlog events and trace spans carry).
-        id_headers = {"X-Engine-Request-Id": handle.request_id,
-                      "X-Request-Id": http_id or str(handle.request_id)}
-        with self.server.tracer.span("http.respond", scope=False,
-                                     request_id=handle.request_id,
-                                     http_id=http_id or "",
-                                     stream=stream):
-            if stream:
-                self._respond_stream(handle, route, id_headers)
-            else:
-                self._respond_blocking(handle, route, id_headers)
+        # Fleet hop (docs/observability.md §10): a forwarded
+        # X-Trace-Context makes this handler a REMOTE-PARENT ROOT — the
+        # replica's spans join the caller's trace under the front
+        # door's span, and the sampled flag minted there overrides the
+        # local head-sampling draw so the trace is kept or dropped
+        # coherently fleet-wide. No header = standalone root, exactly
+        # the pre-fleet behavior.
+        ctx = dtrace.parse(self.headers.get(dtrace.TRACE_HEADER))
+        if ctx is not None:
+            rid_attr = {} if request_id is None \
+                else {"request_id": request_id}
+            root = self.server.tracer.span(
+                "serving.http", scope=False, sampled=ctx.sampled,
+                route=route, http_id=http_id or "",
+                trace_id=ctx.trace_id, remote_parent=ctx.span_id,
+                **rid_attr)
+        else:
+            root = contextlib.nullcontext()
+        with root:
+            try:
+                with self.server.tracer.span("http.request", scope=False,
+                                             route=route,
+                                             http_id=http_id or ""):
+                    handle = self.frontend.submit(
+                        prompt, steps, deadline_s=deadline_s,
+                        stream=stream, request_id=request_id,
+                        tenant=tenant, sched_class=sched_class)
+            except QueueFull as e:
+                self._send_json(429, {"error": str(e)}, route,
+                                headers={"Retry-After": RETRY_AFTER_S})
+                return
+            except (QueueClosed, FrontendError) as e:
+                self._send_json(503, {"error": str(e)}, route,
+                                headers={"Retry-After": RETRY_AFTER_S})
+                return
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)}, route)
+                return
+            # Runlog correlation, BODY-WINS precedence (the PR 17
+            # X-Sched-Class convention): the engine identity is the
+            # body's router-assigned id; the caller's X-Request-Id
+            # header rides along as correlation only, never as the
+            # runlog key.
+            if http_id is not None or ctx is not None:
+                self.server.runlog.emit(
+                    "trace_ctx", request_id=handle.request_id,
+                    **({"http_id": http_id}
+                       if http_id is not None else {}),
+                    **({"trace_id": ctx.trace_id,
+                        "sampled": ctx.sampled}
+                       if ctx is not None else {}))
+            # The id echo: the caller's X-Request-Id comes back
+            # verbatim when sent; the engine id always travels (it is
+            # the key the runlog events and trace spans carry).
+            id_headers = {"X-Engine-Request-Id": handle.request_id,
+                          "X-Request-Id": http_id
+                          or str(handle.request_id)}
+            with self.server.tracer.span("http.respond", scope=False,
+                                         request_id=handle.request_id,
+                                         http_id=http_id or "",
+                                         stream=stream):
+                if stream:
+                    self._respond_stream(handle, route, id_headers)
+                else:
+                    self._respond_blocking(handle, route, id_headers)
+        # Late-span promotion (docs/observability.md §10): the engine's
+        # tail verdict fired at retire/drop time, while this handler's
+        # root/respond spans were still open — now that they have
+        # closed, a tail-kept request pulls them into its trace so the
+        # export has its serving.http root (no-op otherwise).
+        self.server.tracer.promote_request(handle.request_id)
 
     def _finish_fields(self, req, handle=None) -> dict:
         out = {"request_id": req.request_id, "status": req.status,
@@ -523,6 +575,25 @@ def main(argv=None) -> int:
                         "is quarantined as poison")
     p.add_argument("--runlog", default=None,
                    help="stream engine runlog JSONL to this path")
+    p.add_argument("--trace", action="store_true",
+                   help="enable the process tracer (distributed: a "
+                        "forwarded X-Trace-Context joins this "
+                        "replica's spans to the caller's trace)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="head sampling rate for trace roots (1/N of "
+                        "traces kept; tail-based retention keeps "
+                        "SLO-breached/errored/preempted/restored "
+                        "requests regardless)")
+    p.add_argument("--trace-exemplar-k", type=int, default=8,
+                   help="slowest-k tail-exemplar reservoir size")
+    p.add_argument("--trace-flight-k", type=int, default=16,
+                   help="flight-recorder ring: last K finished request "
+                        "traces (GET /debug/trace?flight=1, crash "
+                        "dumps)")
+    p.add_argument("--trace-export", default=None,
+                   help="write the Chrome trace export here after "
+                        "drain; crashes dump the flight ring to "
+                        "<path>.incident.json")
     p.add_argument("--force-cpu", action="store_true",
                    help="pin jax to the CPU backend (smoke/demo hosts)")
     args = p.parse_args(argv)
@@ -537,6 +608,7 @@ def main(argv=None) -> int:
 
     from ..models import TransformerConfig, init_params
     from ..obs.runlog import RunLog
+    from ..obs.trace import Tracer
     from .sched import Scheduler
 
     cfg = TransformerConfig(
@@ -545,6 +617,13 @@ def main(argv=None) -> int:
         max_len=args.max_len, dtype="float32")
     params = init_params(cfg, seed=args.seed)
     runlog = RunLog(path=args.runlog) if args.runlog else None
+    tracer = None
+    if args.trace:
+        tracer = Tracer(enabled=True, sample_rate=args.trace_sample,
+                        exemplar_k=args.trace_exemplar_k,
+                        flight_k=args.trace_flight_k)
+        if args.trace_export:
+            tracer.crash_dump_path = args.trace_export
     # Chaos arming (tier-1 fault smoke, tests/test_faults.py): a JSON
     # fault plan in MARLIN_FAULT_PLAN injects deterministic crashes the
     # supervisor must recover from; absent, this is a no-op.
@@ -573,7 +652,8 @@ def main(argv=None) -> int:
                       if args.spill_dir is not None else {}),
                    **({"restore_min_tokens": args.restore_min_tokens}
                       if args.restore_min_tokens is not None else {}),
-                   **({"scheduler": Scheduler()} if args.sched else {}))
+                   **({"scheduler": Scheduler()} if args.sched else {}),
+                   **({"tracer": tracer} if tracer is not None else {}))
     drained = install_signal_handlers(server)
     print(f"SERVING host={args.host} port={server.port}", flush=True)
     try:
@@ -582,6 +662,10 @@ def main(argv=None) -> int:
         # serve_forever exits via the drain's shutdown(); wait for the
         # drain to finish sealing before reporting success.
         drained.wait(60.0)
+        if tracer is not None and args.trace_export:
+            # Post-drain: the driver is parked, every request's spans
+            # (head-kept + tail-retained) are final.
+            tracer.export(args.trace_export)
     print("DRAINED", flush=True)
     return 0
 

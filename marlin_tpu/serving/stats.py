@@ -185,6 +185,12 @@ class EngineStats:
     n_spec_drafted: int = 0
     n_spec_accepted: int = 0
     spec_accept_ewma: Optional[float] = None
+    # Tail-based trace retention ledger (obs/trace.py, PR 18): finished
+    # requests whose FULL trace was force-kept past the head-sampling
+    # draw, bucketed by the retention reason the engine decided
+    # (slo_breach / timeout / poisoned / preempted / restored / crash).
+    n_traces_kept: int = 0
+    traces_kept_by_reason: Dict[str, int] = field(default_factory=dict)
     rounds: deque = field(
         default_factory=lambda: deque(maxlen=HISTORY))  # guarded-by: _lock
     completed: deque = field(
@@ -322,6 +328,21 @@ class EngineStats:
                 "serving_preempted_total",
                 help="live rows frozen to the host KV tier by the "
                      "scheduler (bit-exact preemption)").inc()
+
+    def record_trace_kept(self, reasons) -> None:
+        """One finished request's trace was tail-retained (kept in full
+        past the head-sampling draw) for ``reasons`` — the engine's
+        retention verdict (engine._finish_trace)."""
+        self.n_traces_kept += 1
+        for reason in reasons:
+            self.traces_kept_by_reason[reason] = \
+                self.traces_kept_by_reason.get(reason, 0) + 1
+        if self.registry is not None:
+            for reason in reasons:
+                self.registry.counter(
+                    "serving_traces_kept_total", reason=reason,
+                    help="request traces force-retained by tail-based "
+                         "retention, by reason").inc()
 
     def record_resume(self, req) -> None:
         """One frozen request thawed back onto a device row
@@ -540,6 +561,11 @@ class EngineStats:
                     self.reclaimed_prefill_flops / 1e9, 4),
                 "admission_copy_bytes": self.admission_copy_bytes,
                 "zero_copy_hits": self.n_zero_copy_hits,
+            })
+        if self.n_traces_kept:
+            out.update({
+                "traces_kept": self.n_traces_kept,
+                "traces_kept_by_reason": dict(self.traces_kept_by_reason),
             })
         if self.n_spec_drafted:
             out.update({

@@ -1,0 +1,515 @@
+"""Distributed tracing (docs/observability.md §10): X-Trace-Context
+propagation, coherent fleet-wide retention, body-wins request-id
+correlation, the trace stitcher, and the fleet-path overhead pin.
+
+Layers:
+
+* UNIT — obs/distributed.py: header mint/parse round-trip, tolerant
+  parsing (malformed → standalone behavior), deterministic ids.
+* PROPERTY — two REAL in-process HTTP replicas behind a simulated
+  front door: for every sampled/unsampled/tail-kept interleaving, a
+  kept request's trace is complete (root + children, zero dangling
+  parents) on exactly the replica that served it, a dropped request's
+  trace is absent entirely, and responses are identical (up to the
+  measured ``timing`` block) with tracing on vs off.
+* STITCH — tools/trace_stitch.py against the committed fixture
+  (tests/data/fleet_trace/: real per-process exports from a traced
+  2-replica fleet run): merges clean, ``--check`` passes in tier-1,
+  and tampered artifacts fail the check.
+* FLEET — a REAL traced fleet (subprocess replicas): exports stitch
+  into one Perfetto-loadable timeline, the deadline-expired request is
+  tail-kept at a 1/64 head rate, the flight recorder answers on the
+  front door, and X-Request-Id precedence is body-wins in the replica
+  runlog. Plus the 5%-overhead pin extended to the fleet path.
+"""
+
+import glob
+import importlib.util
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.obs import distributed as dtrace
+from marlin_tpu.obs.runlog import RunLog
+from marlin_tpu.obs.trace import Tracer
+from marlin_tpu.serving.server import serve
+
+HOST = "127.0.0.1"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "data", "fleet_trace")
+_STITCH_CLI = os.path.join(_REPO, "tools", "trace_stitch.py")
+
+# The per-request span names the retention verdict governs (the
+# serving.http root and everything opened inside it on the handler
+# thread). Engine-thread spans (serving.round and its children, incl.
+# serving.admit) are round-timeline roots sampled by the replica's own
+# rate and legitimately survive a dropped request.
+_REQUEST_SPANS = ("serving.http", "serving.submit", "http.respond")
+
+
+@pytest.fixture(scope="module")
+def ts():
+    spec = importlib.util.spec_from_file_location(
+        "trace_stitch", _STITCH_CLI)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["trace_stitch"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                            n_layers=1, d_ff=128, max_len=64,
+                            dtype="float32")
+    return init_params(cfg, seed=0), cfg
+
+
+def _post(port, body, headers=None, timeout=60.0):
+    conn = http.client.HTTPConnection(HOST, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(body).encode(),
+                     headers or {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30.0):
+    conn = http.client.HTTPConnection(HOST, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+# -- unit: the header -------------------------------------------------
+
+
+class TestTraceContext:
+    def test_mint_parse_round_trip(self):
+        ctx = dtrace.mint(42, True)
+        assert ctx.trace_id == dtrace.trace_id_for(42)
+        assert ctx.span_id == dtrace.span_id_for(ctx.trace_id,
+                                                 "fleet.request")
+        hdr = ctx.to_header()
+        assert hdr == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        back = dtrace.parse(hdr)
+        assert back == ctx
+
+    def test_sampled_flag_round_trips_both_ways(self):
+        assert dtrace.mint(7, False).to_header().endswith("-00")
+        assert dtrace.mint(7, True).to_header().endswith("-01")
+        assert dtrace.parse(dtrace.mint(7, False).to_header()) \
+            .sampled is False
+
+    def test_deterministic_ids(self):
+        # No entropy enters the serving path: the same request id
+        # always derives the same trace — a replayed/restarted request
+        # re-attaches to its original timeline by construction.
+        assert dtrace.mint(9, True) == dtrace.mint(9, True)
+        assert dtrace.trace_id_for(9) != dtrace.trace_id_for(10)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage",
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "b" * 16,          # missing flags
+    ])
+    def test_malformed_headers_parse_none(self, bad):
+        assert dtrace.parse(bad) is None
+
+
+# -- property: coherent retention across two replicas ------------------
+
+
+def _strip_timing(raw: bytes) -> dict:
+    obj = json.loads(raw)
+    obj.pop("timing", None)
+    return obj
+
+
+class TestRetentionCoherence:
+    def _run_arm(self, model, traced: bool, pattern, prompts):
+        """Serve ``pattern`` = [(sampled, tail), ...] across two REAL
+        in-process HTTP replicas. The front door is simulated: an
+        explicit body request_id (the router contract) plus a minted
+        X-Trace-Context carrying the head verdict. ``tail`` rides a
+        microscopic queue deadline — the request deterministically
+        expires before admission (504, status != done), the engine's
+        tail-retention trigger. Returns (responses, tracers)."""
+        params, cfg = model
+        servers, tracers = [], []
+        for _ in range(2):
+            tr = Tracer(enabled=traced, exemplar_k=4, flight_k=4)
+            servers.append(serve(
+                params, cfg, port=0, batch=2, round_steps=2,
+                max_pending=16, seed=0, tracer=tr,
+                runlog=RunLog()).start_background())
+            tracers.append(tr)
+        out = []
+        try:
+            for i, (sampled, tail) in enumerate(pattern):
+                rid = 1000 + i
+                body = {"prompt": prompts[i], "steps": 3,
+                        "request_id": rid}
+                if tail:
+                    body["deadline_s"] = 1e-6
+                headers = {"Content-Type": "application/json"}
+                if traced:
+                    headers[dtrace.TRACE_HEADER] = \
+                        dtrace.mint(rid, sampled).to_header()
+                st, data, hdrs = _post(servers[i % 2].port, body,
+                                       headers)
+                assert st == (504 if tail else 200), (st, data)
+                # Byte-transparency on the wire: tracing adds no
+                # response headers.
+                assert dtrace.TRACE_HEADER not in hdrs
+                out.append((st, data))
+        finally:
+            for s in servers:
+                s.close_now()
+        return out, tracers
+
+    def test_all_interleavings_coherent_and_byte_identical(self, model):
+        # Every (sampled, tail) combination, spread across both
+        # replicas in both orders — 8 requests cover the 4 combos twice
+        # with replica assignment flipped.
+        pattern = [(s, t) for s in (True, False) for t in (True, False)]
+        pattern = pattern + pattern[::-1]
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(1, 60, 5).tolist()
+                   for _ in range(len(pattern))]
+        on, tracers = self._run_arm(model, True, pattern, prompts)
+        off, _ = self._run_arm(model, False, pattern, prompts)
+        # Identical outputs, tracing on vs off: same status codes and
+        # same bodies up to the measured timing block (tokens, ids,
+        # status — the deterministic payload — byte-for-byte).
+        assert [st for st, _ in on] == [st for st, _ in off]
+        for (_, a), (_, b) in zip(on, off):
+            assert _strip_timing(a) == _strip_timing(b)
+        for i, (sampled, tail) in enumerate(pattern):
+            rid = 1000 + i
+            events = tracers[i % 2].events()
+            other = tracers[(i + 1) % 2].events()
+            req = [e for e in events
+                   if e["name"] in _REQUEST_SPANS
+                   and e.get("args", {}).get("request_id") == rid]
+            # The OTHER replica never saw this request.
+            assert not [e for e in other
+                        if e.get("args", {}).get("request_id") == rid]
+            if sampled or tail:
+                # Kept: the remote-parent root is present, carries the
+                # minted trace id, and every parent link resolves
+                # within the export (no dangling parents).
+                roots = [e for e in req if e["name"] == "serving.http"]
+                assert len(roots) == 1, (rid, req)
+                assert roots[0]["args"]["trace_id"] == \
+                    dtrace.trace_id_for(rid)
+                assert roots[0]["args"]["remote_parent"] == \
+                    dtrace.span_id_for(dtrace.trace_id_for(rid),
+                                       "fleet.request")
+                names = {e["name"] for e in events}
+                for e in req:
+                    parent = e.get("args", {}).get("parent")
+                    assert parent is None or parent in names, e
+            else:
+                # Dropped: the request's trace is absent ENTIRELY.
+                assert req == [], (rid, req)
+
+    def test_tail_promotion_never_duplicates_head_kept(self, model):
+        # A request that is BOTH head-sampled and tail-kept (sampled
+        # deadline miss) appears exactly once per span name.
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 60, 5).tolist()]
+        _, tracers = self._run_arm(model, True, [(True, True)], prompts)
+        req = [e for e in tracers[0].events()
+               if e.get("args", {}).get("request_id") == 1000]
+        names = [e["name"] for e in req]
+        assert len(names) == len(set(names)), names
+
+
+# -- body-wins X-Request-Id precedence (PR 17 convention) -------------
+
+
+class TestBodyWinsCorrelation:
+    def test_header_rides_as_correlation_only(self, model):
+        params, cfg = model
+        runlog = RunLog()
+        srv = serve(params, cfg, port=0, batch=2, round_steps=2,
+                    max_pending=8, seed=0,
+                    tracer=Tracer(enabled=True, exemplar_k=2,
+                                  flight_k=2),
+                    runlog=runlog).start_background()
+        try:
+            ctx = dtrace.mint(7007, True)
+            st, data, hdrs = _post(
+                srv.port,
+                {"prompt": [1, 2, 3], "steps": 2, "request_id": 7007},
+                {"Content-Type": "application/json",
+                 "X-Request-Id": "corr-abc",
+                 dtrace.TRACE_HEADER: ctx.to_header()})
+            assert st == 200
+            obj = json.loads(data)
+            # Engine identity is the BODY's router-assigned id; the
+            # caller's header comes back verbatim as correlation.
+            assert obj["request_id"] == 7007
+            assert hdrs["X-Engine-Request-Id"] == "7007"
+            assert hdrs["X-Request-Id"] == "corr-abc"
+        finally:
+            srv.close_now()
+        # The runlog joins all three identities on the engine key.
+        (ev,) = runlog.events("trace_ctx")
+        assert ev["request_id"] == 7007
+        assert ev["http_id"] == "corr-abc"
+        assert ev["trace_id"] == ctx.trace_id
+        assert ev["sampled"] is True
+        # The engine's own timeline is keyed on the body id — the
+        # header id never becomes a runlog key.
+        assert any(e["request_id"] == 7007
+                   for e in runlog.events("submit"))
+        assert not any(e.get("request_id") == "corr-abc"
+                       for e in runlog.events())
+
+    def test_correlation_without_trace_context(self, model):
+        # Pre-fleet callers: X-Request-Id alone still correlates.
+        params, cfg = model
+        runlog = RunLog()
+        srv = serve(params, cfg, port=0, batch=2, round_steps=2,
+                    max_pending=8, seed=0,
+                    runlog=runlog).start_background()
+        try:
+            st, data, _ = _post(
+                srv.port, {"prompt": [1, 2, 3], "steps": 2},
+                {"Content-Type": "application/json",
+                 "X-Request-Id": "solo-1"})
+            assert st == 200
+            rid = json.loads(data)["request_id"]
+        finally:
+            srv.close_now()
+        (ev,) = runlog.events("trace_ctx")
+        assert ev["request_id"] == rid and ev["http_id"] == "solo-1"
+        assert "trace_id" not in ev
+
+
+# -- the stitcher against the committed fixture ------------------------
+
+
+def _fixture_paths():
+    return [os.path.join(_FIXTURE, n) for n in
+            ("frontdoor.trace.json", "replica0.trace.json",
+             "replica1.trace.json")]
+
+
+class TestStitchFixture:
+    def test_fixture_stitches_clean(self, ts):
+        paths = _fixture_paths()
+        doc = ts.stitch([(p, ts.load_trace(p)) for p in paths])
+        assert ts.check(doc) == []
+        evs = doc["traceEvents"]
+        assert doc["metadata"]["n_processes"] == 3
+        # One flow arrow per fleet hop: every head-kept request links
+        # its fleet.request span to the replica's serving.http root.
+        starts = [e for e in evs if e.get("ph") == "s"]
+        finishes = [e for e in evs if e.get("ph") == "f"]
+        assert len(starts) == len(finishes) == 3
+        assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+        # Clock alignment: every arrow points forward in stitched time.
+        fin_ts = {e["id"]: e["ts"] for e in finishes}
+        for s in starts:
+            assert fin_ts[s["id"]] >= s["ts"]
+        # Distinct pids per process, metadata names them for Perfetto.
+        assert {e["pid"] for e in evs} == {0, 1, 2}
+        meta = {e["pid"]: e["args"]["name"] for e in evs
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert meta[0] == "fleet.frontdoor"
+
+    def test_cli_stitch_and_check_exit_zero(self, ts, tmp_path):
+        out = str(tmp_path / "stitched.json")
+        r = subprocess.run(
+            [sys.executable, _STITCH_CLI, *_fixture_paths(), "-o", out],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        r = subprocess.run([sys.executable, _STITCH_CLI, "--check", out],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_check_rejects_tampering(self, ts, tmp_path):
+        paths = _fixture_paths()
+        clean = ts.stitch([(p, ts.load_trace(p)) for p in paths])
+
+        def tampered(mutate):
+            doc = json.loads(json.dumps(clean))
+            mutate(doc)
+            return ts.check(doc)
+
+        def drop_flow_finish(doc):
+            evs = doc["traceEvents"]
+            evs.remove(next(e for e in evs if e.get("ph") == "f"))
+
+        def dangle_parent(doc):
+            span = next(e for e in doc["traceEvents"]
+                        if e.get("ph") == "X")
+            span.setdefault("args", {})["parent"] = "no.such.span"
+
+        def scramble_clock(doc):
+            spans = [e for e in doc["traceEvents"]
+                     if e.get("ph") == "X"]
+            spans[-1]["ts"] = spans[0]["ts"] - 1e9
+
+        def break_schema(doc):
+            doc["traceEvents"] = "nope"
+
+        for mutate in (drop_flow_finish, dangle_parent,
+                       scramble_clock, break_schema):
+            assert tampered(mutate), mutate.__name__
+        # And the CLI exit code carries the verdict.
+        bad = json.loads(json.dumps(clean))
+        drop_flow_finish(bad)
+        path = str(tmp_path / "tampered.json")
+        with open(path, "w") as f:
+            json.dump(bad, f)
+        r = subprocess.run([sys.executable, _STITCH_CLI, "--check",
+                            path], capture_output=True, text=True)
+        assert r.returncode == 1
+
+
+# -- the real fleet: propagation, tail retention, flight recorder ------
+
+
+class TestFleetTracing:
+    def test_traced_fleet_stitches_and_tail_keeps(self, fleet_factory,
+                                                  tmp_path, ts):
+        trace_dir = str(tmp_path / "traces")
+        server = fleet_factory(n_replicas=2, trace=True,
+                               trace_sample=1.0 / 64,
+                               trace_export_dir=trace_dir)
+        port = server.port
+        rids = []
+        for i in range(4):
+            st, data, hdrs = _post(port, {"prompt": [1 + i, 2, 3],
+                                          "steps": 3})
+            assert st == 200, (st, data)
+            rids.append(json.loads(data)["request_id"])
+        # A deadline-expired request: 504, status != done — must be
+        # tail-kept in FULL despite the 1/64 head rate.
+        st, data, _ = _post(port, {"prompt": [9, 9, 9], "steps": 3,
+                                   "deadline_s": 1e-6})
+        assert st == 504, (st, data)
+        expired_rid = json.loads(data)["request_id"]
+        # Flight recorder answers on the FRONT DOOR (and replicas).
+        st, body = _get(port, "/debug/trace?flight=1")
+        assert st == 200
+        flight = json.loads(body)["traceEvents"]
+        assert any(e.get("args", {}).get("request_id") is not None
+                   for e in flight)
+        assert server.begin_drain(120.0)
+        paths = sorted(glob.glob(os.path.join(trace_dir,
+                                              "*.trace.json")))
+        assert len(paths) == 3  # frontdoor + 2 replica incarnations
+        doc = ts.stitch([(p, ts.load_trace(p)) for p in paths])
+        assert ts.check(doc) == []
+        stitched_rids = {e["args"]["request_id"]
+                         for e in doc["traceEvents"]
+                         if e.get("args", {}).get("request_id")
+                         is not None}
+        # The expired request's trace survived tail retention; its
+        # serving.http root is present on whichever replica served it.
+        assert expired_rid in stitched_rids
+        assert any(e["name"] == "serving.http"
+                   and e["args"].get("request_id") == expired_rid
+                   for e in doc["traceEvents"])
+
+    def test_body_wins_through_the_front_door(self, fleet_factory,
+                                              tmp_path):
+        runlog_dir = str(tmp_path / "runlogs")
+        server = fleet_factory(n_replicas=2, runlog_dir=runlog_dir,
+                               trace=True, trace_sample=1.0)
+        st, data, hdrs = _post(server.port,
+                               {"prompt": [1, 2, 3], "steps": 2},
+                               {"Content-Type": "application/json",
+                                "X-Request-Id": "caller-77"})
+        assert st == 200
+        rid = json.loads(data)["request_id"]
+        assert hdrs["X-Request-Id"] == "caller-77"
+        assert hdrs["X-Engine-Request-Id"] == str(rid)
+        assert server.begin_drain(120.0)
+        ctx_events = []
+        for path in glob.glob(os.path.join(runlog_dir,
+                                           "replica*.jsonl")):
+            with open(path) as f:
+                for line in f:
+                    ev = json.loads(line)
+                    if ev.get("kind") == "trace_ctx":
+                        ctx_events.append(ev)
+        (ev,) = [e for e in ctx_events if e.get("http_id")]
+        assert ev["request_id"] == rid  # body id is the runlog key
+        assert ev["http_id"] == "caller-77"  # header = correlation
+        assert ev["trace_id"] == dtrace.trace_id_for(rid)
+
+
+# -- the 5% pin, fleet path -------------------------------------------
+
+
+class TestFleetOverhead:
+    def test_traced_fleet_within_5pct_of_untraced(self, fleet_factory,
+                                                  tmp_path):
+        # The PR-3/PR-4 instrumentation pin extended to the fleet path:
+        # front door + 2 replicas with tracing enabled (1/64 head
+        # sampling + tail retention + flight rings) vs the same fleet
+        # untraced, identical workloads. Same measurement discipline as
+        # tests/test_obs.py: arms INTERLEAVE so machine drift hits
+        # both, and min-of-trials OR median-of-trials within 1.05x
+        # passes (a real overhead fails both estimators, a scheduler
+        # hiccup cannot). Requests decode 40 steps so the trial window
+        # is decode-dominated — per-request fixed costs (HTTP framing,
+        # port-to-port variance between two distinct fleets) would
+        # otherwise swamp a 5% pin on a ~25 ms window.
+        arms = {
+            "off": fleet_factory(
+                n_replicas=2,
+                runlog_dir=str(tmp_path / "rl_off")),
+            "on": fleet_factory(
+                n_replicas=2, trace=True, trace_sample=1.0 / 64,
+                runlog_dir=str(tmp_path / "rl_on"),
+                trace_export_dir=str(tmp_path / "tr_on")),
+        }
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 60, 6).tolist() for _ in range(4)]
+
+        def trial(server):
+            t0 = time.perf_counter()
+            for p in prompts:
+                st, data, _ = _post(server.port,
+                                    {"prompt": p, "steps": 40})
+                assert st == 200, (st, data)
+            return time.perf_counter() - t0
+
+        for server in arms.values():  # warmup: compiles out of band
+            trial(server)
+        times = {name: [] for name in arms}
+        for _ in range(8):
+            for name, server in arms.items():
+                times[name].append(trial(server))
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        # Trimmed mean (drop the 2 slowest trials) is the most stable
+        # of the three against one-off scheduler spikes; min and median
+        # each key off a single order statistic of 8 samples and swing
+        # several percent between two OS-distinct fleet instances even
+        # at zero true overhead.
+        tmean = lambda xs: sum(sorted(xs)[:-2])  # noqa: E731
+        ok_min = min(times["on"]) <= min(times["off"]) * 1.05
+        ok_med = med(times["on"]) <= med(times["off"]) * 1.05
+        ok_tmean = tmean(times["on"]) <= tmean(times["off"]) * 1.05
+        assert ok_min or ok_med or ok_tmean, times

@@ -152,6 +152,17 @@ def build_requests(events: List[dict]) -> Dict[int, dict]:
             r.update(status="poisoned",
                      crash_count=ev.get("crash_count"),
                      quarantine_error=ev.get("error"))
+        elif kind == "trace_ctx":
+            # Distributed-trace correlation (docs/observability.md
+            # §10): the record stays keyed on the BODY's request_id —
+            # the caller's X-Request-Id and the fleet's trace_id ride
+            # along as annotations only (body-wins precedence).
+            r = rec(ev["request_id"])
+            if ev.get("trace_id") is not None:
+                r.update(trace_id=ev["trace_id"],
+                         trace_sampled=ev.get("sampled"))
+            if ev.get("http_id") is not None:
+                r["http_id"] = ev["http_id"]
     return reqs
 
 
@@ -487,6 +498,7 @@ def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
                              if r.get("status") == "poisoned"),
         "engine_failed": any(ev["kind"] == "engine_failed"
                              for ev in events),
+        "n_traced": sum(1 for r in reqs.values() if "trace_id" in r),
         "crashes": cycles,
         "rounds": round_series(events, batch),
         "requests": sorted(reqs.values(),
@@ -625,12 +637,22 @@ def build_fleet_report(entries: List[dict],
         for ev in routes:
             pol = str(ev.get("policy"))
             by_policy[pol] = by_policy.get(pol, 0) + 1
+        # Front-door trace mints: rid -> trace_id, the join key the
+        # stitcher uses; narrated next to the request ids so a human
+        # can hop from a runlog anomaly to the Perfetto timeline.
+        mints = [ev for ev in router_events
+                 if ev["kind"] == "fleet_trace"]
         router = {
             "n_events": len(router_events),
             "n_routes": len(routes),
             "routes_by_policy": by_policy,
             "n_failovers": sum(1 for ev in router_events
                                if ev["kind"] == "fleet_failover"),
+            "n_traces_minted": len(mints),
+            "n_traces_sampled": sum(1 for ev in mints
+                                    if ev.get("sampled")),
+            "trace_ids": {int(ev["request_id"]): ev.get("trace_id")
+                          for ev in mints},
         }
     return {
         "fleet": True,
@@ -667,6 +689,16 @@ def _human_fleet(report: dict) -> str:
                         sorted(r["routes_by_policy"].items()))
         lines.append(f"router: {r['n_routes']} route(s) ({pol}), "
                      f"{r['n_failovers']} failover(s)")
+        if r.get("n_traces_minted"):
+            ids = sorted(r["trace_ids"].items())
+            pairs = ", ".join(
+                f"rid {rid} -> {tid[:12]}" for rid, tid in ids[:8])
+            more = ("" if len(ids) <= 8
+                    else f", ... {len(ids) - 8} more")
+            lines.append(
+                f"traces: {r['n_traces_minted']} context(s) minted at "
+                f"the front door, {r['n_traces_sampled']} head-sampled "
+                f"({pairs}{more})")
     lines.append(
         f"request ids: {report['n_unique_request_ids']} unique across "
         f"the fleet, {report['n_replayed_after_abandonment']} "
@@ -688,6 +720,16 @@ def _human(report: dict) -> str:
         f"{report['n_completed']} completed, "
         f"{report['n_timeout']} timed out",
     ]
+    if report.get("n_traced"):
+        traced = [r for r in report["requests"] if r.get("trace_id")]
+        pairs = ", ".join(
+            f"rid {r['request_id']} -> {r['trace_id'][:12]}"
+            + ("" if r.get("trace_sampled") else " (unsampled)")
+            for r in traced[:8])
+        more = ("" if len(traced) <= 8
+                else f", ... {len(traced) - 8} more")
+        lines.append(f"traces: {report['n_traced']} request(s) joined "
+                     f"a fleet trace ({pairs}{more})")
     if report["n_crashes"]:
         lines.append(
             f"crashes: {report['n_crashes']} engine crash(es), "

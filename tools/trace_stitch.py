@@ -1,0 +1,338 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome trace exports into ONE fleet timeline.
+
+Each fleet process — the front door and every replica incarnation —
+exports its own Chrome/Perfetto ``trace_event`` JSON with its own
+``perf_counter`` epoch and ``pid 0``. Loaded separately they are
+disconnected islands; this tool produces a single Perfetto-loadable
+timeline (``python tools/trace_stitch.py frontdoor.trace.json
+replica0.trace.json ... -o fleet.json``):
+
+* **pid assignment** — the front door becomes pid 0, each replica
+  incarnation its own pid (named via ``ph:"M"`` process_name metadata),
+  so Perfetto renders one track group per process.
+* **clock alignment** — both sides of a hop stamp the SAME router-
+  minted request id (the front door's ``fleet.request`` span, the
+  replica's ``serving.http`` root). For each replica file the offset is
+  ``max(front_door_ts - replica_ts)`` over the shared request ids: the
+  minimum-network-delay estimator, which also guarantees no replica
+  root renders before the front-door span that caused it.
+* **flow events** — one Chrome flow (``ph:"s"`` at the front door,
+  ``ph:"f"`` bound to the replica root) per shared request id draws the
+  front-door→replica arrow.
+* **hygiene** — parent links that do not resolve within their process's
+  retained span set are stripped, so the stitched artifact has zero
+  dangling parent or flow links by construction.
+
+``--check stitched.json`` validates an artifact (schema, monotone
+timestamps per track, zero unmatched flow ids, zero dangling parents)
+and is the tier-1 CI gate for trace fixtures. Exit codes follow
+runlog_report: 0 ok, 1 validation problems, 2 unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Replica export filenames, mirroring fleet/config.py runlog naming:
+# replica0.trace.json (incarnation 0) / replica0.r2.trace.json.
+_REPLICA_RE = re.compile(r"^replica(\d+)(?:\.r(\d+))?\.trace\.json$")
+_FRONTDOOR_RE = re.compile(r"^frontdoor\.trace\.json$")
+
+_VALID_PH = {"X", "M", "s", "f", "i"}
+
+
+def load_trace(path: str) -> List[dict]:
+    """Load one export; accepts ``{"traceEvents": [...]}`` or a bare
+    event list (both are valid Chrome trace JSON)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        raise ValueError(f"{path}: not a Chrome trace document")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    return events
+
+
+def classify_trace(path: str) -> Tuple[str, Optional[int], int]:
+    """(role, replica_index, incarnation) from the export filename;
+    unknown names fall back to content sniffing in :func:`stitch`."""
+    name = path.replace("\\", "/").rsplit("/", 1)[-1]
+    if _FRONTDOOR_RE.match(name):
+        return "frontdoor", None, 0
+    m = _REPLICA_RE.match(name)
+    if m:
+        return "replica", int(m.group(1)), int(m.group(2) or 0)
+    return "unknown", None, 0
+
+
+def _request_spans(events: List[dict], name: str) -> Dict[str, dict]:
+    """request_id -> earliest span named ``name`` carrying that id."""
+    out: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != name:
+            continue
+        rid = ev.get("args", {}).get("request_id")
+        if rid is None:
+            continue
+        rid = str(rid)
+        if rid not in out or ev["ts"] < out[rid]["ts"]:
+            out[rid] = ev
+    return out
+
+
+def _flow_id(rid: str) -> int:
+    try:
+        return int(rid)
+    except ValueError:
+        return abs(hash(rid)) % (1 << 31)
+
+
+def stitch(inputs: List[Tuple[str, List[dict]]]) -> Dict[str, Any]:
+    """Merge ``[(path, events), ...]`` into one trace document."""
+    entries = []
+    for path, events in inputs:
+        role, replica, incarnation = classify_trace(path)
+        if role == "unknown":
+            # Content sniff: only the front door records fleet.request.
+            role = "frontdoor" if any(
+                e.get("name") == "fleet.request" for e in events) \
+                else "replica"
+        entries.append({"path": path, "role": role, "replica": replica,
+                        "incarnation": incarnation, "events": events})
+
+    # pid 0 = front door; replicas in (index, incarnation, path) order.
+    front = [e for e in entries if e["role"] == "frontdoor"]
+    reps = sorted((e for e in entries if e["role"] != "frontdoor"),
+                  key=lambda e: (e["replica"] if e["replica"] is not None
+                                 else 1 << 30,
+                                 e["incarnation"], e["path"]))
+    for e in front:
+        e["pid"] = 0
+    for i, e in enumerate(reps):
+        e["pid"] = i + 1
+
+    fd_spans: Dict[str, dict] = {}
+    for e in front:
+        fd_spans.update(_request_spans(e["events"], "fleet.request"))
+
+    out: List[dict] = []
+    flows: List[dict] = []
+    n_hops = 0
+    for e in front:
+        name = "fleet.frontdoor"
+        out.append({"name": "process_name", "ph": "M", "pid": e["pid"],
+                    "tid": 0, "args": {"name": name}})
+    for e in reps:
+        if e["replica"] is not None:
+            name = f"fleet.replica{e['replica']}"
+            if e["incarnation"]:
+                name += f".r{e['incarnation']}"
+        else:
+            name = e["path"].rsplit("/", 1)[-1]
+        out.append({"name": "process_name", "ph": "M", "pid": e["pid"],
+                    "tid": 0, "args": {"name": name}})
+
+    for e in front:
+        for ev in e["events"]:
+            out.append(dict(ev, pid=e["pid"]))
+
+    for e in reps:
+        roots = _request_spans(e["events"], "serving.http")
+        shared = {rid: root for rid, root in roots.items()
+                  if rid in fd_spans}
+        # Minimum-network-delay clock alignment (module docstring).
+        offset = max((fd_spans[rid]["ts"] - root["ts"]
+                      for rid, root in shared.items()), default=0.0)
+        for ev in e["events"]:
+            ev = dict(ev, pid=e["pid"])
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = ev["ts"] + offset
+            out.append(ev)
+        for rid, root in sorted(shared.items()):
+            fd_ev = fd_spans[rid]
+            fid = _flow_id(rid)
+            args = {"request_id": rid}
+            trace_id = root.get("args", {}).get("trace_id")
+            if trace_id:
+                args["trace_id"] = trace_id
+            flows.append({"name": "fleet.hop", "cat": "fleet",
+                          "ph": "s", "id": fid, "ts": fd_ev["ts"],
+                          "pid": 0, "tid": fd_ev.get("tid", 0),
+                          "args": dict(args)})
+            flows.append({"name": "fleet.hop", "cat": "fleet",
+                          "ph": "f", "bp": "e", "id": fid,
+                          "ts": root["ts"] + offset, "pid": e["pid"],
+                          "tid": root.get("tid", 0),
+                          "args": dict(args)})
+            n_hops += 1
+
+    out.extend(flows)
+
+    # Per-process parent hygiene: strip links that don't resolve
+    # within the pid's own retained span set.
+    names_by_pid: Dict[int, set] = {}
+    for ev in out:
+        if ev.get("ph") == "X":
+            names_by_pid.setdefault(ev["pid"], set()).add(ev["name"])
+    cleaned: List[dict] = []
+    for ev in out:
+        parent = ev.get("args", {}).get("parent")
+        if parent is not None and ev.get("ph") == "X" \
+                and parent not in names_by_pid.get(ev["pid"], set()):
+            ev = dict(ev, args={k: v for k, v in ev["args"].items()
+                                if k != "parent"})
+        cleaned.append(ev)
+
+    # Stable render order: metadata first, then (pid, tid, ts); flow
+    # "s" before "f" at equal stamps so arrows always point forward.
+    def _key(ev):
+        meta = 0 if ev.get("ph") == "M" else 1
+        ph_rank = {"s": 0, "X": 1, "f": 2}.get(ev.get("ph"), 1)
+        return (ev.get("pid", 0), meta, ev.get("tid", 0),
+                ev.get("ts", 0.0), ph_rank)
+
+    cleaned.sort(key=_key)
+    return {"traceEvents": cleaned, "displayTimeUnit": "ms",
+            "metadata": {"tool": "trace_stitch",
+                         "n_processes": len(entries),
+                         "n_hops": n_hops}}
+
+
+def check(doc: Any) -> List[str]:
+    """Validate a stitched artifact; returns a list of problems
+    (empty = Perfetto-loadable per our invariants)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        return ["not a trace document (missing traceEvents list)"]
+    events = doc["traceEvents"]
+    last_ts: Dict[tuple, float] = {}
+    flow_s: Dict[Any, int] = {}
+    flow_f: Dict[Any, int] = {}
+    names_by_pid: Dict[Any, set] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        name, ph = ev.get("name"), ev.get("ph")
+        if not isinstance(name, str) or not name:
+            problems.append(f"event {i}: missing name")
+        if ph not in _VALID_PH:
+            problems.append(f"event {i} ({name}): bad ph {ph!r}")
+            continue
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i} ({name}): non-numeric ts")
+            continue
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i} ({name}): bad dur {dur!r}")
+            names_by_pid.setdefault(ev.get("pid", 0), set()).add(name)
+        track = (ev.get("pid", 0), ev.get("tid", 0))
+        if ts < last_ts.get(track, float("-inf")):
+            problems.append(
+                f"event {i} ({name}): ts {ts} not monotone on track "
+                f"pid={track[0]} tid={track[1]}")
+        last_ts[track] = ts
+        if ph in ("s", "f"):
+            fid = ev.get("id")
+            if fid is None:
+                problems.append(f"event {i} ({name}): flow without id")
+            elif ph == "s":
+                flow_s[fid] = flow_s.get(fid, 0) + 1
+            else:
+                flow_f[fid] = flow_f.get(fid, 0) + 1
+    for fid, n in sorted(flow_s.items(), key=str):
+        if flow_f.get(fid, 0) != n:
+            problems.append(
+                f"flow id {fid}: {n} start(s) vs "
+                f"{flow_f.get(fid, 0)} finish(es)")
+    for fid, n in sorted(flow_f.items(), key=str):
+        if fid not in flow_s:
+            problems.append(f"flow id {fid}: finish without start")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        parent = ev.get("args", {}).get("parent")
+        if parent is not None and parent not in names_by_pid.get(
+                ev.get("pid", 0), set()):
+            problems.append(
+                f"event {i} ({ev.get('name')}): dangling parent "
+                f"{parent!r} in pid {ev.get('pid', 0)}")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("traces", nargs="*",
+                   help="per-process Chrome trace exports "
+                        "(frontdoor.trace.json, replicaN[.rK]"
+                        ".trace.json, ...)")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the stitched trace here "
+                        "(default: stdout)")
+    p.add_argument("--check", metavar="STITCHED", default=None,
+                   help="validate an existing stitched artifact "
+                        "instead of stitching")
+    args = p.parse_args(argv)
+
+    if args.check is not None:
+        if args.traces:
+            p.error("--check takes no positional inputs")
+        try:
+            with open(args.check) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+        problems = check(doc)
+        for prob in problems:
+            print(f"PROBLEM: {prob}", file=sys.stderr)
+        n = len(doc.get("traceEvents", []) if isinstance(doc, dict)
+                else [])
+        print(f"check {args.check}: {n} events, "
+              f"{len(problems)} problem(s)")
+        return 1 if problems else 0
+
+    if not args.traces:
+        p.error("nothing to stitch: pass trace exports or --check")
+    inputs = []
+    for path in args.traces:
+        try:
+            inputs.append((path, load_trace(path)))
+        except (OSError, ValueError) as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+    if not any(events for _, events in inputs):
+        print("ERROR: no trace events in any input", file=sys.stderr)
+        return 2
+    doc = stitch(inputs)
+    problems = check(doc)
+    for prob in problems:
+        print(f"PROBLEM: {prob}", file=sys.stderr)
+    meta = doc["metadata"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, default=str)
+        print(f"stitched {meta['n_processes']} process(es), "
+              f"{meta['n_hops']} hop(s), "
+              f"{len(doc['traceEvents'])} events -> {args.out}")
+    else:
+        print(json.dumps(doc, default=str))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
