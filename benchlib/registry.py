@@ -10,7 +10,7 @@ silently drops out of the dead-tunnel fallback.
 
 from . import (configs_fleet, configs_gemm, configs_http,
                configs_kernels, configs_linalg, configs_ml,
-               configs_sparse, configs_trend)
+               configs_sparse, configs_tp, configs_trend)
 
 CONFIGS = {
     "headline": [configs_gemm.headline],
@@ -40,6 +40,7 @@ CONFIGS = {
     "tenants": [configs_trend.config_tenants],
     "http": [configs_http.config_http],
     "fleet": [configs_fleet.config_fleet],
+    "serving_tp": [configs_tp.config_serving_tp],
     "sweep": [configs_gemm.config_dispatch_sweep],
     "attnsweep": [configs_kernels.config_attention_sweep],
 }
@@ -50,5 +51,5 @@ CONFIGS["all"] = [
     fns[0] for k, fns in CONFIGS.items()
     if k not in ("sweep", "attnsweep", "trend", "serving",
                  "serving_spec", "serving_host_kv", "tenants", "http",
-                 "fleet")
+                 "fleet", "serving_tp")
 ]

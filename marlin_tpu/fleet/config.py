@@ -54,6 +54,15 @@ class FleetConfig:
     # default interactive/batch/best_effort class table; the front door
     # forwards each request's tenant/sched_class fields verbatim.
     sched: bool = False
+    # Tensor parallelism (docs/fleet.md §worker groups): each replica
+    # is spawned as a worker GROUP of this degree — one supervised
+    # process whose engine shards the model over tp_degree devices
+    # (single-process SPMD; on the CPU fleet the devices are forced
+    # host devices, set in replica_environ). The supervisor treats the
+    # group as one unit: one /readyz (with a device quorum), one drain,
+    # one restart budget. All replicas share one degree — failover
+    # byte-exactness requires interchangeable peers.
+    tp_degree: int = 1
     # Per-replica (in-process) supervisor budget — PR 7's knobs.
     max_restarts: int = 3
     restart_window_s: float = 60.0
@@ -122,6 +131,9 @@ class FleetConfig:
             raise ValueError(
                 f"trace_sample must be in (0, 1], got "
                 f"{self.trace_sample}")
+        if self.tp_degree < 1:
+            raise ValueError(
+                f"tp_degree must be >= 1, got {self.tp_degree}")
 
     # -- derived -------------------------------------------------------
 
@@ -198,6 +210,8 @@ class FleetConfig:
                      str(self.restore_min_tokens)]
         if self.sched:
             argv += ["--sched"]
+        if self.tp_degree > 1:
+            argv += ["--tp", str(self.tp_degree)]
         runlog = self.replica_runlog(index, incarnation)
         if runlog is not None:
             argv += ["--runlog", runlog]
@@ -230,6 +244,18 @@ class FleetConfig:
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_ENABLE_X64"] = "True"
         env["JAX_THREEFRY_PARTITIONABLE"] = "true"
+        if self.tp_degree > 1:
+            # The worker group's mesh: tp_degree forced host devices,
+            # pinned here (not inherited) so a replica's device count
+            # is a function of the fleet config, never of whatever
+            # XLA_FLAGS the parent test/bench process happened to run
+            # under. Strip any inherited count first.
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count")]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{self.tp_degree}")
+            env["XLA_FLAGS"] = " ".join(flags)
         # A replica must not inherit a fault plan aimed at a sibling.
         env.pop("MARLIN_FAULT_PLAN", None)
         for i, name, value in self.replica_env:

@@ -111,6 +111,7 @@ class Replica:
                 "incarnation": self._incarnation,
                 "restarts_in_window": len(self._restart_times),
                 "max_restarts": self.config.replica_max_restarts,
+                "tp_degree": self.config.tp_degree,
                 "trace_path": self.config.replica_trace(
                     self.index, self._incarnation),
             }
@@ -236,6 +237,16 @@ class Replica:
             resp = conn.getresponse()
             body = resp.read()
             if resp.status == 200:
+                if self.config.tp_degree > 1:
+                    # Worker-group quorum: a TP replica that came up on
+                    # fewer devices than its degree is NOT ready even
+                    # if its engine thinks it is (belt and suspenders —
+                    # the engine's mesh build normally fails first).
+                    try:
+                        if json.loads(body).get("tp_quorum") is False:
+                            return False, False
+                    except (json.JSONDecodeError, AttributeError):
+                        pass
                 return True, False
             try:
                 return False, bool(json.loads(body).get("draining"))

@@ -65,12 +65,19 @@ _SAMPLE_RE = re.compile(
     r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{(.*)\})?\s+(.*)$")
 
 
-def inject_replica_label(text: str, replica: int) -> str:
+def inject_replica_label(text: str, replica: int,
+                         tp_degree: int = 1) -> str:
     """Rewrite every sample line of a Prometheus exposition with a
     ``replica="<i>"`` label prepended; comment/blank lines are dropped
     (the aggregate keeps HELP/TYPE only for the router's own series —
-    per-replica duplicates would conflict)."""
+    per-replica duplicates would conflict). With ``tp_degree > 1`` a
+    ``tp_degree="<d>"`` label rides along: the replica label still names
+    the worker GROUP (one supervised process spanning ``tp_degree``
+    devices), so group members never appear as duplicate replicas —
+    dashboards divide per-group series by the degree for per-device
+    views."""
     out = []
+    extra = (f',tp_degree="{tp_degree}"' if tp_degree > 1 else "")
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
@@ -78,8 +85,8 @@ def inject_replica_label(text: str, replica: int) -> str:
         if m is None:
             continue
         name, _, labels, value = m.groups()
-        merged = f'replica="{replica}"' + (f",{labels}" if labels
-                                           else "")
+        merged = (f'replica="{replica}"' + extra
+                  + (f",{labels}" if labels else ""))
         out.append(f"{name}{{{merged}}} {value}")
     return "\n".join(out)
 
@@ -258,7 +265,8 @@ class FleetSupervisor:
             text = self.scrape_replica(i)
             if text is None:
                 continue
-            labeled = inject_replica_label(text, i)
+            labeled = inject_replica_label(
+                text, i, tp_degree=self.config.tp_degree)
             if labeled:
                 parts.append(labeled)
         return "\n".join(p for p in parts if p) + "\n"
@@ -273,6 +281,7 @@ class FleetSupervisor:
             "router": counters,
             "n_healthy": self.n_healthy,
             "min_ready": self.config.min_ready,
+            "tp_degree": self.config.tp_degree,
         }
 
 
@@ -721,6 +730,10 @@ def main(argv=None) -> int:
     p.add_argument("--kv-pages", type=int, default=None)
     p.add_argument("--prefill-chunk", type=int, default=None)
     p.add_argument("--min-ready", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1,
+                   help="worker-group degree: each replica is one "
+                        "process sharding the model over this many "
+                        "forced host devices (docs/fleet.md)")
     p.add_argument("--replica-max-restarts", type=int, default=2)
     p.add_argument("--no-affinity", action="store_true")
     p.add_argument("--runlog-dir", default=None,
@@ -748,6 +761,7 @@ def main(argv=None) -> int:
         max_pending=args.max_pending, temperature=args.temperature,
         seed=args.seed, kv_pages=args.kv_pages,
         prefill_chunk=args.prefill_chunk, min_ready=args.min_ready,
+        tp_degree=args.tp,
         replica_max_restarts=args.replica_max_restarts,
         affinity=not args.no_affinity, runlog_dir=args.runlog_dir,
         trace=args.trace, trace_sample=args.trace_sample,

@@ -1,0 +1,263 @@
+"""Single-process tensor parallelism over a named ``model`` mesh axis.
+
+The serving-grade TP path (docs/serving.md §TP). Unlike
+:func:`transformer.shard_params` — which places GSPMD sharding
+constraints and lets XLA partition the unmodified forward — this module
+runs the forward *body* under ``shard_map``: every device executes the
+same Python with LOCAL extents (``cfg.tp_heads`` / ``cfg.tp_kv_heads`` /
+``cfg.tp_ff``), and the only cross-device communication is the explicit
+collective inside :func:`transformer._tp_out`.
+
+Why a second TP path exists at all: bit-exactness. The engine's
+byte-exact failover and golden-replay contracts require the TP>1 logits
+to be IDENTICAL to TP=1, not allclose. GSPMD may re-tile or re-associate
+reductions however it likes; ``shard_map`` pins the schedule we wrote.
+In the default ``tp_mode="gather"`` layout every weight matrix is
+column-sharded, activations are all_gathered around full-contraction
+matmuls, and every output element is one full-width dot product computed
+on exactly one device — the same floating-point reduction order as the
+unsharded model, hence bit-identical. ``tp_mode="psum"`` (Megatron
+row-parallel down projections, one psum per sub-layer) halves the
+collectives but splits the contraction, so it is allclose-only.
+
+Parameter layout (gather mode):
+
+====================  =========================  =======================
+leaf                  spec                       note
+====================  =========================  =======================
+wqkv                  P(None, 'model')           column-PERMUTED so each
+                                                 device holds whole heads
+                                                 ``[q_i | k_i | v_i]``
+wo, w1, w2            P(None, 'model')           contiguous column blocks
+b1                    P('model')                 rides with w1's columns
+b2, lns, embed, pos   P() (replicated)           bias added post-gather
+====================  =========================  =======================
+
+int8 params shard as ``{"q8", "s8"}`` pairs: block-weight scales are
+per-OUTPUT-column ``(1, cols)`` (models/quant.py), so q8 and s8 are
+permuted and sharded together and local dequantization is bit-equal to
+slicing the globally dequantized matrix. In psum mode only wo/w2 change:
+q8 row-sharded ``P('model', None)``, s8 (per-output-column) replicated.
+
+KV caches and page pools keep their GLOBAL rank-4 layouts with heads at
+axis 2 — ``(B, L, Hk, Dh)`` rows, ``(P+1, PAGE, Hk, Dh)`` pages,
+``(..., Hk, 1)`` int8 scales — so one prefix spec :data:`KV_SPEC` covers
+the whole cache subtree and the serving engine's paged gather/scatter
+runs unchanged on local heads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import transformer as tr
+
+# The TP mesh axis name. Distinct from the 'mr'/'mc' marlin grid axes
+# (mesh.py) and the SP engines' axes — validate_tp rejects composition.
+AXIS = "model"
+
+# Prefix spec for every KV-cache/pool leaf: heads live at axis 2 in all
+# of them (k/v rows, int8 scales, page pools), so a single spec shards
+# the whole subtree on the head axis.
+KV_SPEC = P(None, None, AXIS, None)
+
+
+@functools.lru_cache(maxsize=None)
+def tp_mesh(tp: int) -> Mesh:
+    """The 1-D ``('model',)`` mesh over the first ``tp`` devices. Cached:
+    mesh identity is part of jit cache keys, and every entry point of one
+    engine must reuse the same mesh or recompile."""
+    devices = jax.devices()
+    if tp > len(devices):
+        raise ValueError(
+            f"tp {tp} exceeds the {len(devices)} visible devices; on CPU "
+            "raise XLA_FLAGS=--xla_force_host_platform_device_count")
+    return Mesh(np.asarray(devices[:tp]), (AXIS,))
+
+
+def qkv_permutation(cfg: tr.TransformerConfig) -> np.ndarray:
+    """Column permutation taking the packed ``[Q | K | V]`` wqkv layout to
+    per-device blocks ``[q_0|k_0|v_0 | q_1|k_1|v_1 | ...]`` so a plain
+    contiguous ``P(None, 'model')`` split hands device ``i`` whole query
+    heads ``[i*H/tp, (i+1)*H/tp)`` plus their matching KV-head group —
+    grouped attention then needs no communication at all."""
+    d = cfg.d_model
+    dh = d // cfg.n_heads
+    hk = cfg.kv_heads
+    q_cols = np.arange(cfg.n_heads * dh)
+    k_cols = cfg.n_heads * dh + np.arange(hk * dh)
+    v_cols = (cfg.n_heads + hk) * dh + np.arange(hk * dh)
+    hl, hkl = cfg.tp_heads, cfg.tp_kv_heads
+    parts = []
+    for i in range(cfg.tp):
+        parts.append(q_cols[i * hl * dh:(i + 1) * hl * dh])
+        parts.append(k_cols[i * hkl * dh:(i + 1) * hkl * dh])
+        parts.append(v_cols[i * hkl * dh:(i + 1) * hkl * dh])
+    return np.concatenate(parts)
+
+
+def param_specs(cfg: tr.TransformerConfig, quantized: bool):
+    """PartitionSpec pytree matching ``init_params`` (and its int8
+    quantization) leaf-for-leaf — shard_map in_specs and the device_put
+    placement in :func:`tp_shard_params` share this single layout."""
+    colp = P(None, AXIS)
+    rowp = cfg.tp_mode == "psum"
+    down_w = P(AXIS, None) if rowp else colp
+    # Per-output-column scales cannot follow row-sharded q8 rows; they
+    # replicate in psum mode and ride the columns in gather mode.
+    down_s = P() if rowp else colp
+
+    def w(spec_w, spec_s):
+        return {"q8": spec_w, "s8": spec_s} if quantized else spec_w
+
+    ln = {"g": P(), "b": P()}
+    blk = {
+        "ln1": dict(ln),
+        "ln2": dict(ln),
+        "wqkv": w(colp, colp),
+        "wo": w(down_w, down_s),
+        "w1": w(colp, colp),
+        "b1": P(AXIS),
+        "w2": w(down_w, down_s),
+        "b2": P(),
+    }
+    specs = {
+        "embed": w(P(), P()),
+        "ln_f": dict(ln),
+        "blocks": [dict(blk) for _ in range(cfg.n_layers)],
+    }
+    if not cfg.rope:
+        specs["pos"] = P()
+    return specs
+
+
+def tp_shard_params(params, cfg: tr.TransformerConfig, mesh: Mesh = None):
+    """Permute wqkv columns into per-device head blocks and place every
+    leaf on the TP mesh per :func:`param_specs`. Takes UNSHARDED params
+    (the permutation is not idempotent — the engine keeps the original
+    pytree and derives the run copy once). No-op at ``tp == 1``."""
+    tr.validate_tp(cfg)
+    if cfg.tp == 1:
+        return params
+    mesh = tp_mesh(cfg.tp) if mesh is None else mesh
+    quantized = isinstance(params["embed"], dict)
+    perm = qkv_permutation(cfg)
+
+    def permute(wqkv):
+        if isinstance(wqkv, dict):  # int8: scales travel with columns
+            return {"q8": wqkv["q8"][:, perm], "s8": wqkv["s8"][:, perm]}
+        return wqkv[:, perm]
+
+    params = dict(params)
+    params["blocks"] = [dict(bp, wqkv=permute(bp["wqkv"]))
+                        for bp in params["blocks"]]
+
+    def put(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, params, param_specs(cfg, quantized))
+
+
+def replicate(tree, cfg: tr.TransformerConfig, mesh: Mesh = None):
+    """Commit a pytree REPLICATED on the TP mesh — driver-state buffers
+    (token buffer) that donated entry points re-thread every round must
+    start with the sharding they will keep."""
+    if cfg.tp == 1:
+        return tree
+    mesh = tp_mesh(cfg.tp) if mesh is None else mesh
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), tree)
+
+
+def shard_cache(cache, cfg: tr.TransformerConfig, mesh: Mesh = None):
+    """Place a KV cache / page pool pytree on the TP mesh, heads sharded
+    (:data:`KV_SPEC` for every leaf). The leaves keep their global
+    shapes; shard_map bodies see the local-head slices."""
+    if cfg.tp == 1:
+        return cache
+    mesh = tp_mesh(cfg.tp) if mesh is None else mesh
+    sharding = NamedSharding(mesh, KV_SPEC)
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), cache)
+
+
+# -- whole-sequence forwards under shard_map (test + training surface) --
+
+
+def _block_outputs(params, tokens, cfg: tr.TransformerConfig):
+    """Per-block probe: (attention residual states, block output states,
+    logits) — the same math as ``_block`` with the two intermediate
+    states exposed, so the TP property test can pin bit-exactness at
+    every layer boundary, not just the logits."""
+    params = tr._cast_params(params, cfg)
+    x = tr._embed_prefix(params, tokens, cfg)
+
+    def per_seq(xi):
+        atts, outs = [], []
+        for bp in params["blocks"]:
+            s = xi.shape[0]
+            positions = jnp.arange(s) if cfg.rope else None
+            q, k, v = tr._split_qkv(bp, xi, cfg, positions=positions)
+            att = tr._attend_local(q, k, v, cfg).reshape(s, -1)
+            xi = xi + tr._tp_out(att, bp["wo"], cfg)
+            atts.append(xi)
+            xi = tr._mlp_residual(bp, xi, cfg)
+            outs.append(xi)
+        h = tr._layer_norm(params["ln_f"], xi)
+        return jnp.stack(atts), jnp.stack(outs), h
+
+    atts, outs, h = tr._map_seqs(per_seq, x, cfg)
+    return atts, outs, tr._readout(params, h)
+
+
+# Module-level tp==1 jits: a fresh jax.jit wrapper per call would own a
+# fresh compile cache and retrace every time.
+_forward_jit = jax.jit(tr.forward, static_argnames="cfg")
+_block_outputs_jit = jax.jit(_block_outputs, static_argnames="cfg")
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_jit(body, cfg: tr.TransformerConfig, quantized: bool, n_out: int):
+    """jit(shard_map(body)) for a ``body(params, tokens, cfg)`` whole-
+    sequence entry. Cached per (body, cfg, quantized): the shard_map
+    closure must be ONE function object per config or every call would
+    retrace. check_rep=False because the gather-mode bodies end in
+    all_gather-tiled outputs, whose replication shard_map cannot infer."""
+    mesh = tp_mesh(cfg.tp)
+    out_specs = P() if n_out == 1 else tuple(P() for _ in range(n_out))
+    fn = shard_map(
+        functools.partial(body, cfg=cfg),
+        mesh=mesh,
+        in_specs=(param_specs(cfg, quantized), P()),
+        out_specs=out_specs,
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def tp_forward(params, tokens, cfg: tr.TransformerConfig):
+    """tokens (B, S) -> logits (B, S, vocab) under TP. Takes UNSHARDED
+    params (sharded + permuted internally); ``tp == 1`` is the plain
+    jitted forward. Bit-exact across tp in gather mode."""
+    tr.validate_tp(cfg)
+    if cfg.tp == 1:
+        return _forward_jit(params, tokens, cfg=cfg)
+    quantized = isinstance(params["embed"], dict)
+    run = _tp_jit(tr.forward, cfg, quantized, 1)
+    return run(tp_shard_params(params, cfg), tokens)
+
+
+def tp_block_outputs(params, tokens, cfg: tr.TransformerConfig):
+    """(atts (B, L, S, D), mlps (B, L, S, D), logits) under TP — the
+    property-test surface; same sharding contract as :func:`tp_forward`."""
+    tr.validate_tp(cfg)
+    if cfg.tp == 1:
+        return _block_outputs_jit(params, tokens, cfg=cfg)
+    quantized = isinstance(params["embed"], dict)
+    run = _tp_jit(_block_outputs, cfg, quantized, 3)
+    return run(tp_shard_params(params, cfg), tokens)

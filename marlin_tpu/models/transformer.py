@@ -76,6 +76,18 @@ class TransformerConfig(NamedTuple):
     # half of decode's HBM roofline denominator next to the weights.
     # Approximate (~0.4% per-vector rounding), decode-only: training and
     # the flash-attention prompt pass never see quantized K/V.
+    tp: int = 1  # tensor-parallel degree: attention heads (and GQA KV-head
+    # groups) and the MLP hidden dim split over a named "model" mesh axis
+    # under shard_map (models/tp.py). tp == 1 is EXACTLY the single-device
+    # code path — the block bodies use the tp_* local extents, which equal
+    # the global ones. Must divide n_heads, kv_heads, and d_ff.
+    tp_mode: str = "gather"  # how each sub-layer's down projection
+    # reassembles the sharded activations (see _tp_out): "gather" keeps
+    # every weight column-sharded and all_gathers activations around a
+    # full-contraction matmul — bit-exact vs unsharded, two all_gathers
+    # per sub-layer; "psum" is the Megatron row-parallel layout — one
+    # psum per sub-layer, but the split-k partials reassociate the
+    # reduction, so it is allclose-only (docs/serving.md §TP).
 
     @property
     def kv_heads(self) -> int:
@@ -84,6 +96,50 @@ class TransformerConfig(NamedTuple):
     @property
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
+
+    # -- per-device extents under tensor parallelism (== global at tp 1) --
+
+    @property
+    def tp_heads(self) -> int:
+        return self.n_heads // self.tp
+
+    @property
+    def tp_kv_heads(self) -> int:
+        return self.kv_heads // self.tp
+
+    @property
+    def tp_ff(self) -> int:
+        return self.d_ff // self.tp
+
+
+def validate_tp(cfg: TransformerConfig) -> None:
+    """The tensor-parallel config contract, checked at param init and at
+    every TP surface (models/tp.py, the serving engine): the degree must
+    divide every sharded extent — attention heads, GQA KV heads (each
+    device keeps WHOLE query groups, so grouped attention stays local),
+    and the MLP hidden dim — and the reassembly mode must be known."""
+    if cfg.tp < 1:
+        raise ValueError(f"tp must be >= 1, got {cfg.tp}")
+    if cfg.tp_mode not in ("gather", "psum"):
+        raise ValueError(
+            f"unknown tp_mode {cfg.tp_mode!r}; supported: 'gather' "
+            "(bit-exact, two all_gathers per sub-layer) or 'psum' "
+            "(Megatron row-parallel, one psum, allclose-only)")
+    if cfg.tp == 1:
+        return
+    if cfg.n_heads % cfg.tp or cfg.kv_heads % cfg.tp or cfg.d_ff % cfg.tp:
+        raise ValueError(
+            f"tp {cfg.tp} must divide n_heads {cfg.n_heads}, kv_heads "
+            f"{cfg.kv_heads}, and d_ff {cfg.d_ff} (per-device extents "
+            "must be whole heads / whole hidden columns)")
+    if cfg.n_experts:
+        raise ValueError(
+            "tp > 1 does not compose with the MoE MLP (parallel.expert "
+            "owns the device axis there); use dense blocks")
+    if cfg.sequence_parallel:
+        raise ValueError(
+            "tp > 1 does not compose with sequence_parallel (the SP "
+            "engines place their own shardings)")
 
 
 def _sp_conflict(cfg: TransformerConfig) -> Optional[str]:
@@ -117,6 +173,7 @@ def init_params(cfg: TransformerConfig, seed: int = 0):
             f"rope needs an even per-head dim, got "
             f"{cfg.d_model // cfg.n_heads} (rotation pairs dim i with "
             f"i + Dh/2)")
+    validate_tp(cfg)
     k = jax.random.PRNGKey(seed)
     ks = jax.random.split(k, 4 + 6 * cfg.n_layers)
     d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
@@ -275,15 +332,53 @@ def _moe_apply(bp, y, cfg: TransformerConfig):
     return out[:t]
 
 
+def _tp_out(y, w, cfg: TransformerConfig, bias=None):
+    """A tensor-parallel sub-layer's down projection: ``y`` is this
+    device's OUTPUT-sharded slice of the up projection (local attention
+    heads, or local MLP hidden columns), ``w`` the down-projection weight
+    (possibly int8). ``tp == 1`` is the plain matmul — the single-device
+    path compiles to exactly what it did before TP existed.
+
+    "gather" mode (default): ``w`` stays COLUMN-sharded and the
+    activations are all_gathered around a full-contraction matmul — every
+    output element is ONE full-width dot computed on exactly one device,
+    the same reduction order as unsharded, so the result is BIT-IDENTICAL
+    (docs/serving.md §TP). Two all_gathers per sub-layer.
+
+    "psum" mode: the Megatron row-parallel layout — ``w`` row-sharded,
+    one psum of the per-device partial products. One collective per
+    sub-layer, but the split-k partials reassociate the contraction, so
+    psum mode is allclose-only, never bit-exact — which is why it is the
+    option, not the default, on the serving path.
+
+    ``bias`` (replicated) is added AFTER the collective, exactly once —
+    bit-equal to the unsharded ``y @ w + b``."""
+    if cfg.tp == 1:
+        out = y @ _deq(w, y.dtype)
+    elif cfg.tp_mode == "gather":
+        full = jax.lax.all_gather(y, "model", axis=y.ndim - 1, tiled=True)
+        out = full @ _deq(w, y.dtype)
+        out = jax.lax.all_gather(out, "model", axis=out.ndim - 1,
+                                 tiled=True)
+    else:  # "psum"
+        out = jax.lax.psum(y @ _deq(w, y.dtype), "model")
+    if bias is not None:
+        out = out + bias
+    return out
+
+
 def _mlp_residual(bp, x, cfg: TransformerConfig):
     """ln2 -> (dense MLP | MoE routing) -> residual; shared by the training
-    block, prefill, and decode so the block math exists once."""
+    block, prefill, and decode so the block math exists once. Under TP the
+    up projection's local columns feed :func:`_tp_out` (the b1 slice rides
+    sharded with its w1 columns; b2 is replicated and added post-
+    collective)."""
     y = _layer_norm(bp["ln2"], x)
     if cfg.n_experts:
         y = _moe_apply(bp, y, cfg)
     else:
-        y = jax.nn.gelu(y @ _deq(bp["w1"], y.dtype) + bp["b1"]) \
-            @ _deq(bp["w2"], y.dtype) + bp["b2"]
+        y = jax.nn.gelu(y @ _deq(bp["w1"], y.dtype) + bp["b1"])
+        y = _tp_out(y, bp["w2"], cfg, bias=bp["b2"])
     return x + y
 
 
@@ -308,13 +403,18 @@ def _split_qkv(bp, x, cfg: TransformerConfig, positions=None):
     """ln1 -> fused projection -> q (T, H, Dh), k/v (T, Hk, Dh). With
     ``cfg.rope``, Q and K are rotated by ``positions`` (required then);
     cached keys are therefore stored ROTATED — decode rotates only its own
-    query/key at the current position and attends directly."""
+    query/key at the current position and attends directly.
+
+    Under TP (cfg.tp > 1, inside shard_map) ``bp["wqkv"]`` is this
+    device's PERMUTED column block ``[q_local | k_local | v_local]``
+    (models/tp.py lays whole heads per device), so the split points use
+    the LOCAL head counts — identical to the global ones at tp == 1."""
     t, d = x.shape
-    h, hk = cfg.n_heads, cfg.kv_heads
-    dh = d // h
+    h, hk = cfg.tp_heads, cfg.tp_kv_heads
+    dh = d // cfg.n_heads
     qkv = _layer_norm(bp["ln1"], x) @ _deq(bp["wqkv"], x.dtype)
-    # qkv: (T, D + 2 Hk Dh)
-    q, k, v = jnp.split(qkv, [d, d + hk * dh], axis=1)
+    # qkv: (T, (H + 2 Hk) Dh) at the local extents
+    q, k, v = jnp.split(qkv, [h * dh, (h + hk) * dh], axis=1)
     q = q.reshape(t, h, dh)
     k = k.reshape(t, hk, dh)
     if cfg.rope:
@@ -333,8 +433,8 @@ def _block(bp, x, cfg: TransformerConfig, return_kv: bool = False):
     positions = jnp.arange(s) if cfg.rope else None  # full prefix from 0
     q, k, v = _split_qkv(bp, x, cfg, positions=positions)
     attend = _attend_sp if cfg.sequence_parallel else _attend_local
-    att = attend(q, k, v, cfg).reshape(s, d)
-    x = _mlp_residual(bp, x + att @ _deq(bp["wo"], att.dtype), cfg)
+    att = attend(q, k, v, cfg).reshape(s, -1)  # local heads under TP
+    x = _mlp_residual(bp, x + _tp_out(att, bp["wo"], cfg), cfg)
     return (x, k, v) if return_kv else x
 
 
@@ -638,7 +738,9 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         )(q, layer["k"], layer["v"], pos, *extra)
         new_cache.append(layer)
         x = _mlp_residual(
-            bp, x + att.reshape(x.shape) @ _deq(bp["wo"], x.dtype), cfg)
+            bp,
+            x + _tp_out(att.reshape(x.shape[0], -1), bp["wo"], cfg),
+            cfg)
     x = _layer_norm(params["ln_f"], x)
     return _readout(params, x), new_cache
 
@@ -687,7 +789,7 @@ def _chunk_states(params, cache, tokens, pos, cfg: TransformerConfig):
     for bp, layer in zip(params["blocks"], cache):
         q, k, v = _split_qkv(bp, x.reshape(b * c, -1), cfg,
                              positions=positions)
-        q = q.reshape(b, c, cfg.n_heads, dh)
+        q = q.reshape(b, c, cfg.tp_heads, dh)
         k = k.reshape(b, c, hk, dh)
         v = v.reshape(b, c, hk, dh)
 
@@ -718,7 +820,7 @@ def _chunk_states(params, cache, tokens, pos, cfg: TransformerConfig):
                                 *extra)
         new_cache.append(layer)
         x = _mlp_residual(
-            bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
+            bp, x + _tp_out(att.reshape(b, c, -1), bp["wo"], cfg), cfg)
     return x, new_cache
 
 
@@ -887,7 +989,7 @@ def _chunk_states_paged(params, pool, tables, tokens, pos,
     for bp, layer in zip(params["blocks"], pool):
         q, k, v = _split_qkv(bp, x.reshape(b * c, -1), cfg,
                              positions=positions)
-        q = q.reshape(b, c, cfg.n_heads, dh)
+        q = q.reshape(b, c, cfg.tp_heads, dh)
         k = k.reshape(b, c, hk, dh)
         v = v.reshape(b, c, hk, dh)
 
@@ -914,7 +1016,7 @@ def _chunk_states_paged(params, pool, tables, tokens, pos,
                                 chunk_pos, *extra)
         new_pool.append(layer)
         x = _mlp_residual(
-            bp, x + att.reshape(b, c, -1) @ _deq(bp["wo"], x.dtype), cfg)
+            bp, x + _tp_out(att.reshape(b, c, -1), bp["wo"], cfg), cfg)
     return x, new_pool
 
 

@@ -90,15 +90,10 @@ class _PrefillJob:
     done: bool = False
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
-    donate_argnums=(1, 2),
-)
 @jax.named_scope("marlin.serving.decode_round")
-def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
-                  round_steps: int, temperature: float,
-                  eos_id: Optional[int] = None):
+def _decode_round_impl(params, cache, buf, filled, target, done0, keys,
+                       cfg, round_steps: int, temperature: float,
+                       eos_id: Optional[int] = None):
     """One bounded decode round over the full batch (ONE dispatch).
 
     ``cache`` and ``buf`` are DONATED (returned aliased — the engine
@@ -136,6 +131,18 @@ def _decode_round(params, cache, buf, filled, target, done0, keys, cfg,
                        buf, filled, target, done0, keys,
                        round_steps=round_steps, temperature=temperature,
                        eos_id=eos_id)
+
+
+# The module-level jits keep the raw *_impl bodies separate so the
+# tensor-parallel engine (serving/tp.py) can wrap the SAME bodies in
+# jit(shard_map(...)) — one copy of the round semantics, two execution
+# disciplines. Call sites go through the engine's entry-point table
+# (ServingEngine._fn_*), which defaults to these.
+_decode_round = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
+    donate_argnums=(1, 2),
+)(_decode_round_impl)
 
 
 def _round_loop(params, kv, step_fn, buf, filled, target, done0, keys,
@@ -202,15 +209,11 @@ def _round_loop(params, kv, step_fn, buf, filled, target, done0, keys,
     return buf, filled, done, kv, iters, live, keys
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
-    donate_argnums=(1, 2),
-)
 @jax.named_scope("marlin.serving.decode_round_paged")
-def _decode_round_paged(params, pool, buf, tables, filled, target, done0,
-                        keys, cfg, round_steps: int, temperature: float,
-                        eos_id: Optional[int] = None):
+def _decode_round_paged_impl(params, pool, buf, tables, filled, target,
+                             done0, keys, cfg, round_steps: int,
+                             temperature: float,
+                             eos_id: Optional[int] = None):
     """:func:`_decode_round` over the PAGED KV pool (serving/pages.py):
     identical scheduling semantics — bounded while_loop, freeze-at-
     entry, per-row PRNG streams, live-iteration ledger — with the
@@ -231,16 +234,18 @@ def _decode_round_paged(params, pool, buf, tables, filled, target, done0,
                        eos_id=eos_id)
 
 
-@functools.partial(
+_decode_round_paged = functools.partial(
     jax.jit,
-    static_argnames=("cfg", "round_steps", "draft_len", "ngram",
-                     "temperature", "eos_id"),
+    static_argnames=("cfg", "round_steps", "temperature", "eos_id"),
     donate_argnums=(1, 2),
-)
+)(_decode_round_paged_impl)
+
+
 @jax.named_scope("marlin.serving.decode_round_spec")
-def _decode_round_spec(params, cache, buf, filled, target, done0, keys,
-                       cfg, round_steps: int, draft_len: int, ngram: int,
-                       temperature: float, eos_id: Optional[int] = None):
+def _decode_round_spec_impl(params, cache, buf, filled, target, done0,
+                            keys, cfg, round_steps: int, draft_len: int,
+                            ngram: int, temperature: float,
+                            eos_id: Optional[int] = None):
     """:func:`_decode_round` with PR 1's draft+verify chunks inside the
     round (ROADMAP 15, docs/serving.md §7): each iteration drafts
     ``draft_len - 1`` tokens per live row via the shared prompt-lookup
@@ -271,18 +276,20 @@ def _decode_round_spec(params, cache, buf, filled, target, done0, keys,
                             eos_id=eos_id)
 
 
-@functools.partial(
+_decode_round_spec = functools.partial(
     jax.jit,
     static_argnames=("cfg", "round_steps", "draft_len", "ngram",
                      "temperature", "eos_id"),
     donate_argnums=(1, 2),
-)
+)(_decode_round_spec_impl)
+
+
 @jax.named_scope("marlin.serving.decode_round_spec_paged")
-def _decode_round_spec_paged(params, pool, buf, tables, filled, target,
-                             done0, keys, cfg, round_steps: int,
-                             draft_len: int, ngram: int,
-                             temperature: float,
-                             eos_id: Optional[int] = None):
+def _decode_round_spec_paged_impl(params, pool, buf, tables, filled,
+                                  target, done0, keys, cfg,
+                                  round_steps: int, draft_len: int,
+                                  ngram: int, temperature: float,
+                                  eos_id: Optional[int] = None):
     """:func:`_decode_round_spec` over the PAGED KV pool — identical
     speculative scheduling semantics through ``decode_chunk_paged``
     (PR 9's page tables, loop-invariant within a round). The paged
@@ -298,6 +305,14 @@ def _decode_round_spec_paged(params, pool, buf, tables, filled, target,
                             round_steps=round_steps, draft_len=draft_len,
                             ngram=ngram, temperature=temperature,
                             eos_id=eos_id)
+
+
+_decode_round_spec_paged = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "draft_len", "ngram",
+                     "temperature", "eos_id"),
+    donate_argnums=(1, 2),
+)(_decode_round_spec_paged_impl)
 
 
 def _spec_round_loop(params, kv, step_fn, buf, filled, target, done0,
@@ -605,6 +620,65 @@ class ServingEngine:
         self.prefix_sharing = bool(prefix_sharing)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        # Tensor parallelism (docs/serving.md §TP): cfg.tp > 1 swaps
+        # every jitted entry point for its jit(shard_map) sibling
+        # (serving/tp.py) and re-places params + KV state on the TP
+        # mesh. Driver-side host state (tables, filled, keys, slots,
+        # admission, preemption pins) is replicated and UNTOUCHED — the
+        # dispatch table below is the only fork between the disciplines.
+        self.tp = int(cfg.tp)
+        tr.validate_tp(cfg)
+        self._quantized = isinstance(params["embed"], dict)
+        if self.tp > 1:
+            if prefix_cache is not None:
+                raise NotImplementedError(
+                    "tp > 1 composes with the PAGED prefix surface "
+                    "(kv_pages + prefix_sharing); the contiguous "
+                    "PrefixCache pool is not mesh-placed")
+            from ..models import tp as mtp
+            from . import tp as stp
+            mtp.tp_mesh(self.tp)  # validates the device count up front
+            q = self._quantized
+            # Bound dispatch callables (quantized is a static of the TP
+            # jits) + the underlying jits for watchdog registration.
+            self._fn_round = functools.partial(stp.decode_round,
+                                               quantized=q)
+            self._fn_round_paged = functools.partial(
+                stp.decode_round_paged, quantized=q)
+            self._fn_spec = functools.partial(stp.decode_round_spec,
+                                              quantized=q)
+            self._fn_spec_paged = functools.partial(
+                stp.decode_round_spec_paged, quantized=q)
+            self._fn_prefill = functools.partial(stp.prefill_into_row,
+                                                 quantized=q)
+            self._fn_chunk = functools.partial(
+                stp.prefill_chunk_into_row, quantized=q)
+            self._fn_chunk_paged = functools.partial(
+                stp.prefill_chunk_into_row_paged, quantized=q)
+            self._jit_round = stp.decode_round
+            self._jit_round_paged = stp.decode_round_paged
+            self._jit_spec = stp.decode_round_spec
+            self._jit_spec_paged = stp.decode_round_spec_paged
+            self._jit_prefill = stp.prefill_into_row
+            self._jit_chunk = stp.prefill_chunk_into_row
+            self._jit_chunk_paged = stp.prefill_chunk_into_row_paged
+            # Dispatch-time params: the permuted, mesh-placed copy.
+            # self.params stays the ORIGINAL pytree — the permutation is
+            # not idempotent, and spawn_successor hands the original to
+            # the successor, which re-derives its own run copy.
+            self._run_params = mtp.tp_shard_params(params, cfg)
+        else:
+            self._fn_round = self._jit_round = _decode_round
+            self._fn_round_paged = self._jit_round_paged = \
+                _decode_round_paged
+            self._fn_spec = self._jit_spec = _decode_round_spec
+            self._fn_spec_paged = self._jit_spec_paged = \
+                _decode_round_spec_paged
+            self._fn_prefill = self._jit_prefill = prefill_into_row
+            self._fn_chunk = self._jit_chunk = prefill_chunk_into_row
+            self._fn_chunk_paged = self._jit_chunk_paged = \
+                prefill_chunk_into_row_paged
+            self._run_params = params
         # SLO-aware scheduling (serving/sched.py, ISSUE 17): a Scheduler
         # replaces the queue's FIFO ORDER with priority classes + EDF +
         # quotas; on a paged engine with a host tier it also unlocks
@@ -649,9 +723,9 @@ class ServingEngine:
             # compiles land in the baseline, not in round ledgers.
             if not self.spec:
                 self.watchdog.register("serving.decode_round_paged",
-                                       _decode_round_paged)
+                                       self._jit_round_paged)
             self.watchdog.register("serving.prefill_chunk_into_row_paged",
-                                   prefill_chunk_into_row_paged)
+                                   self._jit_chunk_paged)
             if self.host_kv:
                 # The restore scatter compiles once per distinct
                 # spilled-prefix page count; registering it holds the
@@ -662,12 +736,12 @@ class ServingEngine:
         else:
             if not self.spec:
                 self.watchdog.register("serving.decode_round",
-                                       _decode_round)
+                                       self._jit_round)
             self.watchdog.register("serving.prefill_into_row",
-                                   prefill_into_row)
+                                   self._jit_prefill)
             if prefill_chunk is not None:
                 self.watchdog.register("serving.prefill_chunk_into_row",
-                                       prefill_chunk_into_row)
+                                       self._jit_chunk)
                 self.watchdog.register("serving.prefix_copy", copy_kv_rows)
         # Per-request PRNG streams (the sampled-path reproducibility
         # contract): every request's keys derive from fold_in(base,
@@ -733,6 +807,13 @@ class ServingEngine:
             self._cache = None
             self.page_pool = PagePool(cfg, kv_pages,
                                       registry=self.metrics)
+            if self.tp > 1:
+                # Head-axis sharding over the TP mesh; page indirection
+                # (tables, allocator, refcounts) is host state and
+                # never sees the placement.
+                from ..models import tp as mtp
+                self.page_pool.pages = mtp.shard_cache(
+                    self.page_pool.pages, cfg)
             # Host tier BELOW the pool, fresh per incarnation
             # (spawn_successor discards in-memory payloads wholesale —
             # the coherent crash story; host_kv_dir payloads survive on
@@ -769,6 +850,9 @@ class ServingEngine:
             self.host_tier = None
             self._cache = init_kv_cache(cfg, batch,
                                         dtype=cfg.compute_dtype)  # donated-buffer
+            if self.tp > 1:
+                from ..models import tp as mtp
+                self._cache = mtp.shard_cache(self._cache, cfg)
             self.stats.page_pool = None
         # Preemption needs the full substrate: scheduler (policy),
         # paged KV (page-granular freeze/free), host tier (somewhere
@@ -795,6 +879,11 @@ class ServingEngine:
             self.watchdog.register("serving.row_tokens_restore",
                                    restore_row_tokens)
         self._buf = jnp.zeros((batch, cfg.max_len), jnp.int32)  # donated-buffer
+        if self.tp > 1:
+            # Commit the buffer replicated on the TP mesh so the donated
+            # in/out shardings of every entry point match from round one.
+            from ..models import tp as mtp
+            self._buf = mtp.replicate(self._buf, cfg)
         self._filled = np.ones((batch,), np.int32)
         self._target = np.zeros((batch,), np.int32)
         self._active = np.zeros((batch,), bool)
@@ -820,8 +909,8 @@ class ServingEngine:
                 for c in self.spec_draft_lens:
                     if self.paged:
                         self._buf, _, _, pages_d, *_ = \
-                            _decode_round_spec_paged(
-                                self.params, self.page_pool.pages,
+                            self._fn_spec_paged(
+                                self._run_params, self.page_pool.pages,
                                 self._buf, jnp.asarray(self._tables),
                                 jnp.asarray(self._filled),
                                 jnp.asarray(self._target), all_done,
@@ -833,8 +922,8 @@ class ServingEngine:
                         self.page_pool.pages = pages_d
                     else:
                         self._buf, _, _, self._cache, *_ = \
-                            _decode_round_spec(
-                                self.params, self._cache, self._buf,
+                            self._fn_spec(
+                                self._run_params, self._cache, self._buf,
                                 jnp.asarray(self._filled),
                                 jnp.asarray(self._target), all_done,
                                 jnp.asarray(self._keys), cfg=cfg,
@@ -845,10 +934,12 @@ class ServingEngine:
             self.watchdog.register(
                 "serving.decode_round_spec_paged" if self.paged
                 else "serving.decode_round_spec",
-                _decode_round_spec_paged if self.paged
-                else _decode_round_spec)
+                self._jit_spec_paged if self.paged
+                else self._jit_spec)
         self.runlog.emit("engine_start", batch=batch,
                          round_steps=round_steps,
+                         tp_degree=self.tp,
+                         tp_mode=(cfg.tp_mode if self.tp > 1 else None),
                          prefill_chunk=prefill_chunk,
                          max_pending=max_pending,
                          max_len=cfg.max_len,
@@ -1338,8 +1429,8 @@ class ServingEngine:
                 # once per admission) — scoping it keeps the decode
                 # round guardable by obs.watch.no_transfers().
                 with jax.transfer_guard("allow"):
-                    self._cache, self._buf, _, _ = prefill_into_row(
-                        self.params, self._cache, self._buf,
+                    self._cache, self._buf, _, _ = self._fn_prefill(
+                        self._run_params, self._cache, self._buf,
                         jnp.int32(row),
                         jnp.asarray(padded), jnp.int32(s),
                         jnp.asarray(k_first), cfg=self.cfg,
@@ -1689,8 +1780,8 @@ class ServingEngine:
                     padded = np.zeros((pad_prompt_len(s),), np.int32)
                     padded[:s] = req.prompt
                     self.page_pool.pages, self._buf, _ = \
-                        prefill_chunk_into_row_paged(
-                            self.params, self.page_pool.pages, self._buf,
+                        self._fn_chunk_paged(
+                            self._run_params, self.page_pool.pages, self._buf,
                             jnp.int32(job.row), table, jnp.asarray(seg),
                             jnp.int32(c0), jnp.int32(clen),
                             jnp.asarray(padded), jnp.int32(s),
@@ -1699,8 +1790,8 @@ class ServingEngine:
                     job.done = True
                 else:
                     self.page_pool.pages, self._buf = \
-                        prefill_chunk_into_row_paged(
-                            self.params, self.page_pool.pages, self._buf,
+                        self._fn_chunk_paged(
+                            self._run_params, self.page_pool.pages, self._buf,
                             jnp.int32(job.row), table, jnp.asarray(seg),
                             jnp.int32(c0), jnp.int32(clen),
                             jnp.asarray(seg), jnp.int32(s),
@@ -1709,8 +1800,8 @@ class ServingEngine:
             elif final:
                 padded = np.zeros((pad_prompt_len(s),), np.int32)
                 padded[:s] = req.prompt
-                self._cache, self._buf, _ = prefill_chunk_into_row(
-                    self.params, self._cache, self._buf,
+                self._cache, self._buf, _ = self._fn_chunk(
+                    self._run_params, self._cache, self._buf,
                     jnp.int32(job.row), jnp.asarray(seg), jnp.int32(c0),
                     jnp.int32(clen), jnp.asarray(padded), jnp.int32(s),
                     jnp.asarray(job.k_first), cfg=self.cfg,
@@ -1719,8 +1810,8 @@ class ServingEngine:
             else:
                 # Interior chunk: K/V only; prompt/key unused (the
                 # chunk doubles as the dummy prompt operand).
-                self._cache, self._buf = prefill_chunk_into_row(
-                    self.params, self._cache, self._buf,
+                self._cache, self._buf = self._fn_chunk(
+                    self._run_params, self._cache, self._buf,
                     jnp.int32(job.row), jnp.asarray(seg), jnp.int32(c0),
                     jnp.int32(clen), jnp.asarray(seg), jnp.int32(s),
                     jnp.asarray(job.k_first), cfg=self.cfg,
@@ -1918,8 +2009,8 @@ class ServingEngine:
                 if self.spec and self.paged:
                     (self._buf, filled_d, done_d, pages_d, iters_d,
                      live_d, keys_d, drafted_d, accepted_d) = \
-                        _decode_round_spec_paged(
-                            self.params, self.page_pool.pages, self._buf,
+                        self._fn_spec_paged(
+                            self._run_params, self.page_pool.pages, self._buf,
                             jnp.asarray(self._tables),
                             jnp.asarray(self._filled),
                             jnp.asarray(self._target),
@@ -1933,8 +2024,8 @@ class ServingEngine:
                 elif self.spec:
                     (self._buf, filled_d, done_d, self._cache, iters_d,
                      live_d, keys_d, drafted_d, accepted_d) = \
-                        _decode_round_spec(
-                            self.params, self._cache, self._buf,
+                        self._fn_spec(
+                            self._run_params, self._cache, self._buf,
                             jnp.asarray(self._filled),
                             jnp.asarray(self._target),
                             jnp.asarray(done0), jnp.asarray(self._keys),
@@ -1949,8 +2040,8 @@ class ServingEngine:
                     # small explicit push; pages are RESERVED at
                     # admission so the round never allocates).
                     self._buf, filled_d, done_d, pages_d, iters_d, \
-                        live_d, keys_d = _decode_round_paged(
-                            self.params, self.page_pool.pages, self._buf,
+                        live_d, keys_d = self._fn_round_paged(
+                            self._run_params, self.page_pool.pages, self._buf,
                             jnp.asarray(self._tables),
                             jnp.asarray(self._filled),
                             jnp.asarray(self._target),
@@ -1962,8 +2053,8 @@ class ServingEngine:
                     self.page_pool.pages = pages_d
                 else:
                     self._buf, filled_d, done_d, self._cache, iters_d, \
-                        live_d, keys_d = _decode_round(
-                            self.params, self._cache, self._buf,
+                        live_d, keys_d = self._fn_round(
+                            self._run_params, self._cache, self._buf,
                             jnp.asarray(self._filled),
                             jnp.asarray(self._target),
                             jnp.asarray(done0), jnp.asarray(self._keys),
@@ -2310,6 +2401,8 @@ class ServingEngine:
             "round": self.round_idx,
             "batch": self.batch,
             "round_steps": self.round_steps,
+            "tp_degree": self.tp,
+            "tp_mode": self.cfg.tp_mode if self.tp > 1 else None,
             "occupied": self.slots.n_occupied,
             "queue_depth": len(self.queue),
             "queue_closed": self.queue.closed,

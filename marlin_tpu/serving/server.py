@@ -153,11 +153,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"ok": True}, "/healthz")
         elif path == "/readyz":
             ready = self.frontend.ready
+            body = {"ready": ready, "draining": self.frontend.draining,
+                    "driver_alive": self.frontend.alive}
+            if self.server.tp_degree > 1:
+                # Group quorum: the whole TP worker group lives in this
+                # process, so "all members present" is exactly "the
+                # mesh spans tp devices".
+                body["tp_degree"] = self.server.tp_degree
+                body["tp_devices"] = self.server.tp_devices
+                body["tp_quorum"] = (self.server.tp_devices
+                                     >= self.server.tp_degree)
             self._send_json(
-                200 if ready else 503,
-                {"ready": ready, "draining": self.frontend.draining,
-                 "driver_alive": self.frontend.alive},
-                "/readyz",
+                200 if ready else 503, body, "/readyz",
                 headers=None if ready else {"Retry-After": RETRY_AFTER_S})
         elif path == "/debug/engine":
             self._send_json(200, self.frontend.debug_engine(),
@@ -427,6 +434,18 @@ class ServingHTTPServer(ThreadingHTTPServer):
         self.registry = frontend.metrics
         self.tracer = frontend.engine.tracer
         self.runlog = frontend.engine.runlog
+        # Worker-group identity for /readyz (docs/fleet.md §worker
+        # groups): a TP>1 replica is ONE process spanning tp devices;
+        # readiness includes the device quorum so the fleet supervisor
+        # can tell "engine up on a full group" from "engine up but the
+        # mesh came up short" without a second probe.
+        self.tp_degree = int(frontend.engine.cfg.tp)
+        if self.tp_degree > 1:
+            import jax
+
+            self.tp_devices = len(jax.devices())
+        else:
+            self.tp_devices = 1
         self.request_timeout_s = request_timeout_s
         self._drain_once = threading.Lock()
         self._drained = False
@@ -596,7 +615,25 @@ def main(argv=None) -> int:
                         "<path>.incident.json")
     p.add_argument("--force-cpu", action="store_true",
                    help="pin jax to the CPU backend (smoke/demo hosts)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: shard the model over "
+                        "this many devices (one process spanning the "
+                        "worker group; on CPU the mesh comes from "
+                        "forced host devices)")
+    p.add_argument("--tp-mode", default="gather",
+                   choices=("gather", "psum"),
+                   help="TP reassembly: 'gather' (bit-exact vs tp=1) "
+                        "or 'psum' (fewer collectives, allclose-only)")
     args = p.parse_args(argv)
+
+    if args.tp > 1 and "--xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # Must land before the first jax backend touch. The fleet
+        # supervisor sets this in replica_environ; this fallback covers
+        # direct CLI runs on a CPU host.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.tp}").strip()
 
     import jax
 
@@ -614,7 +651,8 @@ def main(argv=None) -> int:
     cfg = TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=4 * args.d_model,
-        max_len=args.max_len, dtype="float32")
+        max_len=args.max_len, dtype="float32",
+        tp=args.tp, tp_mode=args.tp_mode)
     params = init_params(cfg, seed=args.seed)
     runlog = RunLog(path=args.runlog) if args.runlog else None
     tracer = None

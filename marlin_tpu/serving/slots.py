@@ -82,14 +82,9 @@ def pad_prompt_len(prompt_len: int) -> int:
     return -(-prompt_len // 16) * 16
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "temperature"),
-    donate_argnums=(1, 2),
-)
 @jax.named_scope("marlin.serving.prefill_into_row")
-def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
-                     cfg, temperature: float = 0.0):
+def _prefill_into_row_impl(params, cache, buf, row, prompt, prompt_len,
+                           key, cfg, temperature: float = 0.0):
     """Prefill one request and swap it into batch row ``row``, in place.
 
     Args:
@@ -153,15 +148,21 @@ def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
     return cache, buf, prompt_len + 1, first
 
 
-@functools.partial(
+# Raw bodies stay separate from their module-level jits so the tensor-
+# parallel engine (serving/tp.py) can wrap the SAME bodies in
+# jit(shard_map(...)) without double-jitting.
+prefill_into_row = functools.partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "final"),
+    static_argnames=("cfg", "temperature"),
     donate_argnums=(1, 2),
-)
+)(_prefill_into_row_impl)
+
+
 @jax.named_scope("marlin.serving.prefill_chunk_into_row")
-def prefill_chunk_into_row(params, cache, buf, row, chunk, start, chunk_len,
-                           prompt, prompt_len, key, cfg,
-                           temperature: float = 0.0, final: bool = False):
+def _prefill_chunk_into_row_impl(params, cache, buf, row, chunk, start,
+                                 chunk_len, prompt, prompt_len, key, cfg,
+                                 temperature: float = 0.0,
+                                 final: bool = False):
     """One admission-prefill CHUNK into batch row ``row``, in place — the
     chunked-admission sibling of :func:`prefill_into_row` (the engine's
     prefix-reuse/chunked mode; the one-shot flash path above stays the
@@ -217,16 +218,19 @@ def prefill_chunk_into_row(params, cache, buf, row, chunk, start, chunk_len,
     return cache, buf, first
 
 
-@functools.partial(
+prefill_chunk_into_row = functools.partial(
     jax.jit,
     static_argnames=("cfg", "temperature", "final"),
     donate_argnums=(1, 2),
-)
+)(_prefill_chunk_into_row_impl)
+
+
 @jax.named_scope("marlin.serving.prefill_chunk_paged")
-def prefill_chunk_into_row_paged(params, pool, buf, row, table, chunk,
-                                 start, chunk_len, prompt, prompt_len,
-                                 key, cfg, temperature: float = 0.0,
-                                 final: bool = False):
+def _prefill_chunk_into_row_paged_impl(params, pool, buf, row, table,
+                                       chunk, start, chunk_len, prompt,
+                                       prompt_len, key, cfg,
+                                       temperature: float = 0.0,
+                                       final: bool = False):
     """The PAGED sibling of :func:`prefill_chunk_into_row`: one
     admission-prefill chunk written through the row's PAGE TABLE into
     the shared page pool (serving/pages.py) instead of into a
@@ -254,6 +258,13 @@ def prefill_chunk_into_row_paged(params, pool, buf, row, table, chunk,
     first = tr._sample(logits, temperature, key)[0]
     buf = _write_row_tokens(buf, row, prompt, prompt_len, first)
     return pool, buf, first
+
+
+prefill_chunk_into_row_paged = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "final"),
+    donate_argnums=(1, 2),
+)(_prefill_chunk_into_row_paged_impl)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

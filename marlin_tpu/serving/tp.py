@@ -1,0 +1,191 @@
+"""Tensor-parallel serving entry points: the engine's jitted kernels
+wrapped in ``jit(shard_map(...))`` over the ``model`` mesh axis.
+
+The engine's round/prefill semantics live exactly once, in the raw
+``*_impl`` bodies (engine.py / slots.py). This module re-wraps those
+same bodies for TP>1: every device runs the identical round loop on its
+local attention heads (params column-sharded, KV pools head-sharded per
+models/tp.py), and all per-row driver state — token buffers, fill
+counts, page tables, PRNG key streams, done masks — stays REPLICATED.
+Replicated control state means every device's ``while_loop`` takes the
+same trips and every collective lines up; replicated sampling state
+means the sampled token is computed identically everywhere, so the
+gather-mode bit-exactness argument extends per induction from one
+decode step to whole serving rounds (docs/serving.md §TP).
+
+Signatures mirror the wrapped originals exactly (plus one trailing
+static ``quantized`` flag — the params spec tree depends on whether the
+engine quantized its weights, which the config does not record). The
+engine binds ``quantized`` with ``functools.partial`` at init and
+dispatches through one entry-point table for both disciplines; the
+watchdog registers these module-level jits, so the zero-steady-state-
+recompile pin covers TP the same way it covers tp == 1.
+
+Donation carries through: the outer jits donate the same (cache/pool,
+buf) positions as the originals, and in/out specs match leaf-for-leaf,
+so the round's KV buffers alias under TP exactly as before.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models import tp as mtp
+
+_R = P()  # replicated driver-side state
+
+
+def _smap(body, cfg, quantized, n_kv_args, out_specs):
+    """shard_map over the TP mesh: params spec tree + KV prefix specs
+    for the next ``n_kv_args`` args + replicated everything else.
+    check_rep=False: the gather-mode bodies end in all_gather-tiled
+    values whose replication shard_map's checker cannot infer."""
+
+    def wrap(params, kv_args, rest):
+        in_specs = (mtp.param_specs(cfg, quantized),
+                    *([mtp.KV_SPEC] * n_kv_args),
+                    *([_R] * len(rest)))
+        fn = shard_map(body, mesh=mtp.tp_mesh(cfg.tp), in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+        return fn(params, *kv_args, *rest)
+
+    return wrap
+
+
+# Round results order their KV pytree at index 3 (buf, filled, done, kv,
+# iters, live, keys[, drafted, accepted]).
+_ROUND_OUT = (_R, _R, _R, mtp.KV_SPEC, _R, _R, _R)
+_SPEC_OUT = _ROUND_OUT + (_R, _R)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "temperature", "eos_id",
+                     "quantized"),
+    donate_argnums=(1, 2),
+)
+def decode_round(params, cache, buf, filled, target, done0, keys, cfg,
+                 round_steps, temperature, eos_id=None, quantized=False):
+    from . import engine as eng
+
+    body = lambda p, kv, b, f, t, d, k: eng._decode_round_impl(
+        p, kv, b, f, t, d, k, cfg, round_steps, temperature, eos_id)
+    run = _smap(body, cfg, quantized, 1, _ROUND_OUT)
+    return run(params, (cache,), (buf, filled, target, done0, keys))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "temperature", "eos_id",
+                     "quantized"),
+    donate_argnums=(1, 2),
+)
+def decode_round_paged(params, pool, buf, tables, filled, target, done0,
+                       keys, cfg, round_steps, temperature, eos_id=None,
+                       quantized=False):
+    from . import engine as eng
+
+    body = lambda p, kv, b, tb, f, t, d, k: eng._decode_round_paged_impl(
+        p, kv, b, tb, f, t, d, k, cfg, round_steps, temperature, eos_id)
+    run = _smap(body, cfg, quantized, 1, _ROUND_OUT)
+    return run(params, (pool,), (buf, tables, filled, target, done0, keys))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "draft_len", "ngram",
+                     "temperature", "eos_id", "quantized"),
+    donate_argnums=(1, 2),
+)
+def decode_round_spec(params, cache, buf, filled, target, done0, keys,
+                      cfg, round_steps, draft_len, ngram, temperature,
+                      eos_id=None, quantized=False):
+    from . import engine as eng
+
+    body = lambda p, kv, b, f, t, d, k: eng._decode_round_spec_impl(
+        p, kv, b, f, t, d, k, cfg, round_steps, draft_len, ngram,
+        temperature, eos_id)
+    run = _smap(body, cfg, quantized, 1, _SPEC_OUT)
+    return run(params, (cache,), (buf, filled, target, done0, keys))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "round_steps", "draft_len", "ngram",
+                     "temperature", "eos_id", "quantized"),
+    donate_argnums=(1, 2),
+)
+def decode_round_spec_paged(params, pool, buf, tables, filled, target,
+                            done0, keys, cfg, round_steps, draft_len,
+                            ngram, temperature, eos_id=None,
+                            quantized=False):
+    from . import engine as eng
+
+    body = (lambda p, kv, b, tb, f, t, d, k:
+            eng._decode_round_spec_paged_impl(
+                p, kv, b, tb, f, t, d, k, cfg, round_steps, draft_len,
+                ngram, temperature, eos_id))
+    run = _smap(body, cfg, quantized, 1, _SPEC_OUT)
+    return run(params, (pool,), (buf, tables, filled, target, done0, keys))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "quantized"),
+    donate_argnums=(1, 2),
+)
+def prefill_into_row(params, cache, buf, row, prompt, prompt_len, key,
+                     cfg, temperature=0.0, quantized=False):
+    from . import slots
+
+    body = lambda p, kv, b, r, pr, pl, k: slots._prefill_into_row_impl(
+        p, kv, b, r, pr, pl, k, cfg, temperature)
+    run = _smap(body, cfg, quantized, 1, (mtp.KV_SPEC, _R, _R, _R))
+    return run(params, (cache,), (buf, row, prompt, prompt_len, key))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "final", "quantized"),
+    donate_argnums=(1, 2),
+)
+def prefill_chunk_into_row(params, cache, buf, row, chunk, start,
+                           chunk_len, prompt, prompt_len, key, cfg,
+                           temperature=0.0, final=False, quantized=False):
+    from . import slots
+
+    body = (lambda p, kv, b, r, c, s, cl, pr, pl, k:
+            slots._prefill_chunk_into_row_impl(
+                p, kv, b, r, c, s, cl, pr, pl, k, cfg, temperature,
+                final))
+    out = (mtp.KV_SPEC, _R, _R) if final else (mtp.KV_SPEC, _R)
+    run = _smap(body, cfg, quantized, 1, out)
+    return run(params, (cache,),
+               (buf, row, chunk, start, chunk_len, prompt, prompt_len,
+                key))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "final", "quantized"),
+    donate_argnums=(1, 2),
+)
+def prefill_chunk_into_row_paged(params, pool, buf, row, table, chunk,
+                                 start, chunk_len, prompt, prompt_len,
+                                 key, cfg, temperature=0.0, final=False,
+                                 quantized=False):
+    from . import slots
+
+    body = (lambda p, kv, b, r, tb, c, s, cl, pr, pl, k:
+            slots._prefill_chunk_into_row_paged_impl(
+                p, kv, b, r, tb, c, s, cl, pr, pl, k, cfg, temperature,
+                final))
+    out = (mtp.KV_SPEC, _R, _R) if final else (mtp.KV_SPEC, _R)
+    run = _smap(body, cfg, quantized, 1, out)
+    return run(params, (pool,),
+               (buf, row, table, chunk, start, chunk_len, prompt,
+                prompt_len, key))

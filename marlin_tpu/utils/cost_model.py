@@ -244,6 +244,70 @@ def decode_step_cost(cfg, batch: int, param_itemsize: int = 4,
     return flops, float(byts)
 
 
+def _tp_replicated_params(cfg) -> int:
+    """Leaves the gather-mode TP layout REPLICATES (models/tp.py
+    param_specs): the embed/readout table, the final LN, and the learned
+    position table — everything else (per-block matmuls and their
+    biases) is column-sharded over the ``model`` axis."""
+    d = cfg.d_model
+    rep = cfg.vocab * d + 2 * d
+    if not cfg.rope:
+        rep += cfg.max_len * d
+    return int(rep)
+
+
+def tp_decode_step_cost(cfg, batch: int, tp: Optional[int] = None,
+                        param_itemsize: int = 4,
+                        cache_itemsize: int = 4,
+                        quant_weights: bool = False
+                        ) -> Tuple[float, float]:
+    """Per-DEVICE (flops, bytes) of one decode step under gather-mode
+    tensor parallelism at degree ``tp`` (default ``cfg.tp``) — the
+    serving_tp bench's modeled-scaling numerator/denominator.
+
+    Amdahl split of :func:`decode_step_cost`: the per-block matmuls and
+    the cache attention shard over the ``model`` axis (heads / KV-head
+    groups / MLP columns — models/tp.py) and divide by ``tp``; the
+    readout against the replicated embed table (and the replicated
+    bias/LN/pos leaves bundled into the same ``2 * params * B`` pricing)
+    runs in full on every device. Bytes split the same way: replicated
+    leaves stream on every device, sharded weights and the head-sharded
+    KV cache divide. At the typical serving shape the replicated share
+    is the vocab readout, so modeled per-device scaling at TP=4 lands
+    below 4.0 by exactly that readout fraction."""
+    flops1, bytes1 = decode_step_cost(
+        cfg, batch, param_itemsize=param_itemsize,
+        cache_itemsize=cache_itemsize, quant_weights=quant_weights)
+    tp = int(getattr(cfg, "tp", 1) if tp is None else tp)
+    if tp <= 1:
+        return flops1, bytes1
+    rep = _tp_replicated_params(cfg)
+    rep_flops = 2.0 * rep * batch
+    flops = rep_flops + (flops1 - rep_flops) / tp
+    if quant_weights:
+        # The embed table is quantized (per-row scales — one f32 per
+        # vocab row); the other replicated leaves stay float.
+        v, d = cfg.vocab, cfg.d_model
+        rep_bytes = v * d * 1.0 + v * float(param_itemsize) \
+            + (rep - v * d) * float(param_itemsize)
+    else:
+        rep_bytes = float(rep * param_itemsize)
+    byts = rep_bytes + (bytes1 - rep_bytes) / tp
+    return flops, float(byts)
+
+
+def tp_decode_flop_scaling(cfg, batch: int, tp: int,
+                           quant_weights: bool = False) -> float:
+    """Modeled per-device FLOP scaling of one decode step, TP=1 over
+    TP=``tp`` — the quantity ``bench.py --config serving_tp`` gates
+    (the fleet bench's modeled-capacity discipline applied to the
+    device axis: schedule/layout-determined, immune to host weather)."""
+    flops1, _ = decode_step_cost(cfg, batch, quant_weights=quant_weights)
+    flops_tp, _ = tp_decode_step_cost(cfg, batch, tp=tp,
+                                      quant_weights=quant_weights)
+    return float(flops1 / flops_tp)
+
+
 def admission_cost(cfg, prompt_len: int, hit_len: int = 0,
                    chunk: Optional[int] = None,
                    param_itemsize: int = 4) -> Tuple[float, float]:

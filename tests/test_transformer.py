@@ -370,6 +370,32 @@ class TestTensorParallel:
         # mean the layout was lost.
         assert not p_tp["blocks"][0]["wqkv"].sharding.is_fully_replicated
 
+    def test_tp_composes_with_gqa_and_rope(self, rng):
+        """ROADMAP item 11, un-skipped: GQA x RoPE under TP runs on the
+        single-process ``shard_map`` path (models/tp.py), which was built
+        precisely because the GSPMD route below is blocked on jax 0.4.37.
+        The composition is BIT-exact here, not allclose: gather-mode TP
+        keeps every output element a full-width contraction on one
+        device, and the per-device bodies run with local head extents —
+        no GSPMD partitioning of the flash custom call is involved.
+        MQA (n_kv_heads=1) cannot head-shard at tp=2 by design (each
+        device owns whole KV-head groups — validate_tp rejects it), so
+        the GQA arm is n_kv_heads=2 with two query heads per group."""
+        from marlin_tpu.models import tp as mtp
+
+        for tp in (2, 4):
+            cfg = CFG._replace(n_heads=4, n_kv_heads=2, rope=True,
+                               tp=tp)
+            if tp == 4:
+                cfg = cfg._replace(n_kv_heads=4)
+            params = init_params(cfg._replace(tp=1), seed=2)
+            tok = jnp.asarray(rng.integers(0, cfg.vocab, (2, 16)),
+                              jnp.int32)
+            ref = mtp.tp_forward(params, tok, cfg._replace(tp=1))
+            got = mtp.tp_forward(params, tok, cfg)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(ref))
+
     @pytest.mark.skipif(
         tuple(int(x) for x in jax.__version__.split(".")[:3]) < (0, 5, 0),
         reason="jax 0.4.37: GSPMD partitioning of the opaque "
@@ -377,9 +403,12 @@ class TestTensorParallel:
                "GQA(n_kv_heads=1) x RoPE composition under TP (numeric "
                "divergence, pre-existing at seed — it crashed earlier "
                "on the missing-API shims PR 1 added); passes on newer "
-               "jax where the interpret path partitions correctly "
-               "(ROADMAP item 11)")
-    def test_tp_composes_with_gqa_and_rope(self, rng, mesh):
+               "jax where the interpret path partitions correctly. "
+               "This guard now covers ONLY the legacy GSPMD "
+               "shard_params route — the serving TP path ships via "
+               "shard_map (test above), which never hands the Pallas "
+               "call to the partitioner (ROADMAP item 11)")
+    def test_tp_gspmd_composes_with_mqa_and_rope(self, rng, mesh):
         from marlin_tpu.models import shard_params
 
         cfg = CFG._replace(n_kv_heads=1, rope=True)

@@ -587,10 +587,17 @@ def build_fleet_report(entries: List[dict],
                else os.path.basename(e["path"]))
         inc = e["incarnation"] or 0
         rep = build_report(e["events"], phase_tol=phase_tol)
+        # A TP worker group is ONE engine process with ONE runlog — the
+        # engine_start event carries the group's degree, so a TP>1
+        # replica narrates as a single replica with a tp tag, never as
+        # tp_degree-many duplicate replicas.
+        tp = max((int(ev.get("tp_degree") or 1) for ev in e["events"]
+                  if ev["kind"] == "engine_start"), default=1)
         entry = replicas.setdefault(key, {"incarnations": []})
         entry["incarnations"].append({
             "path": os.path.basename(e["path"]),
             "incarnation": inc,
+            "tp_degree": tp,
             "rounds": rep["rounds"],
             **{k: rep[k] for k in _INCARNATION_SUMMARY},
         })
@@ -609,6 +616,8 @@ def build_fleet_report(entries: List[dict],
     for key, entry in replicas.items():
         incs = entry["incarnations"]
         entry["n_incarnations"] = len(incs)
+        entry["tp_degree"] = max(
+            i.get("tp_degree", 1) for i in incs)
         entry["n_submitted"] = sum(i["n_submitted"] for i in incs)
         entry["n_completed"] = sum(i["n_completed"] for i in incs)
         entry["busy_s"] = round(sum(
@@ -677,9 +686,11 @@ def _human_fleet(report: dict) -> str:
         e = report["replicas"][key]
         sealed = all(i["sealed"] for i in e["incarnations"])
         failed = any(i["engine_failed"] for i in e["incarnations"])
+        tp = e.get("tp_degree", 1)
         lines.append(
             f"replica {key}: {e['n_incarnations']} incarnation(s), "
-            f"{e['n_submitted']} submitted, "
+            + (f"tp={tp} worker group, " if tp > 1 else "")
+            + f"{e['n_submitted']} submitted, "
             f"{e['n_completed']} completed, busy {e['busy_s']}s, "
             f"sealed={sealed}"
             + (", FAILED CLOSED" if failed else ""))
