@@ -9,8 +9,8 @@ silently drops out of the dead-tunnel fallback.
 """
 
 from . import (configs_fleet, configs_gemm, configs_http,
-               configs_kernels, configs_linalg, configs_ml,
-               configs_sparse, configs_tp, configs_trend)
+               configs_kernels, configs_linalg, configs_matrix,
+               configs_ml, configs_sparse, configs_tp, configs_trend)
 
 CONFIGS = {
     "headline": [configs_gemm.headline],
@@ -39,6 +39,7 @@ CONFIGS = {
     "serving_host_kv": [configs_trend.config_serving_host_kv],
     "tenants": [configs_trend.config_tenants],
     "http": [configs_http.config_http],
+    "matrix_service": [configs_matrix.config_matrix_service],
     "fleet": [configs_fleet.config_fleet],
     "serving_tp": [configs_tp.config_serving_tp],
     "sweep": [configs_gemm.config_dispatch_sweep],
@@ -51,5 +52,5 @@ CONFIGS["all"] = [
     fns[0] for k, fns in CONFIGS.items()
     if k not in ("sweep", "attnsweep", "trend", "serving",
                  "serving_spec", "serving_host_kv", "tenants", "http",
-                 "fleet", "serving_tp")
+                 "matrix_service", "fleet", "serving_tp")
 ]

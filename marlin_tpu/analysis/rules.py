@@ -425,11 +425,16 @@ class DeterministicServingRule(Rule):
                 continue
             scope = _scope_name(stack)
             if fn in ("random.Random", "np.random.default_rng",
-                      "numpy.random.default_rng") and node.args and \
-                    isinstance(node.args[0], ast.Constant):
-                # A SEEDED generator is deterministic — the sanctioned
-                # way to build synthetic workloads (serving_client's
-                # load CLI). Only ambient draws break replay.
+                      "numpy.random.default_rng",
+                      "np.random.SeedSequence",
+                      "numpy.random.SeedSequence") and node.args:
+                # A SEEDED generator/SeedSequence is deterministic —
+                # the sanctioned way to build synthetic workloads
+                # (serving_client's load CLI) and per-job PRNG streams
+                # (serving/jobs.generate_inputs folds the job seed into
+                # a SeedSequence). Only ambient draws break replay; a
+                # nondeterministic seed EXPRESSION (time.time() inside
+                # the args) is still caught as its own call node.
                 continue
             if fn.startswith("random.") or fn.startswith("np.random.") \
                     or fn.startswith("numpy.random."):

@@ -54,6 +54,16 @@ class FleetConfig:
     # default interactive/batch/best_effort class table; the front door
     # forwards each request's tenant/sched_class fields verbatim.
     sched: bool = False
+    # Matrix-ops job class (docs/matrix_service.md): matrix=True arms
+    # POST /v1/matrix on replicas and opens the front door's job-class
+    # dispatch arm. matrix_replicas=0 means EVERY replica serves matrix
+    # jobs (interleaved with decode rounds); matrix_replicas=k > 0
+    # dedicates the LAST k replicas as the matrix job-class group —
+    # only they get --matrix, and the router dispatches matrix jobs
+    # least-outstanding within that group, keeping quantum interleave
+    # entirely off the LLM replicas.
+    matrix: bool = False
+    matrix_replicas: int = 0
     # Tensor parallelism (docs/fleet.md §worker groups): each replica
     # is spawned as a worker GROUP of this degree — one supervised
     # process whose engine shards the model over tp_degree devices
@@ -134,6 +144,14 @@ class FleetConfig:
         if self.tp_degree < 1:
             raise ValueError(
                 f"tp_degree must be >= 1, got {self.tp_degree}")
+        if not 0 <= self.matrix_replicas <= self.n_replicas:
+            raise ValueError(
+                f"matrix_replicas must be in [0, n_replicas], got "
+                f"{self.matrix_replicas} with "
+                f"n_replicas={self.n_replicas}")
+        if self.matrix_replicas and not self.matrix:
+            raise ValueError(
+                "matrix_replicas > 0 requires matrix=True")
 
     # -- derived -------------------------------------------------------
 
@@ -174,6 +192,19 @@ class FleetConfig:
         return os.path.join(self.trace_export_dir,
                             "frontdoor.trace.json")
 
+    def matrix_group(self) -> Tuple[int, ...]:
+        """Replica indices serving the matrix job class: all of them
+        when ``matrix_replicas == 0``, else the LAST k (the dedicated
+        group — dedicating the tail keeps replica 0's identity as the
+        default LLM target stable under resizes). Empty when the
+        matrix service is off."""
+        if not self.matrix:
+            return ()
+        if self.matrix_replicas == 0:
+            return tuple(range(self.n_replicas))
+        return tuple(range(self.n_replicas - self.matrix_replicas,
+                           self.n_replicas))
+
     def replica_argv(self, index: int,
                      incarnation: int = 0) -> List[str]:
         """argv for replica ``index``: ``python -m marlin_tpu.serving.
@@ -210,6 +241,8 @@ class FleetConfig:
                      str(self.restore_min_tokens)]
         if self.sched:
             argv += ["--sched"]
+        if self.matrix and index in self.matrix_group():
+            argv += ["--matrix"]
         if self.tp_degree > 1:
             argv += ["--tp", str(self.tp_degree)]
         runlog = self.replica_runlog(index, incarnation)

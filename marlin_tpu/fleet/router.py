@@ -63,10 +63,14 @@ class RouteDecision:
 
     request_id: int
     replica_index: int
-    policy: str  # "affinity" | "fallback"
+    policy: str  # "affinity" | "fallback" | "matrix"
     hit_depth: int  # trie depth (tokens) the affinity hit matched
     prefix: Optional[np.ndarray]  # GRAIN-floored prompt copy (trie key)
     prefix_len: int
+    # Job-class constraint (docs/matrix_service.md): when set, failover
+    # candidates come ONLY from these indices — a matrix job must never
+    # fail over onto an LLM-only replica (its /v1/matrix would 404).
+    group: Optional[Tuple[int, ...]] = None
 
 
 class PrefixAffinityRouter:
@@ -212,15 +216,50 @@ class PrefixAffinityRouter:
                    to_replica=new_index, reason=reason)
         decision.replica_index = new_index
 
+    def route_matrix(self) -> RouteDecision:
+        """Job-class dispatch arm (docs/matrix_service.md): pick the
+        least-outstanding healthy replica WITHIN the configured matrix
+        group (``FleetConfig.matrix_group()`` — every replica, or the
+        dedicated tail group) and count the job outstanding like any
+        request. No prefix trie: matrix jobs have no token locality,
+        so load is the only signal. Pair with :meth:`release`."""
+        group = self.config.matrix_group()
+        with self._lock:
+            healthy = [i for i in self._healthy_indices()
+                       if i in group]
+            if not healthy:
+                raise NoHealthyReplica(
+                    "no healthy matrix-class replica (group "
+                    f"{list(group)})")
+            chosen = min(healthy, key=lambda i:
+                         (self._outstanding[i], self._routed[i], i))
+            rid = self._next_id
+            self._next_id += 1
+            self._outstanding[chosen] += 1
+            self._routed[chosen] += 1
+        self.metrics.counter(
+            "fleet_route_total",
+            help="fleet routing decisions by policy",
+            policy="matrix").inc()
+        self._emit("fleet_route", request_id=rid, replica=chosen,
+                   policy="matrix", hit_depth=0)
+        return RouteDecision(request_id=rid, replica_index=chosen,
+                             policy="matrix", hit_depth=0,
+                             prefix=None, prefix_len=0, group=group)
+
     def release(self, decision: RouteDecision) -> None:
         with self._lock:
             self._outstanding[decision.replica_index] -= 1
 
-    def next_candidate(self, tried) -> Optional[int]:
-        """Least-outstanding healthy replica not yet tried, or None."""
+    def next_candidate(self, tried,
+                       group: Optional[Tuple[int, ...]] = None
+                       ) -> Optional[int]:
+        """Least-outstanding healthy replica not yet tried — within
+        ``group`` when given (job-class failover) — or None."""
         with self._lock:
             healthy = [i for i in self._healthy_indices()
-                       if i not in tried]
+                       if i not in tried
+                       and (group is None or i in group)]
             if not healthy:
                 return None
             return min(healthy, key=lambda i:
@@ -254,10 +293,13 @@ def proxy_submit(router: PrefixAffinityRouter,
                  http_id: Optional[str],
                  timeout: float,
                  extra_headers: Optional[Dict[str, str]] = None,
+                 path: str = "/v1/generate",
                  ) -> Tuple[http.client.HTTPConnection,
                             http.client.HTTPResponse,
                             int]:
-    """POST ``payload`` to the decided replica, failing over on
+    """POST ``payload`` to the decided replica at ``path``
+    (``/v1/generate``, or ``/v1/matrix`` for the job-class arm —
+    failover then stays inside ``decision.group``), failing over on
     connect errors and pre-acceptance rejections (429/503 — the
     replica registered nothing, so the replay is byte-exact under the
     request-id contract). Returns ``(conn, resp, replica_index)`` with
@@ -292,7 +334,7 @@ def proxy_submit(router: PrefixAffinityRouter,
             if extra_headers:
                 headers.update(extra_headers)
             try:
-                conn.request("POST", "/v1/generate", payload, headers)
+                conn.request("POST", path, payload, headers)
                 resp = conn.getresponse()
             except (OSError, http.client.HTTPException):
                 # Connect refused, reset, or closed without a status
@@ -314,7 +356,7 @@ def proxy_submit(router: PrefixAffinityRouter,
             f"replica {idx} {reason}"
             + (f" ({status})" if status else ""),
             status=status, body=body, headers=hdrs)
-        nxt = router.next_candidate(tried)
+        nxt = router.next_candidate(tried, group=decision.group)
         if nxt is None:
             raise last
         router.reassign(decision, nxt, reason=reason)

@@ -363,6 +363,9 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if path.startswith("/fleet/drain/"):
             self._drain(path)
             return
+        if path == "/v1/matrix":
+            self._post_matrix()
+            return
         if path != "/v1/generate":
             self._send_json(404, {"error": f"no route {path}"}, path)
             return
@@ -508,8 +511,97 @@ class _FleetHandler(BaseHTTPRequestHandler):
                             f"status_{final_status}" if final_status
                             else "aborted"))
 
+    def _post_matrix(self) -> None:
+        """Job-class dispatch arm (docs/matrix_service.md): route a
+        matrix job to the least-outstanding replica in the configured
+        matrix group and forward bytes transparently — the replica's
+        npz payload (byte-identical to the in-process call) or its
+        typed 400 passes through untouched; failover stays inside the
+        group (a matrix job must never land on an LLM-only replica).
+        404 when the fleet has no matrix arm, mirroring a bare
+        replica."""
+        route = "/v1/matrix"
+        if not self.sup.config.matrix:
+            self._send_json(404, {"error": "matrix service not "
+                                           "enabled on this fleet "
+                                           "(matrix=True)"}, route)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) or b"{}"
+            body = json.loads(raw)
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            stream = bool(body.get("stream", False))
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}",
+                                  "code": "bad_json", "detail": {}},
+                            route)
+            return
+        self.metrics.counter(
+            "fleet_matrix_jobs_total",
+            help="front-door matrix jobs by op (validated at the "
+                 "replica; unknown ops still count — they cost a "
+                 "routed 400)",
+            op=str(body.get("op"))[:16]).inc()
+        http_id = self.headers.get("X-Request-Id")
+        try:
+            decision = self.sup.router.route_matrix()
+        except NoHealthyReplica as e:
+            self._send_json(503, {"error": str(e)}, route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+            return
+        t0 = time.perf_counter()
+        final_status = None
+        try:
+            try:
+                conn, resp, idx = proxy_submit(
+                    self.sup.router, decision, raw, http_id,
+                    self.server.request_timeout_s, path=route)
+            except ProxyAttemptFailed as e:
+                if e.status is not None:
+                    final_status = self._forward_body(
+                        e.status, e.body, e.headers, route, decision)
+                else:
+                    self._send_json(
+                        503, {"error": f"no replica reachable: {e}"},
+                        route, headers={"Retry-After": RETRY_AFTER_S})
+                    final_status = 503
+                return
+            try:
+                ctype = resp.getheader("Content-Type", "")
+                if stream and resp.status == 200 \
+                        and "text/event-stream" in ctype:
+                    final_status = self._forward_stream(
+                        resp, idx, route, decision)
+                else:
+                    try:
+                        payload_out = resp.read()
+                    except (OSError, HTTPException):
+                        self._send_json(
+                            502,
+                            {"error": "replica lost mid-job; retry "
+                             "is safe (no bytes were delivered)"},
+                            route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+                        final_status = 502
+                        return
+                    final_status = self._forward_body(
+                        resp.status, payload_out, resp.getheaders(),
+                        route, decision, replica=idx)
+            finally:
+                conn.close()
+        finally:
+            self.sup.router.release(decision)
+            self.sup.runlog.emit(
+                "fleet_matrix", request_id=decision.request_id,
+                replica=decision.replica_index,
+                status=final_status,
+                dt_s=round(time.perf_counter() - t0, 6))
+
     _FORWARD_HEADERS = ("Content-Type", "X-Request-Id",
-                        "X-Engine-Request-Id", "Retry-After")
+                        "X-Engine-Request-Id", "Retry-After",
+                        "X-Job-Id", "X-Matrix-Meta")
 
     def _id_headers(self, headers, decision, replica=None) -> dict:
         out = {}
@@ -736,6 +828,13 @@ def main(argv=None) -> int:
                         "forced host devices (docs/fleet.md)")
     p.add_argument("--replica-max-restarts", type=int, default=2)
     p.add_argument("--no-affinity", action="store_true")
+    p.add_argument("--matrix", action="store_true",
+                   help="serve /v1/matrix at the front door, routed "
+                        "by job class to matrix-enabled replicas "
+                        "(docs/matrix_service.md)")
+    p.add_argument("--matrix-replicas", type=int, default=0,
+                   help="dedicate the last K replicas to matrix jobs "
+                        "(0 = every replica serves both classes)")
     p.add_argument("--runlog-dir", default=None,
                    help="per-replica + router runlog JSONL directory")
     p.add_argument("--trace", action="store_true",
@@ -764,6 +863,7 @@ def main(argv=None) -> int:
         tp_degree=args.tp,
         replica_max_restarts=args.replica_max_restarts,
         affinity=not args.no_affinity, runlog_dir=args.runlog_dir,
+        matrix=args.matrix, matrix_replicas=args.matrix_replicas,
         trace=args.trace, trace_sample=args.trace_sample,
         trace_flight=args.trace_flight,
         trace_export_dir=args.trace_export_dir)

@@ -15,6 +15,8 @@ from .faults import (EngineStateCorrupt, FaultInjected, FaultPlan,
                      FaultSpec)
 from .frontend import (EngineFailed, EngineFrontend, FrontendError,
                        FrontendRequest, PoisonedRequest)
+from .jobs import (MatrixJobError, MatrixJobHandle, MatrixJobSpec,
+                   MatrixService, matrix_compute)
 from .pages import PAGE, PagePool
 from .prefix import PagedPrefixIndex, PrefixCache, copy_kv_rows
 from .queue import AdmissionQueue, QueueClosed, QueueFull, Request
@@ -40,6 +42,11 @@ __all__ = [
     "FrontendError",
     "FrontendRequest",
     "FrozenRow",
+    "MatrixJobError",
+    "MatrixJobHandle",
+    "MatrixJobSpec",
+    "MatrixService",
+    "matrix_compute",
     "PAGE",
     "PagePool",
     "PagedPrefixIndex",

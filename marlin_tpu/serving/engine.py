@@ -752,6 +752,11 @@ class ServingEngine:
         self._base_key = jax.random.PRNGKey(seed)
         self._next_id = 0
         self.round_idx = 0
+        # Matrix-service quanta the frontend driver interleaved since
+        # the last round emit (serving/jobs.py): stamped onto the round
+        # event so tools/runlog_report.py's stall detector can tell "a
+        # priced matrix quantum ran" from "nothing scheduled".
+        self._matrix_quanta = 0
         # Cost-model calibration (stats.calibration, docs/observability
         # .md §7): the per-round-iteration decode FLOPs the drift ledger
         # prices measured rounds against, computed once — decode shapes
@@ -2202,13 +2207,25 @@ class ServingEngine:
             round_s=round(time.perf_counter() - t_round0, 6),
             decode_s=round(decode_s, 6),
             drift_decode=round(self.stats.calibration.drift("decode"), 4),
-            **page_fields, **spec_fields, **sched_round_fields)
+            **page_fields, **spec_fields, **sched_round_fields,
+            **self._take_matrix_quanta())
         self.round_idx += 1
         # Ownership transfers through the return below; the crash-
         # consistency copy is only needed while a raise could still
         # strand resolved requests inside this engine.
         self._retired_pending = []
         return expired + finished
+
+    def note_matrix_quanta(self, n: int) -> None:
+        """Driver-thread hook (EngineFrontend._drive_loop): credit
+        ``n`` matrix-service quanta to the NEXT round event, so a round
+        whose budget went to a priced matrix quantum never reads as a
+        scheduling stall in the runlog."""
+        self._matrix_quanta += int(n)
+
+    def _take_matrix_quanta(self) -> dict:
+        mq, self._matrix_quanta = self._matrix_quanta, 0
+        return {"matrix_quanta": mq} if mq else {}
 
     def run(self, max_rounds: int = 10_000) -> List[Request]:
         """Step until the queue and every slot are empty (graceful

@@ -33,6 +33,10 @@ site                      fires inside
                           victim is chosen, before its live pages are
                           gathered to the host tier
                           (``_preempt_row``)
+``matrix_quantum``        the matrix service's per-quantum execution
+                          on the driver thread
+                          (``MatrixService.run_quanta``) — a crash
+                          here exercises the seed-replay boundary
 ========================  ============================================
 
 Each site calls :func:`check` (raise or sleep) or :func:`corrupt`
@@ -70,7 +74,7 @@ from ..obs import metrics as obs_metrics
 
 SITES = ("decode_round", "prefill_chunk", "prefix_copy",
          "admission_pop", "stream_fanout", "runlog_emit",
-         "kv_restore", "preempt_spill")
+         "kv_restore", "preempt_spill", "matrix_quantum")
 ACTIONS = ("raise", "delay", "corrupt")
 ENV_VAR = "MARLIN_FAULT_PLAN"
 

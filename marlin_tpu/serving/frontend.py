@@ -203,11 +203,18 @@ class EngineFrontend:
     ``restart_window_s`` before the frontend fails closed with
     :class:`EngineFailed`; a request in flight across ``poison_after``
     consecutive crashes is quarantined with :class:`PoisonedRequest`
-    instead of requeued."""
+    instead of requeued.
+
+    ``matrix`` optionally attaches a
+    :class:`~marlin_tpu.serving.jobs.MatrixService`: the SAME driver
+    thread then interleaves bounded matrix work quanta with decode
+    rounds (a slice per round under LLM load, a bigger slice when the
+    engine is idle — docs/matrix_service.md), and the supervisor's
+    crash boundary covers matrix jobs too (seed replay / poison)."""
 
     def __init__(self, engine, idle_wait: float = 0.05,
                  max_restarts: int = 3, restart_window_s: float = 60.0,
-                 poison_after: int = 2):
+                 poison_after: int = 2, matrix=None):
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got "
                              f"{max_restarts}")
@@ -215,6 +222,11 @@ class EngineFrontend:
             raise ValueError(f"poison_after must be >= 1, got "
                              f"{poison_after}")
         self.engine = engine
+        # MatrixService or None; its own lock guards its job state —
+        # the frontend only ever calls it from the driver thread
+        # (run_quanta / reset_inflight) or thread-safe entry points
+        # (submit / close / abandon).
+        self.matrix = matrix
         self.idle_wait = float(idle_wait)
         self.max_restarts = int(max_restarts)
         self.restart_window_s = float(restart_window_s)
@@ -272,6 +284,8 @@ class EngineFrontend:
         # inherits a closed queue via spawn_successor).
         with self._lock:
             self.engine.close()  # new submits now raise QueueClosed
+        if self.matrix is not None:
+            self.matrix.close()  # matrix submits now raise QueueClosed
         self._draining.set()
         self._wake.set()
         if self._thread is None:
@@ -335,6 +349,22 @@ class EngineFrontend:
         self._wake.set()
         return handle
 
+    def submit_matrix(self, spec, stream: bool = False):
+        """Thread-safe matrix-job submit (the ``POST /v1/matrix``
+        entry): price + queue a VALIDATED spec on the attached
+        :class:`~marlin_tpu.serving.jobs.MatrixService` and wake the
+        driver. ``QueueFull``/``QueueClosed`` propagate for the
+        429/503 mapping; raises ``ValueError`` when no matrix service
+        is attached (the server maps that to 404 — the route does not
+        exist on an LLM-only deployment)."""
+        self._raise_if_fatal()
+        if self.matrix is None:
+            raise ValueError(
+                "matrix service not enabled (start with --matrix)")
+        handle = self.matrix.submit(spec, stream=stream)
+        self._wake.set()
+        return handle
+
     def abandon_stream(self, handle: FrontendRequest) -> None:
         """The SSE client hung up mid-stream (serving/server.py caught
         the broken pipe): stop feeding this handle's chunk queue. The
@@ -367,6 +397,8 @@ class EngineFrontend:
                                    len(self._crash_times),
                                "max_restarts": self.max_restarts,
                                "failed": self._fatal is not None}
+        if self.matrix is not None:
+            out["matrix"] = self.matrix.summary()
         return out
 
     def debug_request(self, request_id: int):
@@ -423,22 +455,43 @@ class EngineFrontend:
     def _drive_loop(self) -> None:
         while not self._stopped.is_set():
             eng = self.engine  # re-read: _recover swaps it
-            if not self._has_work():
+            mx = self.matrix
+            llm_work = self._has_work()
+            mx_work = mx is not None and mx.has_work()
+            if not llm_work and not mx_work:
                 if self._draining.is_set():
                     eng._seal_drain()
                     return
                 self._wake.wait(self.idle_wait)
                 self._wake.clear()
                 continue
-            round_idx = eng.round_idx  # step() increments before return
-            finished = eng.step()
-            # Crash-consistency: hold the round's finished work where
-            # _recover can re-deliver it if fanout dies mid-way
-            # (delivery is idempotent — the handle pop hands each
-            # request out exactly once).
-            self._undelivered = list(finished)
-            self._fanout(eng, finished, round_idx)
-            self._undelivered = []
+            if llm_work:
+                round_idx = eng.round_idx  # step() increments before return
+                finished = eng.step()
+                # Crash-consistency: hold the round's finished work
+                # where _recover can re-deliver it if fanout dies
+                # mid-way (delivery is idempotent — the handle pop
+                # hands each request out exactly once).
+                self._undelivered = list(finished)
+                self._fanout(eng, finished, round_idx)
+                self._undelivered = []
+                if mx_work:
+                    # Mixed traffic: one bounded slice of matrix quanta
+                    # BETWEEN decode rounds — the chunked-prefill
+                    # interleave discipline, so decode SLOs bound the
+                    # added latency by one quantum (jobs.quanta_budget).
+                    n = mx.run_quanta(mx.quanta_budget(idle=False),
+                                      round_idx=round_idx)
+                    if n:
+                        eng.note_matrix_quanta(n)
+            else:
+                # Engine idle: grant matrix work the larger idle slice;
+                # wake-event checks between slices keep submit-to-round
+                # latency at idle_wait semantics for LLM arrivals.
+                n = mx.run_quanta(mx.quanta_budget(idle=True),
+                                  round_idx=eng.round_idx)
+                if n:
+                    eng.note_matrix_quanta(n)
         # Hard stop: anything still in flight will never finish —
         # fail the waiters instead of hanging them.
         self._abandon(FrontendError("frontend stopped mid-flight"))
@@ -585,6 +638,12 @@ class EngineFrontend:
                     keep=True, reason="poisoned")
             if h is not None:
                 h._fail(perr)
+        # Matrix jobs ride the same crash boundary: the in-flight job
+        # replays from its seed on the successor (bit-exact — inputs
+        # are a pure function of the spec) or is quarantined after
+        # poison_after consecutive crashes, mirroring the LLM verdicts.
+        if self.matrix is not None:
+            self.matrix.reset_inflight(exc, now)
         self._wake.set()  # recovered work is ready to schedule
         return True
 
@@ -594,6 +653,8 @@ class EngineFrontend:
             self._handles.clear()
         for h in orphans:
             h._fail(err)
+        if self.matrix is not None:
+            self.matrix.abandon(err)
 
     def _fanout(self, eng, finished: List, round_idx: int) -> None:
         """Post-round delivery: push newly visible tokens to live

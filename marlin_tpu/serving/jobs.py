@@ -1,0 +1,1132 @@
+"""Matrix-ops-as-a-service: a job-class-agnostic execution service on
+the serving substrate (ROADMAP item 17, docs/matrix_service.md).
+
+After 19 PRs only the LLM decode path was servable; every matrix op the
+paper is actually about — GEMM, LU, Cholesky, SVD, spmm, inverse — was
+an in-process library call reachable only from bench.py. This module
+turns them into SUBMITTABLE JOBS on the same driver thread, scheduled
+at iteration granularity (the Orca discipline the engine already
+applies to decode rounds):
+
+* a client POSTs ``/v1/matrix`` (serving/server.py) with ``op``,
+  ``shapes``, ``dtype``, and seed-or-payload inputs; :func:`validate_job`
+  rejects malformed jobs with TYPED errors (:class:`MatrixJobError`
+  carries a machine-readable ``code`` + ``detail`` — the structured 400
+  body) so no job ever reaches the driver thread unpriced;
+* admission PRICES the job with ``utils/cost_model`` (gemm_cost /
+  summa-family rooflines / ell_product_cost) into ROUND BUDGETS:
+  total model units, the executor's quantum count, and — once the
+  :class:`~marlin_tpu.utils.cost_model.CostCalibration` ledger has
+  measured sec/unit for the op class (keys ``matrix_<op>``) — an
+  absolute predicted wall clock and rounds-to-finish;
+* the frontend driver executes the job in BOUNDED WORK QUANTA (panel /
+  block-step / nnz-chunk granularity — the chunked-prefill interleaving
+  discipline applied to matrix kernels): a slice between decode rounds
+  under mixed traffic, a bigger slice when the engine is idle, so
+  decode SLOs survive a 4M-element factorization landing mid-stream;
+* progress streams over the existing SSE machinery (``phase`` /
+  ``quantum`` / ``progress`` events, same byte framing as token
+  streams); results return as dtype-tagged npz payloads under the
+  PR 16 serialization rules VERBATIM (``pages._SAVEZ_NATIVE``:
+  bfloat16 upcasts to float32 on the wire — a value-exact superset —
+  and casts back on decode; int8 results carry their float32 scale
+  siblings).
+
+Byte-exactness: every executor is a HOST LOOP over deterministic steps
+— jitted fixed-shape panel programs (GEMM row panels, the LU panel
+step ``linalg/lu._lu_panel_step`` reused verbatim in ``_lu_blocked``'s
+exact sequence) or sequential numpy scatter-adds (spmm COO chunks) —
+and :func:`matrix_compute` IS that same loop run synchronously. An HTTP
+result is therefore byte-identical to the in-process call by
+construction, not by tolerance; and because inputs are a pure function
+of ``(op, shapes, seed)``, a job replayed after an engine crash
+(frontend supervisor, docs/robustness.md) reproduces identical bytes.
+
+Threading contract mirrors the frontend bridge: handler threads call
+:meth:`MatrixService.submit` / :meth:`validate`; ONLY the driver thread
+calls :meth:`run_quanta` / :meth:`reset_inflight`. Shared job state is
+guarded by ``_lock`` (marlint guarded-by); executor state is
+driver-thread-only by the same contract as the engine's device state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import io
+import json
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ..config import linalg_precision_scope
+from ..linalg.lu import (_host_fetch, _lu_panel_step, _pad_identity,
+                         lu_factor_array)
+from ..obs import metrics as obs_metrics
+from ..obs.runlog import RunLog
+from ..utils import cost_model as cm
+from . import faults
+from .frontend import FrontendError, PoisonedRequest
+# The ONE copy of the npz dtype rules (PR 16): what savez round-trips
+# natively; anything else upcasts to float32 on the wire and casts back
+# on decode.
+from .pages import _SAVEZ_NATIVE
+from .queue import QueueClosed, QueueFull
+
+_EOS = object()  # closes a streaming handle's event queue
+
+# Service-side shape bounds: a job is rejected (typed 400) before any
+# array is materialized, so an overflow shape cannot OOM the driver.
+MAX_DIM = 1 << 14        # per-dimension bound
+MAX_ELEMENTS = 1 << 22   # per-operand element bound (~4M)
+
+_OP_ARITY = {"gemm": 3, "spmm": 3, "svd": 2,
+             "lu": 1, "cholesky": 1, "inverse": 1}
+_FLOAT_DTYPES = ("float32", "float64")
+_OP_DTYPES = {
+    "gemm": ("float32", "float64", "bfloat16", "int8"),
+    "spmm": _FLOAT_DTYPES,
+    "lu": _FLOAT_DTYPES,
+    "cholesky": _FLOAT_DTYPES,
+    "svd": _FLOAT_DTYPES,
+    "inverse": _FLOAT_DTYPES,
+}
+_NP_DTYPES = {"float32": np.float32, "float64": np.float64,
+              "bfloat16": ml_dtypes.bfloat16, "int8": np.int8}
+
+
+class MatrixJobError(ValueError):
+    """A malformed matrix job, rejected at validation/pricing — BEFORE
+    the driver thread (the typed-400 contract). ``code`` is the
+    machine-readable class (``unknown_op`` / ``bad_shape`` /
+    ``shape_overflow`` / ``bad_dtype`` / ``payload_mismatch`` /
+    ``bad_inputs`` / ``bad_knob``); ``detail`` carries the offending
+    values for the structured error body."""
+
+    def __init__(self, code: str, message: str,
+                 detail: Optional[dict] = None):
+        super().__init__(message)
+        self.code = code
+        self.detail = detail or {}
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixJobSpec:
+    """One validated matrix job: everything execution needs, nothing it
+    must re-check. Frozen — replay after a crash rebuilds the executor
+    from this spec and the seed, so the spec must not drift."""
+
+    op: str
+    shapes: Tuple[int, ...]
+    dtype: str
+    seed: Optional[int]
+    # Validated payload operands (payload jobs); None on seed jobs.
+    payload: Optional[Dict[str, np.ndarray]]
+    # Executor granularity knobs (validated, defaulted).
+    panel: int = 32       # gemm row-panel height
+    base: int = 16        # LU panel width (linalg/lu.py base_size)
+    nnz_chunk: int = 4096  # spmm COO chunk
+    density: float = 0.05  # spmm seed-path density
+    k: int = 4            # svd singular values
+
+
+def _x64_enabled() -> bool:
+    return bool(jax.config.jax_enable_x64)
+
+
+def _expected_operands(spec_op: str, shapes: Tuple[int, ...],
+                       dtype: str, nnz: Optional[int] = None
+                       ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+    """name -> (shape, dtype name) of the operands a job consumes —
+    the payload contract and the seed generator's output schema."""
+    if spec_op == "gemm":
+        m, k, n = shapes
+        ops = {"a": ((m, k), dtype), "b": ((k, n), dtype)}
+        if dtype == "int8":
+            ops["a_scale"] = ((m,), "float32")
+            ops["b_scale"] = ((n,), "float32")
+        return ops
+    if spec_op == "spmm":
+        m, k, n = shapes
+        nz = int(nnz) if nnz is not None else 0
+        return {"a_rows": ((nz,), "int64"), "a_cols": ((nz,), "int64"),
+                "a_vals": ((nz,), dtype), "b": ((k, n), dtype)}
+    if spec_op == "svd":
+        m, n = shapes
+        return {"a": ((m, n), dtype)}
+    (n,) = shapes
+    return {"a": ((n, n), dtype)}
+
+
+def validate_job(body: dict) -> MatrixJobSpec:
+    """Validate + normalize one ``POST /v1/matrix`` body into a
+    :class:`MatrixJobSpec`, raising :class:`MatrixJobError` (the typed
+    400) on anything malformed. Every rejection happens HERE, on the
+    handler thread — the driver only ever sees priced, well-formed
+    jobs."""
+    op = body.get("op")
+    if not isinstance(op, str) or op not in cm.MATRIX_JOB_OPS:
+        raise MatrixJobError(
+            "unknown_op", f"unknown op {op!r}; ops: "
+            f"{', '.join(cm.MATRIX_JOB_OPS)}", {"op": op})
+    raw_shapes = body.get("shapes")
+    if not isinstance(raw_shapes, (list, tuple)) or not raw_shapes:
+        raise MatrixJobError("bad_shape", "shapes must be a non-empty "
+                             "list of positive ints",
+                             {"shapes": raw_shapes})
+    try:
+        shapes = tuple(int(s) for s in raw_shapes)
+    except (TypeError, ValueError):
+        raise MatrixJobError("bad_shape", f"non-integer shape entry in "
+                             f"{raw_shapes!r}", {"shapes": raw_shapes})
+    arity = _OP_ARITY[op]
+    if len(shapes) != arity:
+        raise MatrixJobError(
+            "bad_shape", f"op {op!r} takes {arity} shape entr"
+            f"{'y' if arity == 1 else 'ies'} "
+            f"({_shape_doc(op)}), got {len(shapes)}",
+            {"op": op, "shapes": list(shapes)})
+    if any(s <= 0 for s in shapes):
+        raise MatrixJobError("bad_shape", f"non-positive dimension in "
+                             f"{list(shapes)}", {"shapes": list(shapes)})
+    if any(s > MAX_DIM for s in shapes) or _max_elements(op, shapes) \
+            > MAX_ELEMENTS:
+        raise MatrixJobError(
+            "shape_overflow",
+            f"shapes {list(shapes)} exceed the service bound "
+            f"(max dim {MAX_DIM}, max operand elements {MAX_ELEMENTS})",
+            {"shapes": list(shapes), "max_dim": MAX_DIM,
+             "max_elements": MAX_ELEMENTS})
+    dtype = body.get("dtype", "float32")
+    if dtype not in _OP_DTYPES[op]:
+        raise MatrixJobError(
+            "bad_dtype", f"op {op!r} does not support dtype {dtype!r} "
+            f"(supported: {', '.join(_OP_DTYPES[op])})",
+            {"op": op, "dtype": dtype})
+    if dtype == "float64" and op != "spmm" and not _x64_enabled():
+        # Without x64 the jax path silently downcasts — the result
+        # would be float32 bytes under a float64 tag; reject instead.
+        raise MatrixJobError(
+            "bad_dtype", "float64 jobs need jax x64 enabled on this "
+            "server (JAX_ENABLE_X64); spmm (host numpy) is exempt",
+            {"dtype": dtype})
+    knobs = {}
+    for name, default, lo in (("panel", 32, 1), ("base", 16, 1),
+                              ("nnz_chunk", 4096, 1), ("k", 4, 1)):
+        val = body.get(name, default)
+        try:
+            val = int(val)
+        except (TypeError, ValueError):
+            raise MatrixJobError("bad_knob", f"{name} must be an int, "
+                                 f"got {val!r}", {name: val})
+        if val < lo:
+            raise MatrixJobError("bad_knob", f"{name} must be >= {lo}, "
+                                 f"got {val}", {name: val})
+        knobs[name] = val
+    density = body.get("density", 0.05)
+    try:
+        density = float(density)
+    except (TypeError, ValueError):
+        raise MatrixJobError("bad_knob", f"density must be a float, "
+                             f"got {density!r}", {"density": density})
+    if not 0.0 < density <= 1.0:
+        raise MatrixJobError("bad_knob", f"density must be in (0, 1], "
+                             f"got {density}", {"density": density})
+    knobs["density"] = density
+    if op == "svd" and knobs["k"] > min(shapes):
+        raise MatrixJobError(
+            "bad_knob", f"svd k={knobs['k']} exceeds min(shapes)="
+            f"{min(shapes)}", {"k": knobs["k"], "shapes": list(shapes)})
+    payload = body.get("payload")
+    seed: Optional[int] = None
+    if payload is None:
+        try:
+            seed = int(body.get("seed", 0))
+        except (TypeError, ValueError):
+            raise MatrixJobError("bad_inputs", f"seed must be an int, "
+                                 f"got {body.get('seed')!r}",
+                                 {"seed": body.get("seed")})
+        return MatrixJobSpec(op=op, shapes=shapes, dtype=dtype,
+                             seed=seed, payload=None, **knobs)
+    if body.get("seed") is not None:
+        raise MatrixJobError(
+            "bad_inputs", "pass seed OR payload, not both (a payload "
+            "job's replay identity is the payload itself)", {})
+    if not isinstance(payload, dict):
+        raise MatrixJobError("payload_mismatch", "payload must be an "
+                             "object of named operand arrays", {})
+    nnz = None
+    if op == "spmm":
+        vals = payload.get("a_vals")
+        nnz = len(vals) if isinstance(vals, (list, tuple)) else None
+        if nnz is None or nnz < 1 or nnz > MAX_ELEMENTS:
+            raise MatrixJobError(
+                "payload_mismatch", "spmm payload needs a non-empty "
+                "a_vals list (COO values, bounded by the element cap)",
+                {"nnz": nnz, "max_elements": MAX_ELEMENTS})
+    expected = _expected_operands(op, shapes, dtype, nnz=nnz)
+    if set(payload) != set(expected):
+        raise MatrixJobError(
+            "payload_mismatch",
+            f"op {op!r} ({dtype}) payload needs exactly "
+            f"{sorted(expected)}, got {sorted(payload)}",
+            {"expected": sorted(expected), "got": sorted(payload)})
+    arrays: Dict[str, np.ndarray] = {}
+    for name, (shape, dt) in expected.items():
+        try:
+            arr = np.asarray(payload[name], dtype=_np_dtype(dt))
+        except (TypeError, ValueError, OverflowError) as e:
+            raise MatrixJobError(
+                "payload_mismatch",
+                f"payload operand {name!r} is not castable to {dt}: "
+                f"{e}", {"operand": name, "dtype": dt})
+        if arr.shape != shape:
+            raise MatrixJobError(
+                "payload_mismatch",
+                f"payload operand {name!r} has shape "
+                f"{list(arr.shape)}, job shapes imply {list(shape)}",
+                {"operand": name, "got": list(arr.shape),
+                 "expected": list(shape)})
+        arrays[name] = arr
+    if op == "spmm":
+        m, k, _ = shapes
+        if (arrays["a_rows"] < 0).any() or (arrays["a_rows"] >= m).any() \
+                or (arrays["a_cols"] < 0).any() \
+                or (arrays["a_cols"] >= k).any():
+            raise MatrixJobError(
+                "payload_mismatch", "spmm COO indices out of bounds for "
+                f"A({m}, {k})", {"m": m, "k": k})
+    return MatrixJobSpec(op=op, shapes=shapes, dtype=dtype, seed=None,
+                         payload=arrays, **knobs)
+
+
+def _shape_doc(op: str) -> str:
+    return {"gemm": "[m, k, n]", "spmm": "[m, k, n]", "svd": "[m, n]",
+            "lu": "[n]", "cholesky": "[n]", "inverse": "[n]"}[op]
+
+
+def _max_elements(op: str, shapes: Tuple[int, ...]) -> int:
+    if op in ("gemm", "spmm"):
+        m, k, n = shapes
+        return max(m * k, k * n, m * n)
+    if op == "svd":
+        m, n = shapes
+        return m * n
+    (n,) = shapes
+    return n * n
+
+
+def _np_dtype(name: str):
+    return _NP_DTYPES.get(name, np.dtype(name).type)
+
+
+# -- deterministic inputs ---------------------------------------------
+
+
+def generate_inputs(spec: MatrixJobSpec) -> Dict[str, np.ndarray]:
+    """Materialize a job's operands: the payload verbatim, or —
+    seed jobs — a pure function of ``(op, shapes, dtype, seed)`` via a
+    dedicated PCG stream. The crash-replay and fleet-failover
+    byte-exactness arguments both reduce to this purity (the engine's
+    ``f(prompt, steps, seed, request_id)`` contract, applied to matrix
+    jobs)."""
+    if spec.payload is not None:
+        return dict(spec.payload)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([0x6D78, int(spec.seed or 0)]))
+    dt = _np_dtype(spec.dtype)
+
+    def normal(shape):
+        return rng.standard_normal(shape, dtype=np.float64).astype(dt)
+
+    if spec.op == "gemm":
+        m, k, n = spec.shapes
+        if spec.dtype == "int8":
+            return {
+                "a": rng.integers(-127, 127, size=(m, k),
+                                  endpoint=True).astype(np.int8),
+                "b": rng.integers(-127, 127, size=(k, n),
+                                  endpoint=True).astype(np.int8),
+                "a_scale": (rng.random(m) * 0.05
+                            + 0.01).astype(np.float32),
+                "b_scale": (rng.random(n) * 0.05
+                            + 0.01).astype(np.float32),
+            }
+        return {"a": normal((m, k)), "b": normal((k, n))}
+    if spec.op == "spmm":
+        m, k, n = spec.shapes
+        nnz = max(1, int(spec.density * m * k))
+        rows = rng.integers(0, m, size=nnz)
+        cols = rng.integers(0, k, size=nnz)
+        vals = rng.standard_normal(nnz, dtype=np.float64).astype(dt)
+        order = np.lexsort((cols, rows))  # canonical COO order
+        return {"a_rows": rows[order], "a_cols": cols[order],
+                "a_vals": vals[order], "b": normal((k, n))}
+    if spec.op == "svd":
+        return {"a": normal(spec.shapes)}
+    (n,) = spec.shapes
+    if spec.op == "cholesky":
+        b = rng.standard_normal((n, n), dtype=np.float64)
+        return {"a": ((b @ b.T) / n + np.eye(n)).astype(dt)}
+    if spec.op == "inverse":
+        b = rng.standard_normal((n, n), dtype=np.float64)
+        return {"a": (b + n * np.eye(n)).astype(dt)}
+    return {"a": normal((n, n))}  # lu
+
+
+# -- quantum executors ------------------------------------------------
+#
+# Each executor is a host loop over bounded deterministic steps; the
+# synchronous composition of its steps IS the in-process call
+# (matrix_compute), which is the whole byte-exactness argument.
+
+
+@functools.partial(jax.jit, static_argnames=("panel",))
+def _gemm_panel_step(a, b, j0, *, panel: int):
+    """One GEMM row-panel: C[j0:j0+panel] = A[j0:j0+panel] @ B. The
+    panel height is static and the offset traced — ONE compiled
+    program reused across every quantum of every job of this shape
+    class (the LU panel-step discipline, linalg/lu.py)."""
+    ap = jax.lax.dynamic_slice(a, (j0, jnp.int32(0)),
+                               (panel, a.shape[1]))
+    return jnp.dot(ap, b)
+
+
+class _GemmExecutor:
+    """Row-panel GEMM quanta. int8 jobs dequantize (int8 x f32 scales)
+    into the f32 panel loop and REQUANTIZE per output row at the end —
+    the result carries the int8 matrix plus its float32 ``c_scale``
+    sibling (the PR 16 scale-sibling rule, applied to results)."""
+
+    def __init__(self, spec: MatrixJobSpec,
+                 inputs: Dict[str, np.ndarray]):
+        m, k, n = spec.shapes
+        self._spec = spec
+        self._m = m
+        self._quant = spec.dtype == "int8"
+        if self._quant:
+            a = inputs["a"].astype(np.float32) \
+                * inputs["a_scale"][:, None]
+            b = inputs["b"].astype(np.float32) \
+                * inputs["b_scale"][None, :]
+        else:
+            a, b = inputs["a"], inputs["b"]
+        self.panel = min(spec.panel, m)
+        mpad = -(-m // self.panel) * self.panel
+        if mpad != m:
+            a = np.concatenate(
+                [a, np.zeros((mpad - m, k), a.dtype)], axis=0)
+        self._a = jnp.asarray(a)
+        self._b = jnp.asarray(b)
+        self.n_quanta = mpad // self.panel
+        self._panels: List[np.ndarray] = []
+
+    @property
+    def done(self) -> bool:
+        return len(self._panels) >= self.n_quanta
+
+    def step(self) -> None:
+        i = len(self._panels)
+        with linalg_precision_scope():
+            cp = _gemm_panel_step(self._a, self._b,
+                                  jnp.int32(i * self.panel),
+                                  panel=self.panel)
+        self._panels.append(np.asarray(jax.device_get(cp)))
+
+    def result(self) -> Dict[str, np.ndarray]:
+        c = np.concatenate(self._panels, axis=0)[:self._m]
+        if not self._quant:
+            return {"c": c}
+        scale = np.maximum(np.max(np.abs(c), axis=1),
+                           np.float32(1e-30)) / np.float32(127.0)
+        scale = scale.astype(np.float32)
+        q = np.clip(np.rint(c / scale[:, None]), -127, 127) \
+            .astype(np.int8)
+        return {"c": q, "c_scale": scale}
+
+
+class _LuExecutor:
+    """Blocked-LU panel quanta: ``linalg/lu._lu_blocked``'s EXACT
+    sequence (pad-identity, arange perm, one ``_lu_panel_step`` per
+    panel under ``linalg_precision_scope``, slice back, host-fetch the
+    pivots) with the host loop sliced one panel per quantum — the
+    service result is byte-identical to
+    ``lu_factor_array(a, mode="dist", base_size=base)`` because it IS
+    that call, paused between panels."""
+
+    def __init__(self, spec: MatrixJobSpec,
+                 inputs: Dict[str, np.ndarray]):
+        (n,) = spec.shapes
+        self._n = n
+        self.base = min(spec.base, n)
+        a = jnp.asarray(inputs["a"])
+        if self.base >= n:
+            # lu_factor_array's own local fallback for base >= n; one
+            # quantum, still the identical call.
+            self._local_a: Optional[jax.Array] = a
+            self.n_quanta = 1
+            self._i = 0
+            return
+        self._local_a = None
+        self._npad = -(-n // self.base) * self.base
+        self._ap = _pad_identity(a, self._npad) if self._npad != n \
+            else jnp.copy(a)
+        self._perm = jnp.arange(self._ap.shape[0])
+        self.n_quanta = self._npad // self.base
+        self._i = 0
+
+    @property
+    def done(self) -> bool:
+        return self._i >= self.n_quanta
+
+    def step(self) -> None:
+        if self._local_a is not None:
+            packed, perm = lu_factor_array(self._local_a, mode="dist",
+                                           base_size=self.base)
+            self._out = {"lu": np.asarray(jax.device_get(packed)),
+                         "perm": np.asarray(perm)}
+            self._i += 1
+            return
+        with linalg_precision_scope():
+            self._ap, self._perm = _lu_panel_step(
+                self._ap, self._perm, jnp.int32(self._i * self.base),
+                base=self.base)
+        self._i += 1
+
+    def result(self) -> Dict[str, np.ndarray]:
+        if self._local_a is not None:
+            return self._out
+        packed, perm = self._ap, self._perm
+        if self._npad != self._n:
+            packed = packed[:self._n, :self._n]
+            perm = perm[:self._n]
+        return {"lu": np.asarray(jax.device_get(packed)),
+                "perm": _host_fetch(perm)}
+
+
+class _SpmmExecutor:
+    """COO nnz-chunk quanta: each quantum scatter-adds one bounded
+    chunk of A's entries into C with ``np.add.at`` — sequential over
+    the canonical (row, col)-sorted entry order, so the chunked loop
+    applies the EXACT addition sequence of the one-shot call (chunking
+    changes scheduling, never arithmetic). Priced with
+    ``ell_product_cost`` — the low-density roofline of
+    matrix/dist_sparse.py."""
+
+    def __init__(self, spec: MatrixJobSpec,
+                 inputs: Dict[str, np.ndarray]):
+        m, k, n = spec.shapes
+        self._rows = np.asarray(inputs["a_rows"], np.int64)
+        self._cols = np.asarray(inputs["a_cols"], np.int64)
+        self._vals = inputs["a_vals"]
+        self._b = inputs["b"]
+        self._c = np.zeros((m, n), dtype=self._vals.dtype)
+        self.chunk = spec.nnz_chunk
+        self.n_quanta = max(1, -(-len(self._vals) // self.chunk))
+        self._i = 0
+
+    @property
+    def done(self) -> bool:
+        return self._i >= self.n_quanta
+
+    def step(self) -> None:
+        sl = slice(self._i * self.chunk, (self._i + 1) * self.chunk)
+        np.add.at(self._c, self._rows[sl],
+                  self._vals[sl, None] * self._b[self._cols[sl]])
+        self._i += 1
+
+    def result(self) -> Dict[str, np.ndarray]:
+        return {"c": self._c}
+
+
+class _LibraryCallExecutor:
+    """Single-quantum ops (cholesky / svd / inverse): the quantum IS
+    the library call, so service-vs-in-process byte-identity is
+    trivial — and the job is still priced, budgeted, and interleaved
+    like any other (one quantum just means one engine-idle slice)."""
+
+    n_quanta = 1
+
+    def __init__(self, spec: MatrixJobSpec,
+                 inputs: Dict[str, np.ndarray]):
+        self._spec = spec
+        self._inputs = inputs
+        self._out: Optional[Dict[str, np.ndarray]] = None
+
+    @property
+    def done(self) -> bool:
+        return self._out is not None
+
+    def step(self) -> None:
+        spec = self._spec
+        a = jnp.asarray(self._inputs["a"])
+        if spec.op == "cholesky":
+            from ..linalg.cholesky import cholesky_factor_array
+
+            self._out = {"l": np.asarray(
+                jax.device_get(cholesky_factor_array(a, mode="auto")))}
+        elif spec.op == "inverse":
+            from ..linalg.inverse import inverse
+
+            self._out = {"inv": np.asarray(
+                jax.device_get(inverse(a, mode="local")))}
+        else:  # svd
+            from ..matrix.dense import DenseVecMatrix
+
+            res = DenseVecMatrix(a).compute_svd(
+                spec.k, compute_u=True, mode="local-svd")
+            self._out = {
+                "s": np.asarray(res.s), "v": np.asarray(res.v),
+                **({"u": np.asarray(
+                    jax.device_get(res.u.logical))}
+                   if res.u is not None else {})}
+
+    def result(self) -> Dict[str, np.ndarray]:
+        return self._out
+
+
+def build_executor(spec: MatrixJobSpec,
+                   inputs: Optional[Dict[str, np.ndarray]] = None):
+    """Materialize inputs (seed or payload) and the op's executor."""
+    if inputs is None:
+        inputs = generate_inputs(spec)
+    if spec.op == "gemm":
+        return _GemmExecutor(spec, inputs)
+    if spec.op == "lu":
+        return _LuExecutor(spec, inputs)
+    if spec.op == "spmm":
+        return _SpmmExecutor(spec, inputs)
+    return _LibraryCallExecutor(spec, inputs)
+
+
+def executor_quanta(spec: MatrixJobSpec) -> int:
+    """The quantum count WITHOUT materializing arrays — what admission
+    pricing slices the job's units into (the executor later reports
+    the same number; pinned by tests/test_matrix_service.py)."""
+    if spec.op == "gemm":
+        m = spec.shapes[0]
+        return -(-m // min(spec.panel, m))
+    if spec.op == "lu":
+        n = spec.shapes[0]
+        base = min(spec.base, n)
+        return 1 if base >= n else -(-n // base)
+    if spec.op == "spmm":
+        m, k, _ = spec.shapes
+        nnz = len(spec.payload["a_vals"]) if spec.payload is not None \
+            else max(1, int(spec.density * m * k))
+        return max(1, -(-nnz // spec.nnz_chunk))
+    return 1
+
+
+def _cal_key(spec: MatrixJobSpec) -> str:
+    """Calibration-ledger key: per (op, dtype), not per op. The unit
+    count from :func:`~marlin_tpu.utils.cost_model.matrix_job_cost`
+    scales with shape, but the sec/unit an executor actually spends is
+    dtype-shaped — int8 gemm dequantizes into an f32 loop and
+    requantizes per row, bfloat16 upcasts — so one shared ``matrix_op``
+    EWMA ping-pongs between dtypes and every prediction lands between
+    regimes. Keyed per dtype, repeated jobs converge inside the 25%
+    pricing bar (the metrics_matrix SLO gate)."""
+    return f"matrix_{spec.op}_{spec.dtype}"
+
+
+def matrix_compute(body: dict) -> Dict[str, np.ndarray]:
+    """The canonical IN-PROCESS call: validate the same body the HTTP
+    endpoint takes and run the same executor loop synchronously. The
+    service's byte-exactness acceptance is literally
+    ``decode_result(http_bytes)[arrays] == matrix_compute(body)``,
+    array for array, bit for bit."""
+    spec = validate_job(dict(body))
+    ex = build_executor(spec)
+    while not ex.done:
+        ex.step()
+    return ex.result()
+
+
+# -- result wire format (PR 16 npz rules, verbatim) -------------------
+
+
+def encode_result(arrays: Dict[str, np.ndarray], meta: dict) -> bytes:
+    """Dtype-tagged npz payload: native dtypes as-is; non-native
+    (bfloat16) upcast to float32 — a value-exact superset — with a
+    ``__dtype_<name>`` tag so :func:`decode_result` casts back
+    losslessly (serving/pages.py's spill-file rules applied to the
+    wire). ``__meta`` rides inside the same npz as a JSON string, so a
+    result payload is self-describing with zero side channels."""
+    data: Dict[str, np.ndarray] = {}
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        if arr.dtype.name not in _SAVEZ_NATIVE:
+            data["__dtype_" + name] = np.array(arr.dtype.name)
+            arr = np.asarray(arr, np.float32)
+        data[name] = arr
+    data["__meta"] = np.array(json.dumps(meta))
+    buf = io.BytesIO()
+    np.savez(buf, **data)
+    return buf.getvalue()
+
+
+def decode_result(payload: bytes
+                  ) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Inverse of :func:`encode_result`: (arrays, meta), with tagged
+    dtypes cast back (the bfloat16 round trip is exact — every bf16
+    value is representable in f32 and the cast back truncates to the
+    original bits)."""
+    arrays: Dict[str, np.ndarray] = {}
+    tags: Dict[str, str] = {}
+    meta: dict = {}
+    with np.load(io.BytesIO(payload)) as z:
+        for name in z.files:
+            if name == "__meta":
+                meta = json.loads(str(z[name][()]))
+            elif name.startswith("__dtype_"):
+                tags[name[len("__dtype_"):]] = str(z[name][()])
+            else:
+                arrays[name] = z[name]
+    for name, dt in tags.items():
+        arrays[name] = np.asarray(arrays[name], _np_dtype(dt))
+    return arrays, meta
+
+
+# -- the service ------------------------------------------------------
+
+
+class MatrixJobHandle:
+    """One job's handle, mirroring :class:`~marlin_tpu.serving.frontend.
+    FrontendRequest`: handler threads block on :meth:`result` or
+    iterate :meth:`events`; the driver pushes via ``_push_event`` /
+    ``_complete`` / ``_fail``."""
+
+    def __init__(self, job_id: int, stream: bool, submit_time: float):
+        self.job_id = job_id
+        self.stream = stream
+        self.submit_time = submit_time
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.result_bytes: Optional[bytes] = None
+        self.meta: Optional[dict] = None
+        self.abandoned = False  # SSE client hung up; job still runs
+        self._events: Optional[_queue.Queue] = \
+            _queue.Queue() if stream else None
+
+    def result(self, timeout: Optional[float] = None
+               ) -> Tuple[bytes, dict]:
+        """Block until the job finishes; returns ``(npz bytes, meta)``.
+        Raises the typed failure — :class:`PoisonedRequest` /
+        :class:`FrontendError` — and ``TimeoutError`` on timeout."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"matrix job {self.job_id} not done after {timeout}s")
+        if self.error is not None:
+            if isinstance(self.error, FrontendError):
+                raise self.error
+            raise FrontendError(
+                f"driver thread failed serving matrix job "
+                f"{self.job_id}") from self.error
+        return self.result_bytes, self.meta
+
+    def events(self):
+        """Yield progress events (dicts) in execution order, ending at
+        completion; raises the typed failure mid-iteration if the
+        driver died. SSE framing happens in serving/server.py — the
+        same machinery that frames token streams."""
+        if self._events is None:
+            raise ValueError("not a streaming job")
+        while True:
+            ev = self._events.get()
+            if ev is _EOS:
+                if self.error is not None:
+                    if isinstance(self.error, FrontendError):
+                        raise self.error
+                    raise FrontendError(
+                        f"driver thread failed serving matrix job "
+                        f"{self.job_id}") from self.error
+                return
+            yield ev
+
+    # -- driver-thread side -------------------------------------------
+
+    def _push_event(self, ev: dict) -> None:
+        if self._events is not None and not self.abandoned:
+            self._events.put(ev)
+
+    def _complete(self, payload: bytes, meta: dict) -> None:
+        self.result_bytes = payload
+        self.meta = meta
+        if self._events is not None:
+            self._events.put(_EOS)
+        self.done.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        if self._events is not None:
+            self._events.put(_EOS)
+        self.done.set()
+
+
+class _Job:
+    """Driver-side job record. ``executor``/``quanta_done``/timing are
+    driver-thread-only; the queue/handle bookkeeping around it is
+    guarded by the service lock."""
+
+    __slots__ = ("spec", "handle", "job_id", "budget", "executor",
+                 "quanta_done", "crash_count", "t_exec0", "itemsize")
+
+    def __init__(self, spec: MatrixJobSpec, handle: MatrixJobHandle,
+                 budget: dict):
+        self.spec = spec
+        self.handle = handle
+        self.job_id = handle.job_id
+        self.budget = budget
+        self.executor = None
+        self.quanta_done = 0
+        self.crash_count = 0
+        self.t_exec0: Optional[float] = None
+
+
+class MatrixService:
+    """The job queue + quantum scheduler the frontend driver runs
+    matrix work through (module docstring).
+
+    ``round_budget_s`` is the mixed-traffic interleave slice: under LLM
+    load the driver grants one slice of quanta between decode rounds;
+    idle, it grants ``idle_budget_s`` worth. Supervision mirrors the
+    frontend: a job in flight across ``poison_after`` consecutive
+    engine crashes is quarantined with :class:`PoisonedRequest`; any
+    other crash replays the job FROM ITS SEED (deterministic inputs →
+    bit-exact replay)."""
+
+    def __init__(self, metrics=None, runlog=None, calibration=None,
+                 max_pending: int = 8, round_budget_s: float = 0.010,
+                 idle_budget_s: float = 0.050, poison_after: int = 2):
+        if max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {max_pending}")
+        if poison_after < 1:
+            raise ValueError(
+                f"poison_after must be >= 1, got {poison_after}")
+        self.metrics = metrics if metrics is not None \
+            else obs_metrics.registry
+        self.runlog = runlog if runlog is not None \
+            else RunLog(maxlen=1024)
+        self.calibration = calibration if calibration is not None \
+            else cm.CostCalibration(registry=self.metrics)
+        self.max_pending = int(max_pending)
+        self.round_budget_s = float(round_budget_s)
+        self.idle_budget_s = float(idle_budget_s)
+        self.poison_after = int(poison_after)
+        self._lock = threading.Lock()
+        self._pending: deque = deque()  # guarded-by: _lock
+        self._running: Optional[_Job] = None  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self._n_done = 0  # guarded-by: _lock
+        # Register EVERY serving_matrix_* series at construction (the
+        # PR 16 host-tier staleness doctrine): the committed SLO
+        # baseline references these names, and the consistency test
+        # must see them in a live snapshot even before the first job.
+        m = self.metrics
+        for op in cm.MATRIX_JOB_OPS:
+            m.counter("serving_matrix_jobs_total",
+                      help="matrix jobs admitted, by op", op=op)
+        m.counter("serving_matrix_jobs_rejected_total",
+                  help="matrix jobs rejected at validation/pricing "
+                       "(the typed 400s; no job reaches the driver "
+                       "unpriced)")
+        m.counter("serving_matrix_jobs_poisoned_total",
+                  help="matrix jobs quarantined after poison_after "
+                       "consecutive engine crashes")
+        m.counter("serving_matrix_quanta_total",
+                  help="bounded matrix work quanta executed by the "
+                       "driver thread")
+        m.counter("serving_matrix_result_bytes_total",
+                  help="npz result bytes encoded for delivery")
+        m.gauge("serving_matrix_queue_depth",
+                help="matrix jobs queued + running")
+        m.histogram("serving_matrix_job_seconds",
+                    help="per-job execute wall clock, submit-priced "
+                         "into round budgets")
+        m.histogram("serving_matrix_quantum_seconds",
+                    help="per-quantum wall clock — the interleave "
+                         "slice decode SLOs ride on")
+        m.histogram("serving_matrix_budget_rel_err",
+                    buckets=(0.05, 0.1, 0.15, 0.2, 0.25, 0.5, 1.0,
+                             2.5, 10.0),
+                    help="|predicted - measured| / measured of the "
+                         "cost-model round-budget prediction "
+                         "(calibrated jobs only)")
+
+    # -- handler-thread surface ---------------------------------------
+
+    def validate(self, body: dict) -> MatrixJobSpec:
+        """:func:`validate_job` + the rejection counter — the service
+        form the HTTP handler calls so every typed 400 is counted."""
+        try:
+            return validate_job(body)
+        except MatrixJobError:
+            self.metrics.counter(
+                "serving_matrix_jobs_rejected_total").inc()
+            raise
+
+    def submit(self, spec: MatrixJobSpec,
+               stream: bool = False) -> MatrixJobHandle:
+        """Thread-safe submit of a VALIDATED spec: price the job into
+        round budgets (cost_model units x the calibration ledger's
+        sec/unit) and queue it for the driver. ``QueueFull`` /
+        ``QueueClosed`` propagate for the 429/503 mapping."""
+        units, _bytes = cm.matrix_job_cost(
+            spec.op, spec.shapes,
+            itemsize=np.dtype(_np_dtype(spec.dtype)).itemsize,
+            density=spec.density, k_singular=spec.k)
+        budget = cm.matrix_round_budget(
+            units, executor_quanta(spec),
+            self.calibration.sec_per_unit(_cal_key(spec)),
+            self.round_budget_s)
+        with self._lock:
+            if self._closed:
+                raise QueueClosed(
+                    "matrix service draining; job refused")
+            depth = len(self._pending) + (1 if self._running else 0)
+            if depth >= self.max_pending:
+                raise QueueFull(
+                    f"matrix queue full ({depth}/{self.max_pending})")
+            job_id = self._next_id
+            self._next_id += 1
+            handle = MatrixJobHandle(job_id, stream=stream,
+                                     submit_time=time.perf_counter())
+            self._pending.append(_Job(spec, handle, budget))
+            self.metrics.gauge("serving_matrix_queue_depth").set(
+                len(self._pending) + (1 if self._running else 0))
+        self.metrics.counter("serving_matrix_jobs_total",
+                             op=spec.op).inc()
+        self.runlog.emit(
+            "job_submit", job_id=job_id, op=spec.op,
+            shapes=list(spec.shapes), dtype=spec.dtype,
+            units=round(budget["units"], 1),
+            n_quanta=budget["n_quanta"],
+            quanta_per_round=budget["quanta_per_round"],
+            predicted_rounds=budget["predicted_rounds"],
+            **({"predicted_s": round(budget["predicted_s"], 6)}
+               if budget["predicted_s"] is not None else {}))
+        handle._push_event({"phase": "queued", "job_id": job_id,
+                            "op": spec.op,
+                            "n_quanta": budget["n_quanta"]})
+        return handle
+
+    def abandon_stream(self, handle: MatrixJobHandle) -> None:
+        """SSE client hung up mid-progress: stop feeding its event
+        queue; the job itself still runs to completion (its quanta are
+        already priced and scheduled). Idempotent."""
+        handle.abandoned = True
+
+    # -- shared views --------------------------------------------------
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._pending or self._running is not None)
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self) -> None:
+        """Stop admissions (drain): new submits raise QueueClosed;
+        queued + running jobs still execute to completion."""
+        with self._lock:
+            self._closed = True
+
+    def summary(self) -> dict:
+        """Point-in-time service state for ``GET /debug/engine``."""
+        with self._lock:
+            running = None
+            if self._running is not None:
+                j = self._running
+                running = {"job_id": j.job_id, "op": j.spec.op,
+                           "quanta_done": j.quanta_done,
+                           "n_quanta": j.budget["n_quanta"],
+                           "crash_count": j.crash_count}
+            out = {"pending": len(self._pending), "running": running,
+                   "jobs_done": self._n_done, "closed": self._closed}
+        out["calibration"] = {
+            op: st for op, st in self.calibration.summary().items()
+            if op.startswith("matrix_")}
+        return out
+
+    # -- driver-thread surface ----------------------------------------
+
+    def quanta_budget(self, idle: bool) -> int:
+        """How many quanta the current slice may run: the calibrated
+        per-quantum estimate of the RUNNING job's op against the
+        round/idle budget; 1 while the ledger is cold (the conservative
+        floor — interleave safely before promising anything)."""
+        budget_s = self.idle_budget_s if idle else self.round_budget_s
+        with self._lock:
+            job = self._running or (self._pending[0] if self._pending
+                                    else None)
+        if job is None:
+            return 0
+        spu = self.calibration.sec_per_unit(_cal_key(job.spec))
+        b = cm.matrix_round_budget(job.budget["units"],
+                                   job.budget["n_quanta"], spu,
+                                   budget_s)
+        return b["quanta_per_round"]
+
+    def run_quanta(self, max_quanta: int, round_idx: int = 0) -> int:
+        """Execute up to ``max_quanta`` bounded quanta of the current
+        job (FIFO across jobs) on the CALLING (driver) thread; returns
+        the count executed. Exceptions — including an armed
+        ``matrix_quantum`` fault — propagate to the frontend's crash
+        boundary, whose recovery replays the in-flight job from its
+        seed (:meth:`reset_inflight`)."""
+        executed = 0
+        while executed < int(max_quanta):
+            job = self._take_job()
+            if job is None:
+                break
+            build_s = 0.0
+            if job.executor is None:
+                job.t_exec0 = time.perf_counter()
+                job.executor = build_executor(job.spec)
+                # Input materialization is real per-job cost (rng +
+                # device transfer); folded into the first quantum's
+                # calibration sample so the sec/unit ledger prices
+                # what a job actually spends, not just its steps —
+                # sub-ms jobs are build-dominated and would otherwise
+                # sit outside the 25% pricing bar forever.
+                build_s = time.perf_counter() - job.t_exec0
+                self.runlog.emit("job_phase", job_id=job.job_id,
+                                 phase="execute", quantum=0,
+                                 n_quanta=job.executor.n_quanta,
+                                 round=round_idx)
+                job.handle._push_event(
+                    {"phase": "execute", "job_id": job.job_id,
+                     "n_quanta": job.executor.n_quanta})
+            faults.check("matrix_quantum", round_idx=round_idx,
+                         request_id=job.job_id)
+            t0 = time.perf_counter()
+            job.executor.step()
+            dt = time.perf_counter() - t0
+            job.quanta_done += 1
+            executed += 1
+            self.calibration.record(_cal_key(job.spec),
+                                    job.budget["unit_per_quantum"],
+                                    dt + build_s)
+            self.metrics.counter("serving_matrix_quanta_total").inc()
+            self.metrics.histogram(
+                "serving_matrix_quantum_seconds").observe(
+                    dt, exemplar=str(job.job_id))
+            job.handle._push_event(
+                {"phase": "execute", "job_id": job.job_id,
+                 "quantum": job.quanta_done,
+                 "n_quanta": job.executor.n_quanta,
+                 "progress": round(job.quanta_done
+                                   / job.executor.n_quanta, 4)})
+            if job.executor.done:
+                self._finish_job(job, round_idx)
+        return executed
+
+    def _take_job(self) -> Optional[_Job]:
+        with self._lock:
+            if self._running is None and self._pending:
+                self._running = self._pending.popleft()
+                self.metrics.gauge("serving_matrix_queue_depth").set(
+                    len(self._pending) + 1)
+            return self._running
+
+    def _finish_job(self, job: _Job, round_idx: int) -> None:
+        now = time.perf_counter()
+        measured_s = max(now - job.t_exec0, 1e-9)
+        self.runlog.emit("job_phase", job_id=job.job_id,
+                         phase="encode", quantum=job.quanta_done,
+                         n_quanta=job.budget["n_quanta"],
+                         round=round_idx)
+        predicted_s = job.budget["predicted_s"]
+        rel_err = None
+        if predicted_s is not None:
+            rel_err = abs(predicted_s - measured_s) / measured_s
+            self.metrics.histogram(
+                "serving_matrix_budget_rel_err").observe(rel_err)
+        meta = {"job_id": job.job_id, "op": job.spec.op,
+                "shapes": list(job.spec.shapes),
+                "dtype": job.spec.dtype, "status": "done",
+                "quanta": job.quanta_done,
+                "units": round(job.budget["units"], 1),
+                "measured_s": round(measured_s, 6),
+                "predicted_s": (round(predicted_s, 6)
+                                if predicted_s is not None else None),
+                "budget_rel_err": (round(rel_err, 4)
+                                   if rel_err is not None else None),
+                "crash_count": job.crash_count}
+        payload = encode_result(job.executor.result(), meta)
+        self.metrics.counter(
+            "serving_matrix_result_bytes_total").inc(len(payload))
+        self.metrics.histogram("serving_matrix_job_seconds").observe(
+            measured_s, exemplar=str(job.job_id))
+        self.runlog.emit(
+            "job_complete", job_id=job.job_id, op=job.spec.op,
+            status="done", quanta=job.quanta_done,
+            measured_s=round(measured_s, 6),
+            result_bytes=len(payload),
+            **({"predicted_s": round(predicted_s, 6),
+                "budget_rel_err": round(rel_err, 4)}
+               if predicted_s is not None else {}))
+        with self._lock:
+            self._running = None
+            self._n_done += 1
+            self.metrics.gauge("serving_matrix_queue_depth").set(
+                len(self._pending))
+        job.handle._complete(payload, meta)
+
+    # -- crash boundary (frontend._recover) ---------------------------
+
+    def reset_inflight(self, exc: BaseException, now: float) -> None:
+        """The driver crashed with a job mid-execution: either replay
+        it FROM ITS SEED (deterministic inputs make the replayed
+        result bit-exact) or — after ``poison_after`` consecutive
+        crashes — quarantine it with :class:`PoisonedRequest`, the
+        frontend's own verdict applied to the matrix class."""
+        with self._lock:
+            job = self._running
+        if job is None:
+            return
+        job.crash_count += 1
+        if job.crash_count >= self.poison_after:
+            with self._lock:
+                self._running = None
+                self.metrics.gauge("serving_matrix_queue_depth").set(
+                    len(self._pending))
+            self.metrics.counter(
+                "serving_matrix_jobs_poisoned_total").inc()
+            self.runlog.emit(
+                "job_quarantine", job_id=job.job_id,
+                crash_count=job.crash_count,
+                error=f"{type(exc).__name__}: {exc}")
+            job.handle._fail(PoisonedRequest(
+                job.job_id, job.crash_count, exc))
+            return
+        job.executor = None  # rebuild from the spec at the next slice
+        job.quanta_done = 0
+        self.runlog.emit("job_replay", job_id=job.job_id,
+                         crash_count=job.crash_count,
+                         error=f"{type(exc).__name__}: {exc}")
+
+    def abandon(self, err: BaseException) -> None:
+        """Driver died for good (fail-closed / hard stop): fail every
+        queued + running handle so no waiter hangs."""
+        with self._lock:
+            orphans = [j.handle for j in self._pending]
+            if self._running is not None:
+                orphans.append(self._running.handle)
+            self._pending.clear()
+            self._running = None
+            self.metrics.gauge("serving_matrix_queue_depth").set(0)
+        for h in orphans:
+            h._fail(err)

@@ -81,6 +81,7 @@ point); real deployments build params/cfg and call :func:`serve`.
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 import time
@@ -94,6 +95,7 @@ import numpy as np
 from ..obs import distributed as dtrace
 from . import faults
 from .frontend import (EngineFrontend, FrontendError, PoisonedRequest)
+from .jobs import MatrixJobError
 from .queue import QueueClosed, QueueFull
 
 RETRY_AFTER_S = 1  # hint on 429/503: one engine round is usually enough
@@ -210,6 +212,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         path = self.path.split("?", 1)[0]
+        if path == "/v1/matrix":
+            self._post_matrix()
+            return
         if path != "/v1/generate":
             self._send_json(404, {"error": f"no route {path}"}, path)
             return
@@ -310,6 +315,140 @@ class _Handler(BaseHTTPRequestHandler):
         # closed, a tail-kept request pulls them into its trace so the
         # export has its serving.http root (no-op otherwise).
         self.server.tracer.promote_request(handle.request_id)
+
+    # -- POST /v1/matrix ----------------------------------------------
+
+    def _post_matrix(self) -> None:
+        """Matrix-ops-as-a-service (serving/jobs.py, docs/matrix_
+        service.md): validate → typed 400s; price + queue on the
+        frontend's driver; blocking replies carry the dtype-tagged npz
+        payload verbatim (application/octet-stream — byte-identical to
+        the in-process call), streaming replies ride the SAME SSE
+        framing as token streams with the npz base64'd into the
+        terminal ``done`` event."""
+        route = "/v1/matrix"
+        if self.server.matrix is None:
+            # Not an error class, a missing route: this deployment is
+            # LLM-only (start the server with --matrix).
+            self._send_json(404, {"error": "matrix service not "
+                                           "enabled (start with "
+                                           "--matrix)"}, route)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise MatrixJobError("bad_json",
+                                     "body must be a JSON object")
+            stream = bool(body.pop("stream", False))
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}",
+                                  "code": "bad_json", "detail": {}},
+                            route)
+            return
+        http_id = self.headers.get("X-Request-Id")
+        try:
+            # Validation (incl. the rejection counter) happens HERE, on
+            # the handler thread: no job reaches the driver unpriced,
+            # and every rejection is a typed, structured 400.
+            spec = self.server.matrix.validate(body)
+            handle = self.frontend.submit_matrix(spec, stream=stream)
+        except MatrixJobError as e:
+            self._send_json(400, {"error": str(e), "code": e.code,
+                                  "detail": e.detail}, route)
+            return
+        except QueueFull as e:
+            self._send_json(429, {"error": str(e)}, route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+            return
+        except (QueueClosed, FrontendError) as e:
+            self._send_json(503, {"error": str(e)}, route,
+                            headers={"Retry-After": RETRY_AFTER_S})
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)}, route)
+            return
+        id_headers = {"X-Job-Id": handle.job_id,
+                      "X-Request-Id": http_id or str(handle.job_id)}
+        if stream:
+            self._respond_matrix_stream(handle, route, id_headers)
+        else:
+            self._respond_matrix_blocking(handle, route, id_headers)
+
+    def _respond_matrix_blocking(self, handle, route,
+                                 id_headers) -> None:
+        try:
+            payload, meta = handle.result(self.server.request_timeout_s)
+        except PoisonedRequest as e:
+            self._send_json(500, {"error": str(e), "status": "poisoned",
+                                  "request_id": e.request_id,
+                                  "crash_count": e.crash_count},
+                            route, headers=id_headers)
+            return
+        except (FrontendError, TimeoutError) as e:
+            self._send_json(503, {"error": str(e)}, route,
+                            headers=id_headers)
+            return
+        # The npz bytes go out VERBATIM — the payload is the byte-
+        # exactness contract; meta rides both inside the npz (__meta)
+        # and as a header for clients that only want the summary.
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(payload)))
+        self.send_header("X-Matrix-Meta", json.dumps(meta))
+        for k, v in id_headers.items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(payload)
+        self._count(route, 200)
+
+    def _respond_matrix_stream(self, handle, route,
+                               id_headers) -> None:
+        """SSE progress: one ``data:`` event per phase/quantum (the
+        jobs.py event dicts verbatim), then the terminal ``done`` event
+        carrying the npz payload base64'd — same chunked framing as
+        token streams, same in-band error convention (the 200 commits
+        before the outcome is known)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in id_headers.items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        code = 200
+        try:
+            for ev in handle.events():
+                self._sse(ev)
+            payload, meta = handle.result(
+                0.0 if handle.done.is_set() else None)
+            self._sse({"done": True, "meta": meta,
+                       "npz_b64": base64.b64encode(payload).decode()})
+            self._chunk(b"")  # terminal zero-length chunk
+        except PoisonedRequest as e:
+            code = 500  # accounting only: the 200 already went out
+            try:
+                self._sse({"done": True, "status": "poisoned",
+                           "error": str(e),
+                           "request_id": e.request_id,
+                           "crash_count": e.crash_count})
+                self._chunk(b"")
+            except OSError:
+                pass
+        except (FrontendError, TimeoutError) as e:
+            code = 503  # accounting only: the 200 already went out
+            try:
+                self._sse({"done": True, "error": str(e)})
+                self._chunk(b"")
+            except OSError:
+                pass
+        except OSError:
+            # Client hung up mid-progress: stop feeding its event
+            # queue; the job still completes (its quanta are priced
+            # and scheduled).
+            code = 499
+            self.server.matrix.abandon_stream(handle)
+        self._count(route, code)
 
     def _finish_fields(self, req, handle=None) -> dict:
         out = {"request_id": req.request_id, "status": req.status,
@@ -431,6 +570,9 @@ class ServingHTTPServer(ThreadingHTTPServer):
                  request_timeout_s: Optional[float] = 300.0):
         super().__init__(addr, _Handler)
         self.frontend = frontend
+        # MatrixService or None — the /v1/matrix route exists only
+        # when the frontend carries one (404 otherwise).
+        self.matrix = frontend.matrix
         self.registry = frontend.metrics
         self.tracer = frontend.engine.tracer
         self.runlog = frontend.engine.runlog
@@ -496,21 +638,38 @@ class ServingHTTPServer(ThreadingHTTPServer):
 def serve(params, cfg, host: str = "127.0.0.1", port: int = 0,
           request_timeout_s: Optional[float] = 300.0,
           max_restarts: int = 3, restart_window_s: float = 60.0,
-          poison_after: int = 2,
+          poison_after: int = 2, matrix: bool = False,
+          matrix_round_budget_s: float = 0.010,
+          matrix_max_pending: int = 8,
           **engine_kwargs) -> ServingHTTPServer:
     """Build engine + frontend + listener; returns the (not yet
     serving) server — call ``serve_forever()`` (blocking) or
     ``start_background()``. ``port=0`` binds an ephemeral port
     (``server.port`` reports it). The ``max_restarts`` /
     ``restart_window_s`` / ``poison_after`` knobs parameterize the
-    frontend's crash supervisor (docs/robustness.md)."""
+    frontend's crash supervisor (docs/robustness.md).
+
+    ``matrix=True`` attaches a :class:`~marlin_tpu.serving.jobs.
+    MatrixService` sharing the engine's metrics registry + runlog:
+    the ``POST /v1/matrix`` route comes alive and the driver thread
+    interleaves priced matrix quanta with decode rounds
+    (docs/matrix_service.md)."""
     from .engine import ServingEngine
 
     engine = ServingEngine(params, cfg, **engine_kwargs)
+    mx = None
+    if matrix:
+        from .jobs import MatrixService
+
+        mx = MatrixService(metrics=engine.metrics,
+                           runlog=engine.runlog,
+                           round_budget_s=matrix_round_budget_s,
+                           max_pending=matrix_max_pending,
+                           poison_after=poison_after)
     frontend = EngineFrontend(
         engine, max_restarts=max_restarts,
         restart_window_s=restart_window_s,
-        poison_after=poison_after).start()
+        poison_after=poison_after, matrix=mx).start()
     return ServingHTTPServer((host, port), frontend,
                              request_timeout_s=request_timeout_s)
 
@@ -579,6 +738,16 @@ def main(argv=None) -> int:
                    help="minimum extra hit depth (tokens) before a "
                         "restore beats re-prefill; default from the "
                         "measured cost-model crossover")
+    p.add_argument("--matrix", action="store_true",
+                   help="attach the matrix-ops job service: POST "
+                        "/v1/matrix prices distributed matrix jobs "
+                        "into round budgets and interleaves them with "
+                        "decode rounds (docs/matrix_service.md)")
+    p.add_argument("--matrix-round-budget-s", type=float, default=0.01,
+                   help="matrix quanta wall-clock slice granted "
+                        "between decode rounds under mixed traffic")
+    p.add_argument("--matrix-max-pending", type=int, default=8,
+                   help="matrix job admission bound (429 beyond)")
     p.add_argument("--sched", action="store_true",
                    help="SLO-aware scheduler (serving/sched.py): the "
                         "default interactive/batch/best_effort class "
@@ -675,6 +844,9 @@ def main(argv=None) -> int:
                    max_restarts=args.max_restarts,
                    restart_window_s=args.restart_window_s,
                    poison_after=args.poison_after,
+                   matrix=args.matrix,
+                   matrix_round_budget_s=args.matrix_round_budget_s,
+                   matrix_max_pending=args.matrix_max_pending,
                    # `is not None`, not truthiness: RunLog has __len__,
                    # so a fresh (empty) log is falsy; kv_pages/
                    # prefill_chunk stay unset unless given (the engine

@@ -158,6 +158,88 @@ def ell_product_cost(m: int, k: int, n: int, r_slots: int, n_devices: int,
     return flops, float(byts)
 
 
+# -- matrix-service job pricing (serving/jobs.py, ROADMAP item 17) ----
+#
+# The execution service prices every submitted matrix job BEFORE it
+# reaches the driver thread: total model units from the analytic
+# rooflines above, sliced into the executor's quantum count, then
+# multiplied by the CostCalibration ledger's measured sec/unit for the
+# op class (keys ``matrix_<op>``) into a round-budget prediction the
+# runlog/bench confront with the measured wall clock.
+
+MATRIX_JOB_OPS = ("gemm", "lu", "cholesky", "svd", "spmm", "inverse")
+
+
+def matrix_job_cost(op: str, shapes, *, itemsize: int = 4,
+                    density: float = 0.05, k_singular: int = 6,
+                    n_devices: int = 1) -> Tuple[float, float]:
+    """(flops, bytes) one matrix-service job costs end to end.
+
+    ``shapes`` is the job's validated shape list (``[m, k, n]`` for
+    gemm/spmm, ``[n]`` for the square factorizations, ``[m, n]`` for
+    svd). gemm prices with :func:`gemm_cost`; spmm with
+    :func:`ell_product_cost` at the job's density; the factorizations
+    with their classic flop counts (2/3 n^3 LU, 1/3 n^3 Cholesky,
+    2 n^3 inverse = LU + two solves, Lanczos-style ~8 m n k for the
+    truncated SVD) over a one-pass byte model. Unknown ops raise
+    ValueError — pricing is the admission gate, so an unpriceable job
+    must be rejected before the driver ever sees it."""
+    if op == "gemm":
+        m, k, n = shapes
+        return gemm_cost(m, k, n, itemsize=itemsize)
+    if op == "spmm":
+        m, k, n = shapes
+        r_slots = max(1, int(density * k))
+        return ell_product_cost(m, k, n, r_slots, n_devices,
+                                itemsize=itemsize)
+    if op == "lu":
+        (n,) = shapes
+        return (2.0 / 3.0) * n ** 3, float(itemsize) * 2 * n * n
+    if op == "cholesky":
+        (n,) = shapes
+        return (1.0 / 3.0) * n ** 3, float(itemsize) * 2 * n * n
+    if op == "inverse":
+        (n,) = shapes
+        return 2.0 * n ** 3, float(itemsize) * 2 * n * n
+    if op == "svd":
+        m, n = shapes
+        return 8.0 * m * n * k_singular, \
+            float(itemsize) * (m * n + (m + n) * k_singular)
+    raise ValueError(f"unknown matrix job op {op!r}; "
+                     f"ops: {MATRIX_JOB_OPS}")
+
+
+def matrix_round_budget(units: float, n_quanta: int,
+                        sec_per_unit: Optional[float],
+                        round_budget_s: float) -> dict:
+    """Price a job's ``units`` (from :func:`matrix_job_cost`), already
+    sliced into ``n_quanta`` executor quanta, into ROUND BUDGETS.
+
+    With a calibrated ``sec_per_unit`` (CostCalibration.sec_per_unit of
+    the ``matrix_<op>`` class; None while the ledger is cold) the
+    prediction is absolute: per-quantum seconds, how many quanta fit
+    one ``round_budget_s`` slice, and the predicted number of
+    engine-idle rounds the whole job needs. Uncalibrated jobs get the
+    conservative floor — one quantum per round, no wall-clock claim —
+    so a cold service still interleaves safely, it just cannot promise
+    a finish time yet."""
+    n_quanta = max(1, int(n_quanta))
+    out = {"units": float(units), "n_quanta": n_quanta,
+           "unit_per_quantum": float(units) / n_quanta,
+           "predicted_s": None, "quantum_s": None,
+           "quanta_per_round": 1, "predicted_rounds": n_quanta}
+    if sec_per_unit is not None and sec_per_unit > 0 and units > 0:
+        quantum_s = (units / n_quanta) * sec_per_unit
+        per_round = max(1, int(round_budget_s / quantum_s)) \
+            if quantum_s > 0 else n_quanta
+        out.update(
+            predicted_s=units * sec_per_unit,
+            quantum_s=quantum_s,
+            quanta_per_round=per_round,
+            predicted_rounds=-(-n_quanta // per_round))
+    return out
+
+
 def transformer_param_count(cfg) -> int:
     """Parameter count of models/transformer.py's pytree (embed shared with
     the readout; per-block fused qkv / wo / mlp+biases / two LNs; final LN;

@@ -329,12 +329,24 @@ class TestDeterministicServing:
             def workload(vocab):
                 rng = random.Random(0)          # OK: seeded = replayable
                 return [rng.randrange(vocab) for _ in range(4)]
+
+            def job_inputs(seed):
+                # OK: a per-job PRNG stream folded from the job seed —
+                # deterministic given the job, exactly the replay
+                # contract (serving/jobs.generate_inputs).
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([0x6D78, seed]))
+                return rng.standard_normal(4)
+
+            def entropy_stream():
+                return np.random.SeedSequence()  # BUG: OS entropy
         """}, rules=["deterministic-serving"])
         msgs = [f.message for f in rep.findings]
-        assert len(msgs) == 3, msgs
+        assert len(msgs) == 4, msgs
         assert any("random.random" in m for m in msgs)
         assert any("np.random.shuffle" in m for m in msgs)
         assert any("time.time" in m for m in msgs)
+        assert any("SeedSequence" in m for m in msgs)
 
     def test_timestamp_only_on_wrapped_statement_tail(self, tmp_path):
         # Like disable=, the annotation's natural position is the

@@ -37,9 +37,10 @@ import pytest
 from marlin_tpu.models import TransformerConfig, init_params
 from marlin_tpu.obs.metrics import MetricsRegistry
 from marlin_tpu.obs.runlog import RunLog
-from marlin_tpu.serving import (AdmissionQueue, EngineFrontend, QueueClosed,
-                                QueueFull, Request, Scheduler, ServingEngine,
-                                serve)
+from marlin_tpu.serving import (AdmissionQueue, EngineFrontend,
+                                MatrixService, QueueClosed, QueueFull,
+                                Request, Scheduler, ServingEngine, serve)
+from marlin_tpu.serving.jobs import validate_job
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -638,7 +639,16 @@ class TestBaselineMetricConsistency:
                             metrics_registry=reg, kv_pages=32,
                             host_kv_bytes=1 << 20,
                             scheduler=Scheduler())
-        fe = EngineFrontend(eng).start()
+        # Matrix-serving, too: the metrics_matrix block references the
+        # job-seconds histogram and the queue-depth gauge, which
+        # register at MatrixService construction (docs/
+        # matrix_service.md) — an LLM-only smoke would read them as
+        # stale. One real job keeps the histogram honest (count >= 1).
+        mx = MatrixService(metrics=reg)
+        fe = EngineFrontend(eng, matrix=mx).start()
+        mh = fe.submit_matrix(validate_job(
+            {"op": "gemm", "shapes": [16, 8, 8], "dtype": "float32",
+             "seed": 0}))
         # Streamed requests exercise the full phase surface, including
         # the frontend's stream_delivery slice.
         handles = [fe.submit(p, 4, stream=True)
@@ -646,6 +656,8 @@ class TestBaselineMetricConsistency:
         for h in handles:
             list(h.chunks())
             assert h.result(30.0).status == "done"
+        _, m_meta = mh.result(30.0)
+        assert m_meta["status"] == "done"
         assert fe.drain(30.0)
         snap = reg.snapshot()
         with open(os.path.join(_REPO, "tools",

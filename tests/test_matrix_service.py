@@ -1,0 +1,531 @@
+"""Matrix-ops-as-a-service tests (marlin_tpu/serving/jobs.py +
+``POST /v1/matrix``; docs/matrix_service.md).
+
+The ISSUE-20 acceptance claims, each pinned mechanically:
+
+* TYPED ADMISSION — no job reaches the driver unpriced: every
+  malformed body is a :class:`MatrixJobError` with a stable ``code``
+  and structured ``detail``, mapped to an HTTP 400 body the client
+  surfaces as ``error_code``.
+* BYTE-TRANSPARENCY — the npz payload fetched over a real socket
+  decodes to arrays BYTE-identical to the in-process
+  ``matrix_compute`` call of the same body, across
+  f32 / f64 / bfloat16 / int8, blocking and SSE alike (the
+  quantum-sliced executors ARE the library loops run in slices).
+* QUANTUM ACCOUNTING — admission prices the same quantum count the
+  executor later reports (``executor_quanta`` vs ``n_quanta``), and
+  engine round events carry the interleaved ``matrix_quanta``.
+* CHAOS — a deterministic ``matrix_quantum`` crash mid-job replays the
+  job from its seed after the supervisor restart and produces the same
+  bytes; repeated crashes quarantine the job as a typed
+  ``PoisonedRequest``.
+* RETRY IDEMPOTENCY — a matrix job that streamed progress events is
+  never silently resent by the client retry policy (the exact rule
+  token streams follow).
+* FLEET JOB CLASS — ``FleetConfig.matrix_group`` carves the dedicated
+  tail group, ``replica_argv`` arms exactly those replicas, and the
+  group rides ``RouteDecision.group`` so failover stays inside it.
+
+The bench smoke at the bottom runs the real ``bench.py --config
+matrix_service`` subprocess and holds its artifact to the committed SLO
+baseline's ``metrics_matrix`` block.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from marlin_tpu.models import TransformerConfig, init_params
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.obs.runlog import RunLog
+from marlin_tpu.serving import (EngineFrontend, MatrixJobError,
+                                MatrixService, PoisonedRequest,
+                                ServingEngine, faults, serve)
+from marlin_tpu.serving.jobs import (build_executor, decode_result,
+                                     encode_result, executor_quanta,
+                                     generate_inputs, matrix_compute,
+                                     validate_job)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                max_len=32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return init_params(cfg, seed=0), cfg
+
+
+@pytest.fixture(scope="module")
+def mx_server(model):
+    params, cfg = model
+    srv = serve(params, cfg, port=0, batch=2, round_steps=4,
+                max_pending=8, seed=0, matrix=True).start_background()
+    yield srv
+    try:
+        srv.close_now()
+    except OSError:
+        pass
+
+
+@pytest.fixture(scope="module")
+def client_mod():
+    return _load_tool("serving_client")
+
+
+def _assert_bytes_equal(arrays, ref):
+    assert sorted(arrays) == sorted(ref)
+    for k in ref:
+        got, want = np.asarray(arrays[k]), np.asarray(ref[k])
+        assert got.dtype == want.dtype, (k, got.dtype, want.dtype)
+        assert got.shape == want.shape, (k, got.shape, want.shape)
+        assert got.tobytes() == want.tobytes(), k
+
+
+class TestTypedValidation:
+    """No job reaches the driver unpriced: every malformed body is a
+    typed rejection with a stable code + structured detail."""
+
+    @pytest.mark.parametrize("body,code", [
+        ({"op": "qr", "shapes": [4, 4], "dtype": "float32",
+          "seed": 1}, "unknown_op"),
+        ({"op": "gemm", "shapes": [4, "x", 4], "dtype": "float32",
+          "seed": 1}, "bad_shape"),
+        ({"op": "gemm", "shapes": [4, 4], "dtype": "float32",
+          "seed": 1}, "bad_shape"),           # gemm arity is 3 (m,k,n)
+        ({"op": "lu", "shapes": [0], "dtype": "float32",
+          "seed": 1}, "bad_shape"),
+        ({"op": "gemm", "shapes": [1 << 20, 4, 4], "dtype": "float32",
+          "seed": 1}, "shape_overflow"),
+        ({"op": "lu", "shapes": [8], "dtype": "int8",
+          "seed": 1}, "bad_dtype"),           # int8 is gemm-only
+        ({"op": "gemm", "shapes": [4, 4, 4], "dtype": "float32",
+          "seed": "not-an-int"}, "bad_inputs"),
+        ({"op": "gemm", "shapes": [4, 4, 4], "dtype": "float32",
+          "seed": 1, "payload": {}}, "bad_inputs"),   # both
+        ({"op": "svd", "shapes": [8, 8], "dtype": "float32", "seed": 1,
+          "k": 99}, "bad_knob"),
+    ])
+    def test_typed_rejections(self, body, code):
+        with pytest.raises(MatrixJobError) as ei:
+            validate_job(body)
+        assert ei.value.code == code
+        assert isinstance(ei.value.detail, dict)
+
+    def test_payload_mismatch_is_typed(self):
+        spec = validate_job({"op": "gemm", "shapes": [4, 3, 2],
+                             "dtype": "float32", "seed": 0})
+        ok = matrix_compute({"op": "gemm", "shapes": [4, 3, 2],
+                             "dtype": "float32", "seed": 0})
+        assert spec.op == "gemm" and "c" in ok
+        with pytest.raises(MatrixJobError) as ei:
+            validate_job({"op": "gemm", "shapes": [4, 3, 2],
+                          "dtype": "float32",
+                          "payload": {"a": [[1.0, 2.0]],
+                                      "b": [[1.0], [2.0]]}})
+        assert ei.value.code == "payload_mismatch"
+
+    def test_service_counts_rejections(self):
+        reg = MetricsRegistry()
+        mx = MatrixService(metrics=reg)
+        with pytest.raises(MatrixJobError):
+            mx.validate({"op": "qr", "shapes": [4, 4],
+                         "dtype": "float32", "seed": 1})
+        snap = reg.snapshot()
+        assert snap["counters"][
+            "serving_matrix_jobs_rejected_total"] == 1
+
+
+class TestExecutorContracts:
+    @pytest.mark.parametrize("body", [
+        {"op": "gemm", "shapes": [70, 16, 8], "dtype": "float32",
+         "seed": 2, "panel": 32},
+        {"op": "lu", "shapes": [40], "dtype": "float32", "seed": 2,
+         "base": 16},
+        {"op": "spmm", "shapes": [64, 32, 8], "dtype": "float32",
+         "seed": 2, "nnz_chunk": 17},
+        {"op": "cholesky", "shapes": [12], "dtype": "float32",
+         "seed": 2},
+        {"op": "svd", "shapes": [16, 12], "dtype": "float32",
+         "seed": 2, "k": 3},
+        {"op": "inverse", "shapes": [10], "dtype": "float32",
+         "seed": 2},
+    ])
+    def test_pricing_and_executor_agree_on_quanta(self, body):
+        """Admission prices the SAME quantum count the executor later
+        reports — the invariant that keeps round budgets honest."""
+        spec = validate_job(dict(body))
+        ex = build_executor(spec)
+        assert executor_quanta(spec) == ex.n_quanta
+        steps = 0
+        while not ex.done:
+            ex.step()
+            steps += 1
+        assert steps == ex.n_quanta
+
+    def test_lu_executor_matches_library_bytes(self):
+        """The quantum-sliced LU IS ``lu_factor_array(mode="dist")``
+        paused between panels — byte-identical output."""
+        import jax
+
+        from marlin_tpu.linalg.lu import lu_factor_array
+
+        body = {"op": "lu", "shapes": [48], "dtype": "float32",
+                "seed": 5, "base": 16}
+        out = matrix_compute(dict(body))
+        a = generate_inputs(validate_job(dict(body)))["a"]
+        packed, perm = lu_factor_array(a, mode="dist", base_size=16)
+        assert np.asarray(out["lu"]).tobytes() == \
+            np.asarray(jax.device_get(packed)).tobytes()
+        assert np.asarray(out["perm"]).tolist() == \
+            np.asarray(perm).tolist()
+
+    def test_npz_roundtrip_preserves_nonnative_dtypes(self):
+        import ml_dtypes
+
+        arrays = {
+            "x": np.arange(6, dtype=np.float32).reshape(2, 3)
+            .astype(ml_dtypes.bfloat16),
+            "q": np.array([[-127, 3], [5, 127]], dtype=np.int8),
+        }
+        payload = encode_result(dict(arrays), {"op": "t"})
+        back, meta = decode_result(payload)
+        assert meta["op"] == "t"
+        _assert_bytes_equal(back, arrays)
+
+
+class TestHTTPRoundtrips:
+    """f32/f64/bf16/int8 over a real socket, value-exact against the
+    in-process call — the service's byte-transparency contract."""
+
+    @pytest.mark.parametrize("body", [
+        {"op": "gemm", "shapes": [24, 16, 12], "dtype": "float32",
+         "seed": 7},
+        {"op": "gemm", "shapes": [24, 16, 12], "dtype": "float64",
+         "seed": 7},
+        {"op": "gemm", "shapes": [24, 16, 12], "dtype": "bfloat16",
+         "seed": 7},
+        {"op": "gemm", "shapes": [24, 16, 12], "dtype": "int8",
+         "seed": 7},
+        {"op": "lu", "shapes": [32], "dtype": "float32", "seed": 8},
+        {"op": "cholesky", "shapes": [16], "dtype": "float64",
+         "seed": 9},
+        {"op": "spmm", "shapes": [32, 32, 8], "dtype": "float32",
+         "seed": 10},
+        {"op": "svd", "shapes": [16, 12], "dtype": "float32",
+         "seed": 11, "k": 3},
+        {"op": "inverse", "shapes": [12], "dtype": "float32",
+         "seed": 12},
+    ])
+    def test_blocking_roundtrip_value_exact(self, mx_server,
+                                            client_mod, body):
+        c = client_mod.ServingClient(port=mx_server.port)
+        res = c.matrix(**dict(body))
+        assert res["code"] == 200, res
+        ref = matrix_compute(dict(body))
+        _assert_bytes_equal(res["arrays"], ref)
+        # The npz payload is self-describing: decoding the raw wire
+        # bytes reproduces the same arrays AND the header meta.
+        arrays, meta = decode_result(res["payload_bytes"])
+        _assert_bytes_equal(arrays, ref)
+        assert meta == res["meta"]
+        assert meta["op"] == body["op"] and meta["status"] == "done"
+        assert meta["budget_rel_err"] is None or \
+            meta["budget_rel_err"] >= 0
+
+    def test_stream_matches_blocking_bytes(self, mx_server,
+                                           client_mod):
+        body = {"op": "gemm", "shapes": [48, 16, 8], "dtype": "float32",
+                "seed": 21}
+        c = client_mod.ServingClient(port=mx_server.port)
+        blocking = c.matrix(**dict(body))
+        streamed = c.matrix_stream(**dict(body))
+        assert streamed["code"] == 200, streamed
+        # Same bytes either way (meta carries per-job ids/timings, so
+        # compare the arrays the payloads decode to).
+        _assert_bytes_equal(streamed["arrays"], blocking["arrays"])
+        phases = [e.get("phase") for e in streamed["events"]]
+        assert "queued" in phases and "execute" in phases
+        # Progress is monotone over quanta.
+        progress = [e["progress"] for e in streamed["events"]
+                    if "progress" in e]
+        assert progress == sorted(progress)
+
+    def test_http_typed_400_and_bad_json(self, mx_server, client_mod):
+        c = client_mod.ServingClient(port=mx_server.port)
+        res = c.matrix("qr", [4, 4], seed=1)
+        assert res["code"] == 400
+        assert res["error_code"] == "unknown_op"
+        assert "detail" in res
+        # Malformed JSON never reaches validation: typed bad_json.
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", mx_server.port,
+                                          timeout=30)
+        try:
+            conn.request("POST", "/v1/matrix", b"{nope",
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            err = json.loads(resp.read())
+            assert resp.status == 400
+            assert err["code"] == "bad_json"
+        finally:
+            conn.close()
+
+    def test_matrixless_server_404s(self, model, client_mod):
+        params, cfg = model
+        srv = serve(params, cfg, port=0, batch=2, round_steps=4,
+                    seed=0).start_background()
+        try:
+            c = client_mod.ServingClient(port=srv.port)
+            res = c.matrix("gemm", [4, 4, 4], seed=1)
+            assert res["code"] == 404
+            assert "--matrix" in res["error"]
+        finally:
+            srv.begin_drain(30.0)
+
+    def test_llm_traffic_interleaves_and_rounds_carry_quanta(
+            self, mx_server, client_mod):
+        """Mixed traffic on one driver thread: an LLM stream and a
+        matrix job in flight together, and the engine's round events
+        narrate the interleave via ``matrix_quanta``."""
+        c = client_mod.ServingClient(port=mx_server.port)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, 64, 8).astype(np.int32)
+        import threading
+
+        mx_res = {}
+
+        def job():
+            mx_res.update(c.matrix("gemm", [64, 32, 16], seed=33))
+
+        t = threading.Thread(target=job)
+        t.start()
+        llm = c.stream(prompt, 8)
+        t.join(60.0)
+        assert llm["code"] == 200 and len(llm["tokens"]) == 8
+        assert mx_res["code"] == 200
+        ref = matrix_compute({"op": "gemm", "shapes": [64, 32, 16],
+                              "dtype": "float32", "seed": 33})
+        _assert_bytes_equal(mx_res["arrays"], ref)
+        code, dbg_raw, _ = c._get("/debug/engine")
+        assert code == 200
+        dbg = json.loads(dbg_raw)
+        assert dbg["matrix"]["jobs_done"] >= 1
+
+
+class TestChaosReplay:
+    def _frontend(self, model, runlog=None, poison_after=2):
+        params, cfg = model
+        reg = MetricsRegistry()
+        eng = ServingEngine(params, cfg, batch=2, round_steps=4,
+                            metrics_registry=reg, seed=0,
+                            runlog=runlog)
+        mx = MatrixService(metrics=reg, runlog=runlog,
+                           poison_after=poison_after)
+        return EngineFrontend(eng, matrix=mx).start(), reg
+
+    def test_crash_mid_job_replays_bitexact_from_seed(self, model,
+                                                      tmp_path):
+        """The crash boundary: a matrix_quantum fault kills the driver
+        mid-job; the supervisor restarts the engine, the service
+        replays the job FROM ITS SEED, and the delivered bytes equal
+        an undisturbed run."""
+        body = {"op": "lu", "shapes": [48], "dtype": "float32",
+                "seed": 13, "base": 16}
+        ref = matrix_compute(dict(body))
+        runlog = RunLog(path=str(tmp_path / "chaos.jsonl"))
+        plan = faults.install(faults.FaultPlan())
+        crash = plan.add(site="matrix_quantum", action="raise")
+        try:
+            fe, reg = self._frontend(model, runlog=runlog)
+            h = fe.submit_matrix(validate_job(dict(body)))
+            payload, meta = h.result(timeout=120.0)
+            assert crash.fires == 1
+            assert fe.restarts == 1
+            assert meta["status"] == "done"
+            assert meta["crash_count"] == 1
+            arrays, _ = decode_result(payload)
+            _assert_bytes_equal(arrays, ref)
+            # And the payload equals a never-crashed service's bytes
+            # except the crash_count it honestly reports.
+            assert fe.drain(30.0)
+        finally:
+            faults.reset()
+        events = [json.loads(l) for l in
+                  open(tmp_path / "chaos.jsonl")]
+        kinds = [e["kind"] for e in events]
+        assert "job_replay" in kinds
+        replay = next(e for e in events if e["kind"] == "job_replay")
+        assert replay["crash_count"] == 1
+
+    def test_repeated_crashes_quarantine_as_poisoned(self, model):
+        body = {"op": "gemm", "shapes": [32, 16, 8],
+                "dtype": "float32", "seed": 14}
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="matrix_quantum", action="raise", max_fires=5)
+        try:
+            fe, reg = self._frontend(model, poison_after=2)
+            h = fe.submit_matrix(validate_job(dict(body)))
+            with pytest.raises(PoisonedRequest):
+                h.result(timeout=120.0)
+            snap = reg.snapshot()
+            assert snap["counters"][
+                "serving_matrix_jobs_poisoned_total"] == 1
+            assert fe.drain(30.0)
+        finally:
+            faults.reset()
+
+    def test_poisoned_maps_to_500_over_http(self, model, client_mod):
+        params, cfg = model
+        plan = faults.install(faults.FaultPlan())
+        plan.add(site="matrix_quantum", action="raise", max_fires=5)
+        try:
+            srv = serve(params, cfg, port=0, batch=2, round_steps=4,
+                        seed=0, matrix=True).start_background()
+            try:
+                c = client_mod.ServingClient(port=srv.port)
+                res = c.matrix("gemm", [16, 8, 8], seed=15)
+                assert res["code"] == 500
+                assert "crash" in json.dumps(res).lower() or \
+                    res.get("error")
+            finally:
+                srv.begin_drain(30.0)
+        finally:
+            faults.reset()
+
+
+class TestClientRetrySemantics:
+    def test_streamed_progress_is_never_silently_resent(self,
+                                                        client_mod):
+        """The idempotency guard's matrix arm: a retryable result that
+        already delivered progress EVENTS stops the retry loop exactly
+        like delivered tokens do."""
+        sc = client_mod
+        policy = sc.RetryPolicy(max_attempts=4, base_delay_s=0.0)
+        calls = []
+
+        def partial_stream():
+            calls.append(1)
+            return {"code": 503, "retry_after": None,
+                    "events": [{"phase": "execute", "quantum": 1}],
+                    "stream_error": "died mid-progress"}
+
+        res = sc.call_with_retry(partial_stream, policy, key="k",
+                                 sleep=lambda s: None)
+        assert res["attempts"] == 1 and len(calls) == 1
+
+        def clean_503():
+            calls.append(1)
+            return {"code": 503, "retry_after": None}
+
+        calls.clear()
+        res = sc.call_with_retry(clean_503, policy, key="k",
+                                 sleep=lambda s: None)
+        assert res["attempts"] == 4 and len(calls) == 4
+
+
+class TestFleetJobClass:
+    def test_matrix_group_and_replica_argv(self):
+        from marlin_tpu.fleet.config import FleetConfig
+
+        off = FleetConfig(n_replicas=3)
+        assert off.matrix_group() == ()
+        both = FleetConfig(n_replicas=3, matrix=True)
+        assert both.matrix_group() == (0, 1, 2)
+        tail = FleetConfig(n_replicas=4, matrix=True,
+                           matrix_replicas=2)
+        assert tail.matrix_group() == (2, 3)
+        assert "--matrix" not in tail.replica_argv(0, 0)
+        assert "--matrix" in tail.replica_argv(3, 0)
+        with pytest.raises(ValueError):
+            FleetConfig(n_replicas=2, matrix_replicas=1)  # no matrix
+        with pytest.raises(ValueError):
+            FleetConfig(n_replicas=2, matrix=True, matrix_replicas=3)
+
+    def test_route_matrix_stays_in_group(self):
+        from marlin_tpu.fleet.config import FleetConfig
+        from marlin_tpu.fleet.router import PrefixAffinityRouter
+
+        class _Stub:
+            healthy = True
+
+        cfg = FleetConfig(n_replicas=4, matrix=True, matrix_replicas=2)
+        router = PrefixAffinityRouter([_Stub() for _ in range(4)],
+                                      cfg, MetricsRegistry())
+        seen = set()
+        decisions = []
+        for _ in range(6):
+            d = router.route_matrix()
+            decisions.append(d)
+            seen.add(d.replica_index)
+            assert d.group == (2, 3)
+        assert seen == {2, 3}  # least-outstanding spreads the group
+        # Failover candidates honor the group constraint.
+        nxt = router.next_candidate(tried={2}, group=(2, 3))
+        assert nxt == 3
+        assert router.next_candidate(tried={2, 3}, group=(2, 3)) is None
+        for d in decisions:
+            router.release(d)
+
+
+# -- the bench artifact + SLO gate ------------------------------------
+
+
+class TestMatrixSloSmoke:
+    def test_bench_matrix_line_and_slo_gate(self, tmp_path):
+        """`bench.py --config matrix_service` end to end with tiny
+        knobs: mixed LLM+matrix traffic, byte-exactness, zero
+        steady-state recompiles, the LLM SLO green, and the pricing
+        bar — then tools/slo_check.py --metrics-key metrics_matrix
+        against the committed baseline (the tier-1 SLO gate)."""
+        env = dict(
+            os.environ, BENCH_FORCE_CPU="1", BENCH_RETRIES="1",
+            BENCH_MX_D="32", BENCH_MX_L="2", BENCH_MX_REQS="6",
+            BENCH_MX_STEPS="6", BENCH_MX_CONC="3", BENCH_MX_ROUND="4",
+            BENCH_MX_VOCAB="64")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--config", "matrix_service"],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=_REPO)
+        assert r.returncode == 0, r.stderr[-800:]
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        (line,) = [d for d in lines
+                   if d["metric"] == "serving_matrix_service"]
+        assert line["bitexact"] == 1
+        assert line["llm_slo_ok"] == 1
+        assert line["recompiles_after_warmup"] == 0
+        assert line["matrix_jobs_exact"] == line["matrix_jobs_checked"]
+        assert line["budget_rel_err_p50"] is not None
+        assert line["drain_ok"] is True
+        assert line["metrics"]["histograms"][
+            "serving_matrix_job_seconds"]["count"] > 0
+        artifact = tmp_path / "matrix_artifact.jsonl"
+        artifact.write_text(r.stdout)
+        slo = subprocess.run(
+            [sys.executable, "tools/slo_check.py", str(artifact),
+             "--metrics-key", "metrics_matrix"],
+            capture_output=True, text=True, timeout=60, cwd=_REPO)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "SLO OK" in slo.stdout

@@ -557,6 +557,137 @@ class TestSyntheticRunlogs:
         assert report["round_series"][0]["iters"] == 4
 
 
+def _stall_pair(matrix_quanta):
+    """The provable stall signature (round N ready work + free rows,
+    round N+1 does nothing) with ``matrix_quanta`` stamped on the
+    would-be stall round."""
+    return [
+        {"kind": "round", "t": 0.105, "round": 2, "iters": 4,
+         "occupied": 1, "live_iters": 4, "admitted": 0, "retired": 0,
+         "expired": 0, "prefilling": 0, "queue_depth": 3,
+         "wasted_row_iters": 4},
+        {"kind": "round", "t": 0.107, "round": 3, "iters": 4,
+         "occupied": 1, "live_iters": 4, "admitted": 0, "retired": 0,
+         "expired": 0, "prefilling": 0, "queue_depth": 3,
+         "wasted_row_iters": 4, "matrix_quanta": matrix_quanta},
+        {"kind": "submit", "t": 0.1055, "request_id": 7,
+         "prompt_len": 8, "steps": 2, "round": 2, "queue_depth": 4},
+        {"kind": "timeout", "t": 0.108, "request_id": 7, "round": 3,
+         "deadline_rounds": 0, "wait_s": 0.5},
+    ]
+
+
+def _matrix_job_events():
+    """The matrix service's job_* narrative (docs/matrix_service.md)
+    grafted onto the clean log: job 0 prices, executes over rounds 0-1,
+    and completes; job 1 crashes once mid-quantum, replays from its
+    seed, and completes."""
+    return [
+        {"kind": "job_submit", "t": 0.012, "job_id": 0, "op": "gemm",
+         "shapes": [64, 32, 16], "dtype": "float32", "units": 32768.0,
+         "n_quanta": 2, "quanta_per_round": 1, "predicted_rounds": 2,
+         "predicted_s": 0.002},
+        {"kind": "job_phase", "t": 0.04, "job_id": 0,
+         "phase": "execute", "quantum": 0, "n_quanta": 2, "round": 0},
+        {"kind": "job_phase", "t": 0.09, "job_id": 0,
+         "phase": "encode", "quantum": 2, "n_quanta": 2, "round": 1},
+        {"kind": "job_complete", "t": 0.095, "job_id": 0, "op": "gemm",
+         "status": "done", "quanta": 2, "measured_s": 0.0021,
+         "result_bytes": 4242, "predicted_s": 0.002,
+         "budget_rel_err": 0.05},
+        {"kind": "job_submit", "t": 0.013, "job_id": 1, "op": "lu",
+         "shapes": [48], "dtype": "float32", "units": 73728.0,
+         "n_quanta": 3, "quanta_per_round": 1, "predicted_rounds": 3},
+        {"kind": "job_phase", "t": 0.05, "job_id": 1,
+         "phase": "execute", "quantum": 0, "n_quanta": 3, "round": 1},
+        {"kind": "job_replay", "t": 0.06, "job_id": 1,
+         "crash_count": 1, "error": "FaultInjected: matrix_quantum"},
+        {"kind": "job_phase", "t": 0.07, "job_id": 1,
+         "phase": "execute", "quantum": 0, "n_quanta": 3, "round": 1},
+        {"kind": "job_complete", "t": 0.1, "job_id": 1, "op": "lu",
+         "status": "done", "quanta": 3, "measured_s": 0.03,
+         "result_bytes": 9000},
+    ]
+
+
+class TestMatrixServiceNarration:
+    def test_matrix_quanta_round_is_not_a_stall(self, rr, tmp_path):
+        """A round that spent its budget on matrix work quanta was
+        executing, not sitting on ready work — exempt from the stall
+        detector (the round event carries ``matrix_quanta``)."""
+        events = _clean_events()
+        events[-1:-1] = _stall_pair(matrix_quanta=3)
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, events)))
+        assert not [a for a in report["anomalies"]
+                    if a["kind"] == "queue_stall"], report["anomalies"]
+
+    def test_same_round_without_matrix_quanta_is_a_stall(self, rr,
+                                                         tmp_path):
+        # Pinned the other way: the identical pair with zero matrix
+        # quanta stays a provable queue_stall — the exemption must not
+        # swallow genuine stalls in a matrix-enabled log.
+        events = _clean_events()
+        events[-1:-1] = _stall_pair(matrix_quanta=0)
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, events)))
+        stalls = [a for a in report["anomalies"]
+                  if a["kind"] == "queue_stall"]
+        assert len(stalls) == 1 and stalls[0]["round"] == 3
+
+    def test_job_timeline_joins_the_job_event_family(self, rr,
+                                                     tmp_path):
+        events = _clean_events()
+        events[-1:-1] = _matrix_job_events()
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, events)))
+        assert report["ok"] is True, report["anomalies"]
+        assert report["n_matrix_jobs"] == 2
+        assert report["n_matrix_poisoned"] == 0
+        j0, j1 = report["matrix_jobs"]
+        assert j0["op"] == "gemm" and j0["status"] == "done"
+        assert j0["units"] == 32768.0 and j0["n_quanta"] == 2
+        assert j0["execute_round"] == 0 and j0["encode_round"] == 1
+        assert j0["predicted_s"] == 0.002
+        assert j0["budget_rel_err"] == 0.05
+        assert j1["op"] == "lu" and j1["status"] == "done"
+        assert j1["replays"] == 1
+        assert "FaultInjected" in j1["last_error"]
+
+    def test_llm_only_report_has_no_matrix_block(self, rr, tmp_path):
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, _clean_events())))
+        assert "matrix_jobs" not in report
+        assert "n_matrix_jobs" not in report
+
+    def test_unresolved_job_in_sealed_log_is_flagged(self, rr,
+                                                     tmp_path):
+        events = _clean_events()
+        events[-1:-1] = _matrix_job_events()[:2]  # submit + execute
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, events)))
+        assert report["ok"] is False
+        (a,) = [x for x in report["anomalies"]
+                if x["kind"] == "unresolved_matrix_job"]
+        assert a["job_id"] == 0
+
+    def test_quarantine_resolves_a_job(self, rr, tmp_path):
+        events = _clean_events()
+        events[-1:-1] = _matrix_job_events()[:2] + [
+            {"kind": "job_replay", "t": 0.05, "job_id": 0,
+             "crash_count": 1, "error": "RuntimeError: boom"},
+            {"kind": "job_quarantine", "t": 0.06, "job_id": 0,
+             "crash_count": 2, "error": "RuntimeError: boom"},
+        ]
+        report = rr.build_report(rr.load_runlog(
+            _write(tmp_path, events)))
+        assert not [x for x in report["anomalies"]
+                    if x["kind"] == "unresolved_matrix_job"]
+        (j,) = report["matrix_jobs"]
+        assert j["status"] == "poisoned" and j["crash_count"] == 2
+        assert report["n_matrix_poisoned"] == 1
+
+
 def _crash_cycle_events():
     """A clean crash/recovery narrative grafted onto the clean log:
     round 1 crashes with request 0 in flight and request 1 queued, both

@@ -19,7 +19,10 @@ that should never happen in steady state:
   followed by a round that neither admitted, prefilled, nor expired
   anything: the scheduler sat on ready work for a full round. (One
   round's worth of queued-but-unadmitted work is normal — submissions
-  land mid-round, and round events stamp queue depth at round end.)
+  land mid-round, and round events stamp queue depth at round end.
+  A round that executed matrix work quanta — ``matrix_quanta`` on the
+  round event, docs/matrix_service.md — is exempt: slicing a matrix
+  job IS executing, not sitting.)
 * **deadline expiries** — ``timeout`` events (admission never happened).
 * **phase-sum mismatches** — a completed request whose contiguous phase
   durations (queue_wait + admit + decode) disagree with its measured
@@ -40,6 +43,13 @@ that should never happen in steady state:
   per-crash summary) but are NOT anomalies — chaos runs are
   legitimate; the non-chaos gate is the SLO baseline's
   ``engine_restarts == 0`` check (tools/slo_check.py).
+
+Matrix-service runs (docs/matrix_service.md) additionally get a
+per-job timeline (``matrix_jobs``) from the ``job_submit`` /
+``job_phase`` / ``job_complete`` event family — admission pricing,
+execute/encode rounds, crash replays and quarantine verdicts, measured
+vs predicted seconds — and a sealed log flags submitted-but-unresolved
+jobs (``unresolved_matrix_job``).
 
 Fleet merge (docs/fleet.md): pass SEVERAL runlogs — the per-replica
 files a fleet run leaves (``replica<i>.jsonl``,
@@ -340,6 +350,49 @@ def round_series(events: List[dict], batch: Optional[int]) -> dict:
     return out
 
 
+def matrix_jobs(events: List[dict]) -> List[dict]:
+    """Per-job timeline for the matrix service (ISSUE 20): one entry
+    per ``job_submit``, narrating admission pricing, the execute/encode
+    phase rounds, replay/quarantine verdicts after crashes, and the
+    completion ledger (measured vs predicted seconds). Keyed off the
+    ``job_*`` event family the service emits on the engine runlog."""
+    jobs: Dict[int, dict] = {}
+
+    def rec(jid) -> dict:
+        return jobs.setdefault(int(jid), {"job_id": int(jid)})
+
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "job_submit":
+            rec(ev["job_id"]).update(
+                op=ev.get("op"), shapes=ev.get("shapes"),
+                dtype=ev.get("dtype"), units=ev.get("units"),
+                n_quanta=ev.get("n_quanta"),
+                quanta_per_round=ev.get("quanta_per_round"),
+                predicted_rounds=ev.get("predicted_rounds"),
+                **({"predicted_s": ev["predicted_s"]}
+                   if ev.get("predicted_s") is not None else {}))
+        elif kind == "job_phase":
+            r = rec(ev["job_id"])
+            r[f"{ev.get('phase')}_round"] = ev.get("round")
+        elif kind == "job_replay":
+            r = rec(ev["job_id"])
+            r["replays"] = r.get("replays", 0) + 1
+            r["last_error"] = ev.get("error")
+        elif kind == "job_quarantine":
+            rec(ev["job_id"]).update(
+                status="poisoned", crash_count=ev.get("crash_count"),
+                last_error=ev.get("error"))
+        elif kind == "job_complete":
+            rec(ev["job_id"]).update(
+                status=ev.get("status"), quanta=ev.get("quanta"),
+                measured_s=ev.get("measured_s"),
+                result_bytes=ev.get("result_bytes"),
+                **{k: ev[k] for k in ("predicted_s", "budget_rel_err")
+                   if ev.get(k) is not None})
+    return sorted(jobs.values(), key=lambda j: j["job_id"])
+
+
 def find_anomalies(events: List[dict], reqs: Dict[int, dict],
                    phase_tol: float,
                    crash_anomalies: Optional[List[dict]] = None
@@ -434,7 +487,13 @@ def find_anomalies(events: List[dict], reqs: Dict[int, dict],
                     # its slot moving KV state for the scheduler's
                     # priority decision, not sitting on ready work.
                     and cur.get("preempts", 0) == 0
-                    and cur.get("resumes", 0) == 0):
+                    and cur.get("resumes", 0) == 0
+                    # Matrix work quanta (ISSUE 20) ride the same
+                    # driver round: a round that spent its budget
+                    # slicing a matrix job was executing, not sitting
+                    # on ready work — exempt, pinned both ways in
+                    # tests/test_runlog_report.py.
+                    and cur.get("matrix_quanta", 0) == 0):
                 anomalies.append({
                     "kind": "queue_stall", "round": cur.get("round"),
                     "queue_depth": prev.get("queue_depth"),
@@ -465,6 +524,15 @@ def find_anomalies(events: List[dict], reqs: Dict[int, dict],
             if "submit_round" in r and r.get("status") is None:
                 anomalies.append({"kind": "unresolved_request",
                                   "request_id": r["request_id"]})
+        # Matrix jobs seal under the same doctrine: every submitted
+        # job must end in a job_complete or a quarantine verdict
+        # (drain fails the stragglers through their handles, but the
+        # runlog records only resolved outcomes — a submit with
+        # neither is a dropped job).
+        for j in matrix_jobs(events):
+            if "op" in j and j.get("status") is None:
+                anomalies.append({"kind": "unresolved_matrix_job",
+                                  "job_id": j["job_id"]})
 
     # Crash/recovery cycles: every interrupted request must carry a
     # recover or quarantine verdict (docs/robustness.md).
@@ -511,6 +579,14 @@ def build_report(events: List[dict], phase_tol: float = PHASE_TOL_DEFAULT,
         "anomalies": anomalies,
         "ok": not anomalies,
     }
+    # Matrix-service timeline (ISSUE 20) — present only when the run
+    # actually served matrix jobs, so LLM-only reports are unchanged.
+    mjobs = matrix_jobs(events)
+    if mjobs:
+        report["matrix_jobs"] = mjobs
+        report["n_matrix_jobs"] = len(mjobs)
+        report["n_matrix_poisoned"] = sum(
+            1 for j in mjobs if j.get("status") == "poisoned")
     if series:
         report["round_series"] = [
             {k: ev.get(k) for k in ("round", "iters", "occupied",

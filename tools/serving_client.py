@@ -26,9 +26,18 @@ retry or the next call — lands on the next endpoint. The same client
 therefore drives a single server OR the fleet front door with peers as
 fallback, with the idempotent-only retry rules unchanged.
 
+Matrix jobs (docs/matrix_service.md): ``matrix()`` (blocking npz
+result, byte-identical to the in-process call) and ``matrix_stream()``
+(SSE progress events, npz in the terminal event) drive ``POST
+/v1/matrix`` under the SAME retry rules — blocking jobs retry like
+``generate``; a job that streamed progress events is partial and never
+silently resent.
+
 Usage (manual):
     python tools/serving_client.py --port 8000 generate 1 2 3 --steps 8
     python tools/serving_client.py --port 8000 stream 1 2 3 --steps 8
+    python tools/serving_client.py --port 8000 matrix gemm 64 64 64
+    python tools/serving_client.py --port 8000 matrix lu 64 --stream
     python tools/serving_client.py --port 8000 load --requests 16
     python tools/serving_client.py --port 8000 metrics
     python tools/serving_client.py --target :8100 --target :8000 \\
@@ -114,8 +123,12 @@ def call_with_retry(attempt_fn, policy: RetryPolicy, key: str,
             else:
                 retryable = False
         # Idempotency guard: partial streamed output means a retry
-        # would duplicate bytes the consumer already has.
-        partial = retryable and bool(res.get("tokens"))
+        # would duplicate bytes the consumer already has — token
+        # chunks and matrix progress events alike (a matrix job that
+        # streamed progress is never silently resent: the resend would
+        # run the job again and replay events the consumer acted on).
+        partial = retryable and bool(res.get("tokens")
+                                     or res.get("events"))
         if (attempt + 1 >= policy.max_attempts or not retryable
                 or (partial and not policy.retry_streamed_partial)):
             break
@@ -366,6 +379,197 @@ class ServingClient:
         finally:
             conn.close()
 
+    # -- matrix jobs (docs/matrix_service.md) -------------------------
+
+    def matrix(self, op: str, shapes: Sequence[int],
+               dtype: str = "float32", seed: Optional[int] = None,
+               payload=None, request_id: Optional[str] = None,
+               retry: Optional[RetryPolicy] = None,
+               decode: bool = True, **knobs) -> Dict:
+        """Blocking matrix job (``POST /v1/matrix``): returns ``code``,
+        ``dt_s``, the job ``meta`` (from the X-Matrix-Meta header — the
+        same dict rides inside the npz), the raw npz ``payload_bytes``
+        (byte-identical to the in-process call — the service contract),
+        and — when numpy is importable and ``decode`` — the decoded
+        ``arrays``. Typed 400s come back as ``code``/``error``/
+        ``error_code``/``detail``. Blocking jobs are idempotent until
+        delivery, so a :class:`RetryPolicy` retries shed (429/503) and
+        connection-failed attempts exactly like ``generate``."""
+        if retry is not None:
+            return call_with_retry(
+                lambda: self.matrix(op, shapes, dtype=dtype, seed=seed,
+                                    payload=payload,
+                                    request_id=request_id,
+                                    decode=decode, **knobs),
+                retry, key=request_id or f"{op}:{list(shapes)}:{seed}")
+        body = {"op": op, "shapes": list(map(int, shapes)),
+                "dtype": dtype, **knobs}
+        if payload is not None:
+            body["payload"] = payload
+        elif seed is not None:
+            body["seed"] = int(seed)
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        conn = self._conn()
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/matrix", json.dumps(body),
+                         headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            out: Dict = {
+                "code": resp.status,
+                "dt_s": time.perf_counter() - t0,
+                "retry_after": resp.headers.get("Retry-After"),
+                "x_request_id": resp.headers.get("X-Request-Id"),
+                "x_job_id": resp.headers.get("X-Job-Id"),
+            }
+            if resp.status != 200:
+                err = json.loads(raw or b"{}")
+                # "code" stays the HTTP status (the retry contract);
+                # the typed rejection class moves to error_code.
+                if "code" in err:
+                    err["error_code"] = err.pop("code")
+                return {**out, **err}
+            out["payload_bytes"] = raw
+            meta_hdr = resp.headers.get("X-Matrix-Meta")
+            out["meta"] = json.loads(meta_hdr) if meta_hdr else {}
+            if decode:
+                arrays = _decode_npz(raw)
+                if arrays is not None:
+                    out["arrays"] = arrays
+            return out
+        except (ConnectionError, OSError):
+            self._rotate_target()
+            raise
+        finally:
+            conn.close()
+
+    def matrix_stream(self, op: str, shapes: Sequence[int],
+                      dtype: str = "float32",
+                      seed: Optional[int] = None, payload=None,
+                      request_id: Optional[str] = None,
+                      retry: Optional[RetryPolicy] = None,
+                      decode: bool = True, **knobs) -> Dict:
+        """Streaming matrix job: consume the SSE progress stream
+        (``phase``/``quantum``/``progress`` events — recorded with
+        arrival instants in ``events``), then the terminal ``done``
+        event whose base64 npz becomes ``payload_bytes``/``arrays``.
+        A stream that delivered ANY progress event is partial under
+        the :class:`RetryPolicy` idempotency guard — never silently
+        resent, mirroring the token-stream rule."""
+        if retry is not None:
+            return call_with_retry(
+                lambda: self.matrix_stream(op, shapes, dtype=dtype,
+                                           seed=seed, payload=payload,
+                                           request_id=request_id,
+                                           decode=decode, **knobs),
+                retry, key=request_id or f"{op}:{list(shapes)}:{seed}")
+        body = {"op": op, "shapes": list(map(int, shapes)),
+                "dtype": dtype, "stream": True, **knobs}
+        if payload is not None:
+            body["payload"] = payload
+        elif seed is not None:
+            body["seed"] = int(seed)
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        conn = self._conn()
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/matrix", json.dumps(body),
+                         headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                err = json.loads(resp.read() or b"{}")
+                if "code" in err:
+                    err["error_code"] = err.pop("code")
+                return {"code": resp.status, "events": [],
+                        "retry_after": resp.headers.get("Retry-After"),
+                        "dt_s": time.perf_counter() - t0, **err}
+            events: List = []
+            final: Dict = {}
+            stream_error = None
+            try:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    ev = json.loads(line[len(b"data: "):])
+                    if ev.get("done"):
+                        final = ev
+                        break
+                    events.append(
+                        {"t_s": time.perf_counter() - t0, **ev})
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                stream_error = f"{type(e).__name__}: {e}"
+                self._rotate_target()
+            out: Dict = {
+                **({"stream_error": stream_error} if stream_error
+                   else {}),
+                "code": resp.status,
+                "dt_s": time.perf_counter() - t0,
+                "events": events,
+                "x_request_id": resp.headers.get("X-Request-Id"),
+                "x_job_id": resp.headers.get("X-Job-Id"),
+                **{k: v for k, v in final.items()
+                   if k not in ("done", "npz_b64")},
+            }
+            if final.get("npz_b64"):
+                import base64
+
+                out["payload_bytes"] = base64.b64decode(
+                    final["npz_b64"])
+                if decode:
+                    arrays = _decode_npz(out["payload_bytes"])
+                    if arrays is not None:
+                        out["arrays"] = arrays
+            return out
+        except (ConnectionError, OSError):
+            self._rotate_target()
+            raise
+        finally:
+            conn.close()
+
+
+def _decode_npz(payload: bytes):
+    """Decode a dtype-tagged matrix result npz (the serving/jobs.py
+    wire format) into ``{name: ndarray}`` — or None when numpy is not
+    importable (this module stays stdlib-only; the raw bytes are
+    always returned either way). Mirrors jobs.decode_result without
+    importing marlin_tpu: ``__dtype_<name>`` tags cast non-native
+    dtypes (bfloat16) back when ml_dtypes is present."""
+    try:
+        import io as _io
+
+        import numpy as np
+    except ImportError:
+        return None
+    arrays: Dict = {}
+    tags: Dict[str, str] = {}
+    with np.load(_io.BytesIO(payload)) as z:
+        for name in z.files:
+            if name == "__meta":
+                continue  # already delivered via X-Matrix-Meta / meta
+            if name.startswith("__dtype_"):
+                tags[name[len("__dtype_"):]] = str(z[name][()])
+            else:
+                arrays[name] = z[name]
+    for name, dt in tags.items():
+        try:
+            arrays[name] = np.asarray(arrays[name], np.dtype(dt))
+        except TypeError:
+            try:
+                import ml_dtypes
+
+                arrays[name] = np.asarray(
+                    arrays[name], getattr(ml_dtypes, dt))
+            except (ImportError, AttributeError):
+                pass  # leave the value-exact float32 upcast
+    return arrays
+
 
 # -- load generation --------------------------------------------------
 
@@ -504,6 +708,17 @@ def main(argv=None) -> int:
         g.add_argument("--retries", type=int, default=0,
                        help="max retry attempts on 429/503/connect "
                             "errors (default 0 = no retry)")
+    mx = sub.add_parser("matrix")
+    mx.add_argument("op", choices=("gemm", "lu", "cholesky", "svd",
+                                   "spmm", "inverse"))
+    mx.add_argument("shapes", nargs="+", type=int,
+                    help="gemm/spmm: m k n; svd: m n; lu/cholesky/"
+                         "inverse: n")
+    mx.add_argument("--dtype", default="float32")
+    mx.add_argument("--seed", type=int, default=0)
+    mx.add_argument("--stream", action="store_true",
+                    help="SSE progress instead of blocking")
+    mx.add_argument("--retries", type=int, default=0)
     lo = sub.add_parser("load")
     lo.add_argument("--requests", type=int, default=16)
     lo.add_argument("--steps", type=int, default=8)
@@ -532,6 +747,14 @@ def main(argv=None) -> int:
         print(json.dumps(client.stream(args.prompt, args.steps,
                                        args.deadline_s,
                                        retry=policy), indent=2))
+    elif args.cmd == "matrix":
+        policy = RetryPolicy(max_attempts=args.retries + 1) \
+            if args.retries else None
+        fn = client.matrix_stream if args.stream else client.matrix
+        res = fn(args.op, args.shapes, dtype=args.dtype,
+                 seed=args.seed, retry=policy, decode=False)
+        res.pop("payload_bytes", None)  # binary — meta tells the story
+        print(json.dumps(res, indent=2))
     elif args.cmd == "load":
         import random
 
